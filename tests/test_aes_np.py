@@ -1,0 +1,57 @@
+"""FIPS-197 conformance of the NumPy AES spec."""
+
+import numpy as np
+
+from dpf_tpu.core import aes_np
+
+
+def test_sbox_known_entries():
+    # FIPS-197 figure 7 spot checks.
+    assert aes_np.SBOX[0x00] == 0x63
+    assert aes_np.SBOX[0x01] == 0x7C
+    assert aes_np.SBOX[0x53] == 0xED
+    assert aes_np.SBOX[0xFF] == 0x16
+    # S-box is a permutation.
+    assert len(set(aes_np.SBOX.tolist())) == 256
+
+
+def test_fips197_appendix_b_vector():
+    key = bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c")
+    pt = bytes.fromhex("3243f6a8885a308d313198a2e0370734")
+    ct = bytes.fromhex("3925841d02dc09fbdc118597196a0b32")
+    rk = aes_np.expand_key(key)
+    out = aes_np.aes128_encrypt(rk, np.frombuffer(pt, dtype=np.uint8))
+    assert out.tobytes() == ct
+
+
+def test_fips197_appendix_c_vector():
+    key = bytes.fromhex("000102030405060708090a0b0c0d0e0f")
+    pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+    ct = bytes.fromhex("69c4e0d86a7b0430d8cdb78070b4c55a")
+    rk = aes_np.expand_key(key)
+    out = aes_np.aes128_encrypt(rk, np.frombuffer(pt, dtype=np.uint8))
+    assert out.tobytes() == ct
+
+
+def test_key_expansion_first_last_words():
+    # FIPS-197 appendix A.1 expanded key for 2b7e1516...
+    rk = aes_np.expand_key(bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c"))
+    assert rk[0].tobytes().hex() == "2b7e151628aed2a6abf7158809cf4f3c"
+    assert rk[10].tobytes().hex() == "d014f9a8c9ee2589e13f0cc8b6630ca6"
+
+
+def test_mmo_is_encrypt_xor_input():
+    rng = np.random.default_rng(0)
+    blocks = rng.integers(0, 256, size=(32, 16), dtype=np.uint8)
+    rk = aes_np.ROUND_KEYS_L
+    assert np.array_equal(
+        aes_np.aes128_mmo(rk, blocks), aes_np.aes128_encrypt(rk, blocks) ^ blocks
+    )
+
+
+def test_batch_matches_single():
+    rng = np.random.default_rng(1)
+    blocks = rng.integers(0, 256, size=(8, 16), dtype=np.uint8)
+    batched = aes_np.mmo_r(blocks)
+    singles = np.stack([aes_np.mmo_r(blocks[i : i + 1])[0] for i in range(8)])
+    assert np.array_equal(batched, singles)

@@ -1,0 +1,70 @@
+"""Frozen golden vectors pinning the key byte layout and output bytes.
+
+The reference (Go + AES-NI asm) cannot run in this environment (no Go
+toolchain), so these vectors were generated once from the NumPy spec after it
+was line-verified against dpf/dpf.go and pinned to FIPS-197 AES.  They freeze
+the serialization contract: any symmetric refactor that silently changes the
+layout (e.g. swapping tLCW/tRCW, switching the convert key) breaks these even
+though self-consistency tests stay green.  Every backend (JAX/TPU, C++) must
+reproduce these bytes exactly.
+"""
+
+import hashlib
+
+import numpy as np
+
+from dpf_tpu.core import spec
+
+# (log_n, alpha, rng_seed, key_a_hex_or_sha256, sha256(eval_full(key_a)))
+VECTORS = [
+    (
+        3,
+        1,
+        11,
+        "4ecc402210fae920677a0dcc8aacd07f007da72c7fe386d92c5cfa7fd103356318",
+        "0ca3d84dfd7ab04264265605cf8925d1cb9bd4e9f09cd9a6bea652c57afd3971",
+    ),
+    (
+        8,
+        123,
+        42,
+        "8826d916cdfb21c6c1ff91a761565a70002a47ad53865f609411a01045eadcd7"
+        "a000004747897a6d99505683480d6616a08dcb",
+        "8e7a1d8b7443fd4e6ccfa6dc663b62580ab8159125f432f192bbdffb562f6725",
+    ),
+    (
+        12,
+        2048,
+        7,
+        "b5da2238d05bb625a7ffe90379ea65a63952db204f3d88ea5d6c32ce7d24a78a",
+        "b71cbb8775bd46e44d9e8928ff17eeeb81f2ff7a67248442bdb0e01101f1e4ed",
+    ),
+    (
+        20,
+        777777,
+        99,
+        "f6e5e8e4f793edee2559404ab8f1bb7d06473faeb1e718606e6b128627f1dba0",
+        "265f964f51148ea7818184c90e6efc8c883c848d1b84d2597985932771c990b7",
+    ),
+]
+
+
+def test_golden_vectors_frozen():
+    for log_n, alpha, seed, key_hex, out_sha in VECTORS:
+        ka, _ = spec.gen(alpha, log_n, np.random.default_rng(seed))
+        got_key = ka.hex() if len(ka) <= 60 else hashlib.sha256(ka).hexdigest()
+        assert got_key == key_hex, f"key layout drifted at n={log_n}"
+        got_out = hashlib.sha256(spec.eval_full(ka, log_n)).hexdigest()
+        assert got_out == out_sha, f"eval_full output drifted at n={log_n}"
+
+
+def test_fixed_prf_round_keys_frozen():
+    # The two fixed PRF keys' expanded round keys, as baked into kernels.
+    from dpf_tpu.core import aes_np
+
+    assert (
+        hashlib.sha256(aes_np.ROUND_KEYS_L.tobytes()).hexdigest()
+        == hashlib.sha256(aes_np.expand_key(aes_np.PRF_KEY_L).tobytes()).hexdigest()
+    )
+    assert aes_np.ROUND_KEYS_L[0].tobytes() == aes_np.PRF_KEY_L
+    assert aes_np.ROUND_KEYS_R[0].tobytes() == aes_np.PRF_KEY_R
