@@ -1,0 +1,224 @@
+"""AES S-box as a boolean circuit, for bitsliced evaluation on the TPU VPU.
+
+Primary circuit: Boyar-Peralta's 113-gate / depth-16 forward S-box
+(J. Boyar, R. Peralta, "A depth-16 circuit for the AES S-box", 2011 —
+public-domain circuit, reproduced in many bitsliced AES implementations).
+Both circuits are verified exhaustively (all 256 inputs) against the
+from-first-principles S-box table of ``dpf_tpu.core.aes_np`` in
+``tests/test_aes_bitslice.py::test_sbox_circuits_exhaustive``.
+
+The circuit operates on 8 input "planes" and produces 8 output planes.  A
+plane is a numpy/jnp unsigned-integer array (or any value supporting ``^``,
+``&`` and ``~`` elementwise with two's-complement ``~``): every lane bit is
+an independent S-box evaluation.  Note ``~`` means outputs are only defined
+per-bit — with plain Python ints the out-of-lane high bits are garbage, so
+mask with ``& 1`` per lane; fixed-width numpy/jnp dtypes need no masking.
+
+Convention: ``x[0]`` is the **most significant bit** of the S-box input byte,
+``out[0]`` the MSB of the output (Boyar-Peralta's ordering).  Callers using
+LSB-first plane layouts must reverse on the way in and out.
+"""
+
+from __future__ import annotations
+
+
+def sbox_bp113(x):
+    """Forward AES S-box on 8 planes, MSB-first. 113 gates (32 AND, 77 XOR,
+    4 XNOR).  Returns 8 output planes, MSB-first."""
+    (x0, x1, x2, x3, x4, x5, x6, x7) = x
+
+    # --- top linear transform (input expansion to 22 shared signals) ---
+    y14 = x3 ^ x5
+    y13 = x0 ^ x6
+    y9 = x0 ^ x3
+    y8 = x0 ^ x5
+    t0 = x1 ^ x2
+    y1 = t0 ^ x7
+    y4 = y1 ^ x3
+    y12 = y13 ^ y14
+    y2 = y1 ^ x0
+    y5 = y1 ^ x6
+    y3 = y5 ^ y8
+    t1 = x4 ^ y12
+    y15 = t1 ^ x5
+    y20 = t1 ^ x1
+    y6 = y15 ^ x7
+    y10 = y15 ^ t0
+    y11 = y20 ^ y9
+    y7 = x7 ^ y11
+    y17 = y10 ^ y11
+    y19 = y10 ^ y8
+    y16 = t0 ^ y11
+    y21 = y13 ^ y16
+    y18 = x0 ^ y16
+
+    # --- middle non-linear section (GF(2^4) inversion tower) ---
+    t2 = y12 & y15
+    t3 = y3 & y6
+    t4 = t3 ^ t2
+    t5 = y4 & x7
+    t6 = t5 ^ t2
+    t7 = y13 & y16
+    t8 = y5 & y1
+    t9 = t8 ^ t7
+    t10 = y2 & y7
+    t11 = t10 ^ t7
+    t12 = y9 & y11
+    t13 = y14 & y17
+    t14 = t13 ^ t12
+    t15 = y8 & y10
+    t16 = t15 ^ t12
+    t17 = t4 ^ t14
+    t18 = t6 ^ t16
+    t19 = t9 ^ t14
+    t20 = t11 ^ t16
+    t21 = t17 ^ y20
+    t22 = t18 ^ y19
+    t23 = t19 ^ y21
+    t24 = t20 ^ y18
+    t25 = t21 ^ t22
+    t26 = t21 & t23
+    t27 = t24 ^ t26
+    t28 = t25 & t27
+    t29 = t28 ^ t22
+    t30 = t23 ^ t24
+    t31 = t22 ^ t26
+    t32 = t31 & t30
+    t33 = t32 ^ t24
+    t34 = t23 ^ t33
+    t35 = t27 ^ t33
+    t36 = t24 & t35
+    t37 = t36 ^ t34
+    t38 = t27 ^ t36
+    t39 = t29 & t38
+    t40 = t25 ^ t39
+    t41 = t40 ^ t37
+    t42 = t29 ^ t33
+    t43 = t29 ^ t40
+    t44 = t33 ^ t37
+    t45 = t42 ^ t41
+    z0 = t44 & y15
+    z1 = t37 & y6
+    z2 = t33 & x7
+    z3 = t43 & y16
+    z4 = t40 & y1
+    z5 = t29 & y7
+    z6 = t42 & y11
+    z7 = t45 & y17
+    z8 = t41 & y10
+    z9 = t44 & y12
+    z10 = t37 & y3
+    z11 = t33 & y4
+    z12 = t43 & y13
+    z13 = t40 & y5
+    z14 = t29 & y2
+    z15 = t42 & y9
+    z16 = t45 & y14
+    z17 = t41 & y8
+
+    # --- bottom linear transform (shared-XOR output reconstruction) ---
+    t46 = z15 ^ z16
+    t47 = z10 ^ z11
+    t48 = z5 ^ z13
+    t49 = z9 ^ z10
+    t50 = z2 ^ z12
+    t51 = z2 ^ z5
+    t52 = z7 ^ z8
+    t53 = z0 ^ z3
+    t54 = z6 ^ z7
+    t55 = z16 ^ z17
+    t56 = z12 ^ t48
+    t57 = t50 ^ t53
+    t58 = z4 ^ t46
+    t59 = z3 ^ t54
+    t60 = t46 ^ t57
+    t61 = z14 ^ t57
+    t62 = t52 ^ t58
+    t63 = t49 ^ t58
+    t64 = z4 ^ t59
+    t65 = t61 ^ t62
+    t66 = z1 ^ t63
+    s0 = t59 ^ t63
+    s6 = ~(t56 ^ t62)
+    s7 = ~(t48 ^ t60)
+    t67 = t64 ^ t65
+    s3 = t53 ^ t66
+    s4 = t51 ^ t66
+    s5 = t47 ^ t65
+    s1 = ~(t64 ^ s3)
+    s2 = ~(t55 ^ t67)
+
+    return [s0, s1, s2, s3, s4, s5, s6, s7]
+
+
+# ---------------------------------------------------------------------------
+# Fallback circuit derived from first principles: inversion in GF(2^8) via a
+# square-and-multiply addition chain for x^254, with bitsliced schoolbook
+# GF(2^8) multiplication, followed by the affine map.  ~5x more gates than
+# Boyar-Peralta but derivable without trusting a transcribed netlist; kept as
+# a cross-check and safety net.  LSB-first convention internally.
+# ---------------------------------------------------------------------------
+
+
+def _gf_reduce(c):
+    """Reduce a degree-14 polynomial (15 planes) mod x^8+x^4+x^3+x+1."""
+    for k in range(14, 7, -1):
+        d = k - 8
+        c[d + 4] = c[d + 4] ^ c[k]
+        c[d + 3] = c[d + 3] ^ c[k]
+        c[d + 1] = c[d + 1] ^ c[k]
+        c[d + 0] = c[d + 0] ^ c[k]
+    return c[:8]
+
+
+def _gf_mul_planes(a, b):
+    """Bitsliced GF(2^8) multiply mod x^8+x^4+x^3+x+1; a, b are 8 planes
+    LSB-first.  Schoolbook partial products then modular reduction."""
+    # Partial products: c[k] = XOR_{i+j=k} a[i] & b[j], k = 0..14
+    c = [None] * 15
+    for i in range(8):
+        for j in range(8):
+            p = a[i] & b[j]
+            k = i + j
+            c[k] = p if c[k] is None else (c[k] ^ p)
+    return _gf_reduce(c)
+
+
+def _gf_sq_planes(a):
+    """Bitsliced GF(2^8) squaring (linear: spread bits then reduce)."""
+    c = [None] * 15
+    zero = a[0] ^ a[0]
+    for k in range(15):
+        c[k] = a[k // 2] if k % 2 == 0 else zero
+    return _gf_reduce(c)
+
+
+def sbox_algebraic(x):
+    """Forward AES S-box on 8 planes, MSB-first (same interface as
+    :func:`sbox_bp113`), via x^254 then the affine transform."""
+    a = list(reversed(x))  # to LSB-first
+    t1 = _gf_sq_planes(a)  # x^2
+    t2 = _gf_mul_planes(t1, a)  # x^3
+    t3 = t2
+    for _ in range(2):
+        t3 = _gf_sq_planes(t3)  # x^12
+    t4 = _gf_mul_planes(t3, t2)  # x^15
+    t5 = t4
+    for _ in range(4):
+        t5 = _gf_sq_planes(t5)  # x^240
+    t6 = _gf_mul_planes(t5, t3)  # x^252
+    inv = _gf_mul_planes(t6, t1)  # x^254 = x^-1 (and 0 -> 0)
+    # Affine: out_i = b_i ^ b_{i+4} ^ b_{i+5} ^ b_{i+6} ^ b_{i+7} ^ c_i, c=0x63
+    out = []
+    for i in range(8):
+        o = (
+            inv[i]
+            ^ inv[(i + 4) % 8]
+            ^ inv[(i + 5) % 8]
+            ^ inv[(i + 6) % 8]
+            ^ inv[(i + 7) % 8]
+        )
+        if (0x63 >> i) & 1:
+            o = ~o
+        out.append(o)
+    return list(reversed(out))  # back to MSB-first

@@ -1,0 +1,95 @@
+"""Bitsliced AES (JAX) differential tests against the NumPy spec."""
+
+import numpy as np
+import pytest
+
+from dpf_tpu.core import aes_np
+from dpf_tpu.ops import aes_bitslice as bs
+from dpf_tpu.ops.sbox_circuit import sbox_algebraic, sbox_bp113
+
+
+def test_sbox_circuits_exhaustive():
+    xs = np.arange(256, dtype=np.uint8)
+    planes = [((xs >> (7 - b)) & 1).astype(np.uint32) for b in range(8)]
+    for fn in (sbox_bp113, sbox_algebraic):
+        out = fn(planes)
+        got = np.zeros(256, dtype=np.uint8)
+        for b in range(8):
+            got |= ((out[b] & 1) << (7 - b)).astype(np.uint8)
+        assert np.array_equal(got, aes_np.SBOX), fn.__name__
+
+
+def test_pack_unpack_roundtrip_np():
+    rng = np.random.default_rng(0)
+    blocks = rng.integers(0, 256, size=(100, 16), dtype=np.uint8)
+    planes = bs.pack_blocks_np(blocks)
+    assert planes.shape == (128, 4)
+    back = bs.unpack_blocks_np(planes, 100)
+    assert np.array_equal(back, blocks)
+
+
+@pytest.mark.parametrize("nblocks", [1, 32, 100])
+def test_bitsliced_encrypt_matches_numpy(nblocks):
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(nblocks)
+    blocks = rng.integers(0, 256, size=(nblocks, 16), dtype=np.uint8)
+    planes = jnp.asarray(bs.pack_blocks_np(blocks))
+    # FIPS key (generic path) and both fixed DPF keys.
+    fips_rk = aes_np.expand_key(bytes.fromhex("000102030405060708090a0b0c0d0e0f"))
+    for rk, masks in [
+        (fips_rk, bs.round_key_masks(fips_rk)),
+        (aes_np.ROUND_KEYS_L, bs.RK_MASKS_L),
+        (aes_np.ROUND_KEYS_R, bs.RK_MASKS_R),
+    ]:
+        got = bs.unpack_blocks_np(
+            np.asarray(bs.aes128_encrypt_planes(planes, masks)), nblocks
+        )
+        want = aes_np.aes128_encrypt(rk, blocks)
+        assert np.array_equal(got, want)
+
+
+def test_bitsliced_mmo_and_prg_match_numpy():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    blocks = rng.integers(0, 256, size=(64, 16), dtype=np.uint8)
+    planes = jnp.asarray(bs.pack_blocks_np(blocks))
+    left, right = bs.prg_planes(planes)
+    got_l = bs.unpack_blocks_np(np.asarray(left), 64)
+    got_r = bs.unpack_blocks_np(np.asarray(right), 64)
+    assert np.array_equal(got_l, aes_np.mmo_l(blocks))
+    assert np.array_equal(got_r, aes_np.mmo_r(blocks))
+
+
+def test_fips197_vector_through_planes():
+    import jax.numpy as jnp
+
+    key = bytes.fromhex("000102030405060708090a0b0c0d0e0f")
+    pt = np.frombuffer(bytes.fromhex("00112233445566778899aabbccddeeff"), np.uint8)
+    masks = bs.round_key_masks(aes_np.expand_key(key))
+    planes = jnp.asarray(bs.pack_blocks_np(pt[None, :]))
+    out = bs.unpack_blocks_np(np.asarray(bs.aes128_encrypt_planes(planes, masks)), 1)
+    assert out.tobytes().hex() == "69c4e0d86a7b0430d8cdb78070b4c55a"
+
+
+def test_device_transpose_pack_unpack():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(9)
+    K, N = 64, 5
+    words = rng.integers(0, 1 << 32, size=(K, N, 4), dtype=np.uint32)
+    planes = bs.pack_padded_keys(jnp.asarray(words))
+    assert planes.shape == (128, N, K // 32)
+    back = np.asarray(bs.unpack_planes(planes))
+    assert np.array_equal(back, words)
+    # Pin absolute bit semantics: plane p, node n, word kp, lane-bit j must
+    # equal domain-bit p of key (32*kp + j)'s block n.
+    blocks = words.view(np.uint8).reshape(K, N, 16)  # little-endian words
+    pl = np.asarray(planes)
+    for k in [0, 17, 33, 63]:
+        for n in range(N):
+            for p in [0, 1, 8, 77, 127]:
+                dev_bit = (int(pl[p, n, k // 32]) >> (k % 32)) & 1
+                byte_bit = (int(blocks[k, n, p // 8]) >> (p % 8)) & 1
+                assert dev_bit == byte_bit, (k, n, p)
