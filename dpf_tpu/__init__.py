@@ -1,0 +1,85 @@
+"""dpf_tpu — a TPU-native 2-party Distributed Point Function framework.
+
+Re-design of the capabilities of ``dkales/dpf-go`` (Go + x86 AES-NI asm) for
+TPU: the GGM tree expansion runs level-synchronously as bitsliced fixed-key
+AES-128-MMO on the VPU (JAX/XLA, optional Pallas kernel), batched over keys,
+sharded over chip meshes.  Keys are byte-compatible with the reference
+(layout: dpf/dpf.go:89-92,111-112,165).
+
+Reference-parity scalar API (dpf/dpf.go: Gen, Eval, EvalFull):
+
+    ka, kb = dpf_tpu.Gen(alpha, log_n)
+    bit    = dpf_tpu.Eval(ka, x, log_n)
+    shares = dpf_tpu.EvalFull(ka, log_n)
+
+Batch-first TPU API (where the speedup lives):
+
+    kba, kbb = dpf_tpu.gen_batch(alphas, log_n)       # host, vectorized
+    out      = dpf_tpu.eval_full_batch(kba)           # [K, 2^(n-3)] uint8
+    bits     = dpf_tpu.eval_points_batch(kba, xs)     # [K, Q] uint8
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .core import spec
+from .core.keys import KeyBatch, gen_batch
+from .core.spec import key_len
+
+__all__ = [
+    "Gen",
+    "Eval",
+    "EvalFull",
+    "KeyBatch",
+    "gen_batch",
+    "eval_full_batch",
+    "eval_points_batch",
+    "key_len",
+]
+
+
+def Gen(alpha: int, log_n: int, rng=None) -> tuple[bytes, bytes]:
+    """Generate a DPF key pair for point ``alpha`` in [0, 2^log_n).
+
+    Host-side (CPU): O(log N) sequential AES plus CSPRNG draws, mirroring the
+    reference Gen (dpf/dpf.go:71-169).  Keys serialize to the reference's
+    byte layout."""
+    return spec.gen(alpha, log_n, rng)
+
+
+def Eval(key: bytes, x: int, log_n: int, backend: str = "auto") -> int:
+    """Evaluate one share at a single point -> bit (reference dpf/dpf.go:171).
+
+    A single point query does not amortize a device roundtrip, so the default
+    backend is the host evaluator; pass ``backend="jax"`` to force the
+    accelerated path (useful for differential testing)."""
+    if backend in ("auto", "cpu"):
+        return spec.eval_point(key, x, log_n)
+    kb = KeyBatch.from_bytes([key], log_n)
+    return int(eval_points_batch(kb, np.array([[x]], dtype=np.uint64))[0, 0])
+
+
+def EvalFull(key: bytes, log_n: int, backend: str = "auto") -> bytes:
+    """Full-domain evaluation of one key -> 2^(log_n-3) bit-packed bytes
+    (16 bytes when log_n < 7), byte-identical to the reference EvalFull
+    (dpf/dpf.go:243-262)."""
+    if backend == "cpu":
+        return spec.eval_full(key, log_n)
+    kb = KeyBatch.from_bytes([key], log_n)
+    return eval_full_batch(kb)[0].tobytes()
+
+
+def eval_full_batch(kb: KeyBatch, **kwargs) -> np.ndarray:
+    """Full-domain evaluation of a key batch on the accelerator:
+    -> uint8[K, 2^(log_n-3)]."""
+    from .models import dpf as _dpf
+
+    return _dpf.eval_full(kb, **kwargs)
+
+
+def eval_points_batch(kb: KeyBatch, xs: np.ndarray) -> np.ndarray:
+    """Pointwise evaluation of a key batch at xs uint64[K, Q] -> uint8[K, Q]."""
+    from .models import dpf as _dpf
+
+    return _dpf.eval_points(kb, xs)

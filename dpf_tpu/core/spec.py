@@ -135,7 +135,13 @@ def gen(
 
 
 def parse_key(k: DPFKey, log_n: int):
-    """Split a serialized key into (seed[16], t, scw[nu,16], tcw[nu,2], fcw[16])."""
+    """Split a serialized key into (seed[16], t, scw[nu,16], tcw[nu,2], fcw[16]).
+
+    Enforces the canonical form that Gen always produces (and that every
+    backend relies on): control-bit bytes are in {0, 1} and the LSB of each
+    seed/sCW block is clear (reference Gen clears them: dpf/dpf.go:86-87 and
+    via prg at dpf/dpf.go:62-67).  Rejecting non-canonical bytes here keeps
+    all backends bit-identical on every accepted key."""
     nu = max(log_n - 7, 0)
     if len(k) != key_len(log_n):
         raise ValueError(f"dpf: key length {len(k)} != {key_len(log_n)} for n={log_n}")
@@ -146,6 +152,8 @@ def parse_key(k: DPFKey, log_n: int):
     scw = cws[:, :16].copy()
     tcw = cws[:, 16:].copy()
     fcw = buf[len(k) - 16 :].copy()
+    if t > 1 or (tcw > 1).any() or (seed[0] & 1) or (scw[:, 0] & 1).any():
+        raise ValueError("dpf: non-canonical key (control bytes/LSBs)")
     return seed, t, scw, tcw, fcw
 
 

@@ -1,0 +1,301 @@
+"""TPU-native DPF evaluation: level-synchronous GGM expansion on bit-planes.
+
+This is the inversion of the reference's hot path (dpf/dpf.go:213-262): where
+the reference walks the GGM tree by sequential depth-first recursion — one
+AES-NI call at a time — the TPU evaluator expands the tree *breadth-first*:
+level ``i`` holds all ``2^i`` nodes of all ``K`` keys as one bitsliced tensor
+``uint32[128, W, K/32]`` (128 bit-planes, W nodes, keys packed 32/word), and
+one fused batch of vector ops per level does
+
+    PRG doubling (2 fixed-key bitsliced AES-MMO)     reference dpf.go:229
+    control-bit extraction + clearing (plane 0)      reference dpf.go:62-67
+    correction-word XOR masked by parent t-bits      reference dpf.go:230-238
+
+so ``nu = log_n - 7`` tensor steps replace ``2^nu`` recursive calls.  Keys
+are data-parallel all the way through; within a 32-bit lane word the 32 keys
+advance in lockstep.
+
+Outputs are byte-identical to the reference: leaves emit in ascending index
+order (children interleave L,R like the DFS emit order), each leaf is the
+MMO-converted seed XOR the final CW when the control bit is set
+(dpf.go:214-224), and the bit-packed output layout (bit x at byte x//8, bit
+x%8) falls out of the plane layout for free.
+
+Domains too large to materialize in one level (single-key n >= ~26) are
+split at an intermediate level into independent subtrees — the GGM tree has
+no cross-subtree dependence — and each chunk finishes under the same
+compiled function.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.keys import KeyBatch
+from ..ops.aes_bitslice import (
+    RK_MASKS_L,
+    aes128_mmo_planes,
+    pack_padded_keys,
+    prg_planes,
+    unpack_planes,
+)
+
+# ---------------------------------------------------------------------------
+# Host-side packing of key material into plane/mask form
+# ---------------------------------------------------------------------------
+
+
+def _pack_bits_over_keys(bits: np.ndarray) -> np.ndarray:
+    """uint8[..., K] 0/1 -> uint32[..., K//32] packed words."""
+    K = bits.shape[-1]
+    b = bits.reshape(bits.shape[:-1] + (K // 32, 32)).astype(np.uint32)
+    return (b << np.arange(32, dtype=np.uint32)).sum(-1, dtype=np.uint32)
+
+
+def _pack_words_over_keys(words: np.ndarray) -> np.ndarray:
+    """uint32[K, N, 4] block words -> planes uint32[128, N, K//32].
+
+    Single source of truth for this layout is the device-side bit-matrix
+    transpose in ``aes_bitslice.pack_padded_keys`` (whose absolute bit
+    semantics are pinned in tests)."""
+    return np.asarray(pack_padded_keys(jnp.asarray(words)))
+
+
+class DeviceKeys:
+    """Key material packed for the device evaluator (K padded to 32)."""
+
+    def __init__(self, kb: KeyBatch):
+        self.log_n = kb.log_n
+        self.nu = kb.nu
+        self.k = kb.k
+        pad = (-kb.k) % 32
+        self.k_padded = kb.k + pad
+
+        def padk(a):  # zero-pad the key axis
+            return np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+
+        seeds = padk(kb.seeds)
+        ts = padk(kb.ts)
+        scw = padk(kb.scw)
+        tcw = padk(kb.tcw)
+        fcw = padk(kb.fcw)
+
+        self.seed_planes = jnp.asarray(_pack_words_over_keys(seeds[:, None, :]))
+        self.t_words = jnp.asarray(_pack_bits_over_keys(ts & 1)[None, :])  # [1, Kp]
+        if self.nu:
+            # scw [K, nu, 4] packs with levels as the "node" axis, then moves
+            # levels to the front: [nu, 128, Kp] so scw_planes[i] is level i.
+            scw_packed = np.moveaxis(
+                _pack_words_over_keys(np.ascontiguousarray(scw)), 1, 0
+            ).copy()
+            scw_packed[:, 0] = 0  # plane 0 (the t bit) of every sCW is 0 by Gen
+            self.scw_planes = jnp.asarray(scw_packed)
+            self.tl_words = jnp.asarray(
+                _pack_bits_over_keys(np.moveaxis(tcw[:, :, 0] & 1, 0, 1))
+            )  # [nu, Kp]
+            self.tr_words = jnp.asarray(
+                _pack_bits_over_keys(np.moveaxis(tcw[:, :, 1] & 1, 0, 1))
+            )
+        else:
+            self.scw_planes = jnp.zeros((0, 128, self.k_padded // 32), jnp.uint32)
+            self.tl_words = jnp.zeros((0, self.k_padded // 32), jnp.uint32)
+            self.tr_words = jnp.zeros((0, self.k_padded // 32), jnp.uint32)
+        self.fcw_planes = jnp.asarray(_pack_words_over_keys(fcw[:, None, :]))
+
+
+# ---------------------------------------------------------------------------
+# Jitted cores
+# ---------------------------------------------------------------------------
+
+
+def _level_step(S, T, cw_plane, tl_w, tr_w):
+    """One level of the expansion: [128, W, Kp] -> [128, 2W, Kp]."""
+    W = S.shape[1]
+    L, R = prg_planes(S.reshape(128, -1))
+    L = L.reshape(128, W, -1)
+    R = R.reshape(128, W, -1)
+    tl, tr = L[0], R[0]
+    zero = jnp.zeros_like(tl)
+    L, R = L.at[0].set(zero), R.at[0].set(zero)
+    cw = cw_plane[:, None, :]  # [128, 1, Kp]
+    mask = T[None, :, :]  # parent control bits as lane masks
+    L = L ^ (cw & mask)
+    R = R ^ (cw & mask)
+    tl = tl ^ (tl_w[None, :] & T)
+    tr = tr ^ (tr_w[None, :] & T)
+    S = jnp.stack([L, R], axis=2).reshape(128, 2 * W, -1)
+    T = jnp.stack([tl, tr], axis=1).reshape(2 * W, -1)
+    return S, T
+
+
+def _convert_leaves(S, T, fcw_planes):
+    """Leaf conversion + final CW: -> per-key output words [K, W, 4]."""
+    C = aes128_mmo_planes(S.reshape(128, -1), RK_MASKS_L).reshape(S.shape)
+    C = C ^ (fcw_planes & T[None, :, :])
+    return unpack_planes(C)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _eval_full_jit(n_levels, seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes):
+    S, T = seed_planes, t_words
+    for i in range(n_levels):
+        S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i])
+    return _convert_leaves(S, T, fcw_planes)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _expand_prefix_jit(n_levels, seed_planes, t_words, scw_planes, tl_w, tr_w):
+    S, T = seed_planes, t_words
+    for i in range(n_levels):
+        S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i])
+    return S, T
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _finish_chunk_jit(n_levels, first, S, T, scw_planes, tl_w, tr_w, fcw_planes):
+    for i in range(n_levels):
+        S, T = _level_step(S, T, scw_planes[first + i], tl_w[first + i], tr_w[first + i])
+    return _convert_leaves(S, T, fcw_planes)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+# Soft cap on W * Kp (words per plane) for a single compiled expansion; above
+# this the tree is split into independent subtree chunks.  2^19 words/plane
+# -> the [128, W, Kp] tensor is 256 MB; a few live at once during a step.
+MAX_PLANE_WORDS = 1 << 19
+
+
+def eval_full_device(dk: DeviceKeys, max_plane_words: int = MAX_PLANE_WORDS):
+    """Full-domain evaluation on device -> uint32[K_padded, n_leaves, 4].
+
+    The returned words ARE the bit-packed output: word q of leaf w holds
+    domain bits [128*w + 32*q, 128*w + 32*q + 32), LSB-first.
+    """
+    nu = dk.nu
+    kp = dk.k_padded // 32
+    total = (1 << nu) * kp
+    if total <= max_plane_words:
+        return _eval_full_jit(
+            nu, dk.seed_planes, dk.t_words, dk.scw_planes,
+            dk.tl_words, dk.tr_words, dk.fcw_planes,
+        )
+    # Chunked: expand a prefix of c levels, then finish each of the 2^c
+    # independent subtrees under one compiled function.  Minimal split:
+    # c = ceil(log2(ceil(total / max))).
+    n_chunks = -(-total // max_plane_words)
+    c = min((n_chunks - 1).bit_length(), nu)
+    S, T = _expand_prefix_jit(
+        c, dk.seed_planes, dk.t_words, dk.scw_planes, dk.tl_words, dk.tr_words
+    )
+    outs = []
+    for j in range(1 << c):
+        outs.append(
+            _finish_chunk_jit(
+                nu - c, c, S[:, j : j + 1, :], T[j : j + 1, :],
+                dk.scw_planes, dk.tl_words, dk.tr_words, dk.fcw_planes,
+            )
+        )
+    return jnp.concatenate(outs, axis=1)
+
+
+def eval_full(kb: KeyBatch, max_plane_words: int = MAX_PLANE_WORDS) -> np.ndarray:
+    """Full-domain evaluation of a key batch -> uint8[K, out_bytes], where
+    out_bytes = 2^(log_n-3) (16 when log_n < 7), byte-identical to
+    ``spec.eval_full`` / the reference's EvalFull per key."""
+    dk = DeviceKeys(kb)
+    words = np.asarray(eval_full_device(dk, max_plane_words))  # [Kpad, W, 4]
+    out = np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)
+    return out
+
+
+def eval_points(kb: KeyBatch, xs: np.ndarray) -> np.ndarray:
+    """Batched pointwise evaluation: xs uint64[K, Q] -> bits uint8[K, Q].
+
+    One root-to-leaf path walk per (key, query) lane, all lanes in lockstep:
+    per level both PRG children are computed bitsliced and the path bit
+    selects per lane (reference Eval, dpf/dpf.go:171-211, vectorized).
+    """
+    xs = np.asarray(xs, dtype=np.uint64)
+    K, Q = xs.shape
+    if K != kb.k:
+        raise ValueError("xs first axis must match key batch")
+    if (xs >> np.uint64(kb.log_n)).any():
+        raise ValueError("dpf: query index out of domain")
+    pad_q = (-Q) % 32
+    if pad_q:
+        xs = np.concatenate([xs, np.zeros((K, pad_q), np.uint64)], axis=1)
+    qp = xs.shape[1] // 32
+    nu = kb.nu
+    log_n = kb.log_n
+
+    # Per-key masks (0 / ~0): broadcast over the query axis on device.
+    def bits_of_words(words):  # uint32[K, 4] -> uint8[128, K]
+        b = (words[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
+        return np.moveaxis(b.reshape(K, 128), 0, 1).astype(np.uint8)
+
+    m = np.uint32(0xFFFFFFFF)
+    seed_masks = jnp.asarray(bits_of_words(kb.seeds) * m)  # [128, K]
+    fcw_masks = jnp.asarray(bits_of_words(kb.fcw) * m)
+    t_masks = jnp.asarray((kb.ts & 1).astype(np.uint32) * m)  # [K]
+    if nu:
+        scw_b = (kb.scw[:, :, :, None] >> np.arange(32, dtype=np.uint32)) & 1
+        scw_masks = jnp.asarray(
+            np.moveaxis(scw_b.reshape(K, nu, 128), 0, 2).astype(np.uint32) * m
+        )  # [nu, 128, K]
+        tl_masks = jnp.asarray(np.moveaxis(kb.tcw[:, :, 0] & 1, 0, 1).astype(np.uint32) * m)
+        tr_masks = jnp.asarray(np.moveaxis(kb.tcw[:, :, 1] & 1, 0, 1).astype(np.uint32) * m)
+    else:
+        scw_masks = jnp.zeros((0, 128, K), jnp.uint32)
+        tl_masks = jnp.zeros((0, K), jnp.uint32)
+        tr_masks = jnp.zeros((0, K), jnp.uint32)
+
+    # Path-bit lane masks per level, packed over the query axis.
+    shifts = np.array([log_n - 1 - i for i in range(nu)], dtype=np.uint64)
+    pb = ((xs[None, :, :] >> shifts[:, None, None]) & np.uint64(1)).astype(np.uint8)
+    path_words = jnp.asarray(_pack_bits_over_keys(pb))  # [nu, K, Qp]... packs last axis
+    low = jnp.asarray((xs & np.uint64(127)).astype(np.uint32))  # [K, Qpad]
+
+    bits = _eval_points_jit(
+        nu, seed_masks, t_masks, scw_masks, tl_masks, tr_masks,
+        fcw_masks, path_words, low, qp,
+    )
+    return np.asarray(bits)[:, :Q]
+
+
+@partial(jax.jit, static_argnums=(0, 9))
+def _eval_points_jit(
+    nu, seed_masks, t_masks, scw_masks, tl_masks, tr_masks,
+    fcw_masks, path_words, low, qp,
+):
+    K = seed_masks.shape[1]
+    S = jnp.broadcast_to(seed_masks[:, :, None], (128, K, qp))
+    T = jnp.broadcast_to(t_masks[None, :, None], (1, K, qp)).reshape(K, qp)
+    for i in range(nu):
+        L, R = prg_planes(S.reshape(128, -1))
+        L = L.reshape(128, K, qp)
+        R = R.reshape(128, K, qp)
+        tl, tr = L[0], R[0]
+        zero = jnp.zeros_like(tl)
+        L, R = L.at[0].set(zero), R.at[0].set(zero)
+        cw = scw_masks[i][:, :, None] & T[None, :, :]
+        L = L ^ cw
+        R = R ^ cw
+        tl = tl ^ (tl_masks[i][:, None] & T)
+        tr = tr ^ (tr_masks[i][:, None] & T)
+        go_r = path_words[i]  # [K, qp]
+        S = (R & go_r) | (L & ~go_r)
+        T = (tr & go_r) | (tl & ~go_r)
+    C = aes128_mmo_planes(S.reshape(128, -1), RK_MASKS_L).reshape(128, K, qp)
+    C = C ^ (fcw_masks[:, :, None] & T[None, :, :])
+    words = unpack_planes(C.reshape(128, 1, K * qp))  # [K*Q, 1, 4]
+    words = words.reshape(K, qp * 32, 4)
+    qsel = ((low >> 5) & 3).astype(jnp.int32)  # which 32-bit word of the leaf
+    w = jnp.take_along_axis(words, qsel[:, :, None], axis=2)[:, :, 0]
+    return ((w >> (low & 31)) & 1).astype(jnp.uint8)

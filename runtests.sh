@@ -1,0 +1,8 @@
+#!/bin/sh
+# Hermetic CPU test run: 8 virtual JAX CPU devices, axon TPU plugin disabled
+# (if the axon tunnel is wedged, jax.devices() hangs in any process where the
+# plugin registers — unsetting PALLAS_AXON_POOL_IPS skips registration).
+exec env -u PALLAS_AXON_POOL_IPS \
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
+    python -m pytest tests/ -q "$@"

@@ -1,0 +1,108 @@
+"""Differential tests: TPU (JAX) evaluator vs the NumPy golden spec."""
+
+import numpy as np
+import pytest
+
+from dpf_tpu.core import keys as keys_mod
+from dpf_tpu.core import spec
+from dpf_tpu.models import dpf as dpf_mod
+
+
+def _gen_batch_keys(ns, alphas, seed):
+    rng = np.random.default_rng(seed)
+    ka, kb = keys_mod.gen_batch(alphas, ns, rng)
+    return ka, kb
+
+
+def test_gen_batch_matches_scalar_spec():
+    # Vectorized host Gen must produce byte-identical keys to the scalar spec
+    # when fed the same randomness.
+    rng1 = np.random.default_rng(5)
+    kb_a, kb_b = keys_mod.gen_batch([77], 10, rng1)
+    rng2 = np.random.default_rng(5)
+    ka, kb = spec.gen(77, 10, rng2)
+    # gen_batch draws s0 then s1 as [K,16] blocks; scalar spec draws the same.
+    assert kb_a.to_bytes()[0] == ka
+    assert kb_b.to_bytes()[0] == kb
+
+
+def test_keybatch_roundtrip():
+    rng = np.random.default_rng(1)
+    kb_a, _ = keys_mod.gen_batch(list(range(8)), 12, rng)
+    blobs = kb_a.to_bytes()
+    back = keys_mod.KeyBatch.from_bytes(blobs, 12)
+    assert back.to_bytes() == blobs
+    assert spec.key_len(12) == len(blobs[0])
+
+
+@pytest.mark.parametrize("log_n", [3, 6, 7, 8, 10, 13])
+def test_eval_full_matches_spec(log_n):
+    K = 5
+    rng = np.random.default_rng(log_n)
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    kb_a, kb_b = keys_mod.gen_batch(alphas, log_n, np.random.default_rng(7))
+    out_a = dpf_mod.eval_full(kb_a)
+    out_b = dpf_mod.eval_full(kb_b)
+    for i, (ka, kbb) in enumerate(zip(kb_a.to_bytes(), kb_b.to_bytes())):
+        assert out_a[i].tobytes() == spec.eval_full(ka, log_n), f"key {i}"
+        assert out_b[i].tobytes() == spec.eval_full(kbb, log_n)
+    # And the XOR of shares is the indicator function.
+    recon = out_a ^ out_b
+    bits = np.unpackbits(recon, axis=1, bitorder="little")
+    for i in range(K):
+        nz = np.nonzero(bits[i][: 1 << log_n])[0]
+        assert nz.tolist() == [int(alphas[i])]
+
+
+def test_eval_full_large_batch_n10():
+    # K > 32: multiple key words per lane group.
+    K, log_n = 70, 10
+    rng = np.random.default_rng(0)
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    kb_a, kb_b = keys_mod.gen_batch(alphas, log_n, rng)
+    out = dpf_mod.eval_full(kb_a) ^ dpf_mod.eval_full(kb_b)
+    bits = np.unpackbits(out, axis=1, bitorder="little")
+    assert np.array_equal(np.argmax(bits, axis=1), alphas)
+    assert bits.sum() == K
+
+
+def test_eval_full_chunked_matches_unchunked():
+    # Force the chunked path with a tiny budget and compare.
+    K, log_n = 3, 12
+    rng = np.random.default_rng(2)
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    kb_a, _ = keys_mod.gen_batch(alphas, log_n, rng)
+    full = dpf_mod.eval_full(kb_a)
+    chunked = dpf_mod.eval_full(kb_a, max_plane_words=4)
+    assert np.array_equal(full, chunked)
+
+
+@pytest.mark.parametrize("log_n", [3, 7, 9, 33])
+def test_eval_points_matches_spec(log_n):
+    K, Q = 3, 40
+    rng = np.random.default_rng(log_n)
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    kb_a, kb_b = keys_mod.gen_batch(alphas, log_n, np.random.default_rng(4))
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    xs[:, 0] = alphas  # make sure the point itself is queried
+    got_a = dpf_mod.eval_points(kb_a, xs)
+    got_b = dpf_mod.eval_points(kb_b, xs)
+    blobs_a, blobs_b = kb_a.to_bytes(), kb_b.to_bytes()
+    for i in range(K):
+        for j in range(Q):
+            want = spec.eval_point(blobs_a[i], int(xs[i, j]), log_n)
+            assert got_a[i, j] == want, (i, j)
+    recon = got_a ^ got_b
+    assert np.array_equal(recon[:, 0], np.ones(K, np.uint8))
+    for i in range(K):
+        for j in range(1, Q):
+            assert recon[i, j] == (1 if xs[i, j] == alphas[i] else 0)
+
+
+def test_eval_points_rejects_bad_shapes():
+    rng = np.random.default_rng(0)
+    kb_a, _ = keys_mod.gen_batch([1, 2], 8, rng)
+    with pytest.raises(ValueError):
+        dpf_mod.eval_points(kb_a, np.zeros((3, 4), np.uint64))  # K mismatch
+    with pytest.raises(ValueError):
+        dpf_mod.eval_points(kb_a, np.full((2, 4), 256, np.uint64))  # out of domain
