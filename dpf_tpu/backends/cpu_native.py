@@ -1,0 +1,184 @@
+"""ctypes bridge to the C++ AES-NI CPU backend (native/dpf_native.cc).
+
+The native library is the framework's host-side fast path — the structural
+equivalent of the reference's x86 assembly layer (dpf/aes_amd64.s) — and the
+single-core baseline the TPU speedup is measured against.
+
+The shared object is built on demand with g++ (no pip deps); if no compiler
+is available the import still succeeds and ``available()`` returns False so
+pure-Python/JAX paths keep working.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "dpf_native.cc")
+_SO = os.path.join(_REPO_ROOT, "native", "libdpf_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_error: str | None = None
+
+
+def _build(force_soft: bool = False) -> None:
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
+    if not force_soft:
+        cmd = base + ["-maes", "-mssse3", _SRC, "-o", _SO]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            return
+        except (subprocess.CalledProcessError, FileNotFoundError):
+            pass
+    # Software-AES build: non-x86 hosts, or x86 CPUs without the AES flag.
+    cmd = base + ["-DDPFN_FORCE_SOFT", _SRC, "-o", _SO]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def _load():
+    global _lib, _load_error
+    with _lock:
+        if _lib is not None or _load_error is not None:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                _build()
+            lib = ctypes.CDLL(_SO)
+            lib.dpfn_usable.restype = ctypes.c_int
+            if not lib.dpfn_usable():
+                # AES-NI build on a CPU without the flag: rebuild soft.
+                _build(force_soft=True)
+                lib = ctypes.CDLL(_SO)
+                if not lib.dpfn_usable():
+                    raise RuntimeError("native build unusable on this CPU")
+        except Exception as e:  # noqa: BLE001 - any failure => backend absent
+            _load_error = f"{type(e).__name__}: {e}"
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.dpfn_have_aesni.restype = ctypes.c_int
+        lib.dpfn_key_len.restype = ctypes.c_uint64
+        lib.dpfn_key_len.argtypes = [ctypes.c_uint64]
+        lib.dpfn_output_len.restype = ctypes.c_uint64
+        lib.dpfn_output_len.argtypes = [ctypes.c_uint64]
+        lib.dpfn_gen.restype = ctypes.c_int
+        lib.dpfn_gen.argtypes = [ctypes.c_uint64, ctypes.c_uint64, u8p, u8p, u8p, u8p]
+        lib.dpfn_eval.restype = ctypes.c_int
+        lib.dpfn_eval.argtypes = [u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64]
+        lib.dpfn_eval_full.restype = ctypes.c_int
+        lib.dpfn_eval_full.argtypes = [u8p, ctypes.c_uint64, ctypes.c_uint64, u8p, ctypes.c_uint64]
+        lib.dpfn_eval_full_batch.restype = ctypes.c_int
+        lib.dpfn_eval_full_batch.argtypes = [
+            u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, u8p, ctypes.c_uint64,
+        ]
+        lib.dpfn_eval_points_batch.restype = ctypes.c_int
+        lib.dpfn_eval_points_batch.argtypes = [
+            u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, u64p, ctypes.c_uint64, u8p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def load_error() -> str | None:
+    _load()
+    return _load_error
+
+
+def have_aesni() -> bool:
+    lib = _load()
+    return bool(lib and lib.dpfn_have_aesni())
+
+
+def _u8ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def gen(alpha: int, log_n: int, rng: np.random.Generator | None = None) -> tuple[bytes, bytes]:
+    """Native Gen; entropy drawn host-side (deterministic with seeded rng)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    if rng is None:
+        seeds = np.frombuffer(os.urandom(32), dtype=np.uint8).copy()
+    else:
+        seeds = rng.integers(0, 256, size=32, dtype=np.uint8)
+    klen = int(lib.dpfn_key_len(log_n))
+    ka = np.empty(klen, np.uint8)
+    kb = np.empty(klen, np.uint8)
+    rc = lib.dpfn_gen(alpha, log_n, _u8ptr(seeds[:16]), _u8ptr(seeds[16:]),
+                      _u8ptr(ka), _u8ptr(kb))
+    if rc:
+        raise ValueError("dpf: invalid parameters")
+    return ka.tobytes(), kb.tobytes()
+
+
+def eval_point(key: bytes, x: int, log_n: int) -> int:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    kb = np.frombuffer(bytes(key), dtype=np.uint8)
+    rc = lib.dpfn_eval(_u8ptr(kb), len(kb), x, log_n)
+    if rc < 0:
+        raise ValueError(f"dpf: native eval failed (rc={rc})")
+    return rc
+
+
+def eval_full(key: bytes, log_n: int) -> bytes:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    kb = np.frombuffer(bytes(key), dtype=np.uint8)
+    out = np.empty(int(lib.dpfn_output_len(log_n)), np.uint8)
+    rc = lib.dpfn_eval_full(_u8ptr(kb), len(kb), log_n, _u8ptr(out), out.size)
+    if rc:
+        raise ValueError(f"dpf: native eval_full failed (rc={rc})")
+    return out.tobytes()
+
+
+def eval_full_batch(keys: list[bytes], log_n: int) -> np.ndarray:
+    """Sequential single-core batch (the baseline configuration)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    klen = int(lib.dpfn_key_len(log_n))
+    arr = np.frombuffer(b"".join(keys), dtype=np.uint8)
+    if arr.size != klen * len(keys):
+        raise ValueError("dpf: bad key length in batch")
+    olen = int(lib.dpfn_output_len(log_n))
+    out = np.empty((len(keys), olen), np.uint8)
+    rc = lib.dpfn_eval_full_batch(_u8ptr(arr), len(keys), klen, log_n, _u8ptr(out), olen)
+    if rc:
+        raise ValueError(f"dpf: native eval_full_batch failed (rc={rc})")
+    return out
+
+
+def eval_points_batch(keys: list[bytes], xs: np.ndarray, log_n: int) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    klen = int(lib.dpfn_key_len(log_n))
+    arr = np.frombuffer(b"".join(keys), dtype=np.uint8)
+    if arr.size != klen * len(keys):
+        raise ValueError("dpf: bad key length in batch")
+    xs = np.ascontiguousarray(xs, dtype=np.uint64)
+    k, q = xs.shape
+    if k != len(keys):
+        raise ValueError("xs first axis must match number of keys")
+    out = np.empty((k, q), np.uint8)
+    rc = lib.dpfn_eval_points_batch(
+        _u8ptr(arr), k, klen, log_n,
+        xs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), q, _u8ptr(out),
+    )
+    if rc:
+        raise ValueError(f"dpf: native eval_points_batch failed (rc={rc})")
+    return out

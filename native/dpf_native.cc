@@ -1,0 +1,466 @@
+// dpf_native — C++ CPU backend for the dpf_tpu framework.
+//
+// Plays the role the reference implementation fills with hand-written x86
+// assembly (dpf/aes_amd64.s: xor16 / aes128MMO / expandKeyAsm): the fast
+// host-side evaluation path and the measured single-core AES-NI baseline
+// that the TPU backend's speedup is judged against.  Written from the DPF
+// spec (Boyle-Gilboa-Ishai with early termination; see dpf_tpu/core/spec.py)
+// — iterative, batch-oriented C++, not a translation of the Go code.
+//
+// Exposed as a flat C ABI consumed by ctypes (dpf_tpu/backends/cpu_native.py)
+// and linkable from Go via cgo (bridge/go).
+//
+// Build: g++ -O3 -maes -mssse3 -shared -fPIC dpf_native.cc -o libdpf_native.so
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#if defined(__AES__) && defined(__x86_64__) && !defined(DPFN_FORCE_SOFT)
+#include <wmmintrin.h>
+#include <emmintrin.h>
+#define DPFN_HAVE_AESNI 1
+#else
+#define DPFN_HAVE_AESNI 0
+#endif
+
+namespace {
+
+constexpr uint64_t kLeafBits = 128;  // early termination: one AES block/leaf
+constexpr uint64_t kEarlyLevels = 7;
+
+// The two fixed PRF keys of the construction (same constants as the
+// reference, dpf/dpf.go:23-24, and dpf_tpu/core/aes_np.py).
+const uint8_t kPrfKeyL[16] = {36, 156, 50,  234, 92,  230, 49, 9,
+                              174, 170, 205, 160, 98,  236, 29, 243};
+const uint8_t kPrfKeyR[16] = {209, 12, 199, 173, 29, 74, 44,  128,
+                              194, 224, 14,  44,  2,  201, 110, 28};
+
+#if DPFN_HAVE_AESNI
+
+struct RoundKeys {
+  __m128i rk[11];
+};
+
+template <int RCON>
+static inline __m128i expand_step(__m128i key) {
+  __m128i gen = _mm_aeskeygenassist_si128(key, RCON);
+  gen = _mm_shuffle_epi32(gen, _MM_SHUFFLE(3, 3, 3, 3));
+  key = _mm_xor_si128(key, _mm_slli_si128(key, 4));
+  key = _mm_xor_si128(key, _mm_slli_si128(key, 4));
+  key = _mm_xor_si128(key, _mm_slli_si128(key, 4));
+  return _mm_xor_si128(key, gen);
+}
+
+static RoundKeys expand_key(const uint8_t key[16]) {
+  RoundKeys ks;
+  ks.rk[0] = _mm_loadu_si128(reinterpret_cast<const __m128i*>(key));
+  ks.rk[1] = expand_step<0x01>(ks.rk[0]);
+  ks.rk[2] = expand_step<0x02>(ks.rk[1]);
+  ks.rk[3] = expand_step<0x04>(ks.rk[2]);
+  ks.rk[4] = expand_step<0x08>(ks.rk[3]);
+  ks.rk[5] = expand_step<0x10>(ks.rk[4]);
+  ks.rk[6] = expand_step<0x20>(ks.rk[5]);
+  ks.rk[7] = expand_step<0x40>(ks.rk[6]);
+  ks.rk[8] = expand_step<0x80>(ks.rk[7]);
+  ks.rk[9] = expand_step<0x1b>(ks.rk[8]);
+  ks.rk[10] = expand_step<0x36>(ks.rk[9]);
+  return ks;
+}
+
+// Lazy (function-local static) so that merely dlopen()ing the library never
+// executes AES instructions — on a CPU without AES-NI the Python wrapper
+// checks dpfn_usable() first and rebuilds with -DDPFN_FORCE_SOFT instead of
+// the process dying with SIGILL in a static initializer.
+static const RoundKeys& ksL() {
+  static const RoundKeys k = expand_key(kPrfKeyL);
+  return k;
+}
+static const RoundKeys& ksR() {
+  static const RoundKeys k = expand_key(kPrfKeyR);
+  return k;
+}
+
+// Matyas-Meyer-Oseas one-way compression: E_k(x) ^ x.
+static inline __m128i mmo(const RoundKeys& ks, __m128i x) {
+  __m128i s = _mm_xor_si128(x, ks.rk[0]);
+  s = _mm_aesenc_si128(s, ks.rk[1]);
+  s = _mm_aesenc_si128(s, ks.rk[2]);
+  s = _mm_aesenc_si128(s, ks.rk[3]);
+  s = _mm_aesenc_si128(s, ks.rk[4]);
+  s = _mm_aesenc_si128(s, ks.rk[5]);
+  s = _mm_aesenc_si128(s, ks.rk[6]);
+  s = _mm_aesenc_si128(s, ks.rk[7]);
+  s = _mm_aesenc_si128(s, ks.rk[8]);
+  s = _mm_aesenc_si128(s, ks.rk[9]);
+  s = _mm_aesenclast_si128(s, ks.rk[10]);
+  return _mm_xor_si128(s, x);
+}
+
+using Block = __m128i;
+static inline Block load_block(const uint8_t* p) {
+  return _mm_loadu_si128(reinterpret_cast<const __m128i*>(p));
+}
+static inline void store_block(uint8_t* p, Block b) {
+  _mm_storeu_si128(reinterpret_cast<__m128i*>(p), b);
+}
+static inline Block xor_block(Block a, Block b) { return _mm_xor_si128(a, b); }
+static inline Block zero_lsb(Block b) {
+  // clear bit 0 of byte 0 (the control bit slot)
+  alignas(16) static const uint8_t m[16] = {0xFE, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
+                                            0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
+                                            0xFF, 0xFF, 0xFF, 0xFF};
+  return _mm_and_si128(b, load_block(m));
+}
+static inline int lsb(Block b) {
+  return _mm_cvtsi128_si32(b) & 1;
+}
+static inline Block mmoL(Block x) { return mmo(ksL(), x); }
+static inline Block mmoR(Block x) { return mmo(ksR(), x); }
+
+#else  // !DPFN_HAVE_AESNI — portable software AES fallback (table-based).
+
+struct Block {
+  uint8_t b[16];
+};
+
+struct SoftAes {
+  uint8_t sbox[256];
+  uint8_t xt[256];
+  uint8_t rk[11][16];
+};
+
+static uint8_t gf_mul(uint8_t a, uint8_t b) {
+  uint16_t r = 0, x = a;
+  while (b) {
+    if (b & 1) r ^= x;
+    x <<= 1;
+    if (x & 0x100) x ^= 0x11B;
+    b >>= 1;
+  }
+  return static_cast<uint8_t>(r);
+}
+
+static void soft_init(SoftAes& s, const uint8_t key[16]) {
+  // S-box from GF(2^8) inversion + affine map (FIPS-197 5.1.1).
+  for (int x = 0; x < 256; x++) {
+    uint8_t inv = 0;
+    for (int y = 1; y < 256 && x; y++)
+      if (gf_mul(static_cast<uint8_t>(x), static_cast<uint8_t>(y)) == 1) {
+        inv = static_cast<uint8_t>(y);
+        break;
+      }
+    uint8_t r = 0;
+    for (int i = 0; i < 8; i++) {
+      int bit = ((inv >> i) ^ (inv >> ((i + 4) & 7)) ^ (inv >> ((i + 5) & 7)) ^
+                 (inv >> ((i + 6) & 7)) ^ (inv >> ((i + 7) & 7)) ^ (0x63 >> i)) &
+                1;
+      r |= static_cast<uint8_t>(bit << i);
+    }
+    s.sbox[x] = r;
+    s.xt[x] = gf_mul(static_cast<uint8_t>(x), 2);
+  }
+  static const uint8_t rcon[10] = {0x01, 0x02, 0x04, 0x08, 0x10,
+                                   0x20, 0x40, 0x80, 0x1B, 0x36};
+  std::memcpy(s.rk[0], key, 16);
+  for (int r = 1; r <= 10; r++) {
+    uint8_t t[4] = {s.rk[r - 1][13], s.rk[r - 1][14], s.rk[r - 1][15],
+                    s.rk[r - 1][12]};
+    for (int i = 0; i < 4; i++) t[i] = s.sbox[t[i]];
+    t[0] ^= rcon[r - 1];
+    for (int i = 0; i < 4; i++) s.rk[r][i] = s.rk[r - 1][i] ^ t[i];
+    for (int i = 4; i < 16; i++) s.rk[r][i] = s.rk[r - 1][i] ^ s.rk[r][i - 4];
+  }
+}
+
+static SoftAes make_soft(const uint8_t key[16]) {
+  SoftAes s;
+  soft_init(s, key);
+  return s;
+}
+static const SoftAes g_softL = make_soft(kPrfKeyL);
+static const SoftAes g_softR = make_soft(kPrfKeyR);
+
+static Block mmo(const SoftAes& ks, Block x) {
+  uint8_t st[16];
+  for (int i = 0; i < 16; i++) st[i] = x.b[i] ^ ks.rk[0][i];
+  for (int r = 1; r <= 9; r++) {
+    uint8_t sb[16];
+    for (int i = 0; i < 16; i++) sb[i] = ks.sbox[st[i]];
+    uint8_t sh[16];
+    for (int c = 0; c < 4; c++)
+      for (int ro = 0; ro < 4; ro++) sh[4 * c + ro] = sb[4 * ((c + ro) & 3) + ro];
+    for (int c = 0; c < 4; c++) {
+      uint8_t a0 = sh[4 * c], a1 = sh[4 * c + 1], a2 = sh[4 * c + 2],
+              a3 = sh[4 * c + 3];
+      st[4 * c + 0] = static_cast<uint8_t>(ks.xt[a0] ^ ks.xt[a1] ^ a1 ^ a2 ^ a3 ^ ks.rk[r][4 * c + 0]);
+      st[4 * c + 1] = static_cast<uint8_t>(a0 ^ ks.xt[a1] ^ ks.xt[a2] ^ a2 ^ a3 ^ ks.rk[r][4 * c + 1]);
+      st[4 * c + 2] = static_cast<uint8_t>(a0 ^ a1 ^ ks.xt[a2] ^ ks.xt[a3] ^ a3 ^ ks.rk[r][4 * c + 2]);
+      st[4 * c + 3] = static_cast<uint8_t>(ks.xt[a0] ^ a0 ^ a1 ^ a2 ^ ks.xt[a3] ^ ks.rk[r][4 * c + 3]);
+    }
+  }
+  Block out;
+  uint8_t sb[16];
+  for (int i = 0; i < 16; i++) sb[i] = ks.sbox[st[i]];
+  for (int c = 0; c < 4; c++)
+    for (int ro = 0; ro < 4; ro++)
+      out.b[4 * c + ro] =
+          static_cast<uint8_t>(sb[4 * ((c + ro) & 3) + ro] ^ ks.rk[10][4 * c + ro] ^ x.b[4 * c + ro]);
+  return out;
+}
+
+static inline Block load_block(const uint8_t* p) {
+  Block b;
+  std::memcpy(b.b, p, 16);
+  return b;
+}
+static inline void store_block(uint8_t* p, Block b) { std::memcpy(p, b.b, 16); }
+static inline Block xor_block(Block a, Block b) {
+  Block r;
+  for (int i = 0; i < 16; i++) r.b[i] = a.b[i] ^ b.b[i];
+  return r;
+}
+static inline Block zero_lsb(Block b) {
+  b.b[0] &= 0xFE;
+  return b;
+}
+static inline int lsb(Block b) { return b.b[0] & 1; }
+static inline Block mmoL(Block x) { return mmo(g_softL, x); }
+static inline Block mmoR(Block x) { return mmo(g_softR, x); }
+
+#endif  // DPFN_HAVE_AESNI
+
+inline uint64_t tree_levels(uint64_t log_n) {
+  return log_n >= kEarlyLevels ? log_n - kEarlyLevels : 0;
+}
+
+// Canonical-form key validation — same contract as the Python spec
+// (spec.parse_key): control bytes in {0,1}, seed/sCW LSBs clear.  Keeps
+// every backend bit-identical on every accepted key.
+inline bool key_canonical(const uint8_t* key, uint64_t log_n) {
+  if (key[0] & 1 || key[16] > 1) return false;
+  const uint64_t levels = tree_levels(log_n);
+  for (uint64_t i = 0; i < levels; i++) {
+    const uint8_t* cw = key + 17 + 18 * i;
+    if ((cw[0] & 1) || cw[16] > 1 || cw[17] > 1) return false;
+  }
+  return true;
+}
+
+inline uint64_t serialized_key_len(uint64_t log_n) {
+  return 33 + 18 * tree_levels(log_n);
+}
+
+// One level-descend of a party's state along the evaluation path.
+struct PathState {
+  Block s;
+  int t;
+};
+
+inline void descend(PathState& st, const uint8_t* cw, int go_right) {
+  Block sl = mmoL(st.s), sr = mmoR(st.s);
+  int tl = lsb(sl), tr = lsb(sr);
+  sl = zero_lsb(sl);
+  sr = zero_lsb(sr);
+  if (st.t) {
+    Block scw = load_block(cw);
+    sl = xor_block(sl, scw);
+    sr = xor_block(sr, scw);
+    tl ^= cw[16];
+    tr ^= cw[17];
+  }
+  st.s = go_right ? sr : sl;
+  st.t = go_right ? tr : tl;
+}
+
+}  // namespace
+
+extern "C" {
+
+int dpfn_have_aesni(void) { return DPFN_HAVE_AESNI; }
+
+// 1 iff this build can run on this CPU (AES-NI builds need the CPU flag;
+// the software-AES build runs anywhere).
+int dpfn_usable(void) {
+#if DPFN_HAVE_AESNI
+  return __builtin_cpu_supports("aes") ? 1 : 0;
+#else
+  return 1;
+#endif
+}
+
+uint64_t dpfn_key_len(uint64_t log_n) { return serialized_key_len(log_n); }
+
+uint64_t dpfn_output_len(uint64_t log_n) {
+  return log_n >= kEarlyLevels ? (1ULL << (log_n - 3)) : 16;
+}
+
+// Key generation from caller-supplied 16-byte root seeds (the caller owns
+// entropy; passing fixed seeds gives reproducible keys for testing).
+// ka/kb must hold dpfn_key_len(log_n) bytes.  Returns 0 on success.
+int dpfn_gen(uint64_t alpha, uint64_t log_n, const uint8_t* seed0,
+             const uint8_t* seed1, uint8_t* ka, uint8_t* kb) {
+  if (log_n > 63 || alpha >= (1ULL << log_n)) return -1;
+  const uint64_t levels = tree_levels(log_n);
+
+  Block s0 = load_block(seed0), s1 = load_block(seed1);
+  int t0 = lsb(s0), t1 = t0 ^ 1;
+  s0 = zero_lsb(s0);
+  s1 = zero_lsb(s1);
+
+  store_block(ka, s0);
+  ka[16] = static_cast<uint8_t>(t0);
+  store_block(kb, s1);
+  kb[16] = static_cast<uint8_t>(t1);
+  uint8_t* cw_out_a = ka + 17;
+  uint8_t* cw_out_b = kb + 17;
+
+  for (uint64_t i = 0; i < levels; i++) {
+    Block s0l = mmoL(s0), s0r = mmoR(s0);
+    Block s1l = mmoL(s1), s1r = mmoR(s1);
+    int t0l = lsb(s0l), t0r = lsb(s0r), t1l = lsb(s1l), t1r = lsb(s1r);
+    s0l = zero_lsb(s0l);
+    s0r = zero_lsb(s0r);
+    s1l = zero_lsb(s1l);
+    s1r = zero_lsb(s1r);
+
+    const int bit = (alpha >> (log_n - 1 - i)) & 1;
+    // Correction word comes from the children alpha does NOT follow.
+    Block scw = bit ? xor_block(s0l, s1l) : xor_block(s0r, s1r);
+    const uint8_t tlcw = static_cast<uint8_t>(t0l ^ t1l ^ bit ^ 1);
+    const uint8_t trcw = static_cast<uint8_t>(t0r ^ t1r ^ bit);
+    store_block(cw_out_a, scw);
+    cw_out_a[16] = tlcw;
+    cw_out_a[17] = trcw;
+
+    Block keep0 = bit ? s0r : s0l;
+    Block keep1 = bit ? s1r : s1l;
+    const int keep_t0 = bit ? t0r : t0l;
+    const int keep_t1 = bit ? t1r : t1l;
+    const uint8_t keep_tcw = bit ? trcw : tlcw;
+    s0 = t0 ? xor_block(keep0, scw) : keep0;
+    s1 = t1 ? xor_block(keep1, scw) : keep1;
+    t0 = keep_t0 ^ (t0 ? keep_tcw : 0);
+    t1 = keep_t1 ^ (t1 ? keep_tcw : 0);
+    cw_out_a += 18;
+  }
+
+  Block fcw = xor_block(mmoL(s0), mmoL(s1));
+  uint8_t fbytes[16];
+  store_block(fbytes, fcw);
+  fbytes[(alpha & 127) / 8] ^= static_cast<uint8_t>(1u << ((alpha & 127) % 8));
+  std::memcpy(cw_out_a, fbytes, 16);
+  // Both keys share every correction word.
+  std::memcpy(cw_out_b, ka + 17, 18 * levels + 16);
+  return 0;
+}
+
+// Single-point evaluation -> 0/1, or negative on error.
+namespace {
+// Path walk without validation; callers have already checked the key.
+inline int eval_walk(const uint8_t* key, uint64_t key_len, uint64_t x,
+                     uint64_t log_n) {
+  const uint64_t levels = tree_levels(log_n);
+  PathState st{load_block(key), key[16]};
+  for (uint64_t i = 0; i < levels; i++)
+    descend(st, key + 17 + 18 * i, (x >> (log_n - 1 - i)) & 1);
+  Block leaf = mmoL(st.s);
+  if (st.t) leaf = xor_block(leaf, load_block(key + key_len - 16));
+  uint8_t bytes[16];
+  store_block(bytes, leaf);
+  const uint64_t low = x & 127;
+  return (bytes[low / 8] >> (low % 8)) & 1;
+}
+}  // namespace
+
+int dpfn_eval(const uint8_t* key, uint64_t key_len, uint64_t x,
+              uint64_t log_n) {
+  if (log_n > 63 || key_len != serialized_key_len(log_n)) return -1;
+  if (x >> log_n) return -3;  // query index out of domain
+  if (!key_canonical(key, log_n)) return -4;
+  return eval_walk(key, key_len, x, log_n);
+}
+
+// Full-domain evaluation, bit-packed output (dpfn_output_len bytes).
+// Iterative DFS over an explicit per-level stack: breadth is tiny (one
+// pending sibling per level), memory is O(log N), leaves emit in order.
+int dpfn_eval_full(const uint8_t* key, uint64_t key_len, uint64_t log_n,
+                   uint8_t* out, uint64_t out_len) {
+  if (log_n > 63 || key_len != serialized_key_len(log_n)) return -1;
+  if (out_len < dpfn_output_len(log_n)) return -2;
+  if (!key_canonical(key, log_n)) return -4;
+  const uint64_t levels = tree_levels(log_n);
+  const Block fcw = load_block(key + key_len - 16);
+
+  // stack[d] holds the not-yet-visited RIGHT sibling at depth d.
+  std::vector<PathState> pending(levels + 1);
+  uint64_t pending_mask = 0;  // bit d set -> pending[d] valid
+
+  PathState cur{load_block(key), key[16]};
+  uint64_t depth = 0;
+  uint8_t* out_cursor = out;
+  for (;;) {
+    if (depth == levels) {
+      Block leaf = mmoL(cur.s);
+      if (cur.t) leaf = xor_block(leaf, fcw);
+      store_block(out_cursor, leaf);
+      out_cursor += 16;
+      // Pop the deepest pending right sibling.
+      if (!pending_mask) break;
+      uint64_t d = 63 - static_cast<uint64_t>(__builtin_clzll(pending_mask));
+      pending_mask &= ~(1ULL << d);
+      cur = pending[d];
+      depth = d + 1;
+      continue;
+    }
+    const uint8_t* cw = key + 17 + 18 * depth;
+    Block sl = mmoL(cur.s), sr = mmoR(cur.s);
+    int tl = lsb(sl), tr = lsb(sr);
+    sl = zero_lsb(sl);
+    sr = zero_lsb(sr);
+    if (cur.t) {
+      Block scw = load_block(cw);
+      sl = xor_block(sl, scw);
+      sr = xor_block(sr, scw);
+      tl ^= cw[16];
+      tr ^= cw[17];
+    }
+    pending[depth] = PathState{sr, tr};
+    pending_mask |= 1ULL << depth;
+    cur = PathState{sl, tl};
+    depth++;
+  }
+  return 0;
+}
+
+// Batched variants: contiguous keys, contiguous outputs.
+int dpfn_eval_full_batch(const uint8_t* keys, uint64_t n_keys,
+                         uint64_t key_len, uint64_t log_n, uint8_t* out,
+                         uint64_t out_stride) {
+  for (uint64_t i = 0; i < n_keys; i++) {
+    int rc = dpfn_eval_full(keys + i * key_len, key_len, log_n,
+                            out + i * out_stride, out_stride);
+    if (rc) return rc;
+  }
+  return 0;
+}
+
+int dpfn_eval_points_batch(const uint8_t* keys, uint64_t n_keys,
+                           uint64_t key_len, uint64_t log_n,
+                           const uint64_t* xs, uint64_t n_points,
+                           uint8_t* out_bits) {
+  if (log_n > 63 || key_len != serialized_key_len(log_n)) return -1;
+  for (uint64_t i = 0; i < n_keys; i++) {
+    const uint8_t* key = keys + i * key_len;
+    if (!key_canonical(key, log_n)) return -4;  // validate once per key
+    for (uint64_t j = 0; j < n_points; j++) {
+      const uint64_t x = xs[i * n_points + j];
+      if (x >> log_n) return -3;
+      out_bits[i * n_points + j] =
+          static_cast<uint8_t>(eval_walk(key, key_len, x, log_n));
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,87 @@
+"""Differential tests: C++ native backend vs the NumPy golden spec.
+
+The native library is an independent implementation (AES-NI intrinsics or
+software AES), so agreement here is a strong cross-check of both."""
+
+import numpy as np
+import pytest
+
+from dpf_tpu.backends import cpu_native as cn
+from dpf_tpu.core import spec
+
+pytestmark = pytest.mark.skipif(
+    not cn.available(), reason=f"native backend unavailable: {cn.load_error()}"
+)
+
+
+def test_reports_flags():
+    assert isinstance(cn.have_aesni(), bool)
+    assert cn.load_error() is None
+
+
+@pytest.mark.parametrize("log_n", [3, 7, 8, 12, 20])
+def test_gen_matches_spec_bytes(log_n):
+    # Same seeds -> byte-identical keys across implementations.
+    rng1 = np.random.default_rng(log_n)
+    ka_n, kb_n = cn.gen(1 << (log_n - 1), log_n, rng1)
+    rng2 = np.random.default_rng(log_n)
+    ka_s, kb_s = spec.gen(1 << (log_n - 1), log_n, rng2)
+    assert ka_n == ka_s
+    assert kb_n == kb_s
+
+
+@pytest.mark.parametrize("log_n", [3, 7, 9, 13])
+def test_eval_full_matches_spec(log_n):
+    rng = np.random.default_rng(100 + log_n)
+    alpha = int(rng.integers(0, 1 << log_n))
+    ka, kb = spec.gen(alpha, log_n, rng)
+    assert cn.eval_full(ka, log_n) == spec.eval_full(ka, log_n)
+    assert cn.eval_full(kb, log_n) == spec.eval_full(kb, log_n)
+
+
+def test_eval_point_and_reconstruction():
+    rng = np.random.default_rng(0)
+    alpha = 123
+    ka, kb = cn.gen(alpha, 8, rng)
+    for x in range(256):
+        got = cn.eval_point(ka, x, 8) ^ cn.eval_point(kb, x, 8)
+        assert got == (1 if x == alpha else 0)
+        assert cn.eval_point(ka, x, 8) == spec.eval_point(ka, x, 8)
+
+
+def test_batch_entrypoints():
+    rng = np.random.default_rng(1)
+    log_n = 10
+    alphas = rng.integers(0, 1 << log_n, size=8)
+    pairs = [spec.gen(int(a), log_n, rng) for a in alphas]
+    keys_a = [p[0] for p in pairs]
+    out = cn.eval_full_batch(keys_a, log_n)
+    for i, k in enumerate(keys_a):
+        assert out[i].tobytes() == spec.eval_full(k, log_n)
+    xs = rng.integers(0, 1 << log_n, size=(8, 5), dtype=np.uint64)
+    bits = cn.eval_points_batch(keys_a, xs, log_n)
+    for i in range(8):
+        for j in range(5):
+            assert bits[i, j] == spec.eval_point(keys_a[i], int(xs[i, j]), log_n)
+
+
+def test_native_errors():
+    with pytest.raises(ValueError):
+        cn.gen(1 << 8, 8)  # alpha out of domain
+    with pytest.raises(ValueError):
+        cn.eval_full(b"\x00" * 10, 8)  # bad key length
+
+
+def test_native_rejects_noncanonical_and_oob_like_spec():
+    rng = np.random.default_rng(2)
+    ka, _ = spec.gen(5, 10, rng)
+    bad = bytearray(ka)
+    bad[16] = 2  # t byte out of {0,1}
+    with pytest.raises(ValueError):
+        cn.eval_full(bytes(bad), 10)
+    with pytest.raises(ValueError):
+        cn.eval_point(bytes(bad), 5, 10)
+    with pytest.raises(ValueError):
+        cn.eval_point(ka, 1 << 10, 10)  # x out of domain, like spec
+    with pytest.raises(ValueError):
+        cn.eval_points_batch([ka[:-1]], np.zeros((1, 2), np.uint64), 10)
