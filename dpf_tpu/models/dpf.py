@@ -66,13 +66,19 @@ def _pack_words_over_keys(words: np.ndarray) -> np.ndarray:
 
 
 class DeviceKeys:
-    """Key material packed for the device evaluator (K padded to 32)."""
+    """Key material packed for the device evaluator.
 
-    def __init__(self, kb: KeyBatch):
+    K is zero-padded to a multiple of ``pad_to`` (>= 32, itself a multiple of
+    32): 32 is the lane-packing quantum; sharded evaluation passes
+    ``32 * n_shards`` so every shard gets whole lane words."""
+
+    def __init__(self, kb: KeyBatch, pad_to: int = 32):
+        if pad_to % 32:
+            raise ValueError("pad_to must be a multiple of 32")
         self.log_n = kb.log_n
         self.nu = kb.nu
         self.k = kb.k
-        pad = (-kb.k) % 32
+        pad = (-kb.k) % pad_to
         self.k_padded = kb.k + pad
 
         def padk(a):  # zero-pad the key axis

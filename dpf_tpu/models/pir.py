@@ -1,0 +1,229 @@
+"""2-server private information retrieval on top of batched DPF expansion.
+
+Protocol (classic 2-server PIR, the headline application of DPFs — the
+reference implements only the primitive, SURVEY §0): the client hides row
+index ``alpha`` in a DPF key pair; each server expands its share over the
+row domain and XORs together the database rows whose selection bit is 1;
+the client XORs the two 1-row answers to recover row ``alpha``.
+
+TPU mapping: the XOR-of-selected-rows is GF(2) linear algebra —
+``answer = sel_bits[K, N] @ db_bits[N, B] (mod 2)`` — so it runs on the
+**MXU** as an int8 matmul with int32 accumulation and a final parity bit,
+chunked over rows so only row-chunks are ever unpacked to bits.  The
+selection bits come straight from the level-synchronous DPF expansion
+(models/dpf.py) without leaving HBM.
+
+Multi-chip: database rows shard over the ``leaf`` mesh axis — each chip
+expands only the GGM subtree covering its own rows (zero-communication
+domain parallelism) — and the K queries shard over the ``keys`` axis.  The
+only collective is one parity all-reduce of the [K, row_bytes] partial
+answers over ICI (parallel/sharding.xor_allreduce).
+"""
+
+from __future__ import annotations
+
+from functools import cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core.keys import KeyBatch, gen_batch
+from ..parallel.sharding import (
+    KEYS_AXIS,
+    LEAF_AXIS,
+    expand_subtree_local,
+    leaf_axis_levels,
+    xor_allreduce,
+)
+from .dpf import DeviceKeys, _convert_leaves, _level_step
+
+
+def row_domain(n_rows: int) -> tuple[int, int]:
+    """(log_n, padded domain size) for an ``n_rows``-row database.  Client
+    and server must derive the domain identically — single source of truth."""
+    log_n = max(int(n_rows - 1).bit_length(), 3)
+    return log_n, 1 << max(log_n, 7)
+
+
+# ---------------------------------------------------------------------------
+# Client side
+# ---------------------------------------------------------------------------
+
+
+def pir_query(
+    indices: np.ndarray | list[int],
+    n_rows: int,
+    rng: np.random.Generator | None = None,
+) -> tuple[KeyBatch, KeyBatch]:
+    """Build the two servers' query key batches for a batch of row indices."""
+    log_n, _ = row_domain(n_rows)
+    indices = np.asarray(indices, dtype=np.uint64)
+    if (indices >= n_rows).any():
+        raise ValueError("pir: row index out of range")
+    return gen_batch(indices, log_n, rng=rng)
+
+
+def pir_reconstruct(ans_a: np.ndarray, ans_b: np.ndarray) -> np.ndarray:
+    """XOR the two servers' answers -> the requested rows [K, row_bytes]."""
+    return np.bitwise_xor(ans_a, ans_b)
+
+
+# ---------------------------------------------------------------------------
+# Server side
+# ---------------------------------------------------------------------------
+
+
+class PirServer:
+    """One server's database, packed on device.
+
+    ``db``: uint8[N, row_bytes]; both servers hold identical copies.
+    ``mesh``: optional (keys, leaf) mesh; rows shard over ``leaf``.
+    ``chunk_rows``: rows per parity-matmul chunk (int8 unpack granularity).
+    """
+
+    def __init__(
+        self,
+        db: np.ndarray,
+        mesh: Mesh | None = None,
+        chunk_rows: int = 1 << 16,
+    ):
+        db = np.ascontiguousarray(np.asarray(db, dtype=np.uint8))
+        if db.ndim != 2:
+            raise ValueError("db must be [n_rows, row_bytes]")
+        self.n_rows, self.row_bytes = db.shape
+        if self.row_bytes % 4:
+            raise ValueError("row_bytes must be a multiple of 4")
+        self.log_n, dom = row_domain(self.n_rows)
+        self.nu = max(self.log_n - 7, 0)
+        self.mesh = mesh
+        self.n_leaf = mesh.shape.get(LEAF_AXIS, 1) if mesh else 1
+        if mesh is not None:
+            self.subtree_levels = leaf_axis_levels(mesh, self.nu, self.log_n)
+        else:
+            self.subtree_levels = 0
+        # Pad the row count to a full leaf domain so selection words line up
+        # 1:1 with expansion output words (and to whole shards/chunks).
+        self.dom = dom
+        self.chunk_rows = min(chunk_rows, max(dom // self.n_leaf, 128))
+        if dom % (self.n_leaf * self.chunk_rows):
+            raise ValueError("chunk_rows must divide the per-shard domain")
+        padded = np.zeros((dom, self.row_bytes), np.uint8)
+        padded[: self.n_rows] = db
+        self.db_words = jnp.asarray(
+            np.ascontiguousarray(padded).view("<u4")
+        )  # [dom, row_bytes/4]
+
+    def answer(self, queries: KeyBatch) -> np.ndarray:
+        """-> uint8[K, row_bytes]: per-query XOR of selected rows."""
+        if queries.log_n != self.log_n:
+            raise ValueError(
+                f"pir: query domain 2^{queries.log_n} != db domain 2^{self.log_n}"
+            )
+        if self.mesh is None:
+            k_shards = 1
+        else:
+            k_shards = self.mesh.shape[KEYS_AXIS]
+        dk = DeviceKeys(queries, pad_to=32 * k_shards)
+        n_chunks = self.dom // (self.n_leaf * self.chunk_rows)
+        if self.mesh is None:
+            fn = _pir_single(dk.nu, self.chunk_rows, n_chunks)
+        else:
+            fn = _pir_sharded(
+                self.mesh, dk.nu, self.subtree_levels, self.chunk_rows, n_chunks
+            )
+        words = np.asarray(
+            fn(
+                dk.seed_planes, dk.t_words, dk.scw_planes,
+                dk.tl_words, dk.tr_words, dk.fcw_planes, self.db_words,
+            )
+        )  # [Kpad, row_words]
+        return words[: queries.k].view("<u1").reshape(queries.k, -1)
+
+
+# ---------------------------------------------------------------------------
+# Device kernels
+# ---------------------------------------------------------------------------
+
+
+def _unpack_bits_i8(words: jax.Array) -> jax.Array:
+    """uint32[M, W] -> int8[M, 32*W] bits, LSB-first per word.  Used for
+    both the selection rows and the db rows of the parity matmul."""
+    m = words.shape[0]
+    b = (words[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)) & 1
+    return b.reshape(m, -1).astype(jnp.int8)
+
+
+def _pack_bits_u32(bits: jax.Array) -> jax.Array:
+    """int32[..., 32*R] 0/1 -> uint32[..., R]."""
+    shape = bits.shape[:-1] + (bits.shape[-1] // 32, 32)
+    b = bits.reshape(shape).astype(jnp.uint32)
+    return (b << jnp.arange(32, dtype=jnp.uint32)).sum(-1, dtype=jnp.uint32)
+
+
+def _parity_matmul(sel_words, db_words, chunk_rows, n_chunks):
+    """GF(2) product sel[K, N] x db[N, bits] via chunked int8 MXU matmuls.
+
+    sel_words uint32[K, N/32], db_words uint32[N, R] -> uint32[K, R].
+    """
+    K = sel_words.shape[0]
+    R = db_words.shape[1]
+    cw = chunk_rows // 32
+
+    def step(acc, i):
+        sel = _unpack_bits_i8(
+            jax.lax.dynamic_slice_in_dim(sel_words, i * cw, cw, axis=1)
+        )  # int8[K, chunk]
+        dbb = _unpack_bits_i8(
+            jax.lax.dynamic_slice_in_dim(db_words, i * chunk_rows, chunk_rows)
+        )  # int8[chunk, 32R]
+        part = jnp.matmul(sel, dbb, preferred_element_type=jnp.int32)
+        return acc ^ (part & 1), None
+
+    acc0 = jnp.zeros((K, 32 * R), jnp.int32)
+    acc, _ = jax.lax.scan(step, acc0, jnp.arange(n_chunks))
+    return _pack_bits_u32(acc)
+
+
+def _leaves_to_sel_words(words: jax.Array) -> jax.Array:
+    """Expansion output uint32[K, W, 4] -> selection words uint32[K, W*4]
+    in ascending row order (row 128*w + 32*q + bit, LSB-first)."""
+    return words.reshape(words.shape[0], -1)
+
+
+@cache
+def _pir_single(nu: int, chunk_rows: int, n_chunks: int):
+    def body(seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes, db_words):
+        S, T = seed_planes, t_words
+        for i in range(nu):
+            S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i])
+        sel = _leaves_to_sel_words(_convert_leaves(S, T, fcw_planes))
+        return _parity_matmul(sel, db_words, chunk_rows, n_chunks)
+
+    return jax.jit(body)
+
+
+@cache
+def _pir_sharded(mesh: Mesh, nu: int, subtree_levels: int, chunk_rows: int, n_chunks: int):
+    def body(seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes, db_words):
+        S, T = expand_subtree_local(
+            seed_planes, t_words, scw_planes, tl_w, tr_w, nu, subtree_levels
+        )
+        sel = _leaves_to_sel_words(_convert_leaves(S, T, fcw_planes))
+        part = _parity_matmul(sel, db_words, chunk_rows, n_chunks)
+        return xor_allreduce(part, LEAF_AXIS)
+
+    keyed = P(None, None, KEYS_AXIS)
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                keyed, P(None, KEYS_AXIS), keyed, P(None, KEYS_AXIS),
+                P(None, KEYS_AXIS), keyed, P(LEAF_AXIS, None),
+            ),
+            out_specs=P(KEYS_AXIS, None),
+            check_vma=False,
+        )
+    )

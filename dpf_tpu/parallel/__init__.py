@@ -1,0 +1,18 @@
+"""Multi-chip parallelism: mesh construction, sharded evaluation, and the
+parity all-reduce collective."""
+
+from .sharding import (
+    KEYS_AXIS,
+    LEAF_AXIS,
+    eval_full_sharded,
+    make_mesh,
+    xor_allreduce,
+)
+
+__all__ = [
+    "KEYS_AXIS",
+    "LEAF_AXIS",
+    "eval_full_sharded",
+    "make_mesh",
+    "xor_allreduce",
+]

@@ -1,0 +1,156 @@
+"""Multi-chip sharding for DPF evaluation: `jax.shard_map` over an ICI mesh.
+
+The reference is single-threaded (SURVEY §2: no goroutines, no comms).  On a
+TPU pod the natural parallel axes of full-domain DPF evaluation are:
+
+  * ``keys``  — data parallelism over the key batch.  Keys are independent,
+    so the bit-plane tensors shard on their lane-word axis (32 keys/word)
+    with **zero** cross-chip communication.
+  * ``leaf``  — domain parallelism over the output range of each key.  The
+    GGM tree has no cross-subtree dependence below any level, so each chip
+    replicates the first ``log2(leaf)`` levels (O(leaf) tiny nodes), keeps
+    its own subtree, and expands it privately — again zero communication.
+    This is how a single key with 2^30 leaves outgrows one chip's HBM.
+
+The only collective in the whole framework is the parity all-reduce that
+combines per-shard partial XOR answers in the PIR application
+(:func:`xor_allreduce`), riding ICI.
+
+Everything here also runs on a virtual CPU mesh
+(``--xla_force_host_platform_device_count=N``) — that is how the test suite
+and the driver's multi-chip dry-run validate the shardings without N chips.
+"""
+
+from __future__ import annotations
+
+from functools import cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core.keys import KeyBatch
+from ..models.dpf import DeviceKeys, _convert_leaves, _level_step
+
+KEYS_AXIS = "keys"
+LEAF_AXIS = "leaf"
+
+
+def make_mesh(
+    n_keys: int = 1, n_leaf: int = 1, devices: list | None = None
+) -> Mesh:
+    """Build a ``(keys, leaf)`` mesh over the first ``n_keys * n_leaf``
+    devices (defaults to all of ``jax.devices()`` arranged ``(ndev, 1)``)."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if n_keys * n_leaf == 1 and len(devices) > 1:
+        n_keys = len(devices)
+    if n_keys * n_leaf > len(devices):
+        raise ValueError(
+            f"mesh {n_keys}x{n_leaf} needs {n_keys * n_leaf} devices, "
+            f"have {len(devices)}"
+        )
+    devs = np.array(devices[: n_keys * n_leaf]).reshape(n_keys, n_leaf)
+    return Mesh(devs, (KEYS_AXIS, LEAF_AXIS))
+
+
+def xor_allreduce(x: jax.Array, axis_name: str) -> jax.Array:
+    """Bitwise-XOR all-reduce across a mesh axis (inside shard_map).
+
+    XLA has no native XOR collective; an ``all_gather`` + local lane-XOR is
+    one ICI hop and the payloads here (PIR answers, KiB) are tiny."""
+    g = jax.lax.all_gather(x, axis_name)  # [n_shards, ...]
+    return jnp.bitwise_xor.reduce(g, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Sharded full-domain evaluation
+# ---------------------------------------------------------------------------
+
+
+def leaf_axis_levels(mesh: Mesh, nu: int, log_n: int) -> int:
+    """Validate the leaf-axis size against domain 2^log_n and return
+    ``subtree_levels`` = log2(leaf-axis size)."""
+    n_leaf = mesh.shape.get(LEAF_AXIS, 1)
+    if n_leaf & (n_leaf - 1):
+        raise ValueError("leaf axis size must be a power of two")
+    c = n_leaf.bit_length() - 1
+    if c > nu:
+        raise ValueError(
+            f"leaf axis {n_leaf} exceeds 2^nu={1 << nu} subtrees at "
+            f"log_n={log_n}; use a smaller leaf axis"
+        )
+    return c
+
+
+def expand_subtree_local(
+    seed_planes, t_words, scw_planes, tl_w, tr_w, nu: int, subtree_levels: int
+):
+    """Shard-local GGM expansion (inside shard_map): replicate the top
+    ``subtree_levels`` levels, slice this shard's subtree by its
+    ``LEAF_AXIS`` index, expand the remaining levels.  Single source of
+    truth for the subtree-sharding idiom (also used by models/pir.py)."""
+    c = subtree_levels
+    S, T = seed_planes, t_words  # [128, 1, kp_local], [1, kp_local]
+    for i in range(c):
+        S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i])
+    if c:
+        j = jax.lax.axis_index(LEAF_AXIS)
+        S = jax.lax.dynamic_slice_in_dim(S, j, 1, axis=1)
+        T = jax.lax.dynamic_slice_in_dim(T, j, 1, axis=0)
+    for i in range(c, nu):
+        S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i])
+    return S, T
+
+
+@cache
+def _sharded_eval_full(mesh: Mesh, nu: int, subtree_levels: int):
+    """Compile the sharded evaluator for a (mesh, domain) bucket.
+
+    ``subtree_levels`` = log2(leaf-axis size); each shard replicates that
+    many top levels, then expands only its own subtree.
+    """
+
+    def body(seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes):
+        S, T = expand_subtree_local(
+            seed_planes, t_words, scw_planes, tl_w, tr_w, nu, subtree_levels
+        )
+        return _convert_leaves(S, T, fcw_planes)
+
+    keyed = P(None, None, KEYS_AXIS)  # plane tensors: lane-word axis last
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            keyed,
+            P(None, KEYS_AXIS),
+            keyed,
+            P(None, KEYS_AXIS),
+            P(None, KEYS_AXIS),
+            keyed,
+        ),
+        out_specs=P(KEYS_AXIS, LEAF_AXIS, None),
+    )
+    return jax.jit(sharded)
+
+
+def eval_full_sharded(kb: KeyBatch, mesh: Mesh) -> np.ndarray:
+    """Full-domain evaluation of a key batch sharded over ``mesh`` ->
+    uint8[K, 2^(log_n-3)] (16 bytes/key when log_n < 7).
+
+    Key batch shards over the ``keys`` axis; each key's leaf range shards
+    over the ``leaf`` axis (independent GGM subtrees, zero communication).
+    The leaf-axis size must be a power of two and at most 2^nu; pass a
+    keys-only mesh for tiny domains.
+    """
+    n_keys = mesh.shape[KEYS_AXIS]
+    c = leaf_axis_levels(mesh, kb.nu, kb.log_n)
+    dk = DeviceKeys(kb, pad_to=32 * n_keys)
+    fn = _sharded_eval_full(mesh, kb.nu, c)
+    words = np.asarray(
+        fn(
+            dk.seed_planes, dk.t_words, dk.scw_planes,
+            dk.tl_words, dk.tr_words, dk.fcw_planes,
+        )
+    )
+    return np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)
