@@ -17,6 +17,11 @@ Batch-first TPU API (where the speedup lives):
     kba, kbb = dpf_tpu.gen_batch(alphas, log_n)       # host, vectorized
     out      = dpf_tpu.eval_full_batch(kba)           # [K, 2^(n-3)] uint8
     bits     = dpf_tpu.eval_points_batch(kba, xs)     # [K, Q] uint8
+
+FSS gates layered on DPFs (``dpf_tpu.models.fss``):
+
+    ca, cb = fss.gen_lt_batch(alphas, log_n)          # 1{x < alpha} shares
+    ia, ib = fss.gen_interval_batch(lo, hi, log_n)    # 1{lo <= x <= hi}
 """
 
 from __future__ import annotations
@@ -36,7 +41,16 @@ __all__ = [
     "eval_full_batch",
     "eval_points_batch",
     "key_len",
+    "fss",
 ]
+
+
+def __getattr__(name):
+    if name == "fss":
+        from .models import fss as _fss
+
+        return _fss
+    raise AttributeError(f"module 'dpf_tpu' has no attribute {name!r}")
 
 
 def Gen(alpha: int, log_n: int, rng=None) -> tuple[bytes, bytes]:

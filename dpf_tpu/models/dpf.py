@@ -29,6 +29,7 @@ compiled function.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -36,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.keys import KeyBatch
+from ..ops import aes_pallas
 from ..ops.aes_bitslice import (
     RK_MASKS_L,
     aes128_mmo_planes,
@@ -43,6 +45,20 @@ from ..ops.aes_bitslice import (
     prg_planes,
     unpack_planes,
 )
+
+# PRG/convert kernel implementations.  "xla" = fused elementwise DAG left to
+# the XLA fuser; "pallas" = explicit VMEM-tiled Mosaic kernels
+# (ops/aes_pallas.py; interpreted off-TPU).  Selected per call via the
+# ``backend`` argument, defaulting to $DPF_TPU_PRG or "xla".
+_PRG_IMPLS = {"xla": prg_planes, "pallas": aes_pallas.prg_planes_pallas}
+_MMO_IMPLS = {
+    "xla": lambda S: aes128_mmo_planes(S, RK_MASKS_L),
+    "pallas": aes_pallas.mmo_planes_pallas,
+}
+
+
+def default_backend() -> str:
+    return os.environ.get("DPF_TPU_PRG", "xla")
 
 # ---------------------------------------------------------------------------
 # Host-side packing of key material into plane/mask form
@@ -118,10 +134,10 @@ class DeviceKeys:
 # ---------------------------------------------------------------------------
 
 
-def _level_step(S, T, cw_plane, tl_w, tr_w):
+def _level_step(S, T, cw_plane, tl_w, tr_w, backend="xla"):
     """One level of the expansion: [128, W, Kp] -> [128, 2W, Kp]."""
     W = S.shape[1]
-    L, R = prg_planes(S.reshape(128, -1))
+    L, R = _PRG_IMPLS[backend](S.reshape(128, -1))
     L = L.reshape(128, W, -1)
     R = R.reshape(128, W, -1)
     tl, tr = L[0], R[0]
@@ -138,34 +154,43 @@ def _level_step(S, T, cw_plane, tl_w, tr_w):
     return S, T
 
 
-def _convert_leaves(S, T, fcw_planes):
+def _convert_leaves(S, T, fcw_planes, backend="xla"):
     """Leaf conversion + final CW: -> per-key output words [K, W, 4]."""
-    C = aes128_mmo_planes(S.reshape(128, -1), RK_MASKS_L).reshape(S.shape)
+    C = _MMO_IMPLS[backend](S.reshape(128, -1)).reshape(S.shape)
     C = C ^ (fcw_planes & T[None, :, :])
     return unpack_planes(C)
 
 
-@partial(jax.jit, static_argnums=(0,))
-def _eval_full_jit(n_levels, seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes):
+@partial(jax.jit, static_argnums=(0, 7))
+def _eval_full_jit(
+    n_levels, seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes,
+    backend="xla",
+):
     S, T = seed_planes, t_words
     for i in range(n_levels):
-        S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i])
-    return _convert_leaves(S, T, fcw_planes)
+        S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i], backend)
+    return _convert_leaves(S, T, fcw_planes, backend)
 
 
-@partial(jax.jit, static_argnums=(0,))
-def _expand_prefix_jit(n_levels, seed_planes, t_words, scw_planes, tl_w, tr_w):
+@partial(jax.jit, static_argnums=(0, 6))
+def _expand_prefix_jit(
+    n_levels, seed_planes, t_words, scw_planes, tl_w, tr_w, backend="xla"
+):
     S, T = seed_planes, t_words
     for i in range(n_levels):
-        S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i])
+        S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i], backend)
     return S, T
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _finish_chunk_jit(n_levels, first, S, T, scw_planes, tl_w, tr_w, fcw_planes):
+@partial(jax.jit, static_argnums=(0, 1, 8))
+def _finish_chunk_jit(
+    n_levels, first, S, T, scw_planes, tl_w, tr_w, fcw_planes, backend="xla"
+):
     for i in range(n_levels):
-        S, T = _level_step(S, T, scw_planes[first + i], tl_w[first + i], tr_w[first + i])
-    return _convert_leaves(S, T, fcw_planes)
+        S, T = _level_step(
+            S, T, scw_planes[first + i], tl_w[first + i], tr_w[first + i], backend
+        )
+    return _convert_leaves(S, T, fcw_planes, backend)
 
 
 # ---------------------------------------------------------------------------
@@ -178,19 +203,24 @@ def _finish_chunk_jit(n_levels, first, S, T, scw_planes, tl_w, tr_w, fcw_planes)
 MAX_PLANE_WORDS = 1 << 19
 
 
-def eval_full_device(dk: DeviceKeys, max_plane_words: int = MAX_PLANE_WORDS):
+def eval_full_device(
+    dk: DeviceKeys,
+    max_plane_words: int = MAX_PLANE_WORDS,
+    backend: str | None = None,
+):
     """Full-domain evaluation on device -> uint32[K_padded, n_leaves, 4].
 
     The returned words ARE the bit-packed output: word q of leaf w holds
     domain bits [128*w + 32*q, 128*w + 32*q + 32), LSB-first.
     """
+    backend = backend or default_backend()
     nu = dk.nu
     kp = dk.k_padded // 32
     total = (1 << nu) * kp
     if total <= max_plane_words:
         return _eval_full_jit(
             nu, dk.seed_planes, dk.t_words, dk.scw_planes,
-            dk.tl_words, dk.tr_words, dk.fcw_planes,
+            dk.tl_words, dk.tr_words, dk.fcw_planes, backend,
         )
     # Chunked: expand a prefix of c levels, then finish each of the 2^c
     # independent subtrees under one compiled function.  Minimal split:
@@ -198,25 +228,30 @@ def eval_full_device(dk: DeviceKeys, max_plane_words: int = MAX_PLANE_WORDS):
     n_chunks = -(-total // max_plane_words)
     c = min((n_chunks - 1).bit_length(), nu)
     S, T = _expand_prefix_jit(
-        c, dk.seed_planes, dk.t_words, dk.scw_planes, dk.tl_words, dk.tr_words
+        c, dk.seed_planes, dk.t_words, dk.scw_planes, dk.tl_words, dk.tr_words,
+        backend,
     )
     outs = []
     for j in range(1 << c):
         outs.append(
             _finish_chunk_jit(
                 nu - c, c, S[:, j : j + 1, :], T[j : j + 1, :],
-                dk.scw_planes, dk.tl_words, dk.tr_words, dk.fcw_planes,
+                dk.scw_planes, dk.tl_words, dk.tr_words, dk.fcw_planes, backend,
             )
         )
     return jnp.concatenate(outs, axis=1)
 
 
-def eval_full(kb: KeyBatch, max_plane_words: int = MAX_PLANE_WORDS) -> np.ndarray:
+def eval_full(
+    kb: KeyBatch,
+    max_plane_words: int = MAX_PLANE_WORDS,
+    backend: str | None = None,
+) -> np.ndarray:
     """Full-domain evaluation of a key batch -> uint8[K, out_bytes], where
     out_bytes = 2^(log_n-3) (16 when log_n < 7), byte-identical to
     ``spec.eval_full`` / the reference's EvalFull per key."""
     dk = DeviceKeys(kb)
-    words = np.asarray(eval_full_device(dk, max_plane_words))  # [Kpad, W, 4]
+    words = np.asarray(eval_full_device(dk, max_plane_words, backend))  # [Kpad, W, 4]
     out = np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)
     return out
 

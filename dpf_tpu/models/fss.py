@@ -1,0 +1,258 @@
+"""FSS comparison and interval gates built from batched DPFs.
+
+The reference library stops at point functions (dpf/dpf.go: Gen/Eval/
+EvalFull); comparison and interval gates are the canonical FSS application
+layered on top (BGI 2016, sec. 3.2.2: an interval function is a union of at
+most ``log N`` dyadic intervals, each of which is a *point* function on a
+prefix domain).  This module realizes them entirely from the framework's own
+batched DPF primitives, so the whole gate evaluates as ONE bitsliced
+``eval_points`` launch on the accelerator.
+
+Construction (comparison, ``1{x < alpha}`` over ``[0, 2^n)``):
+
+    x < alpha  <=>  exists a unique level i in [0, n):
+                    x and alpha agree on their top i bits,
+                    bit i of alpha (MSB-first) is 1, and bit i of x is 0.
+
+Level i's condition is the point function "top i+1 bits of x equal
+(alpha's top i bits || 0)".  Rather than using a separate (i+1)-bit prefix
+domain per level (ragged shapes -> one compile per level), every level is
+embedded in the full n-bit domain: the level-i DPF's point is the prefix
+*shifted back up* (low bits zero) and queries are masked the same way, so
+all n levels form one uniform ``KeyBatch`` of ``n * G`` keys evaluated in a
+single call.  Levels where alpha's bit is 0 contribute a constant 0: both
+parties receive *identical* keys for a random point, whose evaluations
+cancel under XOR (zero-sharing by key duplication — standard in the
+trusted-dealer / semi-honest 2-server FSS model; a single key reveals
+nothing about its point, so the per-party view is unchanged).
+
+Since the matching level is unique, XOR over levels equals the union, and
+the parties' outputs are XOR-shares of the predicate:
+
+    eval_lt_points(ck_a, xs) ^ eval_lt_points(ck_b, xs) == (xs < alpha)
+
+Interval gates ``1{lo <= x <= hi}`` are the XOR of two comparisons
+(``lt_{hi+1} ^ lt_{lo}``) and evaluate as one fused launch over both gate
+sets; the ``hi == 2^n - 1`` edge folds into a public constant on party A.
+
+Also provided: ``ge_full_from_dpf`` — full-domain comparison shares from a
+SINGLE ordinary DPF key via a carry-less prefix-XOR scan over the bit-packed
+``EvalFull`` output (XOR_{y <= x} DPF(y) = 1{x >= alpha}), which turns the
+already-computed leaf planes into a comparison table with one extra
+device pass.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.keys import KeyBatch, gen_batch
+from .dpf import DeviceKeys, eval_full_device, eval_points
+
+__all__ = [
+    "CmpKeyBatch",
+    "IntervalKeyBatch",
+    "gen_lt_batch",
+    "eval_lt_points",
+    "gen_interval_batch",
+    "eval_interval_points",
+    "ge_full_from_dpf",
+]
+
+
+@dataclass
+class CmpKeyBatch:
+    """One party's share of G comparison gates ``1{x < alpha_g}``.
+
+    ``levels`` holds ``n * G`` full-domain DPF keys, level-major: key
+    ``i * G + g`` is gate g's level-i DPF.  Serializes per gate as the
+    concatenation of its n reference-layout DPF keys."""
+
+    log_n: int
+    levels: KeyBatch  # K = log_n * G keys on the n-bit domain
+
+    @property
+    def g(self) -> int:
+        return self.levels.k // self.log_n
+
+    def to_bytes(self) -> list[bytes]:
+        """-> G blobs, each ``log_n * key_len(log_n)`` bytes."""
+        lv = self.levels.to_bytes()
+        G = self.g
+        return [b"".join(lv[i * G + g] for i in range(self.log_n)) for g in range(G)]
+
+    @classmethod
+    def from_bytes(cls, blobs: list[bytes], log_n: int) -> "CmpKeyBatch":
+        from ..core.spec import key_len
+
+        kl = key_len(log_n)
+        keys: list[bytes] = []
+        for i in range(log_n):
+            for g, blob in enumerate(blobs):
+                if len(blob) != log_n * kl:
+                    raise ValueError(f"fss: gate {g} blob length != {log_n * kl}")
+                keys.append(blob[i * kl : (i + 1) * kl])
+        return cls(log_n, KeyBatch.from_bytes(keys, log_n))
+
+
+@dataclass
+class IntervalKeyBatch:
+    """One party's share of G interval gates ``1{lo_g <= x <= hi_g}``:
+    two comparison gate sets plus a public per-gate constant (non-zero only
+    on party A, only for the ``hi == 2^n - 1`` edge)."""
+
+    upper: CmpKeyBatch  # lt_{hi+1}
+    lower: CmpKeyBatch  # lt_{lo}
+    const: np.ndarray  # uint8 [G]
+
+
+def _rand_points(rng: np.random.Generator, shape, log_n: int) -> np.ndarray:
+    raw = rng.integers(0, 1 << 32, size=shape + (2,), dtype=np.uint64)
+    v = (raw[..., 0] << np.uint64(32)) | raw[..., 1]
+    return v & ((np.uint64(1) << np.uint64(log_n)) - np.uint64(1))
+
+
+def gen_lt_batch(
+    alphas: np.ndarray | list[int],
+    log_n: int,
+    rng: np.random.Generator | None = None,
+) -> tuple[CmpKeyBatch, CmpKeyBatch]:
+    """Generate G comparison gate pairs for ``1{x < alpha}``.
+
+    Host-side trusted-dealer step; one vectorized ``gen_batch`` over all
+    ``log_n * G`` level-DPFs."""
+    alphas = np.asarray(alphas, dtype=np.uint64)
+    if log_n < 1 or log_n > 63:
+        raise ValueError("fss: log_n out of range")
+    if (alphas >> np.uint64(log_n)).any():
+        raise ValueError("fss: alpha out of domain")
+    G = alphas.shape[0]
+    n = log_n
+    point_rng = rng if rng is not None else np.random.default_rng()
+
+    shifts = (n - 1 - np.arange(n, dtype=np.uint64))[:, None]  # [n, 1]
+    pref = alphas[None, :] >> shifts  # top i+1 bits of alpha
+    active = (pref & np.uint64(1)).astype(bool)  # bit i of alpha
+    points = (pref & ~np.uint64(1)) << shifts  # (top-i bits || 0) << shift
+    points = np.where(active, points, _rand_points(point_rng, (n, G), n))
+
+    ka, kb = gen_batch(points.reshape(n * G), n, rng=rng)
+    # Zero-share inactive levels: party B gets party A's key verbatim.
+    idx = np.flatnonzero(~active.reshape(n * G))
+    for f in ("seeds", "ts", "scw", "tcw", "fcw"):
+        getattr(kb, f)[idx] = getattr(ka, f)[idx]
+    return CmpKeyBatch(n, ka), CmpKeyBatch(n, kb)
+
+
+def _masked_prefix_queries(xs: np.ndarray, log_n: int) -> np.ndarray:
+    """uint64[G, Q] -> uint64[n * G, Q]: per level, x with its low
+    ``n - 1 - i`` bits zeroed (the level-i prefix, shifted back up)."""
+    n = log_n
+    shifts = (n - 1 - np.arange(n, dtype=np.uint64))[:, None, None]
+    return ((xs[None, :, :] >> shifts) << shifts).reshape(n * xs.shape[0], -1)
+
+
+def eval_lt_points(ck: CmpKeyBatch, xs: np.ndarray) -> np.ndarray:
+    """Evaluate comparison shares at xs uint64[G, Q] -> uint8[G, Q].
+
+    One bitsliced device launch over all ``n * G`` level-DPFs; the level
+    XOR-reduction collapses the unique matching level into the predicate."""
+    xs = np.asarray(xs, dtype=np.uint64)
+    if xs.ndim != 2 or xs.shape[0] != ck.g:
+        raise ValueError("fss: xs must be [G, Q]")
+    bits = eval_points(ck.levels, _masked_prefix_queries(xs, ck.log_n))
+    return np.bitwise_xor.reduce(bits.reshape(ck.log_n, ck.g, -1), axis=0)
+
+
+def gen_interval_batch(
+    lo: np.ndarray | list[int],
+    hi: np.ndarray | list[int],
+    log_n: int,
+    rng: np.random.Generator | None = None,
+) -> tuple[IntervalKeyBatch, IntervalKeyBatch]:
+    """Generate G interval gate pairs for ``1{lo <= x <= hi}`` (inclusive).
+
+    ``1{lo <= x <= hi} = 1{x < hi+1} ^ 1{x < lo}``; the ``hi = 2^n - 1``
+    edge (where hi+1 leaves the domain) becomes an always-0 gate plus a
+    public constant 1 on party A."""
+    lo = np.asarray(lo, dtype=np.uint64)
+    hi = np.asarray(hi, dtype=np.uint64)
+    if lo.shape != hi.shape or lo.ndim != 1:
+        raise ValueError("fss: lo/hi must be 1-D and equal length")
+    if (lo > hi).any():
+        raise ValueError("fss: lo > hi")
+    top = (np.uint64(1) << np.uint64(log_n)) - np.uint64(1)
+    if (hi > top).any():
+        raise ValueError("fss: hi out of domain")
+    wrap = hi == top
+    # alpha = 0 has no set bits -> every level inactive -> lt_0 == 0 shares.
+    upper_alpha = np.where(wrap, np.uint64(0), hi + np.uint64(1))
+    ua, ub = gen_lt_batch(upper_alpha, log_n, rng=rng)
+    la, lb = gen_lt_batch(lo, log_n, rng=rng)
+    const_a = wrap.astype(np.uint8)
+    const_b = np.zeros_like(const_a)
+    return IntervalKeyBatch(ua, la, const_a), IntervalKeyBatch(ub, lb, const_b)
+
+
+def eval_interval_points(ik: IntervalKeyBatch, xs: np.ndarray) -> np.ndarray:
+    """Evaluate interval shares at xs uint64[G, Q] -> uint8[G, Q].
+
+    Both comparison gate sets fuse into a single device launch (one
+    ``KeyBatch`` of ``2 * n * G`` keys)."""
+    xs = np.asarray(xs, dtype=np.uint64)
+    G, n = ik.upper.g, ik.upper.log_n
+    if xs.ndim != 2 or xs.shape[0] != G:
+        raise ValueError("fss: xs must be [G, Q]")
+    u, lo = ik.upper.levels, ik.lower.levels
+    both = KeyBatch(
+        n,
+        np.concatenate([u.seeds, lo.seeds]),
+        np.concatenate([u.ts, lo.ts]),
+        np.concatenate([u.scw, lo.scw]),
+        np.concatenate([u.tcw, lo.tcw]),
+        np.concatenate([u.fcw, lo.fcw]),
+    )
+    q = _masked_prefix_queries(xs, n)  # [n*G, Q]
+    bits = eval_points(both, np.concatenate([q, q]))
+    bits = bits.reshape(2, n, G, -1)
+    out = np.bitwise_xor.reduce(bits, axis=(0, 1))
+    return out ^ ik.const[:, None]
+
+
+# ---------------------------------------------------------------------------
+# Full-domain comparison from a single ordinary DPF
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _prefix_xor_words(w: jax.Array) -> jax.Array:
+    """Bitwise prefix-XOR over uint32[K, M] in ascending LSB-first bit
+    order: output bit j = XOR of input bits 0..j (per key)."""
+    for sh in (1, 2, 4, 8, 16):
+        w = w ^ (w << sh)
+    par = (w >> 31) & jnp.uint32(1)  # full parity of each word
+    carry = jax.lax.associative_scan(jnp.bitwise_xor, par, axis=1) ^ par
+    return w ^ (jnp.uint32(0) - carry)  # complement words with odd carry-in
+
+
+def ge_full_from_dpf(kb: KeyBatch) -> np.ndarray:
+    """Full-domain comparison table from plain DPF keys: for a key pair on
+    point alpha, the two parties' outputs XOR to the bit-packed indicator
+    ``1{x >= alpha}`` over the whole domain (``1{x < alpha}`` is its public
+    complement).
+
+    Uses the identity XOR_{y <= x} DPF_alpha(y) = 1{x >= alpha}: expand the
+    key with the level-synchronous evaluator, then run one carry-less
+    prefix-XOR scan over the packed leaf words on device.  -> uint8[K,
+    2^(log_n-3)] (16 bytes per key when log_n < 7), same packing as
+    ``eval_full`` (bit x at byte x//8, bit x%8; reference dpf/dpf.go:207).
+    """
+    dk = DeviceKeys(kb)
+    words = eval_full_device(dk)  # [Kpad, W, 4] uint32, ascending bit order
+    scanned = _prefix_xor_words(words.reshape(words.shape[0], -1))
+    out = np.ascontiguousarray(np.asarray(scanned)[: kb.k])
+    return out.view("<u1").reshape(kb.k, -1)

@@ -1,0 +1,153 @@
+"""Pallas TPU kernels for the bitsliced fixed-key AES-MMO hot ops.
+
+The XLA path (``aes_bitslice``) expresses the cipher as one fused elementwise
+DAG; these kernels pin the same circuit into explicit VMEM tiles so the whole
+PRG double-expansion (both fixed-key AES-MMO calls — the reference's two
+``aes128MMO`` invocations per GGM node, dpf/aes_amd64.s:51-82 via
+dpf/dpf.go:59-69) runs as ONE kernel per batch tile: the state planes are
+read from HBM once, ~230 S-box circuit temporaries live entirely in
+VMEM/registers, and both children are written back once.  Leaf conversion
+(single MMO, reference dpf/dpf.go:54-57) gets the same treatment.
+
+Layout matches ``aes_bitslice``: state ``uint32[128, B]``, planes on the
+sublane axis, packed batch words on the lane axis.  The cipher's plane
+wiring (ShiftRows, MixColumns/xtime) is re-expressed with *static* slicing
+and concatenation — Pallas kernels cannot capture array constants, and
+static wiring lowers to sublane moves instead of gathers.  Round keys enter
+as a kernel operand.
+
+Off-TPU the kernels run in interpreter mode so the full differential test
+suite exercises them on CPU CI; ``available()`` reports whether the real
+Mosaic path is in use.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ..core import aes_np
+from .aes_bitslice import (
+    RK_MASKS_L,
+    RK_MASKS_R,
+    _sub_bytes,
+    aes128_mmo_planes,
+    prg_planes,
+)
+
+# Lane tile: 8 * 128 lanes keeps the live [128, BT] uint32 temporaries a few
+# MB, comfortably inside a v5e core's 16 MB VMEM.
+_BT = 1024
+# Minimum batch (in lane words) worth a kernel launch; below this the XLA
+# path is used (levels near the tree root / tiny key batches).
+_MIN_B = 128
+
+# Both fixed-key round-key mask sets as one operand: uint32[2, 11, 128].
+_RK_BOTH = np.stack([RK_MASKS_L, RK_MASKS_R])
+
+_SHIFT_PERM = [int(p) for p in aes_np.SHIFT_ROWS_PERM]  # 16 static byte moves
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def available() -> bool:
+    """True when the Mosaic (non-interpreted) kernels will run."""
+    return _on_tpu()
+
+
+# ---------------------------------------------------------------------------
+# Constant-free circuit helpers (kernel-traceable)
+# ---------------------------------------------------------------------------
+
+
+def _shift_rows_k(S):
+    s = S.reshape(16, 8, -1)
+    return jnp.concatenate([s[p : p + 1] for p in _SHIFT_PERM]).reshape(128, -1)
+
+
+def _xtime_k(a):
+    """GF(2^8) doubling on [..., 8, B] bit axis, static wiring only.
+
+    out0 = a7; out1 = a0^a7; out2 = a1; out3 = a2^a7; out4 = a3^a7;
+    out5..7 = a4..6  (reduction polynomial 0x11B)."""
+    a0, a1, a2, a3, a4, a5, a6, a7 = (a[..., i, :] for i in range(8))
+    return jnp.stack(
+        [a7, a0 ^ a7, a1, a2 ^ a7, a3 ^ a7, a4, a5, a6], axis=-2
+    )
+
+
+def _mix_columns_k(S):
+    s = S.reshape(4, 4, 8, -1)  # [column, row, bit, B]
+    r1 = jnp.concatenate([s[:, 1:], s[:, :1]], axis=1)
+    r2 = jnp.concatenate([s[:, 2:], s[:, :2]], axis=1)
+    r3 = jnp.concatenate([s[:, 3:], s[:, :3]], axis=1)
+    out = _xtime_k(s) ^ _xtime_k(r1) ^ r1 ^ r2 ^ r3
+    return out.reshape(128, -1)
+
+
+def _encrypt_k(S, rk):
+    """AES-128 on [128, B] with round keys rk uint32[11, 128].
+
+    SubBytes is shared with the XLA path (``aes_bitslice._sub_bytes`` — no
+    array constants); only the plane-wiring steps are re-expressed."""
+    S = S ^ rk[0][:, None]
+    for rnd in range(1, 10):
+        S = _mix_columns_k(_shift_rows_k(_sub_bytes(S))) ^ rk[rnd][:, None]
+    return _shift_rows_k(_sub_bytes(S)) ^ rk[10][:, None]
+
+
+def _prg_kernel(s_ref, rk_ref, l_ref, r_ref):
+    S = s_ref[:]
+    rk = rk_ref[:]
+    l_ref[:] = _encrypt_k(S, rk[0]) ^ S
+    r_ref[:] = _encrypt_k(S, rk[1]) ^ S
+
+
+def _mmo_kernel(s_ref, rk_ref, o_ref):
+    S = s_ref[:]
+    o_ref[:] = _encrypt_k(S, rk_ref[0]) ^ S
+
+
+# ---------------------------------------------------------------------------
+# pallas_call wrappers
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _tiled_call(S, kernel, n_out):
+    B = S.shape[1]
+    bt = _BT if B % _BT == 0 else _MIN_B
+    spec = pl.BlockSpec((128, bt), lambda i: (0, i))
+    rk_spec = pl.BlockSpec((2, 11, 128), lambda i: (0, 0, 0))
+    shapes = [jax.ShapeDtypeStruct((128, B), jnp.uint32)] * n_out
+    return pl.pallas_call(
+        kernel,
+        grid=(B // bt,),
+        in_specs=[spec, rk_spec],
+        out_specs=[spec] * n_out if n_out > 1 else spec,
+        out_shape=shapes if n_out > 1 else shapes[0],
+        interpret=not _on_tpu(),
+    )(S, jnp.asarray(_RK_BOTH))
+
+
+def prg_planes_pallas(S: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Fused double-MMO PRG on planes uint32[128, B] -> (L, R).
+
+    Falls back to the XLA expression when B is not tileable."""
+    if S.shape[1] % _MIN_B:
+        return prg_planes(S)
+    L, R = _tiled_call(S, _prg_kernel, 2)
+    return L, R
+
+
+def mmo_planes_pallas(S: jax.Array) -> jax.Array:
+    """Leaf-convert MMO (fixed key L) on planes uint32[128, B]."""
+    if S.shape[1] % _MIN_B:
+        return aes128_mmo_planes(S, RK_MASKS_L)
+    return _tiled_call(S, _mmo_kernel, 1)

@@ -1,0 +1,73 @@
+"""Structured timing and tracing for the accelerated DPF pipeline.
+
+The reference's observability is a pprof CPU profile flag plus one wall-time
+print (dpf_main.go:13,17-24,30).  The TPU-native equivalents here:
+
+- ``PhaseTimer`` — named wall-clock phases (key packing, H2D, compile,
+  kernel, D2H) so end-to-end numbers stay honest about where time goes
+  versus kernel-only throughput (SURVEY §5.5).
+- ``trace`` — context manager around ``jax.profiler`` emitting an XProf
+  trace directory for op-level TPU analysis (SURVEY §5.1, the analogue of
+  the reference's ``-cpuprofile``).
+- ``leaves_per_sec`` — the BASELINE.json headline metric helper.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PhaseTimer:
+    """Accumulates named phase durations; one instance per measured run."""
+
+    phases: dict[str, float] = field(default_factory=dict)
+    counts: dict[str, int] = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.phases[name] = self.phases.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def total(self) -> float:
+        return sum(self.phases.values())
+
+    def report(self) -> str:
+        """Fixed-width per-phase breakdown with shares of total."""
+        tot = self.total() or 1.0
+        lines = [
+            f"  {name:<16} {dt * 1e3:10.2f} ms  {dt / tot * 100:5.1f}%"
+            f"  (x{self.counts[name]})"
+            for name, dt in sorted(self.phases.items(), key=lambda kv: -kv[1])
+        ]
+        lines.append(f"  {'total':<16} {tot * 1e3:10.2f} ms")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None):
+    """XProf trace around a code region when ``log_dir`` is set; no-op
+    otherwise.  View with xprof/tensorboard on the emitted directory."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def leaves_per_sec(n_keys: int, log_n: int, seconds: float) -> float:
+    """The BASELINE.json throughput metric: domain leaves produced per
+    second across the key batch."""
+    return n_keys * float(1 << log_n) / seconds

@@ -1,0 +1,137 @@
+"""Micro-benchmark of the AES-MMO PRG kernel variants on the live device.
+
+Compares, on uint32[128, B] plane state:
+  xla       — current aes_bitslice.prg_planes (byte-major plane order)
+  pallas    — ops/aes_pallas.py Mosaic kernel (same plane order)
+  bitmajor  — XLA path with planes reordered bit-major (p = 16*bit + byte)
+              so the S-box slices 16 contiguous sublanes instead of
+              stride-8 rows (relayout hypothesis)
+
+Usage: python scripts/bench_kernels.py [B_log2=17]
+Prints AES-MMO blocks/sec per variant (1 PRG = 2 MMO over 32*B blocks).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from dpf_tpu.core import aes_np
+from dpf_tpu.ops import aes_pallas
+from dpf_tpu.ops.aes_bitslice import RK_MASKS_L, RK_MASKS_R, prg_planes
+from dpf_tpu.ops.sbox_circuit import sbox_bp113
+
+# ---------------------------------------------------------------------------
+# Bit-major variant: plane p = 16 * bit + byte_pos
+# ---------------------------------------------------------------------------
+
+_PERM_TO_BM = np.argsort(
+    np.array([8 * (p % 16) + (p // 16) for p in range(128)])
+)  # canonical -> bit-major
+_SHIFT_PERM = [int(p) for p in aes_np.SHIFT_ROWS_PERM]
+
+
+def _rk_bm(masks):
+    return jnp.asarray(np.asarray(masks)[:, _PERM_TO_BM])
+
+
+RK_L_BM = _rk_bm(RK_MASKS_L)
+RK_R_BM = _rk_bm(RK_MASKS_R)
+
+
+def _sub_bytes_bm(S):
+    s = S.reshape(8, 16, -1)
+    x = [s[7 - i] for i in range(8)]
+    y = sbox_bp113(x)
+    return jnp.stack(y[::-1]).reshape(128, -1)
+
+
+def _shift_rows_bm(S):
+    s = S.reshape(8, 16, -1)
+    return jnp.stack(
+        [jnp.concatenate([s[:, p : p + 1] for p in _SHIFT_PERM], axis=1)],
+    ).reshape(128, -1)
+
+
+def _xtime_bm(a):  # [8, 16, B]
+    a0, a1, a2, a3, a4, a5, a6, a7 = (a[i] for i in range(8))
+    return jnp.stack([a7, a0 ^ a7, a1, a2 ^ a7, a3 ^ a7, a4, a5, a6])
+
+
+def _mix_columns_bm(S):
+    s = S.reshape(8, 4, 4, -1)  # [bit, col, row, B]
+    r1 = jnp.concatenate([s[:, :, 1:], s[:, :, :1]], axis=2)
+    r2 = jnp.concatenate([s[:, :, 2:], s[:, :, :2]], axis=2)
+    r3 = jnp.concatenate([s[:, :, 3:], s[:, :, :3]], axis=2)
+    out = (
+        _xtime_bm(s.reshape(8, 16, -1)).reshape(s.shape)
+        ^ _xtime_bm(r1.reshape(8, 16, -1)).reshape(s.shape)
+        ^ r1 ^ r2 ^ r3
+    )
+    return out.reshape(128, -1)
+
+
+def _encrypt_bm(S, rk):
+    S = S ^ rk[0][:, None]
+    for rnd in range(1, 10):
+        S = _mix_columns_bm(_shift_rows_bm(_sub_bytes_bm(S))) ^ rk[rnd][:, None]
+    return _shift_rows_bm(_sub_bytes_bm(S)) ^ rk[10][:, None]
+
+
+@jax.jit
+def prg_bm(S):
+    return _encrypt_bm(S, RK_L_BM) ^ S, _encrypt_bm(S, RK_R_BM) ^ S
+
+
+# ---------------------------------------------------------------------------
+
+
+def timeit(fn, S, reps=10):
+    out = jax.block_until_ready(fn(S))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(S)
+        jax.tree_util.tree_map(lambda x: x.block_until_ready(), out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    blog = int(sys.argv[1]) if len(sys.argv) > 1 else 17
+    B = 1 << blog
+    rng = np.random.default_rng(0)
+    S = jnp.asarray(rng.integers(0, 1 << 32, size=(128, B), dtype=np.uint32))
+    blocks = 32 * B * 2  # 2 MMO per PRG
+    print(f"device={jax.devices()[0].platform}, B=2^{blog} lane words, "
+          f"{32 * B} blocks/call")
+
+    jitted_xla = jax.jit(prg_planes)
+    t = timeit(jitted_xla, S)
+    print(f"xla      {blocks / t / 1e9:8.2f} GMMO-blocks/s  ({t * 1e3:.2f} ms)")
+
+    # correctness of bit-major vs canonical
+    Sbm = S[jnp.asarray(_PERM_TO_BM)]
+    l0, r0 = jitted_xla(S)
+    l1, r1 = prg_bm(Sbm)
+    inv = np.argsort(_PERM_TO_BM)
+    np.testing.assert_array_equal(np.asarray(l1)[inv], np.asarray(l0))
+    np.testing.assert_array_equal(np.asarray(r1)[inv], np.asarray(r0))
+    t = timeit(prg_bm, Sbm)
+    print(f"bitmajor {blocks / t / 1e9:8.2f} GMMO-blocks/s  ({t * 1e3:.2f} ms)")
+
+    l2, r2 = aes_pallas.prg_planes_pallas(S)
+    np.testing.assert_array_equal(np.asarray(l2), np.asarray(l0))
+    jitted_pl = jax.jit(aes_pallas.prg_planes_pallas)
+    t = timeit(jitted_pl, S)
+    print(f"pallas   {blocks / t / 1e9:8.2f} GMMO-blocks/s  ({t * 1e3:.2f} ms)")
+
+
+if __name__ == "__main__":
+    main()

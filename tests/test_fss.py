@@ -1,0 +1,136 @@
+"""FSS comparison / interval gates: exhaustive small-domain reconstruction,
+large-domain (n=32) spot checks, serialization, and the full-domain
+prefix-scan comparison — all against brute-force predicates."""
+
+import numpy as np
+import pytest
+
+from dpf_tpu.core.keys import gen_batch
+from dpf_tpu.models.fss import (
+    CmpKeyBatch,
+    eval_interval_points,
+    eval_lt_points,
+    ge_full_from_dpf,
+    gen_interval_batch,
+    gen_lt_batch,
+)
+
+
+def test_lt_exhaustive_small_domain():
+    # Every x in [0, 2^6) against gates at assorted alphas, incl. 0 and max.
+    log_n, G = 6, 6
+    rng = np.random.default_rng(1)
+    alphas = np.array([0, 1, 31, 37, 63, 22], dtype=np.uint64)
+    ca, cb = gen_lt_batch(alphas, log_n, rng=rng)
+    xs = np.broadcast_to(np.arange(64, dtype=np.uint64), (G, 64)).copy()
+    got = eval_lt_points(ca, xs) ^ eval_lt_points(cb, xs)
+    want = (xs < alphas[:, None]).astype(np.uint8)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_lt_exhaustive_above_leaf_domain():
+    # log_n > 7 exercises tree levels inside each level-DPF.
+    log_n, G = 9, 4
+    rng = np.random.default_rng(2)
+    alphas = rng.integers(0, 1 << log_n, size=G, dtype=np.uint64)
+    ca, cb = gen_lt_batch(alphas, log_n, rng=rng)
+    xs = np.broadcast_to(
+        np.arange(1 << log_n, dtype=np.uint64), (G, 1 << log_n)
+    ).copy()
+    got = eval_lt_points(ca, xs) ^ eval_lt_points(cb, xs)
+    want = (xs < alphas[:, None]).astype(np.uint8)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_lt_n32_boundaries():
+    # Config-5 shape (n=32), checked at adversarial points around alpha.
+    log_n, G = 32, 3
+    rng = np.random.default_rng(3)
+    alphas = np.array(
+        [0x00000000, 0x80000001, 0xFFFFFFFF], dtype=np.uint64
+    )
+    ca, cb = gen_lt_batch(alphas, log_n, rng=rng)
+    probes = []
+    for a in alphas:
+        a = int(a)
+        pts = [0, 1, a, (a - 1) % (1 << 32), (a + 1) % (1 << 32), (1 << 32) - 1]
+        pts += [int(v) for v in rng.integers(0, 1 << 32, size=26, dtype=np.uint64)]
+        probes.append(pts)
+    xs = np.array(probes, dtype=np.uint64)
+    got = eval_lt_points(ca, xs) ^ eval_lt_points(cb, xs)
+    want = (xs < alphas[:, None]).astype(np.uint8)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_lt_single_party_share_is_not_predicate():
+    # Shares alone must not equal the predicate (sanity, not a proof).
+    log_n, G = 8, 2
+    rng = np.random.default_rng(4)
+    alphas = np.array([100, 200], dtype=np.uint64)
+    ca, _ = gen_lt_batch(alphas, log_n, rng=rng)
+    xs = np.broadcast_to(np.arange(256, dtype=np.uint64), (G, 256)).copy()
+    share = eval_lt_points(ca, xs)
+    want = (xs < alphas[:, None]).astype(np.uint8)
+    assert (share != want).any()
+
+
+def test_cmp_serialization_roundtrip():
+    log_n, G = 10, 5
+    rng = np.random.default_rng(5)
+    alphas = rng.integers(0, 1 << log_n, size=G, dtype=np.uint64)
+    ca, cb = gen_lt_batch(alphas, log_n, rng=rng)
+    blobs = ca.to_bytes()
+    assert len(blobs) == G
+    ca2 = CmpKeyBatch.from_bytes(blobs, log_n)
+    xs = rng.integers(0, 1 << log_n, size=(G, 32), dtype=np.uint64)
+    np.testing.assert_array_equal(eval_lt_points(ca, xs), eval_lt_points(ca2, xs))
+    got = eval_lt_points(ca2, xs) ^ eval_lt_points(cb, xs)
+    np.testing.assert_array_equal(got, (xs < alphas[:, None]).astype(np.uint8))
+
+
+def test_interval_exhaustive():
+    log_n = 8
+    rng = np.random.default_rng(6)
+    # Edges: full domain, single point, hi = max (wrap const), lo = 0.
+    lo = np.array([0, 77, 13, 0, 200], dtype=np.uint64)
+    hi = np.array([255, 77, 200, 10, 255], dtype=np.uint64)
+    ia, ib = gen_interval_batch(lo, hi, log_n, rng=rng)
+    G = lo.shape[0]
+    xs = np.broadcast_to(np.arange(256, dtype=np.uint64), (G, 256)).copy()
+    got = eval_interval_points(ia, xs) ^ eval_interval_points(ib, xs)
+    want = ((xs >= lo[:, None]) & (xs <= hi[:, None])).astype(np.uint8)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_interval_rejects_bad_bounds():
+    with pytest.raises(ValueError):
+        gen_interval_batch([5], [4], 8)
+    with pytest.raises(ValueError):
+        gen_interval_batch([0], [256], 8)
+
+
+def test_ge_full_from_dpf():
+    log_n, K = 9, 8
+    rng = np.random.default_rng(7)
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, kb = gen_batch(alphas, log_n, rng=rng)
+    rec = ge_full_from_dpf(ka) ^ ge_full_from_dpf(kb)
+    bits = np.unpackbits(rec, axis=1, bitorder="little")
+    want = (
+        np.arange(1 << log_n, dtype=np.uint64)[None, :] >= alphas[:, None]
+    ).astype(np.uint8)
+    np.testing.assert_array_equal(bits, want)
+
+
+def test_ge_full_small_domain():
+    # log_n < 7: single 16-byte leaf block path.
+    log_n, K = 5, 4
+    rng = np.random.default_rng(8)
+    alphas = np.array([0, 7, 19, 31], dtype=np.uint64)
+    ka, kb = gen_batch(alphas, log_n, rng=rng)
+    rec = ge_full_from_dpf(ka) ^ ge_full_from_dpf(kb)
+    bits = np.unpackbits(rec, axis=1, bitorder="little")[:, : 1 << log_n]
+    want = (
+        np.arange(1 << log_n, dtype=np.uint64)[None, :] >= alphas[:, None]
+    ).astype(np.uint8)
+    np.testing.assert_array_equal(bits, want)
