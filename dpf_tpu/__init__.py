@@ -22,6 +22,9 @@ FSS gates layered on DPFs (``dpf_tpu.models.fss``):
 
     ca, cb = fss.gen_lt_batch(alphas, log_n)          # 1{x < alpha} shares
     ia, ib = fss.gen_interval_batch(lo, hi, log_n)    # 1{lo <= x <= hi}
+
+TPU-native fast profile (``dpf_tpu.fast``): same API over a ChaCha12 PRG
+with 512-bit leaves — not reference-key-compatible, ~20x faster on TPU.
 """
 
 from __future__ import annotations
@@ -42,6 +45,7 @@ __all__ = [
     "eval_points_batch",
     "key_len",
     "fss",
+    "fast",
 ]
 
 
@@ -50,6 +54,10 @@ def __getattr__(name):
         from .models import fss as _fss
 
         return _fss
+    if name == "fast":
+        from . import fast as _fast
+
+        return _fast
     raise AttributeError(f"module 'dpf_tpu' has no attribute {name!r}")
 
 

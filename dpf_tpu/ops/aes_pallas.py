@@ -39,9 +39,11 @@ from .aes_bitslice import (
     prg_planes,
 )
 
-# Lane tile: 8 * 128 lanes keeps the live [128, BT] uint32 temporaries a few
-# MB, comfortably inside a v5e core's 16 MB VMEM.
-_BT = 1024
+# Lane tile: 2 * 128 lanes keeps the kernel's scoped VMEM (inputs + both
+# outputs + live S-box temporaries) under a v5e core's 16 MB limit
+# (1024 lanes -> 18.75 MB scoped, OOM) and measured fastest in the
+# scripts/sweep_bt.py sweep (256 > 512 > 128 on v5e).
+_BT = 256
 # Minimum batch (in lane words) worth a kernel launch; below this the XLA
 # path is used (levels near the tree root / tiny key batches).
 _MIN_B = 128

@@ -5,6 +5,7 @@ from .sharding import (
     KEYS_AXIS,
     LEAF_AXIS,
     eval_full_sharded,
+    eval_full_sharded_fast,
     make_mesh,
     xor_allreduce,
 )
@@ -13,6 +14,7 @@ __all__ = [
     "KEYS_AXIS",
     "LEAF_AXIS",
     "eval_full_sharded",
+    "eval_full_sharded_fast",
     "make_mesh",
     "xor_allreduce",
 ]

@@ -154,3 +154,82 @@ def eval_full_sharded(kb: KeyBatch, mesh: Mesh) -> np.ndarray:
         )
     )
     return np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)
+
+
+# ---------------------------------------------------------------------------
+# Sharded evaluation — ChaCha fast profile
+# ---------------------------------------------------------------------------
+
+
+@cache
+def _sharded_eval_full_fast(mesh: Mesh, nu: int, subtree_levels: int):
+    """Sharded fast-profile evaluator for a (mesh, domain) bucket.
+
+    The fast profile's state is word-oriented ([K, W] uint32 per seed word,
+    models/dpf_chacha.py), so the key batch shards on axis 0 and the leaf
+    axis slices each key's subtree on the node axis — same zero-comms
+    decomposition as the bit-plane path."""
+    from ..models.dpf_chacha import _convert_leaves_cc, _level_step_cc
+
+    c = subtree_levels
+
+    def body(seeds, ts, scw, tcw, fcw):
+        S = [seeds[:, i : i + 1] for i in range(4)]
+        T = ts[:, None]
+
+        def step(i, S, T):
+            return _level_step_cc(
+                S, T,
+                [scw[:, i, w] for w in range(4)],
+                tcw[:, i, 0], tcw[:, i, 1],
+            )
+
+        for i in range(c):
+            S, T = step(i, S, T)
+        if c:
+            j = jax.lax.axis_index(LEAF_AXIS)
+            S = [jax.lax.dynamic_slice_in_dim(s, j, 1, axis=1) for s in S]
+            T = jax.lax.dynamic_slice_in_dim(T, j, 1, axis=1)
+        for i in range(c, nu):
+            S, T = step(i, S, T)
+        return _convert_leaves_cc(S, T, [fcw[:, j] for j in range(16)])
+
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(KEYS_AXIS, None),
+            P(KEYS_AXIS),
+            P(KEYS_AXIS, None, None),
+            P(KEYS_AXIS, None, None),
+            P(KEYS_AXIS, None),
+        ),
+        out_specs=P(KEYS_AXIS, LEAF_AXIS, None),
+    )
+    return jax.jit(sharded)
+
+
+def eval_full_sharded_fast(kb, mesh: Mesh) -> np.ndarray:
+    """Sharded full-domain evaluation of a fast-profile key batch ->
+    uint8[K, out_bytes] (out_bytes = 2^(log_n-3), minimum 64).
+
+    ``kb`` is a :class:`~dpf_tpu.models.keys_chacha.KeyBatchFast`; the key
+    batch is zero-padded to a multiple of the ``keys`` axis."""
+    n_keys = mesh.shape[KEYS_AXIS]
+    c = leaf_axis_levels(mesh, kb.nu, kb.log_n)
+    pad = (-kb.k) % n_keys
+
+    def padk(a):
+        return np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+
+    fn = _sharded_eval_full_fast(mesh, kb.nu, c)
+    words = np.asarray(
+        fn(
+            jnp.asarray(padk(kb.seeds)),
+            jnp.asarray(padk(kb.ts).astype(np.uint32)),
+            jnp.asarray(padk(kb.scw)),
+            jnp.asarray(padk(kb.tcw).astype(np.uint32)),
+            jnp.asarray(padk(kb.fcw)),
+        )
+    )
+    return np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)

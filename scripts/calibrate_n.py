@@ -1,0 +1,44 @@
+"""Marginal-cost calibration: time vs chain length N in one session."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def make(n):
+    @jax.jit
+    def f(S):
+        a = S
+        for _ in range(n):
+            a = a ^ (a << 1) ^ (a >> 3)
+        return jnp.bitwise_xor.reduce(a, axis=None)
+
+    return f
+
+
+def main():
+    B = 1 << 17
+    rng = np.random.default_rng(0)
+    S = jnp.asarray(rng.integers(0, 1 << 32, size=(128, B), dtype=np.uint32))
+    for n in (16, 64, 256, 1024):
+        f = make(n)
+        np.asarray(f(S))
+        ts = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            np.asarray(f(S))
+            ts.append(time.perf_counter() - t0)
+        ts = np.array(ts) * 1e3
+        print(
+            f"N={n:5d}  min={ts.min():8.2f} ms  med={np.median(ts):8.2f} ms "
+            f" all={[round(t,1) for t in ts]}"
+        )
+
+
+if __name__ == "__main__":
+    main()

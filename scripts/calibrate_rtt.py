@@ -1,0 +1,62 @@
+"""Separate tunnel dispatch overhead from true device compute.
+
+1. RTT floor: trivial scalar jit call, fetched.
+2. Marginal cost per PRG (xla vs pallas): R serially-chained PRG calls
+   inside one jit; slope over R = true per-call device time."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from dpf_tpu.ops import aes_pallas
+from dpf_tpu.ops.aes_bitslice import prg_planes
+
+
+def bench(f, arg, reps=8):
+    np.asarray(f(arg))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(f(arg))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts)) * 1e3
+
+
+def chained(prg, r):
+    @jax.jit
+    def f(S):
+        a = S
+        for _ in range(r):
+            L, R = prg(a)
+            a = L ^ R  # serial dependence
+        return jnp.bitwise_xor.reduce(a, axis=None)
+
+    return f
+
+
+def main():
+    x = jnp.float32(1.0)
+    triv = jax.jit(lambda v: v + 1)
+    print(f"RTT floor (scalar jit): {bench(triv, x):.2f} ms")
+
+    B = 1 << 17
+    rng = np.random.default_rng(0)
+    S = jnp.asarray(rng.integers(0, 1 << 32, size=(128, B), dtype=np.uint32))
+    blocks = 32 * B * 2
+    for name, prg in (("xla", prg_planes), ("pallas", aes_pallas.prg_planes_pallas)):
+        t1 = bench(chained(prg, 1), S)
+        t5 = bench(chained(prg, 5), S)
+        per = (t5 - t1) / 4
+        print(
+            f"{name:7s} 1-call={t1:7.2f} ms  5-call={t5:7.2f} ms  "
+            f"marginal={per:7.2f} ms/PRG  -> {blocks / per / 1e6:7.2f} GMMO-blocks/s"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Map VPU efficiency vs leading-dim shape for serial bitwise chains, and
+measure whether reshaping [16, B] work into [128, B/8] recovers peak."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+N = 256  # serial iterations, 3 ops each
+
+
+def time_call(build, S, reps=5):
+    @jax.jit
+    def summed(S):
+        return jnp.bitwise_xor.reduce(build(S), axis=None)
+
+    np.asarray(summed(S))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(summed(S))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def chain(a):
+    for _ in range(N):
+        a = a ^ (a << 1) ^ (a >> 3)
+    return a
+
+
+def chain_reshaped(S):  # [16, B] -> do the work as [128, B/8]
+    a = S.reshape(128, -1)
+    return chain(a).reshape(S.shape)
+
+
+def chain_8ary(S):  # [16, B]: 8 independent interleaved chains like the sbox
+    xs = [S ^ jnp.uint32(i) for i in range(8)]
+    for _ in range(N // 8):
+        # emulate sbox-ish mixing: pairwise gates across the 8 wires
+        for i in range(8):
+            xs[i] = xs[i] ^ (xs[(i + 1) % 8] & xs[(i + 3) % 8])
+    out = xs[0]
+    for x in xs[1:]:
+        out = out ^ x
+    return out
+
+
+def main():
+    total_elems = 128 * (1 << 17)  # constant work across shapes
+    rng = np.random.default_rng(0)
+    for rows in (8, 16, 32, 64, 128, 256):
+        cols = total_elems // rows
+        S = jnp.asarray(rng.integers(0, 1 << 32, size=(rows, cols), dtype=np.uint32))
+        vr = 3 * N * total_elems // 1024
+        t = time_call(chain, S)
+        print(f"chain   [{rows:3d},{cols:7d}]  {vr / t / 1e9:7.2f} Gvrops/s ({t*1e3:7.2f} ms)")
+
+    B = 1 << 17
+    S = jnp.asarray(rng.integers(0, 1 << 32, size=(16, B), dtype=np.uint32))
+    vr = 3 * N * 16 * B // 1024
+    t = time_call(chain, S)
+    print(f"16-wide plain     {vr / t / 1e9:7.2f} Gvrops/s ({t*1e3:7.2f} ms)")
+    t = time_call(chain_reshaped, S)
+    print(f"16-wide reshaped  {vr / t / 1e9:7.2f} Gvrops/s ({t*1e3:7.2f} ms)")
+    vr8 = (N // 8) * 8 * 2 * 16 * B // 1024 + 8 * 16 * B // 1024
+    t = time_call(chain_8ary, S)
+    print(f"8-wire  [16,B]    {vr8 / t / 1e9:7.2f} Gvrops/s ({t*1e3:7.2f} ms)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Calibrate achievable VPU throughput for bitwise op chains on the device.
+
+Runs a serial data-dependent chain of N cheap uint32 vector ops over
+[128, B] (the AES kernel's shape) and over [16, B] (the S-box temp shape),
+both in plain XLA and inside a Pallas kernel, and reports effective
+vector-register ops per second.  The AES-MMO PRG needs ~8.9M vreg-ops at
+B=2^17; this script tells us the floor the hardware+compiler can do."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+sys.path.insert(0, ".")
+
+N = 512
+
+
+def chain(S):
+    a = S
+    for i in range(N):
+        a = a ^ (a << 1) ^ (a >> 3)  # 3 ops per iter, serial dependence
+    return a
+
+
+def time_call(build, S, reps=6):
+    @jax.jit
+    def summed(S):
+        return jnp.bitwise_xor.reduce(build(S), axis=None)
+
+    np.asarray(summed(S))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(summed(S))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def pallas_chain(S):
+    def kernel(s_ref, o_ref):
+        o_ref[:] = chain(s_ref[:])
+
+    bt = 256
+    return pl.pallas_call(
+        kernel,
+        grid=(S.shape[1] // bt,),
+        in_specs=[pl.BlockSpec((S.shape[0], bt), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((S.shape[0], bt), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct(S.shape, jnp.uint32),
+        interpret=jax.default_backend() != "tpu",
+    )(S)
+
+
+def main():
+    blog = int(sys.argv[1]) if len(sys.argv) > 1 else 17
+    B = 1 << blog
+    rng = np.random.default_rng(0)
+    for rows in (128, 16):
+        S = jnp.asarray(rng.integers(0, 1 << 32, size=(rows, B), dtype=np.uint32))
+        vregs = 3 * N * rows * B // 1024
+        t = time_call(chain, S, reps=6)
+        print(f"xla    [{rows:3d},2^{blog}]  {vregs / t / 1e9:7.2f} Gvrops/s  ({t * 1e3:7.2f} ms, {vregs/1e6:.1f}M vrops)")
+        t = time_call(pallas_chain, S, reps=6)
+        print(f"pallas [{rows:3d},2^{blog}]  {vregs / t / 1e9:7.2f} Gvrops/s  ({t * 1e3:7.2f} ms)")
+
+
+if __name__ == "__main__":
+    main()

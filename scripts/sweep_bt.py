@@ -1,0 +1,56 @@
+"""Sweep the Pallas PRG kernel lane-tile size on the live device."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from dpf_tpu.ops import aes_pallas
+
+
+def timeit(fn, S, reps=8):
+    @jax.jit
+    def summed(S):
+        L, R = fn(S)
+        return jnp.bitwise_xor.reduce(L, axis=None) ^ jnp.bitwise_xor.reduce(
+            R, axis=None
+        )
+
+    np.asarray(summed(S))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(summed(S))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    blog = int(sys.argv[1]) if len(sys.argv) > 1 else 17
+    B = 1 << blog
+    rng = np.random.default_rng(0)
+    S = jnp.asarray(rng.integers(0, 1 << 32, size=(128, B), dtype=np.uint32))
+    blocks = 32 * B * 2
+    ref = None
+    for bt in (128, 256, 512):
+        aes_pallas._BT = bt
+        jax.clear_caches()
+        try:
+            out = aes_pallas.prg_planes_pallas(S)
+            got = np.asarray(out[0][:2, :4])
+            if ref is None:
+                ref = got
+            else:
+                np.testing.assert_array_equal(got, ref)
+            t = timeit(aes_pallas.prg_planes_pallas, S)
+            print(f"BT={bt:5d}  {blocks / t / 1e9:6.2f} GMMO-blocks/s  ({t * 1e3:.2f} ms)")
+        except Exception as e:  # noqa: BLE001
+            print(f"BT={bt:5d}  FAILED: {str(e)[:120]}")
+
+
+if __name__ == "__main__":
+    main()

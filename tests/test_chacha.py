@@ -1,0 +1,155 @@
+"""ChaCha fast-profile tests: RFC 8439 core vector, spec reconstruction,
+device-vs-spec byte equality, pointwise agreement, serialization, and
+negative paths."""
+
+import numpy as np
+import pytest
+
+from dpf_tpu.core import chacha_np as cc
+from dpf_tpu.models import dpf_chacha as dc
+from dpf_tpu.models import keys_chacha as kc
+
+
+def test_rfc8439_block_vector():
+    # RFC 8439 sec 2.3.2: key 00..1f, counter 1, nonce 00:00:00:09:00:00:00:4a:00:00:00:00
+    key = np.frombuffer(bytes(range(32)), dtype="<u4")
+    out = cc.chacha_block(
+        key, counter=1, nonce=(0x09000000, 0x4A000000, 0), rounds=20
+    )
+    want = [
+        0xE4E7F110, 0x15593BD1, 0x1FDD0F50, 0xC47120A3,
+        0xC7F4D1C7, 0x0368C033, 0x9AAA2204, 0x4E6CD4C3,
+        0x466482D2, 0x09AA9F07, 0x05D7C214, 0xA2028BD9,
+        0xD19C12B5, 0xB94E16DE, 0xE883D0CB, 0x4E3C50A2,
+    ]
+    assert [int(v) for v in out] == want
+
+
+def test_block_vectorizes_over_batch():
+    keys = np.arange(3 * 8, dtype=np.uint32).reshape(3, 8)
+    out = cc.chacha_block(keys, rounds=12)
+    for i in range(3):
+        np.testing.assert_array_equal(out[i], cc.chacha_block(keys[i], rounds=12))
+
+
+def test_spec_reconstruction_small_and_edge():
+    rng = np.random.default_rng(1)
+    for log_n in (1, 4, 8, 9, 11):
+        for alpha in {0, (1 << log_n) - 1, 3 % (1 << log_n)}:
+            ka, kb = cc.gen(alpha, log_n, rng=rng)
+            assert len(ka) == cc.key_len(log_n)
+            fa = np.frombuffer(cc.eval_full(ka, log_n), np.uint8)
+            fb = np.frombuffer(cc.eval_full(kb, log_n), np.uint8)
+            bits = np.unpackbits(fa ^ fb, bitorder="little")
+            assert bits[: 1 << log_n].sum() == 1
+            assert bits[alpha] == 1
+            assert (bits[1 << log_n :] == 0).all()
+
+
+def test_spec_point_vs_full_cross_check():
+    rng = np.random.default_rng(2)
+    log_n, alpha = 12, 1234
+    ka, _ = cc.gen(alpha, log_n, rng=rng)
+    full = np.unpackbits(
+        np.frombuffer(cc.eval_full(ka, log_n), np.uint8), bitorder="little"
+    )
+    for x in [0, 1, alpha, alpha ^ 1, (1 << log_n) - 1]:
+        assert cc.eval_point(ka, x, log_n) == full[x]
+
+
+def test_device_matches_spec_bytes():
+    rng = np.random.default_rng(3)
+    for log_n in (4, 9, 12):
+        K = 8
+        alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+        ka, kb = kc.gen_batch(alphas, log_n, rng=rng)
+        got = dc.eval_full(ka)
+        want = np.stack(
+            [
+                np.frombuffer(cc.eval_full(k, log_n), np.uint8)
+                for k in ka.to_bytes()
+            ]
+        )
+        np.testing.assert_array_equal(got, want)
+        rec = got ^ dc.eval_full(kb)
+        bits = np.unpackbits(rec, axis=1, bitorder="little")[:, : 1 << log_n]
+        assert (bits.sum(axis=1) == 1).all()
+        assert (bits[np.arange(K), alphas.astype(np.int64)] == 1).all()
+
+
+def test_device_points_match_spec():
+    rng = np.random.default_rng(4)
+    log_n, K, Q = 32, 8, 16
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, kb = kc.gen_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    xs[:, 0] = alphas
+    xs[:, 1] = alphas ^ np.uint64(1)
+    rec = dc.eval_points(ka, xs) ^ dc.eval_points(kb, xs)
+    np.testing.assert_array_equal(rec, (xs == alphas[:, None]).astype(np.uint8))
+    # spec agreement on one key
+    spec_bits = [
+        cc.eval_point(ka.to_bytes()[0], int(x), log_n) for x in xs[0]
+    ]
+    np.testing.assert_array_equal(dc.eval_points(ka, xs)[0], spec_bits)
+
+
+def test_serialization_roundtrip():
+    rng = np.random.default_rng(5)
+    log_n, K = 14, 8
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, _ = kc.gen_batch(alphas, log_n, rng=rng)
+    kb2 = kc.KeyBatchFast.from_bytes(ka.to_bytes(), log_n)
+    np.testing.assert_array_equal(dc.eval_full(kb2), dc.eval_full(ka))
+
+
+def test_rejects_bad_input():
+    with pytest.raises(ValueError):
+        kc.gen_batch([1 << 10], 10)
+    with pytest.raises(ValueError):
+        kc.gen_batch([0], 64)
+    with pytest.raises(ValueError):
+        cc.eval_point(b"\x00" * cc.key_len(10), 1 << 10, 10)
+    with pytest.raises(ValueError):
+        cc.eval_full(b"\x00" * 3, 10)
+    rng = np.random.default_rng(6)
+    ka, _ = kc.gen_batch([5], 10, rng=rng)
+    with pytest.raises(ValueError):
+        dc.eval_points(ka, np.array([[1 << 10]], dtype=np.uint64))
+    # non-canonical key: set the seed LSB
+    raw = bytearray(ka.to_bytes()[0])
+    raw[0] |= 1
+    with pytest.raises(ValueError):
+        kc.KeyBatchFast.from_bytes([bytes(raw)], 10)
+
+
+def test_single_share_is_balanced():
+    # One share alone is pseudorandom (density ~0.5), not the indicator.
+    rng = np.random.default_rng(7)
+    ka, _ = kc.gen_batch([100], 12, rng=rng)
+    bits = np.unpackbits(dc.eval_full(ka)[0], bitorder="little")
+    assert 0.4 < bits.mean() < 0.6
+
+
+def test_sharded_fast_matches_spec():
+    # 8-virtual-device mesh (conftest): keys x leaf sharding, vs spec bytes.
+    import jax
+
+    from dpf_tpu.parallel import eval_full_sharded_fast, make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_mesh(4, 2, devices=jax.devices()[:8])
+    rng = np.random.default_rng(8)
+    log_n, K = 12, 10  # K not divisible by the keys axis -> padding path
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, kb = kc.gen_batch(alphas, log_n, rng=rng)
+    got = eval_full_sharded_fast(ka, mesh)
+    want = np.stack(
+        [np.frombuffer(cc.eval_full(k, log_n), np.uint8) for k in ka.to_bytes()]
+    )
+    np.testing.assert_array_equal(got, want)
+    rec = got ^ eval_full_sharded_fast(kb, mesh)
+    bits = np.unpackbits(rec, axis=1, bitorder="little")[:, : 1 << log_n]
+    assert (bits.sum(axis=1) == 1).all()
+    assert (bits[np.arange(K), alphas.astype(np.int64)] == 1).all()
