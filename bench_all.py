@@ -1,0 +1,163 @@
+"""All five BASELINE.md benchmark configs, one JSON line each.
+
+The driver's headline metric lives in bench.py (config 2); this harness
+covers the full matrix for both profiles where applicable.  Timing method:
+single dispatch minus measured tunnel RTT (see bench.py docstring), best of
+several reps.
+
+    python bench_all.py [--scale small|full]
+
+``--scale small`` shrinks domains/batches for CPU smoke runs; ``full`` is
+the real TPU matrix.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+from bench import FALLBACK_BASELINE, _measure_rtt, measure_baseline
+
+
+def _timed(fn, args, rtt, reps=4):
+    np.asarray(fn(*args))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return max(best - rtt, 1e-5)
+
+
+def _emit(name, value, unit, baseline=None):
+    row = {"metric": name, "value": round(value, 3), "unit": unit}
+    if baseline:
+        row["vs_baseline"] = round(value * 1e9 / baseline, 2)
+    print(json.dumps(row), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", choices=["small", "full"], default="full")
+    args = ap.parse_args()
+    small = args.scale == "small"
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from dpf_tpu.core.keys import gen_batch
+    from dpf_tpu.models import keys_chacha as kc
+    from dpf_tpu.models.dpf import DeviceKeys, _eval_full_jit, default_backend
+    from dpf_tpu.models.dpf_chacha import (
+        _eval_full_cc_jit,
+        eval_points as fast_points,
+    )
+    from dpf_tpu.models.fss import eval_lt_points, gen_lt_batch
+    from dpf_tpu.models.pir import PirServer, pir_query, pir_reconstruct
+
+    rtt = _measure_rtt(jax)
+    backend = default_backend()
+    baseline = measure_baseline() if not small else FALLBACK_BASELINE
+    rng = np.random.default_rng(99)
+
+    # ---- config 1: single-key EvalFull, n=16 --------------------------------
+    n1 = 16 if not small else 12
+    ka, _ = kc.gen_batch(np.array([123 % (1 << n1)], np.uint64), n1, rng=rng)
+
+    @jax.jit
+    def f1(seeds, ts, scw, tcw, fcw):
+        w = _eval_full_cc_jit(ka.nu, seeds, ts, scw, tcw, fcw)
+        return jnp.bitwise_xor.reduce(w, axis=None)
+
+    dt = _timed(f1, ka.device_args(), rtt)
+    _emit(f"1-key eval_full n={n1} (fast)", (1 << n1) / dt / 1e9,
+          "Gleaves/sec", baseline)
+
+    # ---- config 2: 1024-key EvalFull, n=20 (headline; both profiles) --------
+    n2, k2 = (20, 1024) if not small else (14, 64)
+    kaf, _ = kc.gen_batch(
+        rng.integers(0, 1 << n2, size=k2, dtype=np.uint64), n2, rng=rng
+    )
+
+    @jax.jit
+    def f2(seeds, ts, scw, tcw, fcw):
+        w = _eval_full_cc_jit(kaf.nu, seeds, ts, scw, tcw, fcw)
+        return jnp.bitwise_xor.reduce(w, axis=None)
+
+    dt = _timed(f2, kaf.device_args(), rtt)
+    _emit(f"{k2}-key eval_full n={n2} (fast)", k2 * (1 << n2) / dt / 1e9,
+          "Gleaves/sec", baseline)
+
+    kac, _ = gen_batch(
+        rng.integers(0, 1 << n2, size=k2, dtype=np.uint64), n2, rng=rng
+    )
+    dk = DeviceKeys(kac)
+
+    @jax.jit
+    def f2c(sp, tw, scw, tl, tr, fcw):
+        w = _eval_full_jit(dk.nu, sp, tw, scw, tl, tr, fcw, backend)
+        return jnp.bitwise_xor.reduce(w.reshape(-1, 4), axis=0)
+
+    dt = _timed(
+        f2c,
+        (dk.seed_planes, dk.t_words, dk.scw_planes, dk.tl_words,
+         dk.tr_words, dk.fcw_planes),
+        rtt,
+    )
+    _emit(f"{k2}-key eval_full n={n2} (compat)", k2 * (1 << n2) / dt / 1e9,
+          "Gleaves/sec", baseline)
+
+    # ---- config 3: pointwise Eval, 2^20 indices over 256 keys, n=30 ---------
+    n3, k3, q3 = (30, 256, 4096) if not small else (30, 16, 64)
+    kap, _ = kc.gen_batch(
+        rng.integers(0, 1 << n3, size=k3, dtype=np.uint64), n3, rng=rng
+    )
+    xs = rng.integers(0, 1 << n3, size=(k3, q3), dtype=np.uint64)
+    fast_points(kap, xs)  # compile + warm
+    best = float("inf")
+    for _ in range(4):
+        t0 = time.perf_counter()
+        fast_points(kap, xs)
+        best = min(best, time.perf_counter() - t0)
+    dt = max(best - rtt, 1e-5)
+    _emit(f"pointwise eval n={n3} {k3}x{q3} (fast)", k3 * q3 / dt / 1e6,
+          "Mqueries/sec")
+
+    # ---- config 4: 2-server PIR, 2^24 x 32 B, 1k queries --------------------
+    nrows, rb, nq = (1 << 24, 32, 1024) if not small else (1 << 12, 32, 16)
+    db = rng.integers(0, 256, size=(nrows, rb), dtype=np.uint8)
+    idx = rng.integers(0, nrows, size=nq, dtype=np.uint64)
+    qa, qb = pir_query(idx, nrows, rng=rng, profile="fast")
+    srv = PirServer(db, profile="fast")
+    srv.answer(qa)  # compile + warm
+    t0 = time.perf_counter()
+    ans_a = srv.answer(qa)
+    dt = max(time.perf_counter() - t0 - rtt, 1e-5)
+    rows = pir_reconstruct(ans_a, srv.answer(qb))
+    np.testing.assert_array_equal(rows, db[idx.astype(np.int64)])
+    _emit(f"2-server PIR {nrows}x{rb}B, {nq} queries (fast)", nq / dt,
+          "queries/sec")
+
+    # ---- config 5: FSS comparison gates, n=32, 4096 gates -------------------
+    n5, g5, q5 = (32, 4096, 32) if not small else (32, 64, 32)
+    ca, cb = gen_lt_batch(
+        rng.integers(0, 1 << n5, size=g5, dtype=np.uint64), n5, rng=rng,
+        profile="fast",
+    )
+    xs5 = rng.integers(0, 1 << n5, size=(g5, q5), dtype=np.uint64)
+    eval_lt_points(ca, xs5)  # compile + warm
+    t0 = time.perf_counter()
+    eval_lt_points(ca, xs5)
+    dt = max(time.perf_counter() - t0 - rtt, 1e-5)
+    _emit(f"FSS lt-gate n={n5} {g5} gates x {q5} pts (fast)",
+          g5 * q5 / dt / 1e6, "Mgate-evals/sec")
+
+
+if __name__ == "__main__":
+    main()

@@ -144,16 +144,7 @@ def eval_full(kb: KeyBatchFast) -> np.ndarray:
     """Full-domain evaluation -> uint8[K, out_bytes] bit-packed
     (out_bytes = 2^(log_n-3), min 64), byte-identical to the spec
     ``chacha_np.eval_full`` per key."""
-    words = np.asarray(
-        _eval_full_cc_jit(
-            kb.nu,
-            jnp.asarray(kb.seeds),
-            jnp.asarray(kb.ts.astype(np.uint32)),
-            jnp.asarray(kb.scw),
-            jnp.asarray(kb.tcw.astype(np.uint32)),
-            jnp.asarray(kb.fcw),
-        )
-    )
+    words = np.asarray(_eval_full_cc_jit(kb.nu, *kb.device_args()))
     return np.ascontiguousarray(words).view("<u1").reshape(kb.k, -1)
 
 
@@ -204,13 +195,6 @@ def eval_points(kb: KeyBatchFast, xs: np.ndarray) -> np.ndarray:
     low = (xs & np.uint64(cc.LEAF_BITS - 1)).astype(np.uint32)
     return np.asarray(
         _eval_points_cc_jit(
-            nu,
-            jnp.asarray(kb.seeds),
-            jnp.asarray(kb.ts.astype(np.uint32)),
-            jnp.asarray(kb.scw),
-            jnp.asarray(kb.tcw.astype(np.uint32)),
-            jnp.asarray(kb.fcw),
-            jnp.asarray(pb),
-            jnp.asarray(low),
+            nu, *kb.device_args(), jnp.asarray(pb), jnp.asarray(low)
         )
     )

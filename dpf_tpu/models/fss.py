@@ -53,6 +53,21 @@ import numpy as np
 from ..core.keys import KeyBatch, gen_batch
 from .dpf import DeviceKeys, eval_full_device, eval_points
 
+
+def _profile_funcs(profile: str):
+    """(gen_batch, eval_points, key-batch class, key_len) per profile."""
+    if profile == "fast":
+        from ..core.chacha_np import key_len as kl
+        from .dpf_chacha import eval_points as ep
+        from .keys_chacha import KeyBatchFast, gen_batch as gb
+
+        return gb, ep, KeyBatchFast, kl
+    if profile == "compat":
+        from ..core.spec import key_len as kl
+
+        return gen_batch, eval_points, KeyBatch, kl
+    raise ValueError(f"fss: unknown profile {profile!r}")
+
 __all__ = [
     "CmpKeyBatch",
     "IntervalKeyBatch",
@@ -70,10 +85,11 @@ class CmpKeyBatch:
 
     ``levels`` holds ``n * G`` full-domain DPF keys, level-major: key
     ``i * G + g`` is gate g's level-i DPF.  Serializes per gate as the
-    concatenation of its n reference-layout DPF keys."""
+    concatenation of its n per-profile-layout DPF keys."""
 
     log_n: int
     levels: KeyBatch  # K = log_n * G keys on the n-bit domain
+    profile: str = "compat"
 
     @property
     def g(self) -> int:
@@ -86,8 +102,10 @@ class CmpKeyBatch:
         return [b"".join(lv[i * G + g] for i in range(self.log_n)) for g in range(G)]
 
     @classmethod
-    def from_bytes(cls, blobs: list[bytes], log_n: int) -> "CmpKeyBatch":
-        from ..core.spec import key_len
+    def from_bytes(
+        cls, blobs: list[bytes], log_n: int, profile: str = "compat"
+    ) -> "CmpKeyBatch":
+        _, _, batch_cls, key_len = _profile_funcs(profile)
 
         kl = key_len(log_n)
         keys: list[bytes] = []
@@ -96,7 +114,7 @@ class CmpKeyBatch:
                 if len(blob) != log_n * kl:
                     raise ValueError(f"fss: gate {g} blob length != {log_n * kl}")
                 keys.append(blob[i * kl : (i + 1) * kl])
-        return cls(log_n, KeyBatch.from_bytes(keys, log_n))
+        return cls(log_n, batch_cls.from_bytes(keys, log_n), profile)
 
 
 @dataclass
@@ -120,11 +138,14 @@ def gen_lt_batch(
     alphas: np.ndarray | list[int],
     log_n: int,
     rng: np.random.Generator | None = None,
+    profile: str = "compat",
 ) -> tuple[CmpKeyBatch, CmpKeyBatch]:
     """Generate G comparison gate pairs for ``1{x < alpha}``.
 
     Host-side trusted-dealer step; one vectorized ``gen_batch`` over all
-    ``log_n * G`` level-DPFs."""
+    ``log_n * G`` level-DPFs.  ``profile="fast"`` builds the gates from
+    ChaCha-profile DPFs (both parties must evaluate with the same profile)."""
+    gen, _, _, _ = _profile_funcs(profile)
     alphas = np.asarray(alphas, dtype=np.uint64)
     if log_n < 1 or log_n > 63:
         raise ValueError("fss: log_n out of range")
@@ -140,12 +161,12 @@ def gen_lt_batch(
     points = (pref & ~np.uint64(1)) << shifts  # (top-i bits || 0) << shift
     points = np.where(active, points, _rand_points(point_rng, (n, G), n))
 
-    ka, kb = gen_batch(points.reshape(n * G), n, rng=rng)
+    ka, kb = gen(points.reshape(n * G), n, rng=rng)
     # Zero-share inactive levels: party B gets party A's key verbatim.
     idx = np.flatnonzero(~active.reshape(n * G))
     for f in ("seeds", "ts", "scw", "tcw", "fcw"):
         getattr(kb, f)[idx] = getattr(ka, f)[idx]
-    return CmpKeyBatch(n, ka), CmpKeyBatch(n, kb)
+    return CmpKeyBatch(n, ka, profile), CmpKeyBatch(n, kb, profile)
 
 
 def _masked_prefix_queries(xs: np.ndarray, log_n: int) -> np.ndarray:
@@ -159,12 +180,13 @@ def _masked_prefix_queries(xs: np.ndarray, log_n: int) -> np.ndarray:
 def eval_lt_points(ck: CmpKeyBatch, xs: np.ndarray) -> np.ndarray:
     """Evaluate comparison shares at xs uint64[G, Q] -> uint8[G, Q].
 
-    One bitsliced device launch over all ``n * G`` level-DPFs; the level
+    One device launch over all ``n * G`` level-DPFs; the level
     XOR-reduction collapses the unique matching level into the predicate."""
+    _, ep, _, _ = _profile_funcs(ck.profile)
     xs = np.asarray(xs, dtype=np.uint64)
     if xs.ndim != 2 or xs.shape[0] != ck.g:
         raise ValueError("fss: xs must be [G, Q]")
-    bits = eval_points(ck.levels, _masked_prefix_queries(xs, ck.log_n))
+    bits = ep(ck.levels, _masked_prefix_queries(xs, ck.log_n))
     return np.bitwise_xor.reduce(bits.reshape(ck.log_n, ck.g, -1), axis=0)
 
 
@@ -173,6 +195,7 @@ def gen_interval_batch(
     hi: np.ndarray | list[int],
     log_n: int,
     rng: np.random.Generator | None = None,
+    profile: str = "compat",
 ) -> tuple[IntervalKeyBatch, IntervalKeyBatch]:
     """Generate G interval gate pairs for ``1{lo <= x <= hi}`` (inclusive).
 
@@ -191,8 +214,8 @@ def gen_interval_batch(
     wrap = hi == top
     # alpha = 0 has no set bits -> every level inactive -> lt_0 == 0 shares.
     upper_alpha = np.where(wrap, np.uint64(0), hi + np.uint64(1))
-    ua, ub = gen_lt_batch(upper_alpha, log_n, rng=rng)
-    la, lb = gen_lt_batch(lo, log_n, rng=rng)
+    ua, ub = gen_lt_batch(upper_alpha, log_n, rng=rng, profile=profile)
+    la, lb = gen_lt_batch(lo, log_n, rng=rng, profile=profile)
     const_a = wrap.astype(np.uint8)
     const_b = np.zeros_like(const_a)
     return IntervalKeyBatch(ua, la, const_a), IntervalKeyBatch(ub, lb, const_b)
@@ -203,12 +226,13 @@ def eval_interval_points(ik: IntervalKeyBatch, xs: np.ndarray) -> np.ndarray:
 
     Both comparison gate sets fuse into a single device launch (one
     ``KeyBatch`` of ``2 * n * G`` keys)."""
+    _, ep, batch_cls, _ = _profile_funcs(ik.upper.profile)
     xs = np.asarray(xs, dtype=np.uint64)
     G, n = ik.upper.g, ik.upper.log_n
     if xs.ndim != 2 or xs.shape[0] != G:
         raise ValueError("fss: xs must be [G, Q]")
     u, lo = ik.upper.levels, ik.lower.levels
-    both = KeyBatch(
+    both = batch_cls(
         n,
         np.concatenate([u.seeds, lo.seeds]),
         np.concatenate([u.ts, lo.ts]),
@@ -217,7 +241,7 @@ def eval_interval_points(ik: IntervalKeyBatch, xs: np.ndarray) -> np.ndarray:
         np.concatenate([u.fcw, lo.fcw]),
     )
     q = _masked_prefix_queries(xs, n)  # [n*G, Q]
-    bits = eval_points(both, np.concatenate([q, q]))
+    bits = ep(both, np.concatenate([q, q]))
     bits = bits.reshape(2, n, G, -1)
     out = np.bitwise_xor.reduce(bits, axis=(0, 1))
     return out ^ ik.const[:, None]
@@ -239,7 +263,7 @@ def _prefix_xor_words(w: jax.Array) -> jax.Array:
     return w ^ (jnp.uint32(0) - carry)  # complement words with odd carry-in
 
 
-def ge_full_from_dpf(kb: KeyBatch) -> np.ndarray:
+def ge_full_from_dpf(kb) -> np.ndarray:
     """Full-domain comparison table from plain DPF keys: for a key pair on
     point alpha, the two parties' outputs XOR to the bit-packed indicator
     ``1{x >= alpha}`` over the whole domain (``1{x < alpha}`` is its public
@@ -247,12 +271,20 @@ def ge_full_from_dpf(kb: KeyBatch) -> np.ndarray:
 
     Uses the identity XOR_{y <= x} DPF_alpha(y) = 1{x >= alpha}: expand the
     key with the level-synchronous evaluator, then run one carry-less
-    prefix-XOR scan over the packed leaf words on device.  -> uint8[K,
-    2^(log_n-3)] (16 bytes per key when log_n < 7), same packing as
+    prefix-XOR scan over the packed leaf words on device.  Accepts either
+    profile's key batch (KeyBatch or KeyBatchFast).  -> uint8[K, out_bytes]
+    (out_bytes = 2^(log_n-3); minimum one leaf block), same packing as
     ``eval_full`` (bit x at byte x//8, bit x%8; reference dpf/dpf.go:207).
     """
-    dk = DeviceKeys(kb)
-    words = eval_full_device(dk)  # [Kpad, W, 4] uint32, ascending bit order
+    from .keys_chacha import KeyBatchFast
+
+    if isinstance(kb, KeyBatchFast):
+        from .dpf_chacha import _eval_full_cc_jit
+
+        # [K, W, 16], ascending bit order
+        words = _eval_full_cc_jit(kb.nu, *kb.device_args())
+    else:
+        words = eval_full_device(DeviceKeys(kb))  # [Kpad, W, 4]
     scanned = _prefix_xor_words(words.reshape(words.shape[0], -1))
     out = np.ascontiguousarray(np.asarray(scanned)[: kb.k])
     return out.view("<u1").reshape(kb.k, -1)

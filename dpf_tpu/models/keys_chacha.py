@@ -58,6 +58,20 @@ class KeyBatchFast:
             raise ValueError("dpf-fast: non-canonical key")
         return cls(log_n, seeds, ts, scw, tcw, fcw)
 
+    def device_args(self):
+        """The five device operands every fast-profile evaluator takes:
+        (seeds, ts, scw, tcw, fcw) as jnp arrays, control bytes widened to
+        uint32 lane masks.  Single source of truth for the marshaling."""
+        import jax.numpy as jnp
+
+        return (
+            jnp.asarray(self.seeds),
+            jnp.asarray(self.ts.astype(np.uint32)),
+            jnp.asarray(self.scw),
+            jnp.asarray(self.tcw.astype(np.uint32)),
+            jnp.asarray(self.fcw),
+        )
+
     def to_bytes(self) -> list[bytes]:
         k, nu = self.k, self.nu
         cws = np.concatenate(

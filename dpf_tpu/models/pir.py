@@ -39,12 +39,16 @@ from ..parallel.sharding import (
 )
 from .dpf import DeviceKeys, _convert_leaves, _level_step
 
+# Leaf width (log2 bits) per profile: compat = one AES block (reference
+# dpf/dpf.go:251), fast = one ChaCha block (core/chacha_np.LEAF_LOG).
+_LEAF_LOG = {"compat": 7, "fast": 9}
 
-def row_domain(n_rows: int) -> tuple[int, int]:
+
+def row_domain(n_rows: int, profile: str = "compat") -> tuple[int, int]:
     """(log_n, padded domain size) for an ``n_rows``-row database.  Client
     and server must derive the domain identically — single source of truth."""
     log_n = max(int(n_rows - 1).bit_length(), 3)
-    return log_n, 1 << max(log_n, 7)
+    return log_n, 1 << max(log_n, _LEAF_LOG[profile])
 
 
 # ---------------------------------------------------------------------------
@@ -56,12 +60,20 @@ def pir_query(
     indices: np.ndarray | list[int],
     n_rows: int,
     rng: np.random.Generator | None = None,
-) -> tuple[KeyBatch, KeyBatch]:
-    """Build the two servers' query key batches for a batch of row indices."""
-    log_n, _ = row_domain(n_rows)
+    profile: str = "compat",
+):
+    """Build the two servers' query key batches for a batch of row indices.
+
+    ``profile="fast"`` uses the ChaCha profile (keys_chacha) — server and
+    client must agree on the profile."""
+    log_n, _ = row_domain(n_rows, profile)
     indices = np.asarray(indices, dtype=np.uint64)
     if (indices >= n_rows).any():
         raise ValueError("pir: row index out of range")
+    if profile == "fast":
+        from .keys_chacha import gen_batch as gen_fast
+
+        return gen_fast(indices, log_n, rng=rng)
     return gen_batch(indices, log_n, rng=rng)
 
 
@@ -88,15 +100,19 @@ class PirServer:
         db: np.ndarray,
         mesh: Mesh | None = None,
         chunk_rows: int = 1 << 16,
+        profile: str = "compat",
     ):
+        if profile not in _LEAF_LOG:
+            raise ValueError(f"pir: unknown profile {profile!r}")
         db = np.ascontiguousarray(np.asarray(db, dtype=np.uint8))
         if db.ndim != 2:
             raise ValueError("db must be [n_rows, row_bytes]")
+        self.profile = profile
         self.n_rows, self.row_bytes = db.shape
         if self.row_bytes % 4:
             raise ValueError("row_bytes must be a multiple of 4")
-        self.log_n, dom = row_domain(self.n_rows)
-        self.nu = max(self.log_n - 7, 0)
+        self.log_n, dom = row_domain(self.n_rows, profile)
+        self.nu = max(self.log_n - _LEAF_LOG[profile], 0)
         self.mesh = mesh
         self.n_leaf = mesh.shape.get(LEAF_AXIS, 1) if mesh else 1
         if mesh is not None:
@@ -115,18 +131,31 @@ class PirServer:
             np.ascontiguousarray(padded).view("<u4")
         )  # [dom, row_bytes/4]
 
-    def answer(self, queries: KeyBatch) -> np.ndarray:
-        """-> uint8[K, row_bytes]: per-query XOR of selected rows."""
+    def answer(self, queries) -> np.ndarray:
+        """-> uint8[K, row_bytes]: per-query XOR of selected rows.
+
+        ``queries``: KeyBatch (compat profile) or KeyBatchFast (fast)."""
+        from .keys_chacha import KeyBatchFast
+
+        want_fast = self.profile == "fast"
+        if isinstance(queries, KeyBatchFast) != want_fast:
+            raise ValueError(
+                f"pir: {type(queries).__name__} queries sent to a "
+                f"{self.profile!r}-profile server; client and server must "
+                "agree on the profile"
+            )
         if queries.log_n != self.log_n:
             raise ValueError(
                 f"pir: query domain 2^{queries.log_n} != db domain 2^{self.log_n}"
             )
+        n_chunks = self.dom // (self.n_leaf * self.chunk_rows)
+        if self.profile == "fast":
+            return self._answer_fast(queries, n_chunks)
         if self.mesh is None:
             k_shards = 1
         else:
             k_shards = self.mesh.shape[KEYS_AXIS]
         dk = DeviceKeys(queries, pad_to=32 * k_shards)
-        n_chunks = self.dom // (self.n_leaf * self.chunk_rows)
         if self.mesh is None:
             fn = _pir_single(dk.nu, self.chunk_rows, n_chunks)
         else:
@@ -139,6 +168,28 @@ class PirServer:
                 dk.tl_words, dk.tr_words, dk.fcw_planes, self.db_words,
             )
         )  # [Kpad, row_words]
+        return words[: queries.k].view("<u1").reshape(queries.k, -1)
+
+    def _answer_fast(self, queries, n_chunks: int) -> np.ndarray:
+        from .keys_chacha import KeyBatchFast
+
+        k_shards = 1 if self.mesh is None else self.mesh.shape[KEYS_AXIS]
+        pad = (-queries.k) % k_shards
+
+        def padk(a):
+            return np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+
+        padded = KeyBatchFast(
+            queries.log_n, padk(queries.seeds), padk(queries.ts),
+            padk(queries.scw), padk(queries.tcw), padk(queries.fcw),
+        )
+        if self.mesh is None:
+            fn = _pir_single_fast(self.nu, self.chunk_rows, n_chunks)
+        else:
+            fn = _pir_sharded_fast(
+                self.mesh, self.nu, self.subtree_levels, self.chunk_rows, n_chunks
+            )
+        words = np.asarray(fn(*padded.device_args(), self.db_words))
         return words[: queries.k].view("<u1").reshape(queries.k, -1)
 
 
@@ -202,6 +253,52 @@ def _pir_single(nu: int, chunk_rows: int, n_chunks: int):
         return _parity_matmul(sel, db_words, chunk_rows, n_chunks)
 
     return jax.jit(body)
+
+
+@cache
+def _pir_single_fast(nu: int, chunk_rows: int, n_chunks: int):
+    from .dpf_chacha import _convert_leaves_cc, _level_step_cc
+
+    def body(seeds, ts, scw, tcw, fcw, db_words):
+        S = [seeds[:, i : i + 1] for i in range(4)]
+        T = ts[:, None]
+        for i in range(nu):
+            S, T = _level_step_cc(
+                S, T, [scw[:, i, w] for w in range(4)], tcw[:, i, 0], tcw[:, i, 1]
+            )
+        leaves = _convert_leaves_cc(S, T, [fcw[:, j] for j in range(16)])
+        sel = leaves.reshape(leaves.shape[0], -1)  # [K, W*16] ascending rows
+        return _parity_matmul(sel, db_words, chunk_rows, n_chunks)
+
+    return jax.jit(body)
+
+
+@cache
+def _pir_sharded_fast(
+    mesh: Mesh, nu: int, subtree_levels: int, chunk_rows: int, n_chunks: int
+):
+    from ..parallel.sharding import expand_subtree_local_cc
+    from .dpf_chacha import _convert_leaves_cc
+
+    def body(seeds, ts, scw, tcw, fcw, db_words):
+        S, T = expand_subtree_local_cc(seeds, ts, scw, tcw, nu, subtree_levels)
+        leaves = _convert_leaves_cc(S, T, [fcw[:, j] for j in range(16)])
+        sel = leaves.reshape(leaves.shape[0], -1)
+        part = _parity_matmul(sel, db_words, chunk_rows, n_chunks)
+        return xor_allreduce(part, LEAF_AXIS)
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                P(KEYS_AXIS, None), P(KEYS_AXIS), P(KEYS_AXIS, None, None),
+                P(KEYS_AXIS, None, None), P(KEYS_AXIS, None), P(LEAF_AXIS, None),
+            ),
+            out_specs=P(KEYS_AXIS, None),
+            check_vma=False,
+        )
+    )
 
 
 @cache

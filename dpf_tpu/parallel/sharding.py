@@ -161,6 +161,34 @@ def eval_full_sharded(kb: KeyBatch, mesh: Mesh) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def expand_subtree_local_cc(seeds, ts, scw, tcw, nu: int, subtree_levels: int):
+    """Fast-profile shard-local GGM expansion (inside shard_map): replicate
+    the top ``subtree_levels`` levels, slice this shard's subtree by its
+    ``LEAF_AXIS`` index, expand the rest.  Word-oriented mirror of
+    :func:`expand_subtree_local`; single source of truth for the fast
+    profile's subtree-sharding idiom (also used by models/pir.py)."""
+    from ..models.dpf_chacha import _level_step_cc
+
+    c = subtree_levels
+    S = [seeds[:, i : i + 1] for i in range(4)]
+    T = ts[:, None]
+
+    def step(i, S, T):
+        return _level_step_cc(
+            S, T, [scw[:, i, w] for w in range(4)], tcw[:, i, 0], tcw[:, i, 1]
+        )
+
+    for i in range(c):
+        S, T = step(i, S, T)
+    if c:
+        j = jax.lax.axis_index(LEAF_AXIS)
+        S = [jax.lax.dynamic_slice_in_dim(s, j, 1, axis=1) for s in S]
+        T = jax.lax.dynamic_slice_in_dim(T, j, 1, axis=1)
+    for i in range(c, nu):
+        S, T = step(i, S, T)
+    return S, T
+
+
 @cache
 def _sharded_eval_full_fast(mesh: Mesh, nu: int, subtree_levels: int):
     """Sharded fast-profile evaluator for a (mesh, domain) bucket.
@@ -169,29 +197,10 @@ def _sharded_eval_full_fast(mesh: Mesh, nu: int, subtree_levels: int):
     models/dpf_chacha.py), so the key batch shards on axis 0 and the leaf
     axis slices each key's subtree on the node axis — same zero-comms
     decomposition as the bit-plane path."""
-    from ..models.dpf_chacha import _convert_leaves_cc, _level_step_cc
-
-    c = subtree_levels
+    from ..models.dpf_chacha import _convert_leaves_cc
 
     def body(seeds, ts, scw, tcw, fcw):
-        S = [seeds[:, i : i + 1] for i in range(4)]
-        T = ts[:, None]
-
-        def step(i, S, T):
-            return _level_step_cc(
-                S, T,
-                [scw[:, i, w] for w in range(4)],
-                tcw[:, i, 0], tcw[:, i, 1],
-            )
-
-        for i in range(c):
-            S, T = step(i, S, T)
-        if c:
-            j = jax.lax.axis_index(LEAF_AXIS)
-            S = [jax.lax.dynamic_slice_in_dim(s, j, 1, axis=1) for s in S]
-            T = jax.lax.dynamic_slice_in_dim(T, j, 1, axis=1)
-        for i in range(c, nu):
-            S, T = step(i, S, T)
+        S, T = expand_subtree_local_cc(seeds, ts, scw, tcw, nu, subtree_levels)
         return _convert_leaves_cc(S, T, [fcw[:, j] for j in range(16)])
 
     sharded = jax.shard_map(
@@ -222,14 +231,12 @@ def eval_full_sharded_fast(kb, mesh: Mesh) -> np.ndarray:
     def padk(a):
         return np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
 
-    fn = _sharded_eval_full_fast(mesh, kb.nu, c)
-    words = np.asarray(
-        fn(
-            jnp.asarray(padk(kb.seeds)),
-            jnp.asarray(padk(kb.ts).astype(np.uint32)),
-            jnp.asarray(padk(kb.scw)),
-            jnp.asarray(padk(kb.tcw).astype(np.uint32)),
-            jnp.asarray(padk(kb.fcw)),
-        )
+    from ..models.keys_chacha import KeyBatchFast
+
+    padded = KeyBatchFast(
+        kb.log_n, padk(kb.seeds), padk(kb.ts), padk(kb.scw),
+        padk(kb.tcw), padk(kb.fcw),
     )
+    fn = _sharded_eval_full_fast(mesh, kb.nu, c)
+    words = np.asarray(fn(*padded.device_args()))
     return np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)

@@ -134,3 +134,49 @@ def test_ge_full_small_domain():
         np.arange(1 << log_n, dtype=np.uint64)[None, :] >= alphas[:, None]
     ).astype(np.uint8)
     np.testing.assert_array_equal(bits, want)
+
+
+def test_lt_fast_profile():
+    log_n, G = 10, 4
+    rng = np.random.default_rng(30)
+    alphas = rng.integers(0, 1 << log_n, size=G, dtype=np.uint64)
+    ca, cb = gen_lt_batch(alphas, log_n, rng=rng, profile="fast")
+    xs = np.broadcast_to(
+        np.arange(1 << log_n, dtype=np.uint64), (G, 1 << log_n)
+    ).copy()
+    got = eval_lt_points(ca, xs) ^ eval_lt_points(cb, xs)
+    np.testing.assert_array_equal(got, (xs < alphas[:, None]).astype(np.uint8))
+    # serialization keeps the profile
+    from dpf_tpu.models.fss import CmpKeyBatch
+
+    ca2 = CmpKeyBatch.from_bytes(ca.to_bytes(), log_n, profile="fast")
+    np.testing.assert_array_equal(
+        eval_lt_points(ca2, xs[:, :16]), eval_lt_points(ca, xs[:, :16])
+    )
+
+
+def test_interval_fast_profile():
+    log_n = 9
+    rng = np.random.default_rng(31)
+    lo = np.array([0, 100, 511], dtype=np.uint64)
+    hi = np.array([511, 200, 511], dtype=np.uint64)
+    ia, ib = gen_interval_batch(lo, hi, log_n, rng=rng, profile="fast")
+    xs = np.broadcast_to(np.arange(512, dtype=np.uint64), (3, 512)).copy()
+    got = eval_interval_points(ia, xs) ^ eval_interval_points(ib, xs)
+    want = ((xs >= lo[:, None]) & (xs <= hi[:, None])).astype(np.uint8)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_ge_full_fast_profile():
+    from dpf_tpu.models.keys_chacha import gen_batch as gen_fast
+
+    log_n, K = 11, 6
+    rng = np.random.default_rng(32)
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, kb = gen_fast(alphas, log_n, rng=rng)
+    rec = ge_full_from_dpf(ka) ^ ge_full_from_dpf(kb)
+    bits = np.unpackbits(rec, axis=1, bitorder="little")
+    want = (
+        np.arange(1 << log_n, dtype=np.uint64)[None, :] >= alphas[:, None]
+    ).astype(np.uint8)
+    np.testing.assert_array_equal(bits[:, : 1 << log_n], want)

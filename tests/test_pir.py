@@ -64,3 +64,37 @@ def test_pir_domain_mismatch_raises():
     qa, _ = pir_query([1], 4096, rng=rng)
     with pytest.raises(ValueError, match="domain"):
         PirServer(db).answer(qa)
+
+
+def test_pir_fast_profile_single():
+    from dpf_tpu.models.pir import PirServer, pir_query, pir_reconstruct
+
+    rng = np.random.default_rng(21)
+    n_rows, row_bytes, K = 700, 8, 5
+    db = rng.integers(0, 256, size=(n_rows, row_bytes), dtype=np.uint8)
+    idx = rng.integers(0, n_rows, size=K, dtype=np.uint64)
+    qa, qb = pir_query(idx, n_rows, rng=rng, profile="fast")
+    srv_a = PirServer(db, chunk_rows=256, profile="fast")
+    srv_b = PirServer(db, chunk_rows=256, profile="fast")
+    got = pir_reconstruct(srv_a.answer(qa), srv_b.answer(qb))
+    np.testing.assert_array_equal(got, db[idx.astype(np.int64)])
+
+
+def test_pir_fast_profile_sharded():
+    import jax
+
+    from dpf_tpu.models.pir import PirServer, pir_query, pir_reconstruct
+    from dpf_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    mesh = make_mesh(4, 2, devices=jax.devices()[:8])
+    rng = np.random.default_rng(22)
+    n_rows, row_bytes, K = 1500, 4, 6
+    db = rng.integers(0, 256, size=(n_rows, row_bytes), dtype=np.uint8)
+    idx = rng.integers(0, n_rows, size=K, dtype=np.uint64)
+    qa, qb = pir_query(idx, n_rows, rng=rng, profile="fast")
+    srv_a = PirServer(db, mesh=mesh, chunk_rows=256, profile="fast")
+    srv_b = PirServer(db, mesh=mesh, chunk_rows=256, profile="fast")
+    got = pir_reconstruct(srv_a.answer(qa), srv_b.answer(qb))
+    np.testing.assert_array_equal(got, db[idx.astype(np.int64)])
