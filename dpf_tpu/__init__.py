@@ -55,9 +55,11 @@ def __getattr__(name):
 
         return _fss
     if name == "fast":
-        from . import fast as _fast
+        # NOT ``from . import fast``: that re-enters this __getattr__ via
+        # _handle_fromlist and recurses.
+        import importlib
 
-        return _fast
+        return importlib.import_module(".fast", __name__)
     raise AttributeError(f"module 'dpf_tpu' has no attribute {name!r}")
 
 

