@@ -1,0 +1,5 @@
+"""Observability utilities: phase timing, XProf tracing, throughput metrics."""
+
+from .profiling import PhaseTimer, leaves_per_sec, trace
+
+__all__ = ["PhaseTimer", "leaves_per_sec", "trace"]

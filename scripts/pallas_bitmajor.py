@@ -1,0 +1,135 @@
+"""Experiment: bit-major plane order INSIDE the Pallas PRG kernel.
+
+Hypothesis: the production kernel's S-box slices (`s[:, 7-i]`, stride 8 on
+the sublane axis) cost relayout work per call; permuting the 128 planes to
+bit-major order (p' = 16*bit + byte) once per tile makes every S-box input
+a contiguous 16-row block.  Cost: two static 128-row permutations per
+cipher (in/out).  Run on TPU to compare against the production kernel.
+
+    python scripts/pallas_bitmajor.py [B_log2=17]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+sys.path.insert(0, ".")
+
+from dpf_tpu.core import aes_np
+from dpf_tpu.ops import aes_pallas
+from dpf_tpu.ops.aes_bitslice import prg_planes
+from dpf_tpu.ops.sbox_circuit import sbox_bp113
+
+# canonical plane 8*byte+bit  ->  bit-major plane 16*bit+byte
+_TO_BM = [8 * (p % 16) + p // 16 for p in range(128)]
+_FROM_BM = [16 * (p % 8) + p // 8 for p in range(128)]
+_SHIFT_PERM = [int(p) for p in aes_np.SHIFT_ROWS_PERM]
+
+
+def _permute(S, perm):
+    return jnp.concatenate([S[p : p + 1] for p in perm])
+
+
+def _sub_bytes_bm(S):  # [128, B] bit-major
+    s = S.reshape(8, 16, -1)
+    y = sbox_bp113([s[7 - i] for i in range(8)])
+    return jnp.concatenate(y[::-1]).reshape(128, -1)
+
+
+def _shift_rows_bm(S):
+    s = S.reshape(8, 16, -1)
+    return jnp.concatenate(
+        [s[:, p : p + 1] for p in _SHIFT_PERM], axis=1
+    ).reshape(128, -1)
+
+
+def _xtime_bm(a):  # [8, 16, B]
+    a0, a1, a2, a3, a4, a5, a6, a7 = (a[i : i + 1] for i in range(8))
+    return jnp.concatenate([a7, a0 ^ a7, a1, a2 ^ a7, a3 ^ a7, a4, a5, a6])
+
+
+def _mix_columns_bm(S):
+    s = S.reshape(8, 4, 4, -1)  # [bit, col, row, B]
+    r1 = jnp.concatenate([s[:, :, 1:], s[:, :, :1]], axis=2)
+    r2 = jnp.concatenate([s[:, :, 2:], s[:, :, :2]], axis=2)
+    r3 = jnp.concatenate([s[:, :, 3:], s[:, :, :3]], axis=2)
+    f = lambda x: _xtime_bm(x.reshape(8, 16, -1)).reshape(s.shape)  # noqa: E731
+    return (f(s) ^ f(r1) ^ r1 ^ r2 ^ r3).reshape(128, -1)
+
+
+def _encrypt_bm(S, rk):  # rk already bit-major [11, 128]
+    S = S ^ rk[0][:, None]
+    for rnd in range(1, 10):
+        S = _mix_columns_bm(_shift_rows_bm(_sub_bytes_bm(S))) ^ rk[rnd][:, None]
+    return _shift_rows_bm(_sub_bytes_bm(S)) ^ rk[10][:, None]
+
+
+def _prg_kernel_bm(s_ref, rk_ref, l_ref, r_ref):
+    S = s_ref[:]
+    Sbm = _permute(S, _TO_BM)
+    rk = rk_ref[:]
+    L = _encrypt_bm(Sbm, rk[0]) ^ Sbm
+    R = _encrypt_bm(Sbm, rk[1]) ^ Sbm
+    l_ref[:] = _permute(L, _FROM_BM)
+    r_ref[:] = _permute(R, _FROM_BM)
+
+
+def prg_planes_pallas_bm(S):
+    B = S.shape[1]
+    bt = 256 if B % 256 == 0 else 128
+    rk_bm = jnp.asarray(np.asarray(aes_pallas._RK_BOTH)[:, :, _TO_BM])
+    spec = pl.BlockSpec((128, bt), lambda i: (0, i))
+    return pl.pallas_call(
+        _prg_kernel_bm,
+        grid=(B // bt,),
+        in_specs=[spec, pl.BlockSpec((2, 11, 128), lambda i: (0, 0, 0))],
+        out_specs=[spec, spec],
+        out_shape=[jax.ShapeDtypeStruct((128, B), jnp.uint32)] * 2,
+        interpret=jax.default_backend() != "tpu",
+    )(S, rk_bm)
+
+
+def main():
+    blog = int(sys.argv[1]) if len(sys.argv) > 1 else 17
+    B = 1 << blog
+    rng = np.random.default_rng(0)
+    S = jnp.asarray(rng.integers(0, 1 << 32, size=(128, B), dtype=np.uint32))
+
+    L0, R0 = prg_planes(S[:, :512])
+    L1, R1 = prg_planes_pallas_bm(S[:, :512])
+    np.testing.assert_array_equal(np.asarray(L0), np.asarray(L1))
+    np.testing.assert_array_equal(np.asarray(R0), np.asarray(R1))
+    print("bit-major kernel correct")
+
+    if jax.default_backend() != "tpu":
+        print("(CPU: skipping timing)")
+        return
+
+    def timeit(fn):
+        @jax.jit
+        def summed(S):
+            L, R = fn(S)
+            return jnp.bitwise_xor.reduce(L ^ R, axis=None)
+
+        np.asarray(summed(S))
+        best = float("inf")
+        for _ in range(6):
+            t0 = time.perf_counter()
+            np.asarray(summed(S))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_prod = timeit(aes_pallas.prg_planes_pallas)
+    t_bm = timeit(prg_planes_pallas_bm)
+    print(f"production kernel: {t_prod * 1e3:8.2f} ms")
+    print(f"bit-major kernel:  {t_bm * 1e3:8.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""CLI driver smoke test (dpf_main.go parity surface)."""
+
+import os
+import subprocess
+import sys
+
+
+def test_cli_runs_and_reports():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, "-m", "dpf_tpu", "--log-n", "10", "--keys", "32",
+         "--reps", "2"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "EvalFull time" in out.stdout
+    assert "evalfull (device)" in out.stdout  # phase breakdown present
