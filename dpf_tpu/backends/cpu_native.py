@@ -81,6 +81,21 @@ def _load():
         lib.dpfn_eval_points_batch.argtypes = [
             u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, u64p, ctypes.c_uint64, u8p,
         ]
+        # Fast profile (ChaCha12, core/chacha_np.py layout).
+        lib.dpfn_cc_key_len.restype = ctypes.c_uint64
+        lib.dpfn_cc_key_len.argtypes = [ctypes.c_uint64]
+        lib.dpfn_cc_output_len.restype = ctypes.c_uint64
+        lib.dpfn_cc_output_len.argtypes = [ctypes.c_uint64]
+        lib.dpfn_cc_gen.restype = ctypes.c_int
+        lib.dpfn_cc_gen.argtypes = [ctypes.c_uint64, ctypes.c_uint64, u8p, u8p, u8p, u8p]
+        lib.dpfn_cc_eval.restype = ctypes.c_int
+        lib.dpfn_cc_eval.argtypes = [u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64]
+        lib.dpfn_cc_eval_full.restype = ctypes.c_int
+        lib.dpfn_cc_eval_full.argtypes = [u8p, ctypes.c_uint64, ctypes.c_uint64, u8p, ctypes.c_uint64]
+        lib.dpfn_cc_eval_full_batch.restype = ctypes.c_int
+        lib.dpfn_cc_eval_full_batch.argtypes = [
+            u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, u8p, ctypes.c_uint64,
+        ]
         _lib = lib
         return _lib
 
@@ -159,6 +174,69 @@ def eval_full_batch(keys: list[bytes], log_n: int) -> np.ndarray:
     rc = lib.dpfn_eval_full_batch(_u8ptr(arr), len(keys), klen, log_n, _u8ptr(out), olen)
     if rc:
         raise ValueError(f"dpf: native eval_full_batch failed (rc={rc})")
+    return out
+
+
+# --------------------------------------------------------------------------
+# Fast profile (ChaCha12): native mirrors of dpf_tpu.fast
+# --------------------------------------------------------------------------
+
+
+def cc_gen(alpha: int, log_n: int, rng: np.random.Generator | None = None) -> tuple[bytes, bytes]:
+    """Native fast-profile Gen (key layout: core/chacha_np.py)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    if rng is None:
+        seeds = np.frombuffer(os.urandom(32), dtype=np.uint8).copy()
+    else:
+        seeds = rng.integers(0, 256, size=32, dtype=np.uint8)
+    klen = int(lib.dpfn_cc_key_len(log_n))
+    ka = np.empty(klen, np.uint8)
+    kb = np.empty(klen, np.uint8)
+    rc = lib.dpfn_cc_gen(alpha, log_n, _u8ptr(seeds[:16]), _u8ptr(seeds[16:]),
+                         _u8ptr(ka), _u8ptr(kb))
+    if rc:
+        raise ValueError("dpf-fast: invalid parameters")
+    return ka.tobytes(), kb.tobytes()
+
+
+def cc_eval_point(key: bytes, x: int, log_n: int) -> int:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    kb = np.frombuffer(bytes(key), dtype=np.uint8)
+    rc = lib.dpfn_cc_eval(_u8ptr(kb), len(kb), x, log_n)
+    if rc < 0:
+        raise ValueError(f"dpf-fast: native eval failed (rc={rc})")
+    return rc
+
+
+def cc_eval_full(key: bytes, log_n: int) -> bytes:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    kb = np.frombuffer(bytes(key), dtype=np.uint8)
+    out = np.empty(int(lib.dpfn_cc_output_len(log_n)), np.uint8)
+    rc = lib.dpfn_cc_eval_full(_u8ptr(kb), len(kb), log_n, _u8ptr(out), out.size)
+    if rc:
+        raise ValueError(f"dpf-fast: native eval_full failed (rc={rc})")
+    return out.tobytes()
+
+
+def cc_eval_full_batch(keys: list[bytes], log_n: int) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    klen = int(lib.dpfn_cc_key_len(log_n))
+    arr = np.frombuffer(b"".join(keys), dtype=np.uint8)
+    if arr.size != klen * len(keys):
+        raise ValueError("dpf-fast: bad key length in batch")
+    olen = int(lib.dpfn_cc_output_len(log_n))
+    out = np.empty((len(keys), olen), np.uint8)
+    rc = lib.dpfn_cc_eval_full_batch(_u8ptr(arr), len(keys), klen, log_n, _u8ptr(out), olen)
+    if rc:
+        raise ValueError(f"dpf-fast: native eval_full_batch failed (rc={rc})")
     return out
 
 

@@ -38,14 +38,29 @@ __all__ = [
 ]
 
 
+def _native():
+    """The C++ backend when it is built and usable, else None."""
+    from .backends import cpu_native
+
+    return cpu_native if cpu_native.available() else None
+
+
 def Gen(alpha: int, log_n: int, rng=None) -> tuple[bytes, bytes]:
     """Generate a fast-profile key pair for ``alpha`` in [0, 2^log_n)."""
+    nat = _native()
+    if nat is not None:
+        return nat.cc_gen(alpha, log_n, rng)
     return _cc.gen(alpha, log_n, rng)
 
 
 def Eval(key: bytes, x: int, log_n: int, backend: str = "auto") -> int:
-    """Evaluate one share at one point -> bit."""
+    """Evaluate one share at one point -> bit.  Host-side by default (a
+    single query does not amortize a device dispatch); native C++ when
+    built, NumPy spec otherwise."""
     if backend in ("auto", "cpu"):
+        nat = _native()
+        if nat is not None:
+            return nat.cc_eval_point(key, x, log_n)
         return _cc.eval_point(key, x, log_n)
     kb = KeyBatchFast.from_bytes([key], log_n)
     return int(_eval_points_dev(kb, np.array([[x]], dtype=np.uint64))[0, 0])
@@ -55,6 +70,9 @@ def EvalFull(key: bytes, log_n: int, backend: str = "auto") -> bytes:
     """Full-domain evaluation of one share -> bit-packed bytes
     (2^(log_n-3), minimum 64)."""
     if backend == "cpu":
+        nat = _native()
+        if nat is not None:
+            return nat.cc_eval_full(key, log_n)
         return _cc.eval_full(key, log_n)
     kb = KeyBatchFast.from_bytes([key], log_n)
     return eval_full_batch(kb)[0].tobytes()

@@ -464,3 +464,275 @@ int dpfn_eval_points_batch(const uint8_t* keys, uint64_t n_keys,
 }
 
 }  // extern "C"
+
+// ===========================================================================
+// Fast profile (ChaCha12 PRG, 512-bit leaves) — native mirror of the spec in
+// dpf_tpu/core/chacha_np.py.  Keys: seed(16) | t(1) | nu*18 | 64, with
+// nu = max(log_n - 9, 0).  Pure uint32 ARX; no CPU feature requirements.
+// ===========================================================================
+
+namespace cc {
+
+constexpr int kRounds = 12;
+constexpr uint64_t kLeafLog = 9;
+constexpr uint32_t kConst[4] = {0x61707865u, 0x3320646Eu, 0x79622D32u,
+                                0x6B206574u};
+constexpr uint32_t kDsExpand[4] = {0x6A09E667u, 0xBB67AE85u, 0x3C6EF372u,
+                                   0xA54FF53Au};
+constexpr uint32_t kDsLeaf[4] = {0x510E527Fu, 0x9B05688Cu, 0x1F83D9ABu,
+                                 0x5BE0CD19u};
+
+inline uint64_t levels(uint64_t log_n) {
+  return log_n >= kLeafLog ? log_n - kLeafLog : 0;
+}
+inline uint64_t klen(uint64_t log_n) { return 17 + 18 * levels(log_n) + 64; }
+
+inline uint32_t rotl(uint32_t x, int r) { return (x << r) | (x >> (32 - r)); }
+
+inline void qr(uint32_t s[16], int a, int b, int c, int d) {
+  s[a] += s[b];
+  s[d] = rotl(s[d] ^ s[a], 16);
+  s[c] += s[d];
+  s[b] = rotl(s[b] ^ s[c], 12);
+  s[a] += s[b];
+  s[d] = rotl(s[d] ^ s[a], 8);
+  s[c] += s[d];
+  s[b] = rotl(s[b] ^ s[c], 7);
+}
+
+// seed: 4 words; ds: 4 words; out: first n_out words of state + init.
+inline void block(const uint32_t seed[4], const uint32_t ds[4], uint32_t* out,
+                  int n_out) {
+  uint32_t init[16], s[16];
+  for (int i = 0; i < 4; i++) init[i] = kConst[i];
+  for (int i = 0; i < 4; i++) init[4 + i] = seed[i];
+  for (int i = 0; i < 4; i++) init[8 + i] = ds[i];
+  init[12] = init[13] = init[14] = init[15] = 0;
+  std::memcpy(s, init, sizeof(s));
+  for (int r = 0; r < kRounds / 2; r++) {
+    qr(s, 0, 4, 8, 12);
+    qr(s, 1, 5, 9, 13);
+    qr(s, 2, 6, 10, 14);
+    qr(s, 3, 7, 11, 15);
+    qr(s, 0, 5, 10, 15);
+    qr(s, 1, 6, 11, 12);
+    qr(s, 2, 7, 8, 13);
+    qr(s, 3, 4, 9, 14);
+  }
+  for (int i = 0; i < n_out; i++) out[i] = s[i] + init[i];
+}
+
+inline void expand(const uint32_t seed[4], uint32_t l[4], uint32_t r[4]) {
+  uint32_t out[8];
+  block(seed, kDsExpand, out, 8);
+  std::memcpy(l, out, 16);
+  std::memcpy(r, out + 4, 16);
+}
+
+inline void convert(const uint32_t seed[4], uint32_t leaf[16]) {
+  block(seed, kDsLeaf, leaf, 16);
+}
+
+inline void load4(const uint8_t* p, uint32_t w[4]) {
+  std::memcpy(w, p, 16);  // little-endian hosts only (x86)
+}
+inline void store4(uint8_t* p, const uint32_t w[4]) { std::memcpy(p, w, 16); }
+inline void xor4(uint32_t a[4], const uint32_t b[4]) {
+  for (int i = 0; i < 4; i++) a[i] ^= b[i];
+}
+
+inline bool canonical(const uint8_t* key, uint64_t log_n) {
+  const uint64_t lv = levels(log_n);
+  if (key[0] & 1 || key[16] > 1) return false;
+  for (uint64_t i = 0; i < lv; i++) {
+    const uint8_t* cw = key + 17 + 18 * i;
+    if (cw[0] & 1 || cw[16] > 1 || cw[17] > 1) return false;
+  }
+  return true;
+}
+
+struct St {
+  uint32_t s[4];
+  int t;
+};
+
+inline void descend(St& st, const uint8_t* cw, int go_right) {
+  uint32_t l[4], r[4];
+  expand(st.s, l, r);
+  int tl = l[0] & 1, tr = r[0] & 1;
+  l[0] &= ~1u;
+  r[0] &= ~1u;
+  if (st.t) {
+    uint32_t scw[4];
+    load4(cw, scw);
+    xor4(l, scw);
+    xor4(r, scw);
+    tl ^= cw[16];
+    tr ^= cw[17];
+  }
+  std::memcpy(st.s, go_right ? r : l, 16);
+  st.t = go_right ? tr : tl;
+}
+
+}  // namespace cc
+
+extern "C" {
+
+uint64_t dpfn_cc_key_len(uint64_t log_n) { return cc::klen(log_n); }
+
+uint64_t dpfn_cc_output_len(uint64_t log_n) {
+  return log_n >= cc::kLeafLog ? (1ULL << (log_n - 3)) : 64;
+}
+
+int dpfn_cc_gen(uint64_t alpha, uint64_t log_n, const uint8_t* seed0,
+                const uint8_t* seed1, uint8_t* ka, uint8_t* kb) {
+  if (log_n > 63 || alpha >= (1ULL << log_n)) return -1;
+  const uint64_t lv = cc::levels(log_n);
+
+  uint32_t s0[4], s1[4];
+  cc::load4(seed0, s0);
+  cc::load4(seed1, s1);
+  int t0 = s0[0] & 1, t1 = t0 ^ 1;
+  s0[0] &= ~1u;
+  s1[0] &= ~1u;
+  cc::store4(ka, s0);
+  ka[16] = static_cast<uint8_t>(t0);
+  cc::store4(kb, s1);
+  kb[16] = static_cast<uint8_t>(t1);
+  uint8_t* cw_out = ka + 17;
+
+  for (uint64_t i = 0; i < lv; i++) {
+    uint32_t l0[4], r0[4], l1[4], r1[4];
+    cc::expand(s0, l0, r0);
+    cc::expand(s1, l1, r1);
+    int t0l = l0[0] & 1, t0r = r0[0] & 1, t1l = l1[0] & 1, t1r = r1[0] & 1;
+    l0[0] &= ~1u;
+    r0[0] &= ~1u;
+    l1[0] &= ~1u;
+    r1[0] &= ~1u;
+
+    const int bit = (alpha >> (log_n - 1 - i)) & 1;
+    uint32_t scw[4];
+    std::memcpy(scw, bit ? l0 : r0, 16);
+    cc::xor4(scw, bit ? l1 : r1);
+    const uint8_t tlcw = static_cast<uint8_t>(t0l ^ t1l ^ bit ^ 1);
+    const uint8_t trcw = static_cast<uint8_t>(t0r ^ t1r ^ bit);
+    cc::store4(cw_out, scw);
+    cw_out[16] = tlcw;
+    cw_out[17] = trcw;
+
+    std::memcpy(s0, bit ? r0 : l0, 16);
+    std::memcpy(s1, bit ? r1 : l1, 16);
+    const int keep_t0 = bit ? t0r : t0l;
+    const int keep_t1 = bit ? t1r : t1l;
+    const uint8_t keep_tcw = bit ? trcw : tlcw;
+    if (t0) cc::xor4(s0, scw);
+    if (t1) cc::xor4(s1, scw);
+    t0 = keep_t0 ^ (t0 ? keep_tcw : 0);
+    t1 = keep_t1 ^ (t1 ? keep_tcw : 0);
+    cw_out += 18;
+  }
+
+  uint32_t c0[16], c1[16];
+  cc::convert(s0, c0);
+  cc::convert(s1, c1);
+  for (int i = 0; i < 16; i++) c0[i] ^= c1[i];
+  const uint64_t low = log_n >= cc::kLeafLog ? (alpha & 511) : alpha;
+  c0[low >> 5] ^= 1u << (low & 31);
+  std::memcpy(cw_out, c0, 64);
+  std::memcpy(kb + 17, ka + 17, 18 * lv + 64);
+  return 0;
+}
+
+int dpfn_cc_eval(const uint8_t* key, uint64_t key_len, uint64_t x,
+                 uint64_t log_n) {
+  if (log_n > 63 || key_len != cc::klen(log_n)) return -1;
+  if (x >> log_n) return -3;
+  if (!cc::canonical(key, log_n)) return -4;
+  const uint64_t lv = cc::levels(log_n);
+  cc::St st;
+  cc::load4(key, st.s);
+  st.t = key[16];
+  for (uint64_t i = 0; i < lv; i++)
+    cc::descend(st, key + 17 + 18 * i, (x >> (log_n - 1 - i)) & 1);
+  uint32_t leaf[16];
+  cc::convert(st.s, leaf);
+  if (st.t) {
+    const uint8_t* fcw = key + key_len - 64;
+    for (int i = 0; i < 16; i++) {
+      uint32_t w;
+      std::memcpy(&w, fcw + 4 * i, 4);
+      leaf[i] ^= w;
+    }
+  }
+  const uint64_t low = log_n >= cc::kLeafLog ? (x & 511) : x;
+  return (leaf[low >> 5] >> (low & 31)) & 1;
+}
+
+int dpfn_cc_eval_full(const uint8_t* key, uint64_t key_len, uint64_t log_n,
+                      uint8_t* out, uint64_t out_len) {
+  if (log_n > 63 || key_len != cc::klen(log_n)) return -1;
+  if (out_len < dpfn_cc_output_len(log_n)) return -2;
+  if (!cc::canonical(key, log_n)) return -4;
+  const uint64_t lv = cc::levels(log_n);
+  uint32_t fcw[16];
+  std::memcpy(fcw, key + key_len - 64, 64);
+
+  std::vector<cc::St> pending(lv + 1);
+  uint64_t pending_mask = 0;
+  cc::St cur;
+  cc::load4(key, cur.s);
+  cur.t = key[16];
+  uint64_t depth = 0;
+  uint8_t* out_cursor = out;
+  for (;;) {
+    if (depth == lv) {
+      uint32_t leaf[16];
+      cc::convert(cur.s, leaf);
+      if (cur.t)
+        for (int i = 0; i < 16; i++) leaf[i] ^= fcw[i];
+      std::memcpy(out_cursor, leaf, 64);
+      out_cursor += 64;
+      if (!pending_mask) break;
+      uint64_t d = 63 - static_cast<uint64_t>(__builtin_clzll(pending_mask));
+      pending_mask &= ~(1ULL << d);
+      cur = pending[d];
+      depth = d + 1;
+      continue;
+    }
+    const uint8_t* cw = key + 17 + 18 * depth;
+    uint32_t l[4], r[4];
+    cc::expand(cur.s, l, r);
+    int tl = l[0] & 1, tr = r[0] & 1;
+    l[0] &= ~1u;
+    r[0] &= ~1u;
+    if (cur.t) {
+      uint32_t scw[4];
+      cc::load4(cw, scw);
+      cc::xor4(l, scw);
+      cc::xor4(r, scw);
+      tl ^= cw[16];
+      tr ^= cw[17];
+    }
+    std::memcpy(pending[depth].s, r, 16);
+    pending[depth].t = tr;
+    pending_mask |= 1ULL << depth;
+    std::memcpy(cur.s, l, 16);
+    cur.t = tl;
+    depth++;
+  }
+  return 0;
+}
+
+int dpfn_cc_eval_full_batch(const uint8_t* keys, uint64_t n_keys,
+                            uint64_t key_len, uint64_t log_n, uint8_t* out,
+                            uint64_t out_stride) {
+  for (uint64_t i = 0; i < n_keys; i++) {
+    int rc = dpfn_cc_eval_full(keys + i * key_len, key_len, log_n,
+                               out + i * out_stride, out_stride);
+    if (rc) return rc;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -85,3 +85,43 @@ def test_native_rejects_noncanonical_and_oob_like_spec():
         cn.eval_point(ka, 1 << 10, 10)  # x out of domain, like spec
     with pytest.raises(ValueError):
         cn.eval_points_batch([ka[:-1]], np.zeros((1, 2), np.uint64), 10)
+
+
+def test_native_fast_profile_matches_spec():
+    # Native ChaCha path vs the NumPy spec, byte-exact keys and outputs.
+    from dpf_tpu.core import chacha_np as cc
+
+    rng = np.random.default_rng(41)
+    for log_n in (4, 9, 12):
+        for alpha in (0, (1 << log_n) - 1):
+            r1 = np.random.default_rng(7)
+            r2 = np.random.default_rng(7)
+            ka_n, kb_n = cn.cc_gen(alpha, log_n, rng=r1)
+            ka_s, kb_s = cc.gen(alpha, log_n, rng=r2)
+            assert ka_n == ka_s and kb_n == kb_s  # same seeds -> same keys
+            assert cn.cc_eval_full(ka_n, log_n) == cc.eval_full(
+                ka_s, log_n
+            )
+            x = int(rng.integers(0, 1 << log_n))
+            assert cn.cc_eval_point(ka_n, x, log_n) == cc.eval_point(
+                ka_s, x, log_n
+            )
+    # batch + reconstruction
+    log_n, K = 11, 6
+    r = np.random.default_rng(11)
+    pairs = [cn.cc_gen(int(a), log_n, rng=r)
+             for a in r.integers(0, 1 << log_n, size=K)]
+    out_a = cn.cc_eval_full_batch([p[0] for p in pairs], log_n)
+    out_b = cn.cc_eval_full_batch([p[1] for p in pairs], log_n)
+    bits = np.unpackbits(out_a ^ out_b, axis=1, bitorder="little")
+    assert (bits.sum(axis=1) == 1).all()
+
+
+def test_native_fast_rejects_bad():
+    with pytest.raises(ValueError):
+        cn.cc_gen(1 << 10, 10)
+    ka, _ = cn.cc_gen(5, 10)
+    with pytest.raises(ValueError):
+        cn.cc_eval_point(ka, 1 << 10, 10)
+    with pytest.raises(ValueError):
+        cn.cc_eval_full(ka[:-1], 10)
