@@ -140,11 +140,60 @@ def _eval_full_cc_jit(nu, seeds, ts, scw, tcw, fcw):
     return _convert_leaves_cc(S, T, [fcw[:, j] for j in range(16)])
 
 
-def eval_full(kb: KeyBatchFast) -> np.ndarray:
+@partial(jax.jit, static_argnums=(0,))
+def _expand_prefix_cc_jit(n_levels, seeds, ts, scw, tcw):
+    S = [seeds[:, i : i + 1] for i in range(4)]
+    T = ts[:, None]
+    for i in range(n_levels):
+        S, T = _level_step_cc(
+            S, T, [scw[:, i, w] for w in range(4)], tcw[:, i, 0], tcw[:, i, 1]
+        )
+    return S, T
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _finish_chunk_cc_jit(n_levels, first, S, T, scw, tcw, fcw):
+    for i in range(n_levels):
+        j = first + i
+        S, T = _level_step_cc(
+            S, T, [scw[:, j, w] for w in range(4)], tcw[:, j, 0], tcw[:, j, 1]
+        )
+    return _convert_leaves_cc(S, T, [fcw[:, j] for j in range(16)])
+
+
+# Soft cap on K * 2^nu leaf nodes per compiled expansion (each leaf is 64 B
+# plus transient children); above it the tree splits into independent
+# subtree chunks, mirroring the compat path (models/dpf.py:MAX_PLANE_WORDS).
+MAX_LEAF_NODES = 1 << 23  # 512 MB of leaf words per chunk
+
+
+def eval_full_device(kb: KeyBatchFast, max_leaf_nodes: int = MAX_LEAF_NODES):
+    """Full-domain evaluation on device -> uint32[K, 2^nu, 16] leaf words
+    (word j of leaf w holds domain bits [512w + 32j, +32), LSB-first)."""
+    nu = kb.nu
+    args = kb.device_args()
+    total = kb.k << nu
+    if total <= max_leaf_nodes:
+        return _eval_full_cc_jit(nu, *args)
+    seeds, ts, scw, tcw, fcw = args
+    n_chunks = -(-total // max_leaf_nodes)
+    c = min((n_chunks - 1).bit_length(), nu)
+    S, T = _expand_prefix_cc_jit(c, seeds, ts, scw, tcw)
+    outs = []
+    for j in range(1 << c):
+        Sj = [s[:, j : j + 1] for s in S]
+        outs.append(
+            _finish_chunk_cc_jit(nu - c, c, Sj, T[:, j : j + 1], scw, tcw, fcw)
+        )
+    return jnp.concatenate(outs, axis=1)
+
+
+def eval_full(kb: KeyBatchFast, max_leaf_nodes: int = MAX_LEAF_NODES) -> np.ndarray:
     """Full-domain evaluation -> uint8[K, out_bytes] bit-packed
     (out_bytes = 2^(log_n-3), min 64), byte-identical to the spec
-    ``chacha_np.eval_full`` per key."""
-    words = np.asarray(_eval_full_cc_jit(kb.nu, *kb.device_args()))
+    ``chacha_np.eval_full`` per key.  Domains too large to materialize in
+    one pass split into independent GGM subtree chunks."""
+    words = np.asarray(eval_full_device(kb, max_leaf_nodes))
     return np.ascontiguousarray(words).view("<u1").reshape(kb.k, -1)
 
 
