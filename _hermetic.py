@@ -1,0 +1,48 @@
+"""Single source of truth for the hermetic virtual-CPU-mesh JAX environment.
+
+Used by ``__graft_entry__.dryrun_multichip`` (subprocess env), ``tests/
+conftest.py`` (in-process, before the first ``import jax``), and mirrored by
+``runtests.sh``.  The recipe:
+
+- drop ``PALLAS_AXON_POOL_IPS``: if the axon device tunnel is wedged, any
+  process where the axon TPU plugin registers hangs inside ``jax.devices()``
+  even with ``JAX_PLATFORMS=cpu``;
+- force ``JAX_PLATFORMS=cpu``;
+- force exactly one ``--xla_force_host_platform_device_count=<n>`` in
+  ``XLA_FLAGS`` (replacing any existing occurrence, which would otherwise
+  win last-flag-wins parsing).
+"""
+
+from __future__ import annotations
+
+import os
+
+# Env vars that must not reach a hermetic JAX process.
+_HOSTILE_VARS = ("PALLAS_AXON_POOL_IPS",)
+
+
+def hermetic_cpu_env(n_devices: int, base=None) -> dict:
+    """A copy of ``base`` (default ``os.environ``) forced onto ``n_devices``
+    virtual CPU devices with the axon TPU plugin disabled."""
+    env = dict(os.environ if base is None else base)
+    for var in _HOSTILE_VARS:
+        env.pop(var, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def apply_hermetic_cpu_env(n_devices: int = 8) -> None:
+    """Force the hermetic env onto ``os.environ`` in place.
+
+    Must run before the first ``import jax`` in the process."""
+    env = hermetic_cpu_env(n_devices)
+    for var in _HOSTILE_VARS:
+        os.environ.pop(var, None)
+    os.environ.update(env)

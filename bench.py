@@ -30,6 +30,7 @@ Prints exactly ONE JSON line:
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -178,7 +179,8 @@ def bench_compat(jax, jnp, rng, rtt: float) -> float:
     return K * (1 << LOG_N) / max(best - rtt, 1e-4)
 
 
-def main() -> None:
+def _measure_all():
+    """One full measurement pass.  Raises on any failure."""
     import jax
 
     # Persistent compilation cache: the ~13 per-level Mosaic kernels plus the
@@ -189,16 +191,59 @@ def main() -> None:
     import jax.numpy as jnp
 
     rng = np.random.default_rng(2026)
+    rtt = _measure_rtt(jax)
+    fast = bench_fast(jax, jnp, rng)
+    compat = bench_compat(jax, jnp, rng, rtt)
+    return fast, compat
+
+
+def main() -> None:
+    """Always prints exactly one JSON line, whatever happens.
+
+    The benchmark record is the round's scoreboard (BENCH_r*.json); an infra
+    hiccup (the axon device tunnel dropping, a backend-init RuntimeError —
+    r01's failure mode) must degrade to a structured `"infra": true` record
+    with bounded retries, never a raw traceback.  Correctness failures
+    (AssertionError from the reconstruction spot-checks) are NOT retried and
+    exit nonzero — a wrong answer is a bug, not weather.
+    """
     try:
-        rtt = _measure_rtt(jax)
-        fast = bench_fast(jax, jnp, rng)
-        compat = bench_compat(jax, jnp, rng, rtt)
-    except AssertionError as e:
+        backoff = float(os.environ.get("DPF_TPU_BENCH_BACKOFF", "10"))
+    except ValueError:
+        backoff = 10.0
+    fast = compat = None
+    err: Exception | None = None
+    attempts = 3
+    for attempt in range(attempts):
+        try:
+            fast, compat = _measure_all()
+            err = None
+            break
+        except AssertionError as e:
+            print(
+                json.dumps({"metric": "error", "value": 0, "unit": "",
+                            "vs_baseline": 0, "detail": str(e)})
+            )
+            sys.exit(1)
+        except Exception as e:  # infra: device tunnel, backend init, OOM
+            err = e
+            if attempt < attempts - 1:
+                time.sleep(backoff * (attempt + 1))
+
+    if err is not None or fast is None:
         print(
-            json.dumps({"metric": "error", "value": 0, "unit": "",
-                        "vs_baseline": 0, "detail": str(e)})
+            json.dumps(
+                {
+                    "metric": f"eval_full_batch K={K} n={LOG_N}",
+                    "value": 0,
+                    "unit": "Gleaves/sec",
+                    "vs_baseline": 0,
+                    "infra": True,
+                    "detail": f"{type(err).__name__}: {err}"[:500],
+                }
+            )
         )
-        sys.exit(1)
+        return
 
     baseline = measure_baseline()
     print(

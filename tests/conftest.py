@@ -1,14 +1,16 @@
 """Test config: force JAX onto a virtual 8-device CPU mesh.
 
 Multi-chip hardware is not available in CI; sharding tests run against
-8 virtual CPU devices.  Must run before the first ``import jax``.
+8 virtual CPU devices.  Must run before the first ``import jax``.  The env
+recipe lives in ``_hermetic.py`` (shared with ``__graft_entry__`` and
+``runtests.sh``).
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _hermetic import apply_hermetic_cpu_env
+
+apply_hermetic_cpu_env(8)

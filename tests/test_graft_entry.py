@@ -1,0 +1,45 @@
+"""The driver-facing entry points must be hermetic.
+
+``MULTICHIP_r01/r02.json`` both went red because ``dryrun_multichip`` ran
+against whatever JAX environment the driver happened to have (the axon TPU
+plugin registering its single real chip, or hanging on a wedged tunnel)
+instead of forcing the virtual CPU mesh.  These tests call the entry point
+from a deliberately hostile environment and assert it still passes — the
+same contract the driver relies on.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_multichip_hermetic_under_hostile_env():
+    """dryrun_multichip(8) must pass even when the caller's env points JAX
+    at a (here: unreachable) axon TPU pool and sets no CPU-mesh flags."""
+    env = dict(os.environ)
+    # Hostile: axon plugin var present, no platform/device-count guards.
+    env["PALLAS_AXON_POOL_IPS"] = "203.0.113.1"
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    env.pop("_DPF_TPU_DRYRUN_INNER", None)
+    code = (
+        "import sys; sys.path.insert(0, {r!r}); "
+        "import __graft_entry__ as g; g.dryrun_multichip(8)"
+    ).format(r=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=1500,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "dryrun_multichip ok" in proc.stdout
+
+
+def test_dryrun_multichip_inner_env_is_scoped():
+    """The inner-run marker must not leak into the calling process env."""
+    assert os.environ.get("_DPF_TPU_DRYRUN_INNER") != "1"
