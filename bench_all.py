@@ -19,7 +19,24 @@ import time
 
 import numpy as np
 
-from bench import FALLBACK_BASELINE, _measure_rtt, measure_baseline
+from bench import FALLBACK_BASELINE, measure_baseline
+
+
+def _measure_rtt(jax) -> float:
+    """Per-dispatch overhead of this environment's device tunnel: a trivial
+    scalar jit call, median of several.  Subtracted from single-dispatch
+    timings below (the headline bench.py uses chained-slope timing instead;
+    here one expansion per dispatch keeps the 5-config matrix affordable)."""
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda v: v + jnp.float32(1))
+    np.asarray(f(jnp.float32(0)))
+    ts = []
+    for _ in range(8):
+        t0 = time.perf_counter()
+        np.asarray(f(jnp.float32(0)))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
 
 
 def _timed(fn, args, rtt, reps=4):

@@ -1,0 +1,138 @@
+// Package dpftpu is a Go client for the dpf_tpu evaluation sidecar.
+//
+// It mirrors the reference library's public surface (dpf/dpf.go: Gen, Eval,
+// EvalFull, type DPFkey []byte) over the sidecar's HTTP endpoints
+// (dpf_tpu/server.py), keeping the reference's keys-as-bytes wire contract:
+// the bytes this client sends and receives are byte-identical to the
+// reference implementation's keys and outputs in the default ("compat")
+// profile.  Only the execution moved — from in-process AES-NI assembly to a
+// TPU evaluator behind a socket.
+//
+// Start the sidecar, then point the client at it:
+//
+//	python -m dpf_tpu.server --port 8990
+//
+//	c := dpftpu.New("http://127.0.0.1:8990")
+//	ka, kb, err := c.Gen(123, 20)
+//	out, err := c.EvalFull(ka, 20)
+package dpftpu
+
+import (
+	"bytes"
+	"fmt"
+	"io"
+	"net/http"
+	"time"
+)
+
+// DPFkey is an opaque serialized DPF key, byte-compatible with the
+// reference's type of the same name (dpf/dpf.go:7).
+type DPFkey []byte
+
+// Client talks to one dpf_tpu sidecar.  Profile selects the evaluation
+// profile: "compat" (reference-key-compatible AES-MMO; default) or "fast"
+// (the TPU-native ChaCha profile — keys are NOT reference-compatible).
+type Client struct {
+	BaseURL string
+	Profile string
+	HTTP    *http.Client
+}
+
+// New returns a client for the sidecar at baseURL (e.g.
+// "http://127.0.0.1:8990") using the compat profile.
+func New(baseURL string) *Client {
+	return &Client{
+		BaseURL: baseURL,
+		Profile: "compat",
+		// Full-domain expansions at large n take seconds on first compile.
+		HTTP: &http.Client{Timeout: 120 * time.Second},
+	}
+}
+
+func (c *Client) post(path string, body []byte) ([]byte, error) {
+	url := c.BaseURL + path + "&profile=" + c.Profile
+	resp, err := c.HTTP.Post(url, "application/octet-stream",
+		bytes.NewReader(body))
+	if err != nil {
+		return nil, fmt.Errorf("dpftpu: %w", err)
+	}
+	defer resp.Body.Close()
+	out, err := io.ReadAll(resp.Body)
+	if err != nil {
+		return nil, fmt.Errorf("dpftpu: reading response: %w", err)
+	}
+	if resp.StatusCode != http.StatusOK {
+		// The sidecar reports evaluation errors as 400 + text reason —
+		// surfaced here as a Go error, never a panic (SURVEY §5.3).
+		return nil, fmt.Errorf("dpftpu: %s: %s", resp.Status, out)
+	}
+	return out, nil
+}
+
+// Gen generates a key pair hiding alpha in [0, 2^logN), mirroring the
+// reference Gen (dpf/dpf.go:71).  The point is a query parameter because
+// generation happens server-side (the sidecar holds the CSPRNG).
+func (c *Client) Gen(alpha uint64, logN uint) (DPFkey, DPFkey, error) {
+	out, err := c.post(
+		fmt.Sprintf("/v1/gen?log_n=%d&alpha=%d", logN, alpha), nil)
+	if err != nil {
+		return nil, nil, err
+	}
+	if len(out)%2 != 0 || len(out) == 0 {
+		return nil, nil, fmt.Errorf("dpftpu: bad gen reply length %d", len(out))
+	}
+	h := len(out) / 2
+	return DPFkey(out[:h]), DPFkey(out[h:]), nil
+}
+
+// Eval evaluates one share at point x, mirroring the reference Eval
+// (dpf/dpf.go:171): returns 0 or 1.
+func (c *Client) Eval(k DPFkey, x uint64, logN uint) (byte, error) {
+	out, err := c.post(
+		fmt.Sprintf("/v1/eval?log_n=%d&x=%d", logN, x), k)
+	if err != nil {
+		return 0, err
+	}
+	if len(out) != 1 {
+		return 0, fmt.Errorf("dpftpu: bad eval reply length %d", len(out))
+	}
+	return out[0], nil
+}
+
+// EvalFull expands one share over the whole domain, mirroring the reference
+// EvalFull (dpf/dpf.go:243): returns 2^(logN-3) bit-packed bytes (bit x at
+// byte x/8, bit x%8 — the reference's LSB-first layout).
+func (c *Client) EvalFull(k DPFkey, logN uint) ([]byte, error) {
+	return c.post(fmt.Sprintf("/v1/evalfull?log_n=%d", logN), k)
+}
+
+// EvalFullBatch expands K shares in one round trip — the entry point that
+// amortizes the device dispatch and where the TPU speedup lives.  All keys
+// must have the same logN; the reply is the K concatenated expansions.
+func (c *Client) EvalFullBatch(keys []DPFkey, logN uint) ([][]byte, error) {
+	if len(keys) == 0 {
+		return nil, nil
+	}
+	kl := len(keys[0])
+	body := make([]byte, 0, kl*len(keys))
+	for _, k := range keys {
+		if len(k) != kl {
+			return nil, fmt.Errorf("dpftpu: inconsistent key lengths")
+		}
+		body = append(body, k...)
+	}
+	out, err := c.post(
+		fmt.Sprintf("/v1/evalfull_batch?log_n=%d&k=%d", logN, len(keys)), body)
+	if err != nil {
+		return nil, err
+	}
+	if len(out)%len(keys) != 0 {
+		return nil, fmt.Errorf("dpftpu: bad batch reply length %d", len(out))
+	}
+	per := len(out) / len(keys)
+	res := make([][]byte, len(keys))
+	for i := range keys {
+		res[i] = out[i*per : (i+1)*per]
+	}
+	return res, nil
+}

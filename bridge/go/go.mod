@@ -1,0 +1,3 @@
+module github.com/dpf-tpu/bridge/go
+
+go 1.21

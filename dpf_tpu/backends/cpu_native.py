@@ -96,6 +96,10 @@ def _load():
         lib.dpfn_cc_eval_full_batch.argtypes = [
             u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, u8p, ctypes.c_uint64,
         ]
+        lib.dpfn_cc_eval_points_batch.restype = ctypes.c_int
+        lib.dpfn_cc_eval_points_batch.argtypes = [
+            u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, u64p, ctypes.c_uint64, u8p,
+        ]
         _lib = lib
         return _lib
 
@@ -259,4 +263,28 @@ def eval_points_batch(keys: list[bytes], xs: np.ndarray, log_n: int) -> np.ndarr
     )
     if rc:
         raise ValueError(f"dpf: native eval_points_batch failed (rc={rc})")
+    return out
+
+
+def cc_eval_points_batch(keys: list[bytes], xs: np.ndarray, log_n: int) -> np.ndarray:
+    """Fast-profile batched pointwise evaluation (mirror of
+    ``eval_points_batch`` over the ChaCha key layout)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    klen = int(lib.dpfn_cc_key_len(log_n))
+    arr = np.frombuffer(b"".join(keys), dtype=np.uint8)
+    if arr.size != klen * len(keys):
+        raise ValueError("dpf-fast: bad key length in batch")
+    xs = np.ascontiguousarray(xs, dtype=np.uint64)
+    k, q = xs.shape
+    if k != len(keys):
+        raise ValueError("xs first axis must match number of keys")
+    out = np.empty((k, q), np.uint8)
+    rc = lib.dpfn_cc_eval_points_batch(
+        _u8ptr(arr), k, klen, log_n,
+        xs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), q, _u8ptr(out),
+    )
+    if rc:
+        raise ValueError(f"dpf-fast: native eval_points_batch failed (rc={rc})")
     return out

@@ -83,6 +83,28 @@ def eval_full_batch(kb: KeyBatchFast) -> np.ndarray:
     return _eval_full_dev(kb)
 
 
-def eval_points_batch(kb: KeyBatchFast, xs: np.ndarray) -> np.ndarray:
-    """Accelerated pointwise evaluation: xs uint64[K, Q] -> uint8[K, Q]."""
+def eval_points_batch(
+    kb: KeyBatchFast, xs: np.ndarray, backend: str = "auto"
+) -> np.ndarray:
+    """Batched pointwise evaluation: xs uint64[K, Q] -> uint8[K, Q].
+
+    ``backend="auto"`` runs on the accelerator; ``backend="cpu"`` runs the
+    host path (native C++ batch entry when built, NumPy spec otherwise) —
+    useful for small batches that don't amortize a dispatch, and as the
+    differential-test counterpart of the device path."""
+    if backend == "cpu":
+        xs = np.asarray(xs, dtype=np.uint64)
+        if xs.ndim != 2 or xs.shape[0] != kb.k:
+            raise ValueError("dpf-fast: xs must be [K, Q]")
+        if (xs >> np.uint64(kb.log_n)).any():
+            raise ValueError("dpf-fast: query index out of domain")
+        keys = kb.to_bytes()
+        nat = _native()
+        if nat is not None:
+            return nat.cc_eval_points_batch(keys, xs, kb.log_n)
+        return np.array(
+            [[_cc.eval_point(k, int(x), kb.log_n) for x in row]
+             for k, row in zip(keys, xs)],
+            dtype=np.uint8,
+        )
     return _eval_points_dev(kb, xs)

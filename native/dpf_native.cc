@@ -7,8 +7,9 @@
 // spec (Boyle-Gilboa-Ishai with early termination; see dpf_tpu/core/spec.py)
 // — iterative, batch-oriented C++, not a translation of the Go code.
 //
-// Exposed as a flat C ABI consumed by ctypes (dpf_tpu/backends/cpu_native.py)
-// and linkable from Go via cgo (bridge/go).
+// Exposed as a flat C ABI consumed by ctypes (dpf_tpu/backends/cpu_native.py).
+// Foreign-language clients (e.g. Go) reach the framework through the HTTP
+// sidecar instead (dpf_tpu/server.py; Go client in bridge/go).
 //
 // Build: g++ -O3 -maes -mssse3 -shared -fPIC dpf_native.cc -o libdpf_native.so
 
@@ -731,6 +732,44 @@ int dpfn_cc_eval_full_batch(const uint8_t* keys, uint64_t n_keys,
     int rc = dpfn_cc_eval_full(keys + i * key_len, key_len, log_n,
                                out + i * out_stride, out_stride);
     if (rc) return rc;
+  }
+  return 0;
+}
+
+// Fast-profile mirror of dpfn_eval_points_batch: contiguous keys, xs
+// uint64[n_keys * n_points], out bits uint8 (0/1) in the same layout.
+// Key canonical-form validation runs once per key, not per point.
+int dpfn_cc_eval_points_batch(const uint8_t* keys, uint64_t n_keys,
+                              uint64_t key_len, uint64_t log_n,
+                              const uint64_t* xs, uint64_t n_points,
+                              uint8_t* out_bits) {
+  if (log_n > 63 || key_len != cc::klen(log_n)) return -1;
+  const uint64_t lv = cc::levels(log_n);
+  for (uint64_t i = 0; i < n_keys; i++) {
+    const uint8_t* key = keys + i * key_len;
+    if (!cc::canonical(key, log_n)) return -4;
+    const uint8_t* fcw = key + key_len - 64;
+    for (uint64_t j = 0; j < n_points; j++) {
+      const uint64_t x = xs[i * n_points + j];
+      if (x >> log_n) return -3;
+      cc::St st;
+      cc::load4(key, st.s);
+      st.t = key[16];
+      for (uint64_t d = 0; d < lv; d++)
+        cc::descend(st, key + 17 + 18 * d, (x >> (log_n - 1 - d)) & 1);
+      uint32_t leaf[16];
+      cc::convert(st.s, leaf);
+      if (st.t) {
+        for (int w = 0; w < 16; w++) {
+          uint32_t v;
+          std::memcpy(&v, fcw + 4 * w, 4);
+          leaf[w] ^= v;
+        }
+      }
+      const uint64_t low = log_n >= cc::kLeafLog ? (x & 511) : x;
+      out_bits[i * n_points + j] =
+          static_cast<uint8_t>((leaf[low >> 5] >> (low & 31)) & 1);
+    }
   }
   return 0;
 }

@@ -1,0 +1,80 @@
+"""End-to-end A/B of compat-path variants at the bench config.
+
+Microbenchmarks of the PRG kernel proved unreliable on this device (the
+chip shows distinct per-process performance modes); this times the REAL
+chained eval_full graph (same method as bench.py) under different knobs:
+
+    python scripts/bench_compat_ab.py pallas:256 pallas:512 xla
+
+Each arg is backend[:BT].  Prints Gleaves/s per variant.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+LOG_N = 20
+K = 1024
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from dpf_tpu.core.keys import gen_batch
+    from dpf_tpu.models.dpf import DeviceKeys, _eval_full_jit
+    from dpf_tpu.ops import aes_pallas
+
+    rng = np.random.default_rng(2026)
+    alphas = rng.integers(0, 1 << LOG_N, size=K, dtype=np.uint64)
+    ka, _ = gen_batch(alphas, LOG_N, rng=rng)
+    dk = DeviceKeys(ka)
+    args = (
+        dk.seed_planes, dk.t_words, dk.scw_planes,
+        dk.tl_words, dk.tr_words, dk.fcw_planes,
+    )
+
+    def chained(r, backend):
+        @jax.jit
+        def f(seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes):
+            acc = jnp.uint32(0)
+            for _ in range(r):
+                words = _eval_full_jit(
+                    dk.nu, seed_planes ^ acc, t_words, scw_planes,
+                    tl_w, tr_w, fcw_planes, backend,
+                )
+                acc = acc ^ jnp.bitwise_xor.reduce(words, axis=None)
+            return acc
+
+        return f
+
+    for spec_str in sys.argv[1:] or ["pallas:256"]:
+        parts = spec_str.split(":")
+        backend = parts[0]
+        if len(parts) > 1:
+            aes_pallas._BT = int(parts[1])
+        jax.clear_caches()
+        f1, f3 = chained(1, backend), chained(3, backend)
+        np.asarray(f1(*args))
+        np.asarray(f3(*args))
+        best = float("inf")
+        for _ in range(4):
+            t0 = time.perf_counter()
+            np.asarray(f1(*args))
+            t1 = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            np.asarray(f3(*args))
+            t3 = time.perf_counter() - t0
+            best = min(best, (t3 - t1) / 2)
+        gl = K * (1 << LOG_N) / best / 1e9
+        print(f"{spec_str:14s} {gl:7.2f} Gleaves/s  ({best * 1e3:.1f} ms/expansion)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""Which tensor shapes run bitwise chains at full VPU rate (XLA, live TPU)?
+
+Same total element count (2^24 uint32), different [rows, cols] splits — the
+AES S-box currently does 72% of its ops on [16, B] shapes; this quantifies
+what that shape choice costs vs alternatives before restructuring the
+kernel.  3 serial ops per chain iter, N iters; reports G element-ops/s.
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+N = 256
+TOTAL_LOG2 = 24
+
+
+def chain(a):
+    for _ in range(N):
+        a = a ^ (a << 1) ^ (a >> 3)
+    return a
+
+
+def time_call(S, reps=6):
+    @jax.jit
+    def summed(S):
+        return jnp.bitwise_xor.reduce(chain(S), axis=None)
+
+    np.asarray(summed(S))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(summed(S))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    rng = np.random.default_rng(0)
+    total = 1 << TOTAL_LOG2
+    flat = rng.integers(0, 1 << 32, size=total, dtype=np.uint32)
+    elops = 3 * N * total
+    for rows_log2 in (0, 3, 4, 5, 7, 10, 13, 17):
+        rows = 1 << rows_log2
+        S = jnp.asarray(flat.reshape(rows, total // rows))
+        t = time_call(S)
+        print(
+            f"[{rows:6d},{total // rows:8d}]  {elops / t / 1e9:8.1f} Gelops/s"
+            f"  ({t * 1e3:7.2f} ms)"
+        )
+
+
+if __name__ == "__main__":
+    main()

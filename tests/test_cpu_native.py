@@ -117,6 +117,44 @@ def test_native_fast_profile_matches_spec():
     assert (bits.sum(axis=1) == 1).all()
 
 
+def test_native_fast_eval_points_batch_matches_spec():
+    """dpfn_cc_eval_points_batch vs chacha_np.eval_point, plus the fast.py
+    cpu-backend wiring, plus 2-party reconstruction through the batch."""
+    from dpf_tpu import fast
+    from dpf_tpu.core import chacha_np as cc
+
+    log_n, K, Q = 11, 5, 7
+    rng = np.random.default_rng(23)
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    pairs = [cn.cc_gen(int(a), log_n, rng=rng) for a in alphas]
+    keys_a = [p[0] for p in pairs]
+    keys_b = [p[1] for p in pairs]
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    xs[:, 0] = alphas  # guarantee each key hits its point once
+
+    bits_a = cn.cc_eval_points_batch(keys_a, xs, log_n)
+    bits_b = cn.cc_eval_points_batch(keys_b, xs, log_n)
+    for i in range(K):
+        for j in range(Q):
+            assert bits_a[i, j] == cc.eval_point(keys_a[i], int(xs[i, j]), log_n)
+    rec = bits_a ^ bits_b
+    assert (rec == (xs == alphas[:, None])).all()
+
+    # fast.py surface: backend="cpu" routes to the same native entry.
+    kb = fast.KeyBatchFast.from_bytes(keys_a, log_n)
+    np.testing.assert_array_equal(
+        fast.eval_points_batch(kb, xs, backend="cpu"), bits_a
+    )
+
+    # error paths mirror the compat batch entry
+    with pytest.raises(ValueError):
+        cn.cc_eval_points_batch([keys_a[0][:-1]], np.zeros((1, 2), np.uint64), log_n)
+    with pytest.raises(ValueError):
+        cn.cc_eval_points_batch(
+            [keys_a[0]], np.full((1, 1), 1 << log_n, np.uint64), log_n
+        )
+
+
 def test_native_fast_rejects_bad():
     with pytest.raises(ValueError):
         cn.cc_gen(1 << 10, 10)

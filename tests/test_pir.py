@@ -98,3 +98,32 @@ def test_pir_fast_profile_sharded():
     srv_b = PirServer(db, mesh=mesh, chunk_rows=256, profile="fast")
     got = pir_reconstruct(srv_a.answer(qa), srv_b.answer(qb))
     np.testing.assert_array_equal(got, db[idx.astype(np.int64)])
+
+
+def test_pir_config4_full_scale_traces():
+    """BASELINE.md config 4 (2^24 rows x 32 B, 1024 queries): the full-scale
+    parity-matmul graph must trace with the exact shapes the real run uses
+    (jax.eval_shape — no 512 MB database or device needed).  Guards against
+    shape/segmenting bugs that only appear at size (chunk count, leaf
+    padding, output packing)."""
+    import jax
+
+    from dpf_tpu.models.pir import _pir_single_fast, row_domain
+
+    n_rows, row_bytes, K = 1 << 24, 32, 1024
+    log_n, dom = row_domain(n_rows, "fast")
+    assert (log_n, dom) == (24, 1 << 24)
+    nu = log_n - 9
+    chunk_rows = 1 << 16
+    fn = _pir_single_fast(nu, chunk_rows, dom // chunk_rows)
+    u32 = np.uint32
+    out = jax.eval_shape(
+        fn,
+        jax.ShapeDtypeStruct((K, 4), u32),       # seeds
+        jax.ShapeDtypeStruct((K,), u32),         # ts
+        jax.ShapeDtypeStruct((K, nu, 4), u32),   # scw
+        jax.ShapeDtypeStruct((K, nu, 2), u32),   # tcw
+        jax.ShapeDtypeStruct((K, 16), u32),      # fcw
+        jax.ShapeDtypeStruct((dom, row_bytes // 4), u32),  # db words
+    )
+    assert out.shape == (K, row_bytes // 4) and out.dtype == u32
