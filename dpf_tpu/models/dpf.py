@@ -48,12 +48,21 @@ from ..ops.aes_bitslice import (
 
 # PRG/convert kernel implementations.  "xla" = fused elementwise DAG left to
 # the XLA fuser; "pallas" = explicit VMEM-tiled Mosaic kernels
-# (ops/aes_pallas.py; interpreted off-TPU).  Selected per call via the
-# ``backend`` argument, defaulting to $DPF_TPU_PRG or "xla".
-_PRG_IMPLS = {"xla": prg_planes, "pallas": aes_pallas.prg_planes_pallas}
+# (ops/aes_pallas.py; interpreted off-TPU); "pallas_bm" = the same kernels
+# with the level state held in BIT-MAJOR plane order across the whole
+# expansion (S-box reads contiguous sublane blocks; permutes only at the
+# pipeline boundaries).  Selected per call via the ``backend`` argument,
+# defaulting to $DPF_TPU_PRG or the measured-fastest for the platform.
+_PRG_IMPLS = {
+    "xla": prg_planes,
+    "pallas": aes_pallas.prg_planes_pallas,
+    "pallas_bm": aes_pallas.prg_planes_pallas_bm,
+}
 _MMO_IMPLS = {
     "xla": lambda S: aes128_mmo_planes(S, RK_MASKS_L),
     "pallas": aes_pallas.mmo_planes_pallas,
+    # converts back to canonical plane order on output
+    "pallas_bm": aes_pallas.mmo_planes_pallas_bm_canon,
 }
 
 
@@ -61,10 +70,11 @@ def default_backend() -> str:
     env = os.environ.get("DPF_TPU_PRG")
     if env:
         return env
-    # Measured on v5e (scripts/calibrate_rtt.py): the Mosaic kernel runs the
-    # PRG ~2.5x faster than the XLA elementwise DAG.  Off-TPU the kernels
-    # would run interpreted (slow), so CPU/GPU default to XLA.
-    return "pallas" if jax.default_backend() == "tpu" else "xla"
+    # Measured end-to-end on v5e at the headline config
+    # (scripts/bench_compat_ab.py): pallas_bm 27.1 > pallas 23.5 > xla 4.8
+    # Gleaves/s.  Off-TPU the kernels would run interpreted (slow), so
+    # CPU/GPU default to XLA.
+    return "pallas_bm" if jax.default_backend() == "tpu" else "xla"
 
 # ---------------------------------------------------------------------------
 # Host-side packing of key material into plane/mask form
@@ -167,11 +177,22 @@ def _convert_leaves(S, T, fcw_planes, backend="xla"):
     return unpack_planes(C)
 
 
+def _to_bm(seed_planes, scw_planes):
+    """Canonical -> bit-major plane order for the level-state inputs.  Runs
+    on the tiny pre-expansion tensors ([128, 1, Kp] seeds, [nu, 128, Kp]
+    CWs); the big leaf-level tensors never pay a standalone permute (the
+    leaf-convert kernel emits canonical order from inside VMEM)."""
+    perm = jnp.asarray(aes_pallas._TO_BM)
+    return seed_planes[perm], scw_planes[:, perm]
+
+
 @partial(jax.jit, static_argnums=(0, 7))
 def _eval_full_jit(
     n_levels, seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes,
     backend="xla",
 ):
+    if backend == "pallas_bm":
+        seed_planes, scw_planes = _to_bm(seed_planes, scw_planes)
     S, T = seed_planes, t_words
     for i in range(n_levels):
         S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i], backend)
@@ -182,6 +203,10 @@ def _eval_full_jit(
 def _expand_prefix_jit(
     n_levels, seed_planes, t_words, scw_planes, tl_w, tr_w, backend="xla"
 ):
+    """NB: with backend="pallas_bm" the returned S is in bit-major order —
+    feed it only to _finish_chunk_jit with the same backend."""
+    if backend == "pallas_bm":
+        seed_planes, scw_planes = _to_bm(seed_planes, scw_planes)
     S, T = seed_planes, t_words
     for i in range(n_levels):
         S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i], backend)
@@ -192,6 +217,9 @@ def _expand_prefix_jit(
 def _finish_chunk_jit(
     n_levels, first, S, T, scw_planes, tl_w, tr_w, fcw_planes, backend="xla"
 ):
+    """S and scw_planes must already be in the backend's plane order (the
+    chunk loop in eval_full_device permutes the CWs once for pallas_bm, not
+    once per chunk)."""
     for i in range(n_levels):
         S, T = _level_step(
             S, T, scw_planes[first + i], tl_w[first + i], tr_w[first + i], backend
@@ -237,12 +265,16 @@ def eval_full_device(
         c, dk.seed_planes, dk.t_words, dk.scw_planes, dk.tl_words, dk.tr_words,
         backend,
     )
+    scw = dk.scw_planes
+    if backend == "pallas_bm":
+        # One permute for all chunks; S from the prefix is already bit-major.
+        scw = scw[:, jnp.asarray(aes_pallas._TO_BM)]
     outs = []
     for j in range(1 << c):
         outs.append(
             _finish_chunk_jit(
                 nu - c, c, S[:, j : j + 1, :], T[j : j + 1, :],
-                dk.scw_planes, dk.tl_words, dk.tr_words, dk.fcw_planes, backend,
+                scw, dk.tl_words, dk.tr_words, dk.fcw_planes, backend,
             )
         )
     return jnp.concatenate(outs, axis=1)

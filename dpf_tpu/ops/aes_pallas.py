@@ -38,12 +38,15 @@ from .aes_bitslice import (
     aes128_mmo_planes,
     prg_planes,
 )
+from .sbox_circuit import sbox_bp113
 
-# Lane tile: 2 * 128 lanes keeps the kernel's scoped VMEM (inputs + both
-# outputs + live S-box temporaries) under a v5e core's 16 MB limit
-# (1024 lanes -> 18.75 MB scoped, OOM) and measured fastest in the
-# scripts/sweep_bt.py sweep (256 > 512 > 128 on v5e).
-_BT = 256
+# Lane tile.  128 lanes measured ~2x faster than 256 END-TO-END at the
+# headline config (scripts/bench_compat_ab.py on v5e: 22.9 vs 11.7
+# Gleaves/s) — the smaller tile halves the live S-box temporary footprint
+# and its spill traffic.  (The earlier kernel-only sweep_bt.py microbench
+# preferred 256; it mismeasured — the device shows per-process performance
+# modes that swamp isolated kernel timings.)
+_BT = 128
 # Minimum batch (in lane words) worth a kernel launch; below this the XLA
 # path is used (levels near the tree root / tiny key batches).
 _MIN_B = 128
@@ -52,6 +55,16 @@ _MIN_B = 128
 _RK_BOTH = np.stack([RK_MASKS_L, RK_MASKS_R])
 
 _SHIFT_PERM = [int(p) for p in aes_np.SHIFT_ROWS_PERM]  # 16 static byte moves
+
+# Bit-major plane order p' = 16*bit + byte (canonical is p = 8*byte + bit).
+# In this order every S-box input/output plane is a CONTIGUOUS 16-sublane
+# block instead of a stride-8 slice, trading the per-gate relayout work for
+# two static 128-row permutations at the pipeline boundaries.  Plane 0 (the
+# control-bit plane, byte 0 bit 0) is index 0 in both orders, so the DPF
+# evaluator's t-bit handling is order-agnostic.
+_TO_BM = [8 * (p % 16) + p // 16 for p in range(128)]  # S_bm = S[_TO_BM]
+_FROM_BM = [16 * (p % 8) + p // 8 for p in range(128)]  # S = S_bm[_FROM_BM]
+_RK_BOTH_BM = np.ascontiguousarray(_RK_BOTH[:, :, _TO_BM])
 
 
 def _on_tpu() -> bool:
@@ -116,13 +129,71 @@ def _mmo_kernel(s_ref, rk_ref, o_ref):
     o_ref[:] = _encrypt_k(S, rk_ref[0]) ^ S
 
 
+# --- bit-major variants (state and round keys in _TO_BM plane order) -------
+
+
+def _permute_rows(S, perm):
+    return jnp.concatenate([S[p : p + 1] for p in perm])
+
+
+def _sub_bytes_bm(S):
+    s = S.reshape(8, 16, -1)
+    y = sbox_bp113([s[7 - i] for i in range(8)])  # circuit is MSB-first
+    return jnp.concatenate(y[::-1]).reshape(128, -1)
+
+
+def _shift_rows_bm(S):
+    s = S.reshape(8, 16, -1)
+    return jnp.concatenate(
+        [s[:, p : p + 1] for p in _SHIFT_PERM], axis=1
+    ).reshape(128, -1)
+
+
+def _xtime_bm(a):  # [8, 16, B] -> bit-rotate + carry (reduction poly 0x11B)
+    a0, a1, a2, a3, a4, a5, a6, a7 = (a[i : i + 1] for i in range(8))
+    return jnp.concatenate([a7, a0 ^ a7, a1, a2 ^ a7, a3 ^ a7, a4, a5, a6])
+
+
+def _mix_columns_bm(S):
+    s = S.reshape(8, 4, 4, -1)  # [bit, col, row, B]
+    r1 = jnp.concatenate([s[:, :, 1:], s[:, :, :1]], axis=2)
+    r2 = jnp.concatenate([s[:, :, 2:], s[:, :, :2]], axis=2)
+    r3 = jnp.concatenate([s[:, :, 3:], s[:, :, :3]], axis=2)
+    f = lambda x: _xtime_bm(x.reshape(8, 16, -1)).reshape(s.shape)  # noqa: E731
+    return (f(s) ^ f(r1) ^ r1 ^ r2 ^ r3).reshape(128, -1)
+
+
+def _encrypt_bm(S, rk):
+    S = S ^ rk[0][:, None]
+    for rnd in range(1, 10):
+        S = _mix_columns_bm(_shift_rows_bm(_sub_bytes_bm(S))) ^ rk[rnd][:, None]
+    return _shift_rows_bm(_sub_bytes_bm(S)) ^ rk[10][:, None]
+
+
+def _prg_kernel_bm(s_ref, rk_ref, l_ref, r_ref):
+    """Pure bit-major PRG: no permutes — the evaluator holds level state in
+    bit-major order for the whole expansion."""
+    S = s_ref[:]
+    rk = rk_ref[:]
+    l_ref[:] = _encrypt_bm(S, rk[0]) ^ S
+    r_ref[:] = _encrypt_bm(S, rk[1]) ^ S
+
+
+def _mmo_canon_kernel_bm(s_ref, rk_ref, o_ref):
+    """Leaf convert from bit-major state to CANONICAL-order output planes:
+    the one boundary where the bit-major pipeline pays a permute (in-VMEM
+    sublane moves), so the bit-packed output layout is unchanged."""
+    S = s_ref[:]
+    o_ref[:] = _permute_rows(_encrypt_bm(S, rk_ref[0]) ^ S, _FROM_BM)
+
+
 # ---------------------------------------------------------------------------
 # pallas_call wrappers
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
-def _tiled_call(S, kernel, n_out):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _tiled_call(S, kernel, n_out, bm):
     B = S.shape[1]
     bt = _BT if B % _BT == 0 else _MIN_B
     spec = pl.BlockSpec((128, bt), lambda i: (0, i))
@@ -135,7 +206,7 @@ def _tiled_call(S, kernel, n_out):
         out_specs=[spec] * n_out if n_out > 1 else spec,
         out_shape=shapes if n_out > 1 else shapes[0],
         interpret=not _on_tpu(),
-    )(S, jnp.asarray(_RK_BOTH))
+    )(S, jnp.asarray(_RK_BOTH_BM if bm else _RK_BOTH))
 
 
 def prg_planes_pallas(S: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -144,7 +215,7 @@ def prg_planes_pallas(S: jax.Array) -> tuple[jax.Array, jax.Array]:
     Falls back to the XLA expression when B is not tileable."""
     if S.shape[1] % _MIN_B:
         return prg_planes(S)
-    L, R = _tiled_call(S, _prg_kernel, 2)
+    L, R = _tiled_call(S, _prg_kernel, 2, False)
     return L, R
 
 
@@ -152,4 +223,25 @@ def mmo_planes_pallas(S: jax.Array) -> jax.Array:
     """Leaf-convert MMO (fixed key L) on planes uint32[128, B]."""
     if S.shape[1] % _MIN_B:
         return aes128_mmo_planes(S, RK_MASKS_L)
-    return _tiled_call(S, _mmo_kernel, 1)
+    return _tiled_call(S, _mmo_kernel, 1, False)
+
+
+def prg_planes_pallas_bm(S: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """PRG on BIT-MAJOR planes uint32[128, B] -> (L, R), also bit-major.
+
+    Non-tileable widths (levels near the tree root) detour through the
+    canonical XLA expression; the permutes there are on tiny tensors."""
+    if S.shape[1] % _MIN_B:
+        perm = jnp.asarray(_FROM_BM)
+        L, R = prg_planes(S[perm])
+        to = jnp.asarray(_TO_BM)
+        return L[to], R[to]
+    L, R = _tiled_call(S, _prg_kernel_bm, 2, True)
+    return L, R
+
+
+def mmo_planes_pallas_bm_canon(S: jax.Array) -> jax.Array:
+    """Leaf-convert MMO on BIT-MAJOR planes -> CANONICAL-order planes."""
+    if S.shape[1] % _MIN_B:
+        return aes128_mmo_planes(S[jnp.asarray(_FROM_BM)], RK_MASKS_L)
+    return _tiled_call(S, _mmo_canon_kernel_bm, 1, True)

@@ -73,7 +73,7 @@ def main():
             t3 = time.perf_counter() - t0
             best = min(best, (t3 - t1) / 2)
         gl = K * (1 << LOG_N) / best / 1e9
-        print(f"{spec_str:14s} {gl:7.2f} Gleaves/s  ({best * 1e3:.1f} ms/expansion)")
+        print(f"{spec_str:14s} {gl:7.2f} Gleaves/s  ({best * 1e3:.1f} ms/expansion)", flush=True)
 
 
 if __name__ == "__main__":
