@@ -69,6 +69,10 @@ _MMO_IMPLS = {
 def default_backend() -> str:
     env = os.environ.get("DPF_TPU_PRG")
     if env:
+        if env not in _PRG_IMPLS:
+            raise ValueError(
+                f"DPF_TPU_PRG={env!r} unknown; choose from {sorted(_PRG_IMPLS)}"
+            )
         return env
     # Measured end-to-end on v5e at the headline config
     # (scripts/bench_compat_ab.py): pallas_bm 27.1 > pallas 23.5 > xla 4.8
@@ -177,13 +181,20 @@ def _convert_leaves(S, T, fcw_planes, backend="xla"):
     return unpack_planes(C)
 
 
+def _scw_to_bm(scw_planes):
+    """Canonical -> bit-major plane order for the per-level CW planes.
+    THE single source of truth for permuting host-packed CWs to the
+    bit-major pipeline (used by the unchunked entry, the chunk loop, and
+    the sharded evaluators)."""
+    return scw_planes[:, jnp.asarray(aes_pallas._TO_BM)]
+
+
 def _to_bm(seed_planes, scw_planes):
     """Canonical -> bit-major plane order for the level-state inputs.  Runs
     on the tiny pre-expansion tensors ([128, 1, Kp] seeds, [nu, 128, Kp]
     CWs); the big leaf-level tensors never pay a standalone permute (the
     leaf-convert kernel emits canonical order from inside VMEM)."""
-    perm = jnp.asarray(aes_pallas._TO_BM)
-    return seed_planes[perm], scw_planes[:, perm]
+    return seed_planes[jnp.asarray(aes_pallas._TO_BM)], _scw_to_bm(scw_planes)
 
 
 @partial(jax.jit, static_argnums=(0, 7))
@@ -268,7 +279,7 @@ def eval_full_device(
     scw = dk.scw_planes
     if backend == "pallas_bm":
         # One permute for all chunks; S from the prefix is already bit-major.
-        scw = scw[:, jnp.asarray(aes_pallas._TO_BM)]
+        scw = _scw_to_bm(scw)
     outs = []
     for j in range(1 << c):
         outs.append(

@@ -37,7 +37,7 @@ from ..parallel.sharding import (
     leaf_axis_levels,
     xor_allreduce,
 )
-from .dpf import DeviceKeys, _convert_leaves, _level_step
+from .dpf import DeviceKeys, _convert_leaves, _level_step, _to_bm, default_backend
 
 # Leaf width (log2 bits) per profile: compat = one AES block (reference
 # dpf/dpf.go:251), fast = one ChaCha block (core/chacha_np.LEAF_LOG).
@@ -156,11 +156,13 @@ class PirServer:
         else:
             k_shards = self.mesh.shape[KEYS_AXIS]
         dk = DeviceKeys(queries, pad_to=32 * k_shards)
+        backend = default_backend()
         if self.mesh is None:
-            fn = _pir_single(dk.nu, self.chunk_rows, n_chunks)
+            fn = _pir_single(dk.nu, self.chunk_rows, n_chunks, backend)
         else:
             fn = _pir_sharded(
-                self.mesh, dk.nu, self.subtree_levels, self.chunk_rows, n_chunks
+                self.mesh, dk.nu, self.subtree_levels, self.chunk_rows,
+                n_chunks, backend,
             )
         words = np.asarray(
             fn(
@@ -168,7 +170,11 @@ class PirServer:
                 dk.tl_words, dk.tr_words, dk.fcw_planes, self.db_words,
             )
         )  # [Kpad, row_words]
-        return words[: queries.k].view("<u1").reshape(queries.k, -1)
+        return (
+            np.ascontiguousarray(words[: queries.k])
+            .view("<u1")
+            .reshape(queries.k, -1)
+        )
 
     def _answer_fast(self, queries, n_chunks: int) -> np.ndarray:
         from .keys_chacha import KeyBatchFast
@@ -190,7 +196,11 @@ class PirServer:
                 self.mesh, self.nu, self.subtree_levels, self.chunk_rows, n_chunks
             )
         words = np.asarray(fn(*padded.device_args(), self.db_words))
-        return words[: queries.k].view("<u1").reshape(queries.k, -1)
+        return (
+            np.ascontiguousarray(words[: queries.k])
+            .view("<u1")
+            .reshape(queries.k, -1)
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -244,12 +254,14 @@ def _leaves_to_sel_words(words: jax.Array) -> jax.Array:
 
 
 @cache
-def _pir_single(nu: int, chunk_rows: int, n_chunks: int):
+def _pir_single(nu: int, chunk_rows: int, n_chunks: int, backend: str = "xla"):
     def body(seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes, db_words):
+        if backend == "pallas_bm":
+            seed_planes, scw_planes = _to_bm(seed_planes, scw_planes)
         S, T = seed_planes, t_words
         for i in range(nu):
-            S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i])
-        sel = _leaves_to_sel_words(_convert_leaves(S, T, fcw_planes))
+            S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i], backend)
+        sel = _leaves_to_sel_words(_convert_leaves(S, T, fcw_planes, backend))
         return _parity_matmul(sel, db_words, chunk_rows, n_chunks)
 
     return jax.jit(body)
@@ -302,12 +314,16 @@ def _pir_sharded_fast(
 
 
 @cache
-def _pir_sharded(mesh: Mesh, nu: int, subtree_levels: int, chunk_rows: int, n_chunks: int):
+def _pir_sharded(
+    mesh: Mesh, nu: int, subtree_levels: int, chunk_rows: int, n_chunks: int,
+    backend: str = "xla",
+):
     def body(seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes, db_words):
         S, T = expand_subtree_local(
-            seed_planes, t_words, scw_planes, tl_w, tr_w, nu, subtree_levels
+            seed_planes, t_words, scw_planes, tl_w, tr_w, nu, subtree_levels,
+            backend,
         )
-        sel = _leaves_to_sel_words(_convert_leaves(S, T, fcw_planes))
+        sel = _leaves_to_sel_words(_convert_leaves(S, T, fcw_planes, backend))
         part = _parity_matmul(sel, db_words, chunk_rows, n_chunks)
         return xor_allreduce(part, LEAF_AXIS)
 

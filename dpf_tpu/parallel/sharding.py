@@ -31,7 +31,13 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.keys import KeyBatch
-from ..models.dpf import DeviceKeys, _convert_leaves, _level_step
+from ..models.dpf import (
+    DeviceKeys,
+    _convert_leaves,
+    _level_step,
+    _to_bm,
+    default_backend,
+)
 
 KEYS_AXIS = "keys"
 LEAF_AXIS = "leaf"
@@ -84,28 +90,34 @@ def leaf_axis_levels(mesh: Mesh, nu: int, log_n: int) -> int:
 
 
 def expand_subtree_local(
-    seed_planes, t_words, scw_planes, tl_w, tr_w, nu: int, subtree_levels: int
+    seed_planes, t_words, scw_planes, tl_w, tr_w, nu: int, subtree_levels: int,
+    backend: str = "xla",
 ):
     """Shard-local GGM expansion (inside shard_map): replicate the top
     ``subtree_levels`` levels, slice this shard's subtree by its
     ``LEAF_AXIS`` index, expand the remaining levels.  Single source of
-    truth for the subtree-sharding idiom (also used by models/pir.py)."""
+    truth for the subtree-sharding idiom (also used by models/pir.py).
+
+    With ``backend="pallas_bm"`` the returned S is in bit-major plane order
+    (feed it only to a convert with the same backend)."""
+    if backend == "pallas_bm":
+        seed_planes, scw_planes = _to_bm(seed_planes, scw_planes)
     c = subtree_levels
     S, T = seed_planes, t_words  # [128, 1, kp_local], [1, kp_local]
     for i in range(c):
-        S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i])
+        S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i], backend)
     if c:
         j = jax.lax.axis_index(LEAF_AXIS)
         S = jax.lax.dynamic_slice_in_dim(S, j, 1, axis=1)
         T = jax.lax.dynamic_slice_in_dim(T, j, 1, axis=0)
     for i in range(c, nu):
-        S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i])
+        S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i], backend)
     return S, T
 
 
 @cache
-def _sharded_eval_full(mesh: Mesh, nu: int, subtree_levels: int):
-    """Compile the sharded evaluator for a (mesh, domain) bucket.
+def _sharded_eval_full(mesh: Mesh, nu: int, subtree_levels: int, backend: str):
+    """Compile the sharded evaluator for a (mesh, domain, backend) bucket.
 
     ``subtree_levels`` = log2(leaf-axis size); each shard replicates that
     many top levels, then expands only its own subtree.
@@ -113,9 +125,10 @@ def _sharded_eval_full(mesh: Mesh, nu: int, subtree_levels: int):
 
     def body(seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes):
         S, T = expand_subtree_local(
-            seed_planes, t_words, scw_planes, tl_w, tr_w, nu, subtree_levels
+            seed_planes, t_words, scw_planes, tl_w, tr_w, nu, subtree_levels,
+            backend,
         )
-        return _convert_leaves(S, T, fcw_planes)
+        return _convert_leaves(S, T, fcw_planes, backend)
 
     keyed = P(None, None, KEYS_AXIS)  # plane tensors: lane-word axis last
     sharded = jax.shard_map(
@@ -134,19 +147,23 @@ def _sharded_eval_full(mesh: Mesh, nu: int, subtree_levels: int):
     return jax.jit(sharded)
 
 
-def eval_full_sharded(kb: KeyBatch, mesh: Mesh) -> np.ndarray:
+def eval_full_sharded(
+    kb: KeyBatch, mesh: Mesh, backend: str | None = None
+) -> np.ndarray:
     """Full-domain evaluation of a key batch sharded over ``mesh`` ->
     uint8[K, 2^(log_n-3)] (16 bytes/key when log_n < 7).
 
     Key batch shards over the ``keys`` axis; each key's leaf range shards
     over the ``leaf`` axis (independent GGM subtrees, zero communication).
     The leaf-axis size must be a power of two and at most 2^nu; pass a
-    keys-only mesh for tiny domains.
+    keys-only mesh for tiny domains.  ``backend`` defaults to the platform's
+    measured-fastest kernel set (models/dpf.default_backend).
     """
+    backend = backend or default_backend()
     n_keys = mesh.shape[KEYS_AXIS]
     c = leaf_axis_levels(mesh, kb.nu, kb.log_n)
     dk = DeviceKeys(kb, pad_to=32 * n_keys)
-    fn = _sharded_eval_full(mesh, kb.nu, c)
+    fn = _sharded_eval_full(mesh, kb.nu, c, backend)
     words = np.asarray(
         fn(
             dk.seed_planes, dk.t_words, dk.scw_planes,
