@@ -19,6 +19,7 @@ package dpftpu
 
 import (
 	"bytes"
+	"encoding/binary"
 	"fmt"
 	"io"
 	"net/http"
@@ -104,6 +105,49 @@ func (c *Client) Eval(k DPFkey, x uint64, logN uint) (byte, error) {
 // byte x/8, bit x%8 — the reference's LSB-first layout).
 func (c *Client) EvalFull(k DPFkey, logN uint) ([]byte, error) {
 	return c.post(fmt.Sprintf("/v1/evalfull?log_n=%d", logN), k)
+}
+
+// EvalPointsBatch evaluates K shares at Q points each in one round trip:
+// xs[i] holds key i's Q query indices; the reply bit [i][j] is
+// Eval(keys[i], xs[i][j]).  All keys must have the same logN and every
+// row of xs the same length.
+func (c *Client) EvalPointsBatch(keys []DPFkey, xs [][]uint64, logN uint) ([][]byte, error) {
+	if len(keys) == 0 {
+		return nil, nil
+	}
+	if len(xs) != len(keys) {
+		return nil, fmt.Errorf("dpftpu: xs rows != key count")
+	}
+	kl := len(keys[0])
+	nq := len(xs[0])
+	body := make([]byte, 0, kl*len(keys)+8*nq*len(keys))
+	for _, k := range keys {
+		if len(k) != kl {
+			return nil, fmt.Errorf("dpftpu: inconsistent key lengths")
+		}
+		body = append(body, k...)
+	}
+	for _, row := range xs {
+		if len(row) != nq {
+			return nil, fmt.Errorf("dpftpu: inconsistent query row lengths")
+		}
+		for _, x := range row {
+			body = binary.LittleEndian.AppendUint64(body, x)
+		}
+	}
+	out, err := c.post(fmt.Sprintf(
+		"/v1/eval_points_batch?log_n=%d&k=%d&q=%d", logN, len(keys), nq), body)
+	if err != nil {
+		return nil, err
+	}
+	if len(out) != len(keys)*nq {
+		return nil, fmt.Errorf("dpftpu: bad points reply length %d", len(out))
+	}
+	res := make([][]byte, len(keys))
+	for i := range keys {
+		res[i] = out[i*nq : (i+1)*nq]
+	}
+	return res, nil
 }
 
 // EvalFullBatch expands K shares in one round trip — the entry point that

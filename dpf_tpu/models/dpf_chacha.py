@@ -197,8 +197,10 @@ def eval_full(kb: KeyBatchFast, max_leaf_nodes: int = MAX_LEAF_NODES) -> np.ndar
     return np.ascontiguousarray(words).view("<u1").reshape(kb.k, -1)
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _eval_points_cc_jit(nu, log_n, seeds, ts, scw, tcw, fcw, xs_hi, xs_lo):
+@partial(jax.jit, static_argnums=(0, 1, 9))
+def _eval_points_cc_jit(
+    nu, log_n, seeds, ts, scw, tcw, fcw, xs_hi, xs_lo, level_groups=0
+):
     """Query-major path walk: xs_hi/xs_lo uint32[Q, K] (the query index
     split in halves — JAX runs 32-bit by default and the domain index can
     exceed 2^32, log_n up to 63; for log_n <= 32 the caller passes a [1, 1]
@@ -212,9 +214,38 @@ def _eval_points_cc_jit(nu, log_n, seeds, ts, scw, tcw, fcw, xs_hi, xs_lo):
     and H2D transfer through the device tunnel dominated this entry point
     before (seconds per call vs ~100 ms of device work); key material is
     uploaded once per batch (KeyBatchFast.device_args memoizes).
+
+    ``level_groups`` (static) serves the FSS comparison gates (models/
+    fss.py): nonzero means the K keys are ``level_groups`` level-major
+    repeats of G underlying gates (K = level_groups * n_levels * G with
+    levels arranged key-major blocks of G), xs is uint32[Q, G], and the
+    level-i block's query is x with its low ``log_n - 1 - i`` bits zeroed.
+    The masking collapses to ANDing the descent bit with the trace-time
+    constant ``1{walk level j <= block level i}`` — so the host never
+    replicates the query tensor n times (for n=32 gates that replication
+    plus its upload cost more than the whole device walk).
     """
     low = xs_lo & np.uint32(cc.LEAF_BITS - 1)
-    shp = low.shape
+    if level_groups:
+        K = seeds.shape[0]
+        Q, G = xs_lo.shape
+        n_lv = K // (level_groups * G)
+        # level index of every key: key k sits in block (k // G) % n_lv
+        key_level = (np.arange(K) // G) % n_lv  # host constant, folded
+        # The level-i query zeroes bits below s = log_n - 1 - i, including
+        # (for i near the bottom) part of the 9 in-leaf bits.
+        s_of_key = log_n - 1 - key_level
+        lowmask = np.where(
+            s_of_key >= cc.LEAF_LOG,
+            np.uint32(0),
+            (np.uint32(cc.LEAF_BITS - 1) & ~((1 << s_of_key) - 1)).astype(
+                np.uint32
+            ),
+        )
+        low = jnp.tile(low, (1, K // G)) & jnp.asarray(lowmask)[None, :]
+        shp = (Q, K)
+    else:
+        shp = low.shape
     S = [jnp.broadcast_to(seeds[None, :, i], shp) for i in range(4)]
     T = jnp.broadcast_to(ts[None, :], shp)
     for i in range(nu):
@@ -233,6 +264,9 @@ def _eval_points_cc_jit(nu, log_n, seeds, ts, scw, tcw, fcw, xs_hi, xs_lo):
             pbit = (xs_hi >> np.uint32(b - 32)) & np.uint32(1)
         else:
             pbit = (xs_lo >> np.uint32(b)) & np.uint32(1)
+        if level_groups:
+            keep = jnp.asarray((key_level >= i).astype(np.uint32))  # [K//... G-tiled]
+            pbit = jnp.tile(pbit, (1, K // G)) & keep[None, :]
         bm = jnp.uint32(0) - pbit
         S = [(R[w] & bm) | (L[w] & ~bm) for w in range(4)]
         T = (tr & bm) | (tl & ~bm)
@@ -245,6 +279,18 @@ def _eval_points_cc_jit(nu, log_n, seeds, ts, scw, tcw, fcw, xs_hi, xs_lo):
     return ((sel >> (low & 31)) & 1).astype(jnp.uint8)
 
 
+def _split_queries(xs: np.ndarray, log_n: int):
+    """uint64[A, B] -> (xs_hi, xs_lo) device operands of the transposed
+    queries (xs_hi is a never-read [1,1] dummy when log_n <= 32)."""
+    xs_t = np.ascontiguousarray(xs.T)
+    xs_lo = jnp.asarray((xs_t & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    if log_n > 32:
+        xs_hi = jnp.asarray((xs_t >> np.uint64(32)).astype(np.uint32))
+    else:
+        xs_hi = jnp.zeros((1, 1), jnp.uint32)
+    return xs_hi, xs_lo
+
+
 def eval_points(kb: KeyBatchFast, xs: np.ndarray) -> np.ndarray:
     """Batched pointwise evaluation: xs uint64[K, Q] -> uint8[K, Q]."""
     xs = np.asarray(xs, dtype=np.uint64)
@@ -252,13 +298,35 @@ def eval_points(kb: KeyBatchFast, xs: np.ndarray) -> np.ndarray:
         raise ValueError("dpf-fast: xs must be [K, Q]")
     if (xs >> np.uint64(kb.log_n)).any():
         raise ValueError("dpf-fast: query index out of domain")
-    xs_t = np.ascontiguousarray(xs.T)  # [Q, K]
-    xs_lo = (xs_t & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-    if kb.log_n > 32:
-        xs_hi = jnp.asarray((xs_t >> np.uint64(32)).astype(np.uint32))
-    else:
-        xs_hi = jnp.zeros((1, 1), jnp.uint32)  # never read when log_n <= 32
+    xs_hi, xs_lo = _split_queries(xs, kb.log_n)
     bits = _eval_points_cc_jit(
-        kb.nu, kb.log_n, *kb.device_args(), xs_hi, jnp.asarray(xs_lo)
+        kb.nu, kb.log_n, *kb.device_args(), xs_hi, xs_lo
+    )
+    return np.asarray(bits).T
+
+
+def eval_points_level_grouped(
+    kb: KeyBatchFast, xs: np.ndarray, groups: int
+) -> np.ndarray:
+    """FSS-support pointwise evaluation over level-major key groups.
+
+    ``kb`` holds ``groups * log_n * G`` keys arranged as ``groups`` repeats
+    of ``log_n`` level-major blocks of ``G`` gates (models/fss.py layout);
+    ``xs`` is the RAW gate queries uint64[G, Q].  Key ``i*G + g`` of each
+    group is evaluated at xs[g] with its low ``log_n - 1 - i`` bits zeroed
+    (the dyadic-prefix query) — the masking happens on device against
+    trace-time constants, so neither the host nor the wire ever sees the
+    level-replicated query tensor.  -> uint8[groups * log_n * G, Q]."""
+    xs = np.asarray(xs, dtype=np.uint64)
+    if xs.ndim != 2:
+        raise ValueError("dpf-fast: xs must be [G, Q]")
+    if kb.k != groups * kb.log_n * xs.shape[0]:
+        raise ValueError("dpf-fast: key count != groups * log_n * G")
+    if (xs >> np.uint64(kb.log_n)).any():
+        raise ValueError("dpf-fast: query index out of domain")
+    xs_hi, xs_lo = _split_queries(xs, kb.log_n)
+    bits = _eval_points_cc_jit(
+        kb.nu, kb.log_n, *kb.device_args(), xs_hi, xs_lo,
+        level_groups=groups,
     )
     return np.asarray(bits).T

@@ -44,7 +44,7 @@ device pass.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
@@ -55,17 +55,23 @@ from .dpf import DeviceKeys, eval_full_device, eval_points
 
 
 def _profile_funcs(profile: str):
-    """(gen_batch, eval_points, key-batch class, key_len) per profile."""
+    """(gen_batch, eval_points, key-batch class, key_len, grouped_eval) per
+    profile.  ``grouped_eval(levels, xs, groups)`` evaluates level-major key
+    groups with the dyadic-prefix masking done on device, or None when the
+    profile only supports host-expanded queries."""
     if profile == "fast":
         from ..core.chacha_np import key_len as kl
-        from .dpf_chacha import eval_points as ep
+        from .dpf_chacha import (
+            eval_points as ep,
+            eval_points_level_grouped as grouped,
+        )
         from .keys_chacha import KeyBatchFast, gen_batch as gb
 
-        return gb, ep, KeyBatchFast, kl
+        return gb, ep, KeyBatchFast, kl, grouped
     if profile == "compat":
         from ..core.spec import key_len as kl
 
-        return gen_batch, eval_points, KeyBatch, kl
+        return gen_batch, eval_points, KeyBatch, kl, None
     raise ValueError(f"fss: unknown profile {profile!r}")
 
 __all__ = [
@@ -105,7 +111,7 @@ class CmpKeyBatch:
     def from_bytes(
         cls, blobs: list[bytes], log_n: int, profile: str = "compat"
     ) -> "CmpKeyBatch":
-        _, _, batch_cls, key_len = _profile_funcs(profile)
+        _, _, batch_cls, key_len, _ = _profile_funcs(profile)
 
         kl = key_len(log_n)
         keys: list[bytes] = []
@@ -126,6 +132,9 @@ class IntervalKeyBatch:
     upper: CmpKeyBatch  # lt_{hi+1}
     lower: CmpKeyBatch  # lt_{lo}
     const: np.ndarray  # uint8 [G]
+    # Fused upper||lower key batch, built lazily by eval_interval_points and
+    # reused (with its device-resident operands) across calls.
+    _both: object = field(default=None, repr=False, compare=False)
 
 
 def _rand_points(rng: np.random.Generator, shape, log_n: int) -> np.ndarray:
@@ -145,7 +154,7 @@ def gen_lt_batch(
     Host-side trusted-dealer step; one vectorized ``gen_batch`` over all
     ``log_n * G`` level-DPFs.  ``profile="fast"`` builds the gates from
     ChaCha-profile DPFs (both parties must evaluate with the same profile)."""
-    gen, _, _, _ = _profile_funcs(profile)
+    gen, _, _, _, _ = _profile_funcs(profile)
     alphas = np.asarray(alphas, dtype=np.uint64)
     if log_n < 1 or log_n > 63:
         raise ValueError("fss: log_n out of range")
@@ -181,12 +190,18 @@ def eval_lt_points(ck: CmpKeyBatch, xs: np.ndarray) -> np.ndarray:
     """Evaluate comparison shares at xs uint64[G, Q] -> uint8[G, Q].
 
     One device launch over all ``n * G`` level-DPFs; the level
-    XOR-reduction collapses the unique matching level into the predicate."""
-    _, ep, _, _ = _profile_funcs(ck.profile)
+    XOR-reduction collapses the unique matching level into the predicate.
+    The fast profile masks the dyadic-prefix queries on device
+    (eval_points_level_grouped) — the raw [G, Q] queries are all that
+    crosses the wire; the compat profile expands them host-side."""
     xs = np.asarray(xs, dtype=np.uint64)
     if xs.ndim != 2 or xs.shape[0] != ck.g:
         raise ValueError("fss: xs must be [G, Q]")
-    bits = ep(ck.levels, _masked_prefix_queries(xs, ck.log_n))
+    _, ep, _, _, grouped = _profile_funcs(ck.profile)
+    if grouped is not None:
+        bits = grouped(ck.levels, xs, groups=1)
+    else:
+        bits = ep(ck.levels, _masked_prefix_queries(xs, ck.log_n))
     return np.bitwise_xor.reduce(bits.reshape(ck.log_n, ck.g, -1), axis=0)
 
 
@@ -226,22 +241,28 @@ def eval_interval_points(ik: IntervalKeyBatch, xs: np.ndarray) -> np.ndarray:
 
     Both comparison gate sets fuse into a single device launch (one
     ``KeyBatch`` of ``2 * n * G`` keys)."""
-    _, ep, batch_cls, _ = _profile_funcs(ik.upper.profile)
+    _, ep, batch_cls, _, grouped = _profile_funcs(ik.upper.profile)
     xs = np.asarray(xs, dtype=np.uint64)
     G, n = ik.upper.g, ik.upper.log_n
     if xs.ndim != 2 or xs.shape[0] != G:
         raise ValueError("fss: xs must be [G, Q]")
-    u, lo = ik.upper.levels, ik.lower.levels
-    both = batch_cls(
-        n,
-        np.concatenate([u.seeds, lo.seeds]),
-        np.concatenate([u.ts, lo.ts]),
-        np.concatenate([u.scw, lo.scw]),
-        np.concatenate([u.tcw, lo.tcw]),
-        np.concatenate([u.fcw, lo.fcw]),
-    )
-    q = _masked_prefix_queries(xs, n)  # [n*G, Q]
-    bits = ep(both, np.concatenate([q, q]))
+    both = ik._both
+    if both is None:
+        u, lo = ik.upper.levels, ik.lower.levels
+        both = batch_cls(
+            n,
+            np.concatenate([u.seeds, lo.seeds]),
+            np.concatenate([u.ts, lo.ts]),
+            np.concatenate([u.scw, lo.scw]),
+            np.concatenate([u.tcw, lo.tcw]),
+            np.concatenate([u.fcw, lo.fcw]),
+        )
+        ik._both = both  # fused batch reused (and device-cached) across calls
+    if grouped is not None:
+        bits = grouped(both, xs, groups=2)
+    else:
+        q = _masked_prefix_queries(xs, n)  # [n*G, Q]
+        bits = ep(both, np.concatenate([q, q]))
     bits = bits.reshape(2, n, G, -1)
     out = np.bitwise_xor.reduce(bits, axis=(0, 1))
     return out ^ ik.const[:, None]

@@ -15,6 +15,9 @@ Endpoints (all POST, binary bodies, profile/params in the query string):
   /v1/evalfull?log_n=N[&profile=fast]        body: one key  -> bit-packed bytes
   /v1/evalfull_batch?log_n=N&k=K[&profile=fast]
         body: K concatenated keys -> K concatenated expansions
+  /v1/eval_points_batch?log_n=N&k=K&q=Q[&profile=fast]
+        body: K concatenated keys || K*Q little-endian uint64 indices
+        -> K*Q bytes of 0/1 bits (row-major [K, Q])
   /healthz                                    -> "ok"
 
 Batched endpoints amortize the device dispatch exactly like the in-process
@@ -97,6 +100,19 @@ class _Handler(BaseHTTPRequestHandler):
                     raise ValueError(f"body must be {k}*{kl} bytes")
                 keys = [bytes(body[i * kl : (i + 1) * kl]) for i in range(k)]
                 out = api.eval_full_batch(batch_cls.from_bytes(keys, log_n))
+                self._reply(200, np.ascontiguousarray(out).tobytes())
+            elif route == "/v1/eval_points_batch":
+                k, nq = int(q["k"]), int(q["q"])
+                kl = key_len(log_n)
+                if len(body) != k * kl + k * nq * 8:
+                    raise ValueError(
+                        f"body must be {k}*{kl} key bytes + {k}*{nq}*8 index bytes"
+                    )
+                keys = [bytes(body[i * kl : (i + 1) * kl]) for i in range(k)]
+                xs = np.frombuffer(body[k * kl :], dtype="<u8").reshape(k, nq)
+                out = api.eval_points_batch(
+                    batch_cls.from_bytes(keys, log_n), xs
+                )
                 self._reply(200, np.ascontiguousarray(out).tobytes())
             else:
                 self._reply(404, b"not found", "text/plain")

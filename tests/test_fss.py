@@ -180,3 +180,42 @@ def test_ge_full_fast_profile():
         np.arange(1 << log_n, dtype=np.uint64)[None, :] >= alphas[:, None]
     ).astype(np.uint8)
     np.testing.assert_array_equal(bits[:, : 1 << log_n], want)
+
+
+def test_grouped_eval_matches_host_expanded_queries():
+    """eval_points_level_grouped (on-device dyadic-prefix masking) must be
+    bit-identical to evaluating the host-expanded masked queries — across
+    domains where the masks reach into the 512-bit leaf (log_n close to or
+    below LEAF_LOG) and above it."""
+    from dpf_tpu.models.dpf_chacha import eval_points, eval_points_level_grouped
+    from dpf_tpu.models.fss import _masked_prefix_queries, gen_lt_batch
+
+    rng = np.random.default_rng(31)
+    for log_n in (6, 10, 14):
+        G, Q = 3, 5
+        alphas = rng.integers(0, 1 << log_n, size=G, dtype=np.uint64)
+        ca, _ = gen_lt_batch(alphas, log_n, rng=rng, profile="fast")
+        xs = rng.integers(0, 1 << log_n, size=(G, Q), dtype=np.uint64)
+        got = eval_points_level_grouped(ca.levels, xs, groups=1)
+        want = eval_points(ca.levels, _masked_prefix_queries(xs, log_n))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_interval_fast_profile_deep_domain():
+    """groups=2 on-device masking with real walk levels (log_n > LEAF_LOG):
+    the log_n=9 interval test has nu=0 and never exercises the descent
+    masking, so this pins the two-group key_level layout at depth,
+    including the cached fused batch on a second call."""
+    from dpf_tpu.models.fss import eval_interval_points, gen_interval_batch
+
+    log_n = 14
+    rng = np.random.default_rng(47)
+    lo = np.array([0, 1000, 9999], dtype=np.uint64)
+    hi = np.array([0, 2000, (1 << log_n) - 1], dtype=np.uint64)
+    ia, ib = gen_interval_batch(lo, hi, log_n, rng=rng, profile="fast")
+    xs = rng.integers(0, 1 << log_n, size=(3, 16), dtype=np.uint64)
+    xs[:, :3] = np.stack([lo, hi, (hi + 1) & ((1 << log_n) - 1)], axis=1)
+    for _ in range(2):  # second pass hits the _both cache
+        got = eval_interval_points(ia, xs) ^ eval_interval_points(ib, xs)
+        want = ((xs >= lo[:, None]) & (xs <= hi[:, None])).astype(np.uint8)
+        np.testing.assert_array_equal(got, want)

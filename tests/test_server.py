@@ -70,3 +70,35 @@ def test_errors_propagate_as_400(srv):
     with pytest.raises(urllib.error.HTTPError) as ei:
         _post(f"{srv}/v1/evalfull_batch?log_n=9&k=2", b"\x00")
     assert ei.value.code == 400
+
+
+def test_eval_points_batch_endpoint_both_profiles(srv):
+    log_n, k, q = 9, 3, 4
+    alphas = [5, 77, 300]
+    for profile, kl in (("compat", spec.key_len(log_n)), ("fast", cc.key_len(log_n))):
+        suffix = f"&profile={profile}"
+        blobs = [
+            _post(f"{srv}/v1/gen?log_n={log_n}&alpha={a}{suffix}") for a in alphas
+        ]
+        xs = np.array(
+            [[a, (a + 1) % (1 << log_n), 0, a] for a in alphas], dtype="<u8"
+        )
+        out = []
+        for half in (0, 1):
+            body = b"".join(b[half * kl : (half + 1) * kl] for b in blobs)
+            body += xs.tobytes()
+            out.append(
+                _post(
+                    f"{srv}/v1/eval_points_batch?log_n={log_n}&k={k}&q={q}{suffix}",
+                    body,
+                )
+            )
+        rec = (
+            np.frombuffer(out[0], np.uint8) ^ np.frombuffer(out[1], np.uint8)
+        ).reshape(k, q)
+        want = (xs == np.array(alphas, dtype=np.uint64)[:, None]).astype(np.uint8)
+        np.testing.assert_array_equal(rec, want)
+    # malformed body -> 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{srv}/v1/eval_points_batch?log_n=9&k=2&q=1", b"\x00")
+    assert ei.value.code == 400
