@@ -131,6 +131,16 @@ def main():
     _emit(f"pointwise eval n={n3} {k3}x{q3} (fast, incl. dispatch)",
           k3 * q3 / dt / 1e6, "Mqueries/sec")
 
+    from dpf_tpu.core.keys import gen_batch as gen_compat
+    from dpf_tpu.models.dpf import eval_points as compat_points
+
+    kac3, _ = gen_compat(
+        rng.integers(0, 1 << n3, size=k3, dtype=np.uint64), n3, rng=rng
+    )
+    dt = _timed_host_call(lambda: compat_points(kac3, xs))
+    _emit(f"pointwise eval n={n3} {k3}x{q3} (compat, incl. dispatch)",
+          k3 * q3 / dt / 1e6, "Mqueries/sec")
+
     # ---- config 4: 2-server PIR, 2^24 x 32 B, 1k queries --------------------
     nrows, rb, nq = (1 << 24, 32, 1024) if not small else (1 << 12, 32, 16)
     db = rng.integers(0, 256, size=(nrows, rb), dtype=np.uint8)

@@ -20,7 +20,7 @@ wall time as a handful.
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -37,6 +37,10 @@ class KeyBatch:
     scw: np.ndarray  # uint32 [K, nu, 4]
     tcw: np.ndarray  # uint8  [K, nu, 2]
     fcw: np.ndarray  # uint32 [K, 4]
+    # Device-resident per-key lane masks, built lazily by the pointwise
+    # evaluator (models/dpf._point_masks) and reused across calls — key
+    # material is immutable once evaluated.
+    _point_masks: object = field(default=None, repr=False, compare=False)
 
     @property
     def k(self) -> int:

@@ -305,27 +305,15 @@ def eval_full(
     return out
 
 
-def eval_points(kb: KeyBatch, xs: np.ndarray) -> np.ndarray:
-    """Batched pointwise evaluation: xs uint64[K, Q] -> bits uint8[K, Q].
+def _point_masks(kb: KeyBatch):
+    """Per-key lane masks (0 / ~0) for the pointwise walk, broadcast over
+    the query axis on device.  Built once per key batch and cached on it —
+    key material is immutable once evaluated, and rebuilding + re-uploading
+    ~(nu+2)*128*K*4 bytes of masks per call would dominate serving calls."""
+    if kb._point_masks is not None:
+        return kb._point_masks
+    K, nu = kb.k, kb.nu
 
-    One root-to-leaf path walk per (key, query) lane, all lanes in lockstep:
-    per level both PRG children are computed bitsliced and the path bit
-    selects per lane (reference Eval, dpf/dpf.go:171-211, vectorized).
-    """
-    xs = np.asarray(xs, dtype=np.uint64)
-    K, Q = xs.shape
-    if K != kb.k:
-        raise ValueError("xs first axis must match key batch")
-    if (xs >> np.uint64(kb.log_n)).any():
-        raise ValueError("dpf: query index out of domain")
-    pad_q = (-Q) % 32
-    if pad_q:
-        xs = np.concatenate([xs, np.zeros((K, pad_q), np.uint64)], axis=1)
-    qp = xs.shape[1] // 32
-    nu = kb.nu
-    log_n = kb.log_n
-
-    # Per-key masks (0 / ~0): broadcast over the query axis on device.
     def bits_of_words(words):  # uint32[K, 4] -> uint8[128, K]
         b = (words[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
         return np.moveaxis(b.reshape(K, 128), 0, 1).astype(np.uint8)
@@ -345,26 +333,64 @@ def eval_points(kb: KeyBatch, xs: np.ndarray) -> np.ndarray:
         scw_masks = jnp.zeros((0, 128, K), jnp.uint32)
         tl_masks = jnp.zeros((0, K), jnp.uint32)
         tr_masks = jnp.zeros((0, K), jnp.uint32)
+    kb._point_masks = (
+        seed_masks, t_masks, scw_masks, tl_masks, tr_masks, fcw_masks
+    )
+    return kb._point_masks
 
-    # Path-bit lane masks per level, packed over the query axis.
-    shifts = np.array([log_n - 1 - i for i in range(nu)], dtype=np.uint64)
-    pb = ((xs[None, :, :] >> shifts[:, None, None]) & np.uint64(1)).astype(np.uint8)
-    path_words = jnp.asarray(_pack_bits_over_keys(pb))  # [nu, K, Qp]... packs last axis
-    low = jnp.asarray((xs & np.uint64(127)).astype(np.uint32))  # [K, Qpad]
+
+def eval_points(kb: KeyBatch, xs: np.ndarray) -> np.ndarray:
+    """Batched pointwise evaluation: xs uint64[K, Q] -> bits uint8[K, Q].
+
+    One root-to-leaf path walk per (key, query) lane, all lanes in lockstep:
+    per level both PRG children are computed bitsliced and the path bit
+    selects per lane (reference Eval, dpf/dpf.go:171-211, vectorized).
+    Key masks are device-cached across calls; the per-call upload is the
+    query indices themselves (split into uint32 halves — the domain index
+    can exceed 2^32), from which the per-level packed path words are built
+    on device.
+    """
+    xs = np.asarray(xs, dtype=np.uint64)
+    K, Q = xs.shape
+    if K != kb.k:
+        raise ValueError("xs first axis must match key batch")
+    if (xs >> np.uint64(kb.log_n)).any():
+        raise ValueError("dpf: query index out of domain")
+    pad_q = (-Q) % 32
+    if pad_q:
+        xs = np.concatenate([xs, np.zeros((K, pad_q), np.uint64)], axis=1)
+    qp = xs.shape[1] // 32
+
+    xs_lo = jnp.asarray((xs & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    if kb.log_n > 32:
+        xs_hi = jnp.asarray((xs >> np.uint64(32)).astype(np.uint32))
+    else:
+        xs_hi = jnp.zeros((1, 1), jnp.uint32)  # never read when log_n <= 32
 
     bits = _eval_points_jit(
-        nu, seed_masks, t_masks, scw_masks, tl_masks, tr_masks,
-        fcw_masks, path_words, low, qp,
+        kb.nu, kb.log_n, *_point_masks(kb), xs_hi, xs_lo, qp
     )
     return np.asarray(bits)[:, :Q]
 
 
-@partial(jax.jit, static_argnums=(0, 9))
+@partial(jax.jit, static_argnums=(0, 1, 10))
 def _eval_points_jit(
-    nu, seed_masks, t_masks, scw_masks, tl_masks, tr_masks,
-    fcw_masks, path_words, low, qp,
+    nu, log_n, seed_masks, t_masks, scw_masks, tl_masks, tr_masks,
+    fcw_masks, xs_hi, xs_lo, qp,
 ):
     K = seed_masks.shape[1]
+    lane = jnp.arange(32, dtype=jnp.uint32)
+
+    def path_words(i):
+        """Packed path-bit lane masks for level i: uint32[K, qp] where word
+        w packs queries [32w, 32w+32)'s descent bits (LSB-first)."""
+        b = log_n - 1 - i  # static per level
+        if b >= 32:
+            pb = (xs_hi >> np.uint32(b - 32)) & np.uint32(1)
+        else:
+            pb = (xs_lo >> np.uint32(b)) & np.uint32(1)
+        return (pb.reshape(K, qp, 32) << lane).sum(-1, dtype=jnp.uint32)
+
     S = jnp.broadcast_to(seed_masks[:, :, None], (128, K, qp))
     T = jnp.broadcast_to(t_masks[None, :, None], (1, K, qp)).reshape(K, qp)
     for i in range(nu):
@@ -379,13 +405,14 @@ def _eval_points_jit(
         R = R ^ cw
         tl = tl ^ (tl_masks[i][:, None] & T)
         tr = tr ^ (tr_masks[i][:, None] & T)
-        go_r = path_words[i]  # [K, qp]
+        go_r = path_words(i)  # [K, qp]
         S = (R & go_r) | (L & ~go_r)
         T = (tr & go_r) | (tl & ~go_r)
     C = aes128_mmo_planes(S.reshape(128, -1), RK_MASKS_L).reshape(128, K, qp)
     C = C ^ (fcw_masks[:, :, None] & T[None, :, :])
     words = unpack_planes(C.reshape(128, 1, K * qp))  # [K*Q, 1, 4]
     words = words.reshape(K, qp * 32, 4)
+    low = xs_lo & np.uint32(127)  # index within the 128-bit leaf
     qsel = ((low >> 5) & 3).astype(jnp.int32)  # which 32-bit word of the leaf
     w = jnp.take_along_axis(words, qsel[:, :, None], axis=2)[:, :, 0]
     return ((w >> (low & 31)) & 1).astype(jnp.uint8)

@@ -8,7 +8,7 @@ shape to the reference, dpf/dpf.go:111-112), 64-byte final CW for the
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -25,6 +25,8 @@ class KeyBatchFast:
     scw: np.ndarray  # uint32 [K, nu, 4]
     tcw: np.ndarray  # uint8  [K, nu, 2]
     fcw: np.ndarray  # uint32 [K, 16]
+    # Memoized device operands (see device_args).
+    _device_args: object = field(default=None, repr=False, compare=False)
 
     @property
     def k(self) -> int:
@@ -68,9 +70,8 @@ class KeyBatchFast:
         ~70 MB of keys vs ~1 ms of device work per call).  Callers that
         mutate the arrays (gen_lt_batch's zero-sharing) do so before the
         first evaluation."""
-        cached = getattr(self, "_device_args", None)
-        if cached is not None:
-            return cached
+        if self._device_args is not None:
+            return self._device_args
         import jax.numpy as jnp
 
         args = (
