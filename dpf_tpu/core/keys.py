@@ -41,6 +41,9 @@ class KeyBatch:
     # evaluator (models/dpf._point_masks) and reused across calls — key
     # material is immutable once evaluated.
     _point_masks: object = field(default=None, repr=False, compare=False)
+    # Zero-padded copies keyed by pad amount (parallel/sharding), so padding
+    # to a mesh doesn't defeat the per-batch device caches.
+    _padded: object = field(default=None, repr=False, compare=False)
 
     @property
     def k(self) -> int:

@@ -373,11 +373,12 @@ def eval_points(kb: KeyBatch, xs: np.ndarray) -> np.ndarray:
     return np.asarray(bits)[:, :Q]
 
 
-@partial(jax.jit, static_argnums=(0, 1, 10))
-def _eval_points_jit(
+def _eval_points_body(
     nu, log_n, seed_masks, t_masks, scw_masks, tl_masks, tr_masks,
     fcw_masks, xs_hi, xs_lo, qp,
 ):
+    """Traceable core of the pointwise walk (shared by the single-chip jit
+    and the shard_map'd evaluator in parallel/sharding.py)."""
     K = seed_masks.shape[1]
     lane = jnp.arange(32, dtype=jnp.uint32)
 
@@ -416,3 +417,6 @@ def _eval_points_jit(
     qsel = ((low >> 5) & 3).astype(jnp.int32)  # which 32-bit word of the leaf
     w = jnp.take_along_axis(words, qsel[:, :, None], axis=2)[:, :, 0]
     return ((w >> (low & 31)) & 1).astype(jnp.uint8)
+
+
+_eval_points_jit = partial(jax.jit, static_argnums=(0, 1, 10))(_eval_points_body)

@@ -197,8 +197,7 @@ def eval_full(kb: KeyBatchFast, max_leaf_nodes: int = MAX_LEAF_NODES) -> np.ndar
     return np.ascontiguousarray(words).view("<u1").reshape(kb.k, -1)
 
 
-@partial(jax.jit, static_argnums=(0, 1, 9))
-def _eval_points_cc_jit(
+def _eval_points_cc_body(
     nu, log_n, seeds, ts, scw, tcw, fcw, xs_hi, xs_lo, level_groups=0
 ):
     """Query-major path walk: xs_hi/xs_lo uint32[Q, K] (the query index
@@ -277,6 +276,11 @@ def _eval_points_cc_jit(
     w = jnp.stack(out, axis=2)  # [Q, K, 16]
     sel = jnp.take_along_axis(w, widx[:, :, None].astype(jnp.int32), axis=2)[:, :, 0]
     return ((sel >> (low & 31)) & 1).astype(jnp.uint8)
+
+
+_eval_points_cc_jit = partial(jax.jit, static_argnums=(0, 1, 9))(
+    _eval_points_cc_body
+)
 
 
 def _split_queries(xs: np.ndarray, log_n: int):

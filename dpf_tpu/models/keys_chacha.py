@@ -27,6 +27,9 @@ class KeyBatchFast:
     fcw: np.ndarray  # uint32 [K, 16]
     # Memoized device operands (see device_args).
     _device_args: object = field(default=None, repr=False, compare=False)
+    # Zero-padded copies keyed by pad amount (parallel/sharding), so padding
+    # to a mesh doesn't defeat the device_args memoization.
+    _padded: object = field(default=None, repr=False, compare=False)
 
     @property
     def k(self) -> int:

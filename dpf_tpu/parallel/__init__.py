@@ -6,6 +6,8 @@ from .sharding import (
     LEAF_AXIS,
     eval_full_sharded,
     eval_full_sharded_fast,
+    eval_points_sharded,
+    eval_points_sharded_fast,
     make_mesh,
     xor_allreduce,
 )
@@ -15,6 +17,8 @@ __all__ = [
     "LEAF_AXIS",
     "eval_full_sharded",
     "eval_full_sharded_fast",
+    "eval_points_sharded",
+    "eval_points_sharded_fast",
     "make_mesh",
     "xor_allreduce",
 ]

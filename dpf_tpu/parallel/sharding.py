@@ -243,17 +243,169 @@ def eval_full_sharded_fast(kb, mesh: Mesh) -> np.ndarray:
     batch is zero-padded to a multiple of the ``keys`` axis."""
     n_keys = mesh.shape[KEYS_AXIS]
     c = leaf_axis_levels(mesh, kb.nu, kb.log_n)
-    pad = (-kb.k) % n_keys
+    padded = _pad_fast_batch(kb, (-kb.k) % n_keys)
+    fn = _sharded_eval_full_fast(mesh, kb.nu, c)
+    words = np.asarray(fn(*padded.device_args()))
+    return np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)
+
+
+def _pad_fast_batch(kb, pad: int):
+    """Zero-pad the key axis; memoized on ``kb`` so repeated sharded calls
+    reuse the padded batch's device-resident operands."""
+    from ..models.keys_chacha import KeyBatchFast
+
+    if not pad:
+        return kb
+    cache = kb._padded or {}
+    if pad in cache:
+        return cache[pad]
 
     def padk(a):
         return np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
-
-    from ..models.keys_chacha import KeyBatchFast
 
     padded = KeyBatchFast(
         kb.log_n, padk(kb.seeds), padk(kb.ts), padk(kb.scw),
         padk(kb.tcw), padk(kb.fcw),
     )
-    fn = _sharded_eval_full_fast(mesh, kb.nu, c)
-    words = np.asarray(fn(*padded.device_args()))
-    return np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)
+    cache[pad] = padded
+    kb._padded = cache
+    return padded
+
+
+def _pad_compat_batch(kb: KeyBatch, pad: int) -> KeyBatch:
+    """Compat mirror of :func:`_pad_fast_batch` (same memoization reason —
+    the padded copy carries the _point_masks device cache)."""
+    if not pad:
+        return kb
+    cache = kb._padded or {}
+    if pad in cache:
+        return cache[pad]
+    padded = KeyBatch(
+        kb.log_n,
+        *(
+            np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+            for a in (kb.seeds, kb.ts, kb.scw, kb.tcw, kb.fcw)
+        ),
+    )
+    cache[pad] = padded
+    kb._padded = cache
+    return padded
+
+
+# ---------------------------------------------------------------------------
+# Sharded pointwise evaluation — key-batch data parallelism, no collectives
+# ---------------------------------------------------------------------------
+
+
+@cache
+def _sharded_eval_points(mesh: Mesh, nu: int, log_n: int, qp: int):
+    """Compat pointwise walk sharded over the ``keys`` axis.  Queries travel
+    with their keys (each shard walks its own (key, query) lanes); meshes
+    with a leaf axis recompute redundantly across it.  xs_hi shards with
+    the keys when the domain needs the high index half (log_n > 32); below
+    that it is the replicated [1, 1] dummy."""
+    from ..models.dpf import _eval_points_body
+
+    def body(seed_m, t_m, scw_m, tl_m, tr_m, fcw_m, xs_hi, xs_lo):
+        return _eval_points_body(
+            nu, log_n, seed_m, t_m, scw_m, tl_m, tr_m, fcw_m,
+            xs_hi, xs_lo, qp,
+        )
+
+    keyed = P(None, KEYS_AXIS)
+    hi_spec = P(KEYS_AXIS, None) if log_n > 32 else P(None, None)
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                keyed, P(KEYS_AXIS), P(None, None, KEYS_AXIS),
+                keyed, keyed, keyed, hi_spec, P(KEYS_AXIS, None),
+            ),
+            out_specs=P(KEYS_AXIS, None),
+            check_vma=False,
+        )
+    )
+
+
+def eval_points_sharded(kb: KeyBatch, xs: np.ndarray, mesh: Mesh) -> np.ndarray:
+    """Sharded batched pointwise evaluation (compat profile):
+    xs uint64[K, Q] -> uint8[K, Q], key batch sharded over the ``keys``
+    axis — pure data parallelism, zero cross-chip communication (the
+    reference Eval is one key / one point at a time, dpf/dpf.go:171)."""
+    from ..models.dpf import _point_masks
+
+    xs = np.asarray(xs, dtype=np.uint64)
+    if xs.ndim != 2 or xs.shape[0] != kb.k:
+        raise ValueError("dpf: xs must be [K, Q]")
+    if (xs >> np.uint64(kb.log_n)).any():
+        raise ValueError("dpf: query index out of domain")
+    n_keys = mesh.shape[KEYS_AXIS]
+    K, Q = xs.shape
+    pad = (-K) % n_keys
+    kb = _pad_compat_batch(kb, pad)
+    if pad:
+        xs = np.concatenate([xs, np.zeros((pad, Q), np.uint64)])
+    pad_q = (-Q) % 32
+    if pad_q:
+        xs = np.concatenate(
+            [xs, np.zeros((xs.shape[0], pad_q), np.uint64)], axis=1
+        )
+    qp = xs.shape[1] // 32
+    xs_lo = jnp.asarray((xs & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    if kb.log_n > 32:
+        xs_hi = jnp.asarray((xs >> np.uint64(32)).astype(np.uint32))
+    else:
+        xs_hi = jnp.zeros((1, 1), jnp.uint32)
+    fn = _sharded_eval_points(mesh, kb.nu, kb.log_n, qp)
+    bits = np.asarray(fn(*_point_masks(kb), xs_hi, xs_lo))
+    return bits[:K, :Q]
+
+
+@cache
+def _sharded_eval_points_fast(mesh: Mesh, nu: int, log_n: int):
+    """Fast-profile pointwise walk sharded over the ``keys`` axis.  State is
+    query-major [Q, K] (models/dpf_chacha.py), so the key axis is LAST."""
+    from ..models.dpf_chacha import _eval_points_cc_body
+
+    def body(seeds, ts, scw, tcw, fcw, xs_hi, xs_lo):
+        return _eval_points_cc_body(
+            nu, log_n, seeds, ts, scw, tcw, fcw, xs_hi, xs_lo
+        )
+
+    hi_spec = P(None, KEYS_AXIS) if log_n > 32 else P(None, None)
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                P(KEYS_AXIS, None), P(KEYS_AXIS), P(KEYS_AXIS, None, None),
+                P(KEYS_AXIS, None, None), P(KEYS_AXIS, None),
+                hi_spec, P(None, KEYS_AXIS),
+            ),
+            out_specs=P(None, KEYS_AXIS),
+            check_vma=False,
+        )
+    )
+
+
+def eval_points_sharded_fast(kb, xs: np.ndarray, mesh: Mesh) -> np.ndarray:
+    """Sharded batched pointwise evaluation (fast profile):
+    xs uint64[K, Q] -> uint8[K, Q], key batch sharded over ``keys``."""
+    from ..models.dpf_chacha import _split_queries
+
+    xs = np.asarray(xs, dtype=np.uint64)
+    if xs.ndim != 2 or xs.shape[0] != kb.k:
+        raise ValueError("dpf-fast: xs must be [K, Q]")
+    if (xs >> np.uint64(kb.log_n)).any():
+        raise ValueError("dpf-fast: query index out of domain")
+    n_keys = mesh.shape[KEYS_AXIS]
+    K, Q = xs.shape
+    pad = (-K) % n_keys
+    padded = _pad_fast_batch(kb, pad)
+    if pad:
+        xs = np.concatenate([xs, np.zeros((pad, Q), np.uint64)])
+    xs_hi, xs_lo = _split_queries(xs, kb.log_n)  # [Q, Kpad]
+    fn = _sharded_eval_points_fast(mesh, kb.nu, kb.log_n)
+    bits = np.asarray(fn(*padded.device_args(), xs_hi, xs_lo))
+    return bits.T[:K]

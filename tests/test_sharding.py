@@ -117,3 +117,45 @@ def test_unknown_prg_backend_rejected(monkeypatch):
     monkeypatch.setenv("DPF_TPU_PRG", "nope")
     with pytest.raises(ValueError, match="DPF_TPU_PRG"):
         default_backend()
+
+
+@pytest.mark.parametrize("log_n", [11, 33])
+def test_eval_points_sharded_matches_spec(log_n):
+    """Sharded compat pointwise walk vs the byte-exact spec, spanning the
+    uint32 index boundary (log_n=33 exercises the sharded xs_hi spec) and a
+    key count that needs padding to the mesh."""
+    from dpf_tpu.parallel import eval_points_sharded
+
+    rng = np.random.default_rng(90 + log_n)
+    K, Q = 5, 7  # K not a multiple of the keys axis -> padded
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, kb_ = dpf_tpu.gen_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    xs[:, 0] = alphas
+    mesh = make_mesh(4, 2)
+    got_a = eval_points_sharded(ka, xs, mesh)
+    got = got_a ^ eval_points_sharded(kb_, xs, mesh)
+    np.testing.assert_array_equal(got, (xs == alphas[:, None]).astype(np.uint8))
+    for i in range(K):
+        for j in range(Q):
+            assert got_a[i, j] == spec.eval_point(
+                ka.to_bytes()[i], int(xs[i, j]), log_n
+            )
+
+
+@pytest.mark.parametrize("log_n", [11, 33])
+def test_eval_points_sharded_fast_matches(log_n):
+    from dpf_tpu.models.keys_chacha import gen_batch as gen_fast
+    from dpf_tpu.parallel import eval_points_sharded_fast
+
+    rng = np.random.default_rng(95 + log_n)
+    K, Q = 6, 5
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, kb_ = gen_fast(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    xs[:, 0] = alphas
+    mesh = make_mesh(4, 1, devices=jax.devices()[:4])
+    got = eval_points_sharded_fast(ka, xs, mesh) ^ eval_points_sharded_fast(
+        kb_, xs, mesh
+    )
+    np.testing.assert_array_equal(got, (xs == alphas[:, None]).astype(np.uint8))
