@@ -57,15 +57,19 @@ def measure_baseline() -> float:
             ka, _ = cpu_native.gen(int(a), LOG_N, rng=rng)
             keys.append(ka)
         cpu_native.eval_full_batch(keys[:4], LOG_N)  # warm
-        t0 = time.perf_counter()
-        cpu_native.eval_full_batch(keys, LOG_N)
-        dt = time.perf_counter() - t0
-        return len(keys) * (1 << LOG_N) / dt
+        # Best-of: the host core is shared too — a loaded-host sample would
+        # understate the baseline and flatter the TPU ratio.
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            cpu_native.eval_full_batch(keys, LOG_N)
+            best = min(best, time.perf_counter() - t0)
+        return len(keys) * (1 << LOG_N) / best
     except Exception:
         return FALLBACK_BASELINE
 
 
-def _marginal_time(f1, fR, args, r: int, repeats: int = 4) -> float:
+def _marginal_time(f1, fR, args, r: int, repeats: int = 6) -> float:
     """Best-of slope between an R-chained and a 1-chained dispatch.
 
     A tunnel-latency spike during the 1-chain dispatch can push t1 above tR
@@ -90,6 +94,23 @@ def _marginal_time(f1, fR, args, r: int, repeats: int = 4) -> float:
     return min(positive)
 
 
+def _check_reconstruction(eval_fn, batch_cls, ka, kb, alphas, what: str):
+    """2-party reconstruction spot-check on a 4-key slice: the XOR of the
+    shares must be exactly the indicator of alpha.  Shared by both
+    profiles' benches so the scoreboard numbers are self-validating."""
+    def slice4(b):
+        return batch_cls(
+            b.log_n, b.seeds[:4], b.ts[:4], b.scw[:4], b.tcw[:4], b.fcw[:4]
+        )
+
+    rec = eval_fn(slice4(ka)) ^ eval_fn(slice4(kb))
+    bits = np.unpackbits(rec, axis=1, bitorder="little")
+    if (bits.sum(axis=1) != 1).any() or (
+        bits[np.arange(4), alphas[:4].astype(np.int64)] != 1
+    ).any():
+        raise AssertionError(f"{what} reconstruction failed")
+
+
 def bench_fast(jax, jnp, rng) -> float:
     """Fast profile (ChaCha): -> leaves/sec."""
     from dpf_tpu.models import keys_chacha as kc
@@ -97,19 +118,9 @@ def bench_fast(jax, jnp, rng) -> float:
 
     alphas = rng.integers(0, 1 << LOG_N, size=K, dtype=np.uint64)
     ka, kb = kc.gen_batch(alphas, LOG_N, rng=rng)
-
-    # Correctness spot-check: 2-party reconstruction on a 4-key slice.
-    sl = kc.KeyBatchFast(
-        LOG_N, ka.seeds[:4], ka.ts[:4], ka.scw[:4], ka.tcw[:4], ka.fcw[:4]
+    _check_reconstruction(
+        eval_full, kc.KeyBatchFast, ka, kb, alphas, "fast-profile"
     )
-    sl_b = kc.KeyBatchFast(
-        LOG_N, kb.seeds[:4], kb.ts[:4], kb.scw[:4], kb.tcw[:4], kb.fcw[:4]
-    )
-    bits = np.unpackbits(eval_full(sl) ^ eval_full(sl_b), axis=1, bitorder="little")
-    if (bits.sum(axis=1) != 1).any() or (
-        bits[np.arange(4), alphas[:4].astype(np.int64)] != 1
-    ).any():
-        raise AssertionError("fast-profile reconstruction failed")
 
     nu = ka.nu
     args = (
@@ -149,9 +160,19 @@ def bench_compat(jax, jnp, rng) -> float:
     from dpf_tpu.core.keys import gen_batch
     from dpf_tpu.models.dpf import DeviceKeys, _eval_full_jit, default_backend
 
+    from functools import partial as _partial
+
+    from dpf_tpu.core.keys import KeyBatch
+    from dpf_tpu.models.dpf import eval_full
+
     backend = default_backend()
     alphas = rng.integers(0, 1 << LOG_N, size=K, dtype=np.uint64)
-    ka, _ = gen_batch(alphas, LOG_N, rng=rng)
+    ka, kb = gen_batch(alphas, LOG_N, rng=rng)
+    # Spot-check through the same backend the timed run uses.
+    _check_reconstruction(
+        _partial(eval_full, backend=backend), KeyBatch, ka, kb, alphas,
+        "compat-profile",
+    )
     dk = DeviceKeys(ka)
 
     def chained(r):

@@ -57,13 +57,19 @@ _PRG_IMPLS = {
     "xla": prg_planes,
     "pallas": aes_pallas.prg_planes_pallas,
     "pallas_bm": aes_pallas.prg_planes_pallas_bm,
+    # experimental: interleaved double-encrypt, bit-major state
+    "pallas_bm_il": aes_pallas.prg_planes_pallas_bm_il,
 }
 _MMO_IMPLS = {
     "xla": lambda S: aes128_mmo_planes(S, RK_MASKS_L),
     "pallas": aes_pallas.mmo_planes_pallas,
     # converts back to canonical plane order on output
     "pallas_bm": aes_pallas.mmo_planes_pallas_bm_canon,
+    "pallas_bm_il": aes_pallas.mmo_planes_pallas_bm_canon,
 }
+# Backends whose level state lives in bit-major plane order (need the
+# canonical->bm permute of seeds/CWs at the pipeline entry).
+_BM_BACKENDS = frozenset({"pallas_bm", "pallas_bm_il"})
 
 
 def default_backend() -> str:
@@ -202,7 +208,7 @@ def _eval_full_jit(
     n_levels, seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes,
     backend="xla",
 ):
-    if backend == "pallas_bm":
+    if backend in _BM_BACKENDS:
         seed_planes, scw_planes = _to_bm(seed_planes, scw_planes)
     S, T = seed_planes, t_words
     for i in range(n_levels):
@@ -214,9 +220,10 @@ def _eval_full_jit(
 def _expand_prefix_jit(
     n_levels, seed_planes, t_words, scw_planes, tl_w, tr_w, backend="xla"
 ):
-    """NB: with backend="pallas_bm" the returned S is in bit-major order —
-    feed it only to _finish_chunk_jit with the same backend."""
-    if backend == "pallas_bm":
+    """NB: with a bit-major backend (_BM_BACKENDS) the returned S is in
+    bit-major plane order — feed it only to _finish_chunk_jit with the same
+    backend."""
+    if backend in _BM_BACKENDS:
         seed_planes, scw_planes = _to_bm(seed_planes, scw_planes)
     S, T = seed_planes, t_words
     for i in range(n_levels):
@@ -277,7 +284,7 @@ def eval_full_device(
         backend,
     )
     scw = dk.scw_planes
-    if backend == "pallas_bm":
+    if backend in _BM_BACKENDS:
         # One permute for all chunks; S from the prefix is already bit-major.
         scw = _scw_to_bm(scw)
     outs = []

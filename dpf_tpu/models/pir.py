@@ -37,7 +37,14 @@ from ..parallel.sharding import (
     leaf_axis_levels,
     xor_allreduce,
 )
-from .dpf import DeviceKeys, _convert_leaves, _level_step, _to_bm, default_backend
+from .dpf import (
+    _BM_BACKENDS,
+    DeviceKeys,
+    _convert_leaves,
+    _level_step,
+    _to_bm,
+    default_backend,
+)
 
 # Leaf width (log2 bits) per profile: compat = one AES block (reference
 # dpf/dpf.go:251), fast = one ChaCha block (core/chacha_np.LEAF_LOG).
@@ -256,7 +263,7 @@ def _leaves_to_sel_words(words: jax.Array) -> jax.Array:
 @cache
 def _pir_single(nu: int, chunk_rows: int, n_chunks: int, backend: str = "xla"):
     def body(seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes, db_words):
-        if backend == "pallas_bm":
+        if backend in _BM_BACKENDS:
             seed_planes, scw_planes = _to_bm(seed_planes, scw_planes)
         S, T = seed_planes, t_words
         for i in range(nu):

@@ -179,6 +179,37 @@ def _prg_kernel_bm(s_ref, rk_ref, l_ref, r_ref):
     r_ref[:] = _encrypt_bm(S, rk[1]) ^ S
 
 
+def _encrypt2_bm_interleaved(S, rk2):
+    """Both fixed-key encryptions round-by-round in lockstep: halves the
+    serial dependency depth at the cost of a doubled live state.  Whether
+    that wins depends on Mosaic's scheduler/spills — selected only when the
+    end-to-end A/B (scripts/bench_compat_ab.py) says so."""
+    A = S ^ rk2[0, 0][:, None]
+    B = S ^ rk2[1, 0][:, None]
+    for rnd in range(1, 10):
+        A = _mix_columns_bm(_shift_rows_bm(_sub_bytes_bm(A))) ^ rk2[0, rnd][:, None]
+        B = _mix_columns_bm(_shift_rows_bm(_sub_bytes_bm(B))) ^ rk2[1, rnd][:, None]
+    A = _shift_rows_bm(_sub_bytes_bm(A)) ^ rk2[0, 10][:, None]
+    B = _shift_rows_bm(_sub_bytes_bm(B)) ^ rk2[1, 10][:, None]
+    return A, B
+
+
+def _prg_kernel_bm_il(s_ref, rk_ref, l_ref, r_ref):
+    S = s_ref[:]
+    A, B = _encrypt2_bm_interleaved(S, rk_ref[:])
+    l_ref[:] = A ^ S
+    r_ref[:] = B ^ S
+
+
+def prg_planes_pallas_bm_il(S: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Experimental interleaved bit-major PRG (same contract as
+    prg_planes_pallas_bm)."""
+    if S.shape[1] % _MIN_B:
+        return prg_planes_pallas_bm(S)  # shared non-tileable fallback
+    L, R = _tiled_call(S, _prg_kernel_bm_il, 2, True)
+    return L, R
+
+
 def _mmo_canon_kernel_bm(s_ref, rk_ref, o_ref):
     """Leaf convert from bit-major state to CANONICAL-order output planes:
     the one boundary where the bit-major pipeline pays a permute (in-VMEM

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.keys import KeyBatch
 from ..models.dpf import (
+    _BM_BACKENDS,
     DeviceKeys,
     _convert_leaves,
     _level_step,
@@ -98,9 +99,10 @@ def expand_subtree_local(
     ``LEAF_AXIS`` index, expand the remaining levels.  Single source of
     truth for the subtree-sharding idiom (also used by models/pir.py).
 
-    With ``backend="pallas_bm"`` the returned S is in bit-major plane order
-    (feed it only to a convert with the same backend)."""
-    if backend == "pallas_bm":
+    With a bit-major backend (models/dpf._BM_BACKENDS) the returned S is in
+    bit-major plane order (feed it only to a convert with the same
+    backend)."""
+    if backend in _BM_BACKENDS:
         seed_planes, scw_planes = _to_bm(seed_planes, scw_planes)
     c = subtree_levels
     S, T = seed_planes, t_words  # [128, 1, kp_local], [1, kp_local]

@@ -119,3 +119,21 @@ def test_eval_full_pallas_bm_backend_matches_spec():
     )
     got_chunked = np.ascontiguousarray(words[:K]).view("<u1").reshape(K, -1)
     np.testing.assert_array_equal(got_chunked, want)
+
+
+def test_eval_full_pallas_bm_il_matches_spec():
+    # Interleaved double-encrypt variant: byte-identical to the spec.
+    # W*Kp = 2^6 * 2 = 128 lane words so the Mosaic kernel path actually
+    # runs (smaller shapes would silently take the XLA fallback).
+    log_n, K = 13, 64
+    rng = np.random.default_rng(9)
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, _ = gen_batch(alphas, log_n, rng=rng)
+    got = eval_full(ka, backend="pallas_bm_il")
+    want = np.stack(
+        [
+            np.frombuffer(spec.eval_full(k, log_n), np.uint8)
+            for k in ka.to_bytes()
+        ]
+    )
+    np.testing.assert_array_equal(got, want)
