@@ -73,6 +73,39 @@ def _quarter(s, a, b, c, d):
     s[b] = _rotl(s[b] ^ s[c], 7)
 
 
+def double_round(s):
+    """One ChaCha double round (column + diagonal) on a 16-element word
+    state, in place.  Elementwise ``+ ^ << >>`` only, so it works on numpy
+    arrays AND traced jnp arrays — the single source of the permutation for
+    the spec (here), the XLA evaluator (models/dpf_chacha) and the Pallas
+    walk kernel (ops/chacha_pallas)."""
+    _quarter(s, 0, 4, 8, 12)
+    _quarter(s, 1, 5, 9, 13)
+    _quarter(s, 2, 6, 10, 14)
+    _quarter(s, 3, 7, 11, 15)
+    _quarter(s, 0, 5, 10, 15)
+    _quarter(s, 1, 6, 11, 12)
+    _quarter(s, 2, 7, 8, 13)
+    _quarter(s, 3, 4, 9, 14)
+
+
+def grouped_masks(k: int, g: int, log_n: int):
+    """(key_level, lowmask) uint32[k] for a level-major FSS gate batch of
+    ``k`` keys over ``g`` gates (groups * log_n level blocks; models/fss.py
+    layout).  key_level[j] is key j's comparison level; lowmask[j] is the
+    level's in-leaf dyadic-prefix mask (0 when the whole leaf index is
+    above the prefix).  Shared by the XLA pointwise body and the Pallas
+    walk kernel so the two backends cannot drift."""
+    key_level = (np.arange(k) // g) % log_n
+    s_of_key = log_n - 1 - key_level
+    lowmask = np.where(
+        s_of_key >= LEAF_LOG,
+        np.uint32(0),
+        (np.uint32(LEAF_BITS - 1) & ~((1 << s_of_key) - 1)).astype(np.uint32),
+    )
+    return key_level.astype(np.uint32), lowmask
+
+
 def chacha_block(
     key: np.ndarray, counter: int = 0, nonce=(0, 0, 0), rounds: int = 20
 ) -> np.ndarray:
@@ -92,14 +125,7 @@ def chacha_block(
     s = [init[..., i].copy() for i in range(16)]
     with np.errstate(over="ignore"):
         for _ in range(rounds // 2):
-            _quarter(s, 0, 4, 8, 12)
-            _quarter(s, 1, 5, 9, 13)
-            _quarter(s, 2, 6, 10, 14)
-            _quarter(s, 3, 7, 11, 15)
-            _quarter(s, 0, 5, 10, 15)
-            _quarter(s, 1, 6, 11, 12)
-            _quarter(s, 2, 7, 8, 13)
-            _quarter(s, 3, 4, 9, 14)
+            double_round(s)
         out = np.stack(s, axis=-1) + init
     return out.astype(np.uint32)
 

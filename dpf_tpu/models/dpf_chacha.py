@@ -30,29 +30,23 @@ _DSX = [int(v) for v in cc.DS_EXPAND]
 _DSL = [int(v) for v in cc.DS_LEAF]
 
 
-def _rotl(x, r):
-    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+# Bench-only knob (scripts/bench_points_fast.py): unroll the ChaCha rounds
+# inside the XLA pointwise walk.  Measured 1.3x there, but the Pallas walk
+# kernel (ops/chacha_pallas.py) supersedes that path on TPU, so the
+# default stays the cheap-to-compile loop.
+_POINTS_UNROLL = False
 
 
-def _qr(s, a, b, c, d):
-    s[a] = s[a] + s[b]
-    s[d] = _rotl(s[d] ^ s[a], 16)
-    s[c] = s[c] + s[d]
-    s[b] = _rotl(s[b] ^ s[c], 12)
-    s[a] = s[a] + s[b]
-    s[d] = _rotl(s[d] ^ s[a], 8)
-    s[c] = s[c] + s[d]
-    s[b] = _rotl(s[b] ^ s[c], 7)
-
-
-def _chacha_core(seed, ds, n_out):
+def _chacha_core(seed, ds, n_out, unroll=False):
     """seed: 4 arrays; ds: 4 ints.  Runs the ChaCha12 permutation with the
     fast-profile state layout and returns the first n_out output words
     (permuted state + initial state, RFC 8439 feed-forward).
 
-    The double-round loop is a ``lax.fori_loop`` (shape-invariant body):
-    the expansion unrolls over tree levels already, and unrolling the
-    rounds too made XLA compile time explode on deep trees."""
+    The double-round body is shared with the spec and the Pallas walk
+    kernel (core/chacha_np.double_round).  Default is a ``lax.fori_loop``
+    over double rounds — shape-invariant, keeps XLA compile time sane (an
+    unrolled pointwise graph measured minutes of XLA CPU compile);
+    ``unroll=True`` unrolls the rounds instead."""
     z = jnp.zeros_like(seed[0])
 
     def const(v):
@@ -67,29 +61,27 @@ def _chacha_core(seed, ds, n_out):
 
     def dbl_round(_, s):
         s = list(s)
-        _qr(s, 0, 4, 8, 12)
-        _qr(s, 1, 5, 9, 13)
-        _qr(s, 2, 6, 10, 14)
-        _qr(s, 3, 7, 11, 15)
-        _qr(s, 0, 5, 10, 15)
-        _qr(s, 1, 6, 11, 12)
-        _qr(s, 2, 7, 8, 13)
-        _qr(s, 3, 4, 9, 14)
+        cc.double_round(s)
         return tuple(s)
 
-    s = jax.lax.fori_loop(0, cc.ROUNDS // 2, dbl_round, tuple(init))
+    if unroll:
+        s = tuple(init)
+        for _ in range(cc.ROUNDS // 2):
+            s = dbl_round(None, s)
+    else:
+        s = jax.lax.fori_loop(0, cc.ROUNDS // 2, dbl_round, tuple(init))
     return [s[i] + init[i] for i in range(n_out)]
 
 
-def _prg_expand(seed):
+def _prg_expand(seed, unroll=False):
     """4x[K, W] -> (left 4x, right 4x) child seed words."""
-    out = _chacha_core(seed, _DSX, 8)
+    out = _chacha_core(seed, _DSX, 8, unroll)
     return out[0:4], out[4:8]
 
 
-def _convert(seed):
+def _convert(seed, unroll=False):
     """4x[K, W] -> 16 output words (the leaf's 512 bits)."""
-    return _chacha_core(seed, _DSL, 16)
+    return _chacha_core(seed, _DSL, 16, unroll)
 
 
 def _interleave(l, r):
@@ -228,19 +220,10 @@ def _eval_points_cc_body(
     if level_groups:
         K = seeds.shape[0]
         Q, G = xs_lo.shape
-        n_lv = K // (level_groups * G)
-        # level index of every key: key k sits in block (k // G) % n_lv
-        key_level = (np.arange(K) // G) % n_lv  # host constant, folded
-        # The level-i query zeroes bits below s = log_n - 1 - i, including
-        # (for i near the bottom) part of the 9 in-leaf bits.
-        s_of_key = log_n - 1 - key_level
-        lowmask = np.where(
-            s_of_key >= cc.LEAF_LOG,
-            np.uint32(0),
-            (np.uint32(cc.LEAF_BITS - 1) & ~((1 << s_of_key) - 1)).astype(
-                np.uint32
-            ),
-        )
+        # Per-key level index + in-leaf prefix mask, shared with the Pallas
+        # walk kernel (core/chacha_np.grouped_masks) — host constants,
+        # folded at trace time.
+        key_level, lowmask = cc.grouped_masks(K, G, log_n)
         low = jnp.tile(low, (1, K // G)) & jnp.asarray(lowmask)[None, :]
         shp = (Q, K)
     else:
@@ -248,7 +231,7 @@ def _eval_points_cc_body(
     S = [jnp.broadcast_to(seeds[None, :, i], shp) for i in range(4)]
     T = jnp.broadcast_to(ts[None, :], shp)
     for i in range(nu):
-        L, R = _prg_expand(S)
+        L, R = _prg_expand(S, unroll=_POINTS_UNROLL)
         tl = L[0] & np.uint32(1)
         tr = R[0] & np.uint32(1)
         L[0] = L[0] & ~np.uint32(1)
@@ -269,7 +252,7 @@ def _eval_points_cc_body(
         bm = jnp.uint32(0) - pbit
         S = [(R[w] & bm) | (L[w] & ~bm) for w in range(4)]
         T = (tr & bm) | (tl & ~bm)
-    out = _convert(S)  # 16x [Q, K]
+    out = _convert(S, unroll=_POINTS_UNROLL)  # 16x [Q, K]
     msk = jnp.uint32(0) - T
     out = [out[j] ^ (fcw[None, :, j] & msk) for j in range(16)]
     widx = (low >> 5) & 15
@@ -295,13 +278,28 @@ def _split_queries(xs: np.ndarray, log_n: int):
     return xs_hi, xs_lo
 
 
+def _use_walk_kernel(k: int) -> bool:
+    from ..ops import chacha_pallas as cp
+
+    return cp.points_backend() == "pallas" and cp.usable(k)
+
+
 def eval_points(kb: KeyBatchFast, xs: np.ndarray) -> np.ndarray:
-    """Batched pointwise evaluation: xs uint64[K, Q] -> uint8[K, Q]."""
+    """Batched pointwise evaluation: xs uint64[K, Q] -> uint8[K, Q].
+
+    On TPU (key counts divisible by 128) the whole walk runs as one Pallas
+    kernel (ops/chacha_pallas.py) — state in VMEM instead of an HBM round
+    trip per fused op; the XLA body is the fallback and A/B reference
+    (DPF_TPU_POINTS=xla)."""
     xs = np.asarray(xs, dtype=np.uint64)
     if xs.ndim != 2 or xs.shape[0] != kb.k:
         raise ValueError("dpf-fast: xs must be [K, Q]")
     if (xs >> np.uint64(kb.log_n)).any():
         raise ValueError("dpf-fast: query index out of domain")
+    if _use_walk_kernel(kb.k):
+        from ..ops import chacha_pallas as cp
+
+        return cp.eval_points_walk(kb, xs)
     xs_hi, xs_lo = _split_queries(xs, kb.log_n)
     bits = _eval_points_cc_jit(
         kb.nu, kb.log_n, *kb.device_args(), xs_hi, xs_lo
@@ -310,7 +308,7 @@ def eval_points(kb: KeyBatchFast, xs: np.ndarray) -> np.ndarray:
 
 
 def eval_points_level_grouped(
-    kb: KeyBatchFast, xs: np.ndarray, groups: int
+    kb: KeyBatchFast, xs: np.ndarray, groups: int, reduce: bool = False
 ) -> np.ndarray:
     """FSS-support pointwise evaluation over level-major key groups.
 
@@ -320,7 +318,10 @@ def eval_points_level_grouped(
     group is evaluated at xs[g] with its low ``log_n - 1 - i`` bits zeroed
     (the dyadic-prefix query) — the masking happens on device against
     trace-time constants, so neither the host nor the wire ever sees the
-    level-replicated query tensor.  -> uint8[groups * log_n * G, Q]."""
+    level-replicated query tensor.  -> uint8[groups * log_n * G, Q]; with
+    ``reduce`` the level/group blocks are XOR-folded into gate shares
+    -> uint8[G, Q] (on device when the Pallas walk kernel is in use — the
+    D2H transfer shrinks by groups * log_n)."""
     xs = np.asarray(xs, dtype=np.uint64)
     if xs.ndim != 2:
         raise ValueError("dpf-fast: xs must be [G, Q]")
@@ -328,9 +329,19 @@ def eval_points_level_grouped(
         raise ValueError("dpf-fast: key count != groups * log_n * G")
     if (xs >> np.uint64(kb.log_n)).any():
         raise ValueError("dpf-fast: query index out of domain")
+    if _use_walk_kernel(kb.k):
+        from ..ops import chacha_pallas as cp
+
+        return cp.eval_points_walk(kb, xs, groups=groups, reduce=reduce)
     xs_hi, xs_lo = _split_queries(xs, kb.log_n)
     bits = _eval_points_cc_jit(
         kb.nu, kb.log_n, *kb.device_args(), xs_hi, xs_lo,
         level_groups=groups,
     )
-    return np.asarray(bits).T
+    out = np.asarray(bits).T
+    if reduce:
+        g = xs.shape[0]
+        return np.bitwise_xor.reduce(
+            out.reshape(groups * kb.log_n, g, -1), axis=0
+        )
+    return out

@@ -199,9 +199,10 @@ def eval_lt_points(ck: CmpKeyBatch, xs: np.ndarray) -> np.ndarray:
         raise ValueError("fss: xs must be [G, Q]")
     _, ep, _, _, grouped = _profile_funcs(ck.profile)
     if grouped is not None:
-        bits = grouped(ck.levels, xs, groups=1)
-    else:
-        bits = ep(ck.levels, _masked_prefix_queries(xs, ck.log_n))
+        # Level XOR-fold happens on device (ops/chacha_pallas.py): only the
+        # [G, Q] gate shares cross the host link, not [n*G, Q] level bits.
+        return grouped(ck.levels, xs, groups=1, reduce=True)
+    bits = ep(ck.levels, _masked_prefix_queries(xs, ck.log_n))
     return np.bitwise_xor.reduce(bits.reshape(ck.log_n, ck.g, -1), axis=0)
 
 
@@ -259,12 +260,11 @@ def eval_interval_points(ik: IntervalKeyBatch, xs: np.ndarray) -> np.ndarray:
         )
         ik._both = both  # fused batch reused (and device-cached) across calls
     if grouped is not None:
-        bits = grouped(both, xs, groups=2)
+        out = grouped(both, xs, groups=2, reduce=True)  # device XOR-fold
     else:
         q = _masked_prefix_queries(xs, n)  # [n*G, Q]
         bits = ep(both, np.concatenate([q, q]))
-    bits = bits.reshape(2, n, G, -1)
-    out = np.bitwise_xor.reduce(bits, axis=(0, 1))
+        out = np.bitwise_xor.reduce(bits.reshape(2, n, G, -1), axis=(0, 1))
     return out ^ ik.const[:, None]
 
 

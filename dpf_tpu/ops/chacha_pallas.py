@@ -1,0 +1,321 @@
+"""Pallas TPU kernel for the fast-profile pointwise walk.
+
+The XLA pointwise body (models/dpf_chacha._eval_points_cc_body) materializes
+its [Q, K] lane state in HBM between fused ops: ~24 ChaCha cores per query
+lane, each a separate read-modify-write of up to 16 state words (64 MB at
+config-3 scale) — the walk runs at <10% of the op rate the expansion
+sustains.  This kernel runs the ENTIRE root-to-leaf walk (all ``nu`` levels
+plus leaf conversion and in-leaf bit selection — the reference's Eval loop,
+dpf/dpf.go:171-211, vectorized over (query, key) lanes) inside one Pallas
+program per [QT, KT] tile: seeds and correction words are read from
+HBM once per tile, the 16-word ChaCha state lives in VMEM/registers, and
+one uint32 0/1 bit per lane is written back.
+
+Operand layout is key-minor (rows x K lanes) so every per-key constant is a
+natural [rows, KT] VMEM block:
+
+    meta   uint32[3, K]        rows: t bits | key_level | in-leaf low mask
+    seeds  uint32[4, K]        seed words
+    scw    uint32[max(4 nu,4), K]   row 4 i + w = level-i seed-CW word w
+    tcw    uint32[max(2 nu,2), K]   rows 2 i / 2 i + 1 = level-i tL / tR CW
+    fcw    uint32[16, K]       final-CW words
+    xs     uint32[Q, K]        query indices (low words; high only n > 32)
+
+``key_level``/``lowmask`` fold the FSS dyadic-prefix masking (models/fss.py)
+into the same kernel: level-grouped gate batches set key_level[k] = the
+key's level i (descent bits below it are ANDed away) and lowmask to the
+level's in-leaf prefix mask; plain pointwise batches pass log_n / 511.
+
+Off-TPU the kernel runs in interpreter mode (tests); the XLA body remains
+the fallback for key counts not divisible by 128 and is selectable via
+``DPF_TPU_POINTS=xla``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+from ..core import chacha_np as cc
+
+_C = [int(v) for v in cc._CONSTANTS]
+_DSX = [int(v) for v in cc.DS_EXPAND]
+_DSL = [int(v) for v in cc.DS_LEAF]
+
+_KT = 128  # key-tile (lane) width
+_QT_CAP = 128  # max query-tile rows; actual tile = largest divisor of Q
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def points_backend() -> str:
+    """'pallas' | 'xla' for the pointwise walk (env DPF_TPU_POINTS)."""
+    env = os.environ.get("DPF_TPU_POINTS", "auto")
+    if env not in ("auto", "xla", "pallas"):
+        raise ValueError("DPF_TPU_POINTS must be auto|xla|pallas")
+    if env != "auto":
+        return env
+    return "pallas" if _on_tpu() else "xla"
+
+
+def usable(k: int) -> bool:
+    return k % _KT == 0
+
+
+# ---------------------------------------------------------------------------
+# Kernel
+# ---------------------------------------------------------------------------
+
+
+# In-kernel loop structure knobs (A/B'd end-to-end on the device; see
+# scripts/bench_points_fast.py): unrolled rounds give Mosaic the whole
+# ChaCha DAG to schedule instead of a serial fori_loop carry.
+_UNROLL_ROUNDS = True
+_UNROLL_LEVELS = False
+
+
+def _cc_core(S, ds, n_out):
+    """ChaCha12 with the fast-profile state layout on [QT, KT] word arrays;
+    state stays in VMEM/registers in-kernel.  The double-round body is the
+    shared one (core/chacha_np.double_round)."""
+    z = jnp.zeros_like(S[0])
+    init = (
+        [z + np.uint32(v) for v in _C]
+        + list(S)
+        + [z + np.uint32(v) for v in ds]
+        + [z, z, z, z]
+    )
+
+    def dbl(_, s):
+        s = list(s)
+        cc.double_round(s)
+        return tuple(s)
+
+    s = tuple(init)
+    if _UNROLL_ROUNDS:
+        for _ in range(cc.ROUNDS // 2):
+            s = dbl(None, s)
+    else:
+        s = lax.fori_loop(0, cc.ROUNDS // 2, dbl, s)
+    return [s[j] + init[j] for j in range(n_out)]
+
+
+def _walk_kernel(
+    meta_ref, seeds_ref, scw_ref, tcw_ref, fcw_ref, xs_lo_ref, xs_hi_ref,
+    out_ref, *, nu, log_n,
+):
+    QT, KT = out_ref.shape
+    one = np.uint32(1)
+    ts = meta_ref[0:1, :]
+    kl = meta_ref[1:2, :]
+    lowmask = meta_ref[2:3, :]
+    xs_lo = xs_lo_ref[:]
+    S = tuple(
+        jnp.broadcast_to(seeds_ref[w : w + 1, :], (QT, KT)) for w in range(4)
+    )
+    T = jnp.broadcast_to(ts, (QT, KT))
+
+    def level(i, carry):
+        S0, S1, S2, S3, T = carry
+        out = _cc_core([S0, S1, S2, S3], _DSX, 8)
+        L, R = out[:4], out[4:]
+        tl = L[0] & one
+        tr = R[0] & one
+        L[0] = L[0] & ~one
+        R[0] = R[0] & ~one
+        msk = jnp.uint32(0) - T
+        cw = scw_ref[pl.ds(4 * i, 4), :]  # [4, KT]
+        tlcw = tcw_ref[pl.ds(2 * i, 1), :]  # [1, KT]
+        trcw = tcw_ref[pl.ds(2 * i + 1, 1), :]
+        L = [L[w] ^ (cw[w : w + 1, :] & msk) for w in range(4)]
+        R = [R[w] ^ (cw[w : w + 1, :] & msk) for w in range(4)]
+        tl = tl ^ (tlcw & T)
+        tr = tr ^ (trcw & T)
+        iu = np.uint32(i) if isinstance(i, int) else i.astype(jnp.uint32)
+        bu = np.uint32(log_n - 1) - iu  # descent bit index, MSB-first
+        if log_n <= 32:
+            pbit = (xs_lo >> bu) & one
+        else:
+            p_lo = (xs_lo >> jnp.minimum(bu, np.uint32(31))) & one
+            p_hi = (xs_hi_ref[:] >> jnp.where(
+                bu >= np.uint32(32), bu - np.uint32(32), np.uint32(0)
+            )) & one
+            pbit = jnp.where(bu >= np.uint32(32), p_hi, p_lo)
+        keep = jnp.where(kl >= iu, one, np.uint32(0))
+        pbit = pbit & keep
+        bm = jnp.uint32(0) - pbit
+        S0, S1, S2, S3 = ((R[w] & bm) | (L[w] & ~bm) for w in range(4))
+        T = (tr & bm) | (tl & ~bm)
+        return S0, S1, S2, S3, T
+
+    carry = (*S, T)
+    if _UNROLL_LEVELS:
+        for i in range(nu):
+            carry = level(i, carry)
+    else:
+        carry = lax.fori_loop(0, nu, level, carry)
+    S0, S1, S2, S3, T = carry
+    out = _cc_core([S0, S1, S2, S3], _DSL, 16)
+    msk = jnp.uint32(0) - T
+    low = xs_lo & np.uint32(cc.LEAF_BITS - 1) & lowmask
+    widx = (low >> np.uint32(5)) & np.uint32(15)
+    sel = jnp.zeros_like(xs_lo)
+    for j in range(16):
+        oj = out[j] ^ (fcw_ref[j : j + 1, :] & msk)
+        sel = sel | (oj & (jnp.uint32(0) - (widx == j).astype(jnp.uint32)))
+    out_ref[:] = (sel >> (low & np.uint32(31))) & one
+
+
+def _walk_raw(meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi, log_n, nu, qt):
+    Q, K = xs_lo.shape
+    qspec = pl.BlockSpec((qt, _KT), lambda q, k: (q, k))
+
+    def rows(n):
+        return pl.BlockSpec((n, _KT), lambda q, k: (0, k))
+
+    kern = functools.partial(_walk_kernel, nu=nu, log_n=log_n)
+    return pl.pallas_call(
+        kern,
+        grid=(Q // qt, K // _KT),
+        in_specs=[
+            rows(3), rows(4), rows(scw_t.shape[0]), rows(tcw_t.shape[0]),
+            rows(16), qspec, qspec if log_n > 32 else rows(1),
+        ],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((Q, K), jnp.uint32),
+        interpret=not _on_tpu(),
+    )(meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi)
+
+
+@functools.partial(jax.jit, static_argnums=(7, 8, 9))
+def _walk_call(meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi, log_n, nu, qt):
+    # uint8 on device: the result crosses the host link (4x smaller D2H).
+    return _walk_raw(
+        meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi, log_n, nu, qt
+    ).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnums=(7, 8, 9, 10))
+def _walk_call_reduced(
+    meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi, log_n, nu, qt, g
+):
+    """Walk + on-device XOR-reduction over the level (and group) blocks of
+    an FSS gate batch: [Q, K] bits -> uint8[Q, g].  The reduction is why
+    this exists — an FSS answer is the XOR over a gate's level-DPFs
+    (models/fss.py), and reducing before D2H shrinks the transfer by
+    K/g (= groups * log_n, 64x at BASELINE config 5)."""
+    bits = _walk_raw(
+        meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi, log_n, nu, qt
+    )
+    q, k = bits.shape
+    return (
+        jax.lax.reduce(
+            bits.reshape(q, k // g, g), np.uint32(0), jax.lax.bitwise_xor, (1,)
+        )
+    ).astype(jnp.uint8)
+
+
+# ---------------------------------------------------------------------------
+# Host wrappers
+# ---------------------------------------------------------------------------
+
+
+def walk_operands(kb, groups: int = 0):
+    """Transposed device operands for the walk kernel, memoized per key
+    batch (key material is immutable once evaluated; the FSS layouts also
+    depend only on (k, log_n, groups))."""
+    cache = getattr(kb, "_walk_ops", None)
+    if cache is None:
+        cache = {}
+        try:
+            kb._walk_ops = cache
+        except AttributeError:  # frozen dataclass; recompute per call
+            pass
+    if groups in cache:
+        return cache[groups]
+    k, nu = kb.k, kb.nu
+    if groups:
+        g = k // (groups * kb.log_n)
+        key_level, lowmask = cc.grouped_masks(k, g, kb.log_n)
+    else:
+        key_level = np.full(k, kb.log_n, np.uint32)
+        lowmask = np.full(k, cc.LEAF_BITS - 1, np.uint32)
+    meta = jnp.asarray(
+        np.stack([kb.ts.astype(np.uint32), key_level, lowmask])
+    )
+    seeds_t = jnp.asarray(np.ascontiguousarray(kb.seeds.T))
+    if nu:
+        scw_t = jnp.asarray(
+            np.moveaxis(kb.scw, 0, 2).reshape(4 * nu, k)
+        )
+        tcw_t = jnp.asarray(
+            np.moveaxis(kb.tcw.astype(np.uint32), 0, 2).reshape(2 * nu, k)
+        )
+    else:  # never read by the kernel (level loop is empty)
+        scw_t = jnp.zeros((4, k), jnp.uint32)
+        tcw_t = jnp.zeros((2, k), jnp.uint32)
+    fcw_t = jnp.asarray(np.ascontiguousarray(kb.fcw.T))
+    ops = (meta, seeds_t, scw_t, tcw_t, fcw_t)
+    cache[groups] = ops
+    return ops
+
+
+def _qtile(q: int) -> int:
+    qt = 8
+    while qt < _QT_CAP and q % (qt * 2) == 0:
+        qt *= 2
+    return qt
+
+
+def eval_points_walk(
+    kb, xs: np.ndarray, groups: int = 0, reduce: bool = False
+) -> np.ndarray:
+    """Pointwise walk via the Pallas kernel.
+
+    ``xs`` is uint64[K, Q] for plain batches (groups=0) or the RAW gate
+    queries uint64[G, Q] for level-grouped FSS batches — same contracts as
+    models/dpf_chacha.eval_points / eval_points_level_grouped, which route
+    here on TPU.  -> uint8[K, Q]; with ``reduce`` (grouped only) the level/
+    group blocks are XOR-folded on device -> uint8[G, Q]."""
+    k = kb.k
+    meta, seeds_t, scw_t, tcw_t, fcw_t = walk_operands(kb, groups)
+    xs_t = np.ascontiguousarray(xs.T)  # [Q, G or K]
+    q = xs_t.shape[0]
+    pad_q = (-q) % 8
+    if pad_q:
+        xs_t = np.concatenate(
+            [xs_t, np.zeros((pad_q,) + xs_t.shape[1:], xs_t.dtype)]
+        )
+    xs_lo = jnp.asarray((xs_t & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    rep = k // xs_t.shape[1]
+    if rep > 1:  # level-grouped: queries repeat across level blocks
+        xs_lo = jnp.tile(xs_lo, (1, rep))
+    if kb.log_n > 32:
+        xs_hi = jnp.asarray((xs_t >> np.uint64(32)).astype(np.uint32))
+        if rep > 1:
+            xs_hi = jnp.tile(xs_hi, (1, rep))
+    else:
+        xs_hi = jnp.zeros((1, k), jnp.uint32)  # never read
+    qt = _qtile(xs_lo.shape[0])
+    if reduce:
+        if not groups:
+            raise ValueError("reduce requires a level-grouped batch")
+        g = k // (groups * kb.log_n)
+        bits = _walk_call_reduced(
+            meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi,
+            kb.log_n, kb.nu, qt, g,
+        )
+    else:
+        bits = _walk_call(
+            meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi,
+            kb.log_n, kb.nu, qt,
+        )
+    return np.asarray(bits)[:q].T

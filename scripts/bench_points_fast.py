@@ -1,0 +1,69 @@
+"""A/B experiments for the fast-profile pointwise walk at config 3.
+
+Variants (each an end-to-end eval_points call, incl. dispatch):
+
+    loop    XLA body, ChaCha rounds as lax.fori_loop (the fallback default)
+    unroll  XLA body, rounds unrolled (one fused kernel per level)
+    pallas  the Pallas walk kernel (ops/chacha_pallas.py, the TPU default)
+
+    python scripts/bench_points_fast.py loop unroll pallas
+
+NB end-to-end times here are dominated by the host link on the dev tunnel
+(~4 MB of queries up, ~1 MB of bits down); for device-only kernel rates use
+the chained-slope method (bench_all.py notes).  Prints Mqueries/s.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+LOG_N = 30
+K = 256
+Q = 4096
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from dpf_tpu.models import dpf_chacha as dc
+    from dpf_tpu.models.keys_chacha import gen_batch
+
+    rng = np.random.default_rng(7)
+    alphas = rng.integers(0, 1 << LOG_N, size=K, dtype=np.uint64)
+    ka, _ = gen_batch(alphas, LOG_N, rng=rng)
+    xs = rng.integers(0, 1 << LOG_N, size=(K, Q), dtype=np.uint64)
+
+    for variant in sys.argv[1:] or ["loop", "pallas"]:
+        # Pin the routing: without this, eval_points on TPU picks the
+        # Pallas kernel for every variant and the XLA A/B measures nothing.
+        os.environ["DPF_TPU_POINTS"] = (
+            "pallas" if variant == "pallas" else "xla"
+        )
+        dc._POINTS_UNROLL = variant == "unroll"
+        jax.clear_caches()
+        # warm (compile)
+        t0 = time.perf_counter()
+        bits = dc.eval_points(ka, xs)
+        compile_s = time.perf_counter() - t0
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            bits = dc.eval_points(ka, xs)
+            best = min(best, time.perf_counter() - t0)
+        mq = K * Q / best / 1e6
+        print(
+            f"{variant:8s} {mq:8.2f} Mq/s  ({best * 1e3:.1f} ms/call, "
+            f"compile {compile_s:.1f}s, checksum {int(bits.sum())})",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+"""Differential tests for the Pallas pointwise-walk kernel
+(ops/chacha_pallas.py) against the NumPy fast-profile spec and the XLA
+pointwise body.  Off-TPU the kernel runs in Pallas interpreter mode, so
+these exercise the real kernel program on the CPU mesh."""
+
+import numpy as np
+import pytest
+
+from dpf_tpu.core import chacha_np as cc
+from dpf_tpu.models import dpf_chacha as dc
+from dpf_tpu.models.keys_chacha import gen_batch
+from dpf_tpu.ops import chacha_pallas as cp
+
+
+def test_walk_kernel_matches_spec():
+    rng = np.random.default_rng(11)
+    log_n, k, q = 14, 128, 16
+    alphas = rng.integers(0, 1 << log_n, size=k, dtype=np.uint64)
+    ka, kb = gen_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(k, q), dtype=np.uint64)
+    xs[:, 0] = alphas  # include the hit point per key
+    ba = cp.eval_points_walk(ka, xs)
+    bb = cp.eval_points_walk(kb, xs)
+    want = (xs == alphas[:, None]).astype(np.uint8)
+    assert ((ba ^ bb) == want).all()
+    # and against the spec per party (not only the XOR)
+    for kbatch, bits in ((ka, ba), (kb, bb)):
+        blobs = kbatch.to_bytes()
+        for i in range(0, k, 17):  # spot-check a spread of keys
+            for j in range(q):
+                assert bits[i, j] == cc.eval_point(
+                    blobs[i], int(xs[i, j]), log_n
+                )
+
+
+def test_walk_kernel_matches_xla_body_large_domain():
+    rng = np.random.default_rng(12)
+    log_n, k, q = 34, 128, 8  # exercises the xs_hi (n > 32) path
+    alphas = rng.integers(0, 1 << log_n, size=k, dtype=np.uint64)
+    ka, _ = gen_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(k, q), dtype=np.uint64)
+    xs[:, 0] = alphas
+    got = cp.eval_points_walk(ka, xs)
+    xs_hi, xs_lo = dc._split_queries(xs, log_n)
+    want = np.asarray(
+        dc._eval_points_cc_jit(ka.nu, log_n, *ka.device_args(), xs_hi, xs_lo)
+    ).T
+    assert (got == want).all()
+    assert got[np.arange(k), 0].any()  # hit points present for one party
+
+
+def test_walk_kernel_small_domain_no_levels():
+    rng = np.random.default_rng(13)
+    log_n, k, q = 8, 128, 8  # nu = 0: empty level loop, in-leaf select only
+    alphas = rng.integers(0, 1 << log_n, size=k, dtype=np.uint64)
+    ka, kb = gen_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(k, q), dtype=np.uint64)
+    xs[:, 0] = alphas
+    ba = cp.eval_points_walk(ka, xs)
+    bb = cp.eval_points_walk(kb, xs)
+    want = (xs == alphas[:, None]).astype(np.uint8)
+    assert ((ba ^ bb) == want).all()
+
+
+def test_walk_kernel_grouped_matches_xla_body():
+    rng = np.random.default_rng(14)
+    log_n, g, q, groups = 16, 4, 8, 2
+    k = groups * log_n * g
+    if k % 128:
+        pytest.skip("grouped test needs k % 128 == 0")
+    alphas = rng.integers(0, 1 << log_n, size=k, dtype=np.uint64)
+    ka, _ = gen_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(g, q), dtype=np.uint64)
+    got = cp.eval_points_walk(ka, xs, groups=groups)
+    xs_hi, xs_lo = dc._split_queries(xs, log_n)
+    want = np.asarray(
+        dc._eval_points_cc_jit(
+            ka.nu, log_n, *ka.device_args(), xs_hi, xs_lo, level_groups=groups
+        )
+    ).T
+    assert (got == want).all()
+
+
+def test_walk_kernel_grouped_reduced():
+    """On-device level/group XOR-fold must equal the host reduction."""
+    rng = np.random.default_rng(16)
+    log_n, g, q, groups = 16, 4, 8, 2
+    k = groups * log_n * g
+    alphas = rng.integers(0, 1 << log_n, size=k, dtype=np.uint64)
+    ka, _ = gen_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(g, q), dtype=np.uint64)
+    full = cp.eval_points_walk(ka, xs, groups=groups)
+    want = np.bitwise_xor.reduce(
+        full.reshape(groups * log_n, g, q), axis=0
+    )
+    got = cp.eval_points_walk(ka, xs, groups=groups, reduce=True)
+    assert got.shape == (g, q)
+    assert (got == want).all()
+
+
+def test_eval_points_routes_and_pads(monkeypatch):
+    """eval_points must give identical bits via both backends, including a
+    query count that needs padding to the 8-row tile quantum."""
+    rng = np.random.default_rng(15)
+    log_n, k, q = 12, 128, 13  # q pads 13 -> 16
+    alphas = rng.integers(0, 1 << log_n, size=k, dtype=np.uint64)
+    ka, _ = gen_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(k, q), dtype=np.uint64)
+    monkeypatch.setenv("DPF_TPU_POINTS", "pallas")
+    got = dc.eval_points(ka, xs)
+    monkeypatch.setenv("DPF_TPU_POINTS", "xla")
+    want = dc.eval_points(ka, xs)
+    assert got.shape == (k, q)
+    assert (got == want).all()
