@@ -346,7 +346,9 @@ def _point_masks(kb: KeyBatch):
     return kb._point_masks
 
 
-def eval_points(kb: KeyBatch, xs: np.ndarray) -> np.ndarray:
+def eval_points(
+    kb: KeyBatch, xs: np.ndarray, backend: str | None = None
+) -> np.ndarray:
     """Batched pointwise evaluation: xs uint64[K, Q] -> bits uint8[K, Q].
 
     One root-to-leaf path walk per (key, query) lane, all lanes in lockstep:
@@ -355,7 +357,8 @@ def eval_points(kb: KeyBatch, xs: np.ndarray) -> np.ndarray:
     Key masks are device-cached across calls; the per-call upload is the
     query indices themselves (split into uint32 halves — the domain index
     can exceed 2^32), from which the per-level packed path words are built
-    on device.
+    on device.  ``backend`` picks the PRG kernel exactly as in eval_full
+    (default: the platform's measured-fastest).
     """
     xs = np.asarray(xs, dtype=np.uint64)
     K, Q = xs.shape
@@ -363,6 +366,7 @@ def eval_points(kb: KeyBatch, xs: np.ndarray) -> np.ndarray:
         raise ValueError("xs first axis must match key batch")
     if (xs >> np.uint64(kb.log_n)).any():
         raise ValueError("dpf: query index out of domain")
+    backend = backend or default_backend()
     pad_q = (-Q) % 32
     if pad_q:
         xs = np.concatenate([xs, np.zeros((K, pad_q), np.uint64)], axis=1)
@@ -375,17 +379,22 @@ def eval_points(kb: KeyBatch, xs: np.ndarray) -> np.ndarray:
         xs_hi = jnp.zeros((1, 1), jnp.uint32)  # never read when log_n <= 32
 
     bits = _eval_points_jit(
-        kb.nu, kb.log_n, *_point_masks(kb), xs_hi, xs_lo, qp
+        kb.nu, kb.log_n, *_point_masks(kb), xs_hi, xs_lo, qp, backend
     )
     return np.asarray(bits)[:, :Q]
 
 
 def _eval_points_body(
     nu, log_n, seed_masks, t_masks, scw_masks, tl_masks, tr_masks,
-    fcw_masks, xs_hi, xs_lo, qp,
+    fcw_masks, xs_hi, xs_lo, qp, backend="xla",
 ):
     """Traceable core of the pointwise walk (shared by the single-chip jit
-    and the shard_map'd evaluator in parallel/sharding.py)."""
+    and the shard_map'd evaluator in parallel/sharding.py).  The per-level
+    PRG and the leaf convert go through the same kernel table as eval_full;
+    with a bit-major backend the level state is held in bit-major plane
+    order for the whole walk (plane 0 — the control-bit plane — is index 0
+    in both orders, and the path-bit select is plane-order-agnostic), with
+    the mask permutes done once on the small per-key tensors."""
     K = seed_masks.shape[1]
     lane = jnp.arange(32, dtype=jnp.uint32)
 
@@ -399,10 +408,14 @@ def _eval_points_body(
             pb = (xs_lo >> np.uint32(b)) & np.uint32(1)
         return (pb.reshape(K, qp, 32) << lane).sum(-1, dtype=jnp.uint32)
 
+    if backend in _BM_BACKENDS:
+        perm = jnp.asarray(aes_pallas._TO_BM)
+        seed_masks = seed_masks[perm]
+        scw_masks = scw_masks[:, perm]
     S = jnp.broadcast_to(seed_masks[:, :, None], (128, K, qp))
     T = jnp.broadcast_to(t_masks[None, :, None], (1, K, qp)).reshape(K, qp)
     for i in range(nu):
-        L, R = prg_planes(S.reshape(128, -1))
+        L, R = _PRG_IMPLS[backend](S.reshape(128, -1))
         L = L.reshape(128, K, qp)
         R = R.reshape(128, K, qp)
         tl, tr = L[0], R[0]
@@ -416,7 +429,8 @@ def _eval_points_body(
         go_r = path_words(i)  # [K, qp]
         S = (R & go_r) | (L & ~go_r)
         T = (tr & go_r) | (tl & ~go_r)
-    C = aes128_mmo_planes(S.reshape(128, -1), RK_MASKS_L).reshape(128, K, qp)
+    # leaf convert emits CANONICAL plane order from any backend
+    C = _MMO_IMPLS[backend](S.reshape(128, -1)).reshape(128, K, qp)
     C = C ^ (fcw_masks[:, :, None] & T[None, :, :])
     words = unpack_planes(C.reshape(128, 1, K * qp))  # [K*Q, 1, 4]
     words = words.reshape(K, qp * 32, 4)
@@ -426,4 +440,6 @@ def _eval_points_body(
     return ((w >> (low & 31)) & 1).astype(jnp.uint8)
 
 
-_eval_points_jit = partial(jax.jit, static_argnums=(0, 1, 10))(_eval_points_body)
+_eval_points_jit = partial(jax.jit, static_argnums=(0, 1, 10, 11))(
+    _eval_points_body
+)

@@ -300,7 +300,7 @@ def _pad_compat_batch(kb: KeyBatch, pad: int) -> KeyBatch:
 
 
 @cache
-def _sharded_eval_points(mesh: Mesh, nu: int, log_n: int, qp: int):
+def _sharded_eval_points(mesh: Mesh, nu: int, log_n: int, qp: int, backend: str):
     """Compat pointwise walk sharded over the ``keys`` axis.  Queries travel
     with their keys (each shard walks its own (key, query) lanes); meshes
     with a leaf axis recompute redundantly across it.  xs_hi shards with
@@ -311,7 +311,7 @@ def _sharded_eval_points(mesh: Mesh, nu: int, log_n: int, qp: int):
     def body(seed_m, t_m, scw_m, tl_m, tr_m, fcw_m, xs_hi, xs_lo):
         return _eval_points_body(
             nu, log_n, seed_m, t_m, scw_m, tl_m, tr_m, fcw_m,
-            xs_hi, xs_lo, qp,
+            xs_hi, xs_lo, qp, backend,
         )
 
     keyed = P(None, KEYS_AXIS)
@@ -330,12 +330,17 @@ def _sharded_eval_points(mesh: Mesh, nu: int, log_n: int, qp: int):
     )
 
 
-def eval_points_sharded(kb: KeyBatch, xs: np.ndarray, mesh: Mesh) -> np.ndarray:
+def eval_points_sharded(
+    kb: KeyBatch, xs: np.ndarray, mesh: Mesh, backend: str | None = None
+) -> np.ndarray:
     """Sharded batched pointwise evaluation (compat profile):
     xs uint64[K, Q] -> uint8[K, Q], key batch sharded over the ``keys``
     axis — pure data parallelism, zero cross-chip communication (the
-    reference Eval is one key / one point at a time, dpf/dpf.go:171)."""
+    reference Eval is one key / one point at a time, dpf/dpf.go:171).
+    ``backend`` selects the PRG kernel set per shard (models/dpf)."""
     from ..models.dpf import _point_masks
+
+    backend = backend or default_backend()
 
     xs = np.asarray(xs, dtype=np.uint64)
     if xs.ndim != 2 or xs.shape[0] != kb.k:
@@ -359,7 +364,7 @@ def eval_points_sharded(kb: KeyBatch, xs: np.ndarray, mesh: Mesh) -> np.ndarray:
         xs_hi = jnp.asarray((xs >> np.uint64(32)).astype(np.uint32))
     else:
         xs_hi = jnp.zeros((1, 1), jnp.uint32)
-    fn = _sharded_eval_points(mesh, kb.nu, kb.log_n, qp)
+    fn = _sharded_eval_points(mesh, kb.nu, kb.log_n, qp, backend)
     bits = np.asarray(fn(*_point_masks(kb), xs_hi, xs_lo))
     return bits[:K, :Q]
 

@@ -12,7 +12,7 @@ jnp = pytest.importorskip("jax.numpy")
 
 from dpf_tpu.core import spec
 from dpf_tpu.core.keys import gen_batch
-from dpf_tpu.models.dpf import eval_full
+from dpf_tpu.models.dpf import eval_full, eval_points
 from dpf_tpu.ops import aes_pallas
 from dpf_tpu.ops.aes_bitslice import RK_MASKS_L, aes128_mmo_planes, prg_planes
 
@@ -137,3 +137,22 @@ def test_eval_full_pallas_bm_il_matches_spec():
         ]
     )
     np.testing.assert_array_equal(got, want)
+
+
+def test_eval_points_pallas_bm_matches_xla():
+    # Pointwise walk with the level state in bit-major order must agree
+    # with the XLA backend bit-for-bit (and hit the queried points).
+    # K * qp = 64 * 2 = 128 lane words -> the Mosaic kernel path runs.
+    log_n, K, Q = 13, 64, 64
+    rng = np.random.default_rng(17)
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, kb = gen_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    xs[:, 0] = alphas
+    got = eval_points(ka, xs, backend="pallas_bm")
+    want = eval_points(ka, xs, backend="xla")
+    np.testing.assert_array_equal(got, want)
+    rec = got ^ eval_points(kb, xs, backend="pallas_bm")
+    np.testing.assert_array_equal(
+        rec, (xs == alphas[:, None]).astype(np.uint8)
+    )
