@@ -112,9 +112,16 @@ def _check_reconstruction(eval_fn, batch_cls, ka, kb, alphas, what: str):
 
 
 def bench_fast(jax, jnp, rng) -> float:
-    """Fast profile (ChaCha): -> leaves/sec."""
+    """Fast profile (ChaCha): -> leaves/sec.  Times the platform's default
+    expansion pipeline — on TPU that is the VMEM-resident Pallas expand+
+    convert kernel (ops/chacha_pallas.py, env DPF_TPU_FAST to override)."""
     from dpf_tpu.models import keys_chacha as kc
-    from dpf_tpu.models.dpf_chacha import _eval_full_cc_jit, eval_full
+    from dpf_tpu.models.dpf_chacha import (
+        _eval_full_cc_jit,
+        _eval_full_pk_jit,
+        eval_full,
+    )
+    from dpf_tpu.ops import chacha_pallas as cp
 
     alphas = rng.integers(0, 1 << LOG_N, size=K, dtype=np.uint64)
     ka, kb = kc.gen_batch(alphas, LOG_N, rng=rng)
@@ -130,19 +137,30 @@ def bench_fast(jax, jnp, rng) -> float:
         jnp.asarray(ka.tcw.astype(np.uint32)),
         jnp.asarray(ka.fcw),
     )
+    from dpf_tpu.models.dpf_chacha import MAX_LEAF_NODES
+
+    eligible, s, kp = cp.expand_plan(nu, K, MAX_LEAF_NODES)
+    use_kernel = cp.expand_backend() == "pallas" and eligible and kp == K
+    if use_kernel:
+        kern_ops = cp.expand_operands(ka, s)
 
     def chained(r):
         @jax.jit
         def f(seeds, ts, scw, tcw, fcw):
             acc = jnp.uint32(0)
             for _ in range(r):
-                w = _eval_full_cc_jit(nu, seeds ^ acc, ts, scw, tcw, fcw)
+                if use_kernel:
+                    w = _eval_full_pk_jit(
+                        nu, s, seeds ^ acc, ts, scw, tcw, *kern_ops
+                    )
+                else:
+                    w = _eval_full_cc_jit(nu, seeds ^ acc, ts, scw, tcw, fcw)
                 acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
             return acc
 
         return f
 
-    r = 5
+    r = 9 if use_kernel else 5  # ~1 ms/expansion needs a deeper chain
     dt = _marginal_time(chained(1), chained(r), args, r)
     return K * (1 << LOG_N) / dt
 

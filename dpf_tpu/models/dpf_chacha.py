@@ -159,12 +159,71 @@ def _finish_chunk_cc_jit(n_levels, first, S, T, scw, tcw, fcw):
 MAX_LEAF_NODES = 1 << 23  # 512 MB of leaf words per chunk
 
 
-def eval_full_device(kb: KeyBatchFast, max_leaf_nodes: int = MAX_LEAF_NODES):
+@partial(jax.jit, static_argnums=(0, 1))
+def _eval_full_pk_jit(nu, first, seeds, ts, scw, tcw, scw_p, tcw_p, fcw_p):
+    """Hybrid expansion: XLA level steps for levels 0..first-1 (widths too
+    small to tile), then ONE Pallas program per tile runs levels
+    first..nu-1 plus leaf conversion with the ChaCha state resident in
+    VMEM (ops/chacha_pallas.expand kernel) — the XLA round loop's ~12
+    full-state HBM round trips per level collapse to state-in once,
+    leaves out once.  -> uint32[K, 2^nu, 16]."""
+    from ..ops import chacha_pallas as cp
+
+    S = [seeds[:, i : i + 1] for i in range(4)]
+    T = ts[:, None]
+    for i in range(first):
+        S, T = _level_step_cc(
+            S, T, [scw[:, i, w] for w in range(4)], tcw[:, i, 0], tcw[:, i, 1]
+        )
+    levels = nu - first
+    outs = cp._expand_raw(
+        S[0], S[1], S[2], S[3], T, scw_p, tcw_p, fcw_p, levels
+    )
+    outs = [cp.deinterleave_leaves(o, levels) for o in outs]
+    return jnp.stack(outs, axis=2)
+
+
+def _eval_full_pallas_device(kb: KeyBatchFast, entry_level: int):
+    """Kernel-path full expansion; requires nu >= 7 (the kernel entry level
+    must be at least 128 nodes wide).  Pads the key axis to the kernel's
+    8-key sublane tile and slices the padding back off."""
+    from ..ops import chacha_pallas as cp
+    from ..parallel.sharding import _pad_fast_batch
+
+    pk = _pad_fast_batch(kb, (-kb.k) % cp._EKT)
+    seeds, ts, scw, tcw, _ = pk.device_args()
+    words = _eval_full_pk_jit(
+        pk.nu, entry_level, seeds, ts, scw, tcw,
+        *cp.expand_operands(pk, entry_level),
+    )
+    return words[: kb.k]
+
+
+def eval_full_device(
+    kb: KeyBatchFast,
+    max_leaf_nodes: int = MAX_LEAF_NODES,
+    backend: str | None = None,
+):
     """Full-domain evaluation on device -> uint32[K, 2^nu, 16] leaf words
-    (word j of leaf w holds domain bits [512w + 32j, +32), LSB-first)."""
+    (word j of leaf w holds domain bits [512w + 32j, +32), LSB-first).
+
+    ``backend``: 'pallas' (TPU default; env DPF_TPU_FAST) runs the deep
+    levels + leaf convert in the VMEM-resident kernel; 'xla' is the
+    fallback/reference pipeline.  A 'pallas' request degrades to 'xla'
+    when the kernel is ineligible (nu < 7, or the padded-key leaf
+    materialization would blow the cap and the chunked XLA pipeline must
+    take over) — outputs are identical either way."""
     nu = kb.nu
-    args = kb.device_args()
     total = kb.k << nu
+    from ..ops import chacha_pallas as cp
+
+    backend = backend or cp.expand_backend()
+    if backend not in ("xla", "pallas"):
+        raise ValueError(f"dpf-fast: unknown backend {backend!r}")
+    eligible, entry_level, _ = cp.expand_plan(nu, kb.k, max_leaf_nodes)
+    if backend == "pallas" and eligible:
+        return _eval_full_pallas_device(kb, entry_level)
+    args = kb.device_args()
     if total <= max_leaf_nodes:
         return _eval_full_cc_jit(nu, *args)
     seeds, ts, scw, tcw, fcw = args
@@ -180,12 +239,16 @@ def eval_full_device(kb: KeyBatchFast, max_leaf_nodes: int = MAX_LEAF_NODES):
     return jnp.concatenate(outs, axis=1)
 
 
-def eval_full(kb: KeyBatchFast, max_leaf_nodes: int = MAX_LEAF_NODES) -> np.ndarray:
+def eval_full(
+    kb: KeyBatchFast,
+    max_leaf_nodes: int = MAX_LEAF_NODES,
+    backend: str | None = None,
+) -> np.ndarray:
     """Full-domain evaluation -> uint8[K, out_bytes] bit-packed
     (out_bytes = 2^(log_n-3), min 64), byte-identical to the spec
     ``chacha_np.eval_full`` per key.  Domains too large to materialize in
     one pass split into independent GGM subtree chunks."""
-    words = np.asarray(eval_full_device(kb, max_leaf_nodes))
+    words = np.asarray(eval_full_device(kb, max_leaf_nodes, backend))
     return np.ascontiguousarray(words).view("<u1").reshape(kb.k, -1)
 
 

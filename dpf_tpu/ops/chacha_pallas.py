@@ -1,4 +1,4 @@
-"""Pallas TPU kernel for the fast-profile pointwise walk.
+"""Pallas TPU kernels for the fast profile: pointwise walk + expansion.
 
 The XLA pointwise body (models/dpf_chacha._eval_points_cc_body) materializes
 its [Q, K] lane state in HBM between fused ops: ~24 ChaCha cores per query
@@ -29,6 +29,17 @@ level's in-leaf prefix mask; plain pointwise batches pass log_n / 511.
 Off-TPU the kernel runs in interpreter mode (tests); the XLA body remains
 the fallback for key counts not divisible by 128 and is selectable via
 ``DPF_TPU_POINTS=xla``.
+
+The EXPANSION kernel (``expand_convert``) applies the same VMEM-residency
+idea to full-domain evaluation (the reference's EvalFull loop,
+dpf/dpf.go:213-262, restructured breadth-first): the XLA expansion's
+ChaCha double-round loop carries 16 x [K, W] words through HBM per
+iteration — ~12 full-state HBM round trips per level, which makes the
+whole expansion memory-bound.  The kernel takes a [KT, WT] tile of
+level-``s`` seeds and runs ALL remaining levels plus leaf conversion in
+VMEM, so HBM sees only the level-``s`` state once in and the leaf words
+once out.  State is [keys (sublanes), nodes (lanes)] — the evaluator's
+native layout, no transposes anywhere.
 """
 
 from __future__ import annotations
@@ -319,3 +330,138 @@ def eval_points_walk(
             kb.log_n, kb.nu, qt,
         )
     return np.asarray(bits)[:q].T
+
+
+# ---------------------------------------------------------------------------
+# Expansion kernel: levels s..nu + leaf conversion, VMEM-resident
+# ---------------------------------------------------------------------------
+
+_EKT = 8  # key-tile (sublane) height
+_EWT = 128  # node-tile (lane) width at kernel entry
+# Max levels fused per kernel program: leaf tile = _EKT * _EWT * 2^L nodes,
+# 16 output words each -> 2 MB of VMEM outputs at L=5 (plus ~2x transients).
+_EXP_LEVELS = 5
+
+
+def expand_backend() -> str:
+    """'pallas' | 'xla' for the fast-profile expansion (env DPF_TPU_FAST)."""
+    env = os.environ.get("DPF_TPU_FAST", "auto")
+    if env not in ("auto", "xla", "pallas"):
+        raise ValueError("DPF_TPU_FAST must be auto|xla|pallas")
+    if env != "auto":
+        return env
+    return "pallas" if _on_tpu() else "xla"
+
+
+def _expand_kernel(
+    s0_ref, s1_ref, s2_ref, s3_ref, t_ref, scw_ref, tcw_ref, fcw_ref,
+    *out_refs, levels,
+):
+    one = np.uint32(1)
+    S = [s0_ref[:], s1_ref[:], s2_ref[:], s3_ref[:]]
+    T = t_ref[:]
+
+    def bcast(col, shape):  # [KT, 1] per-key constant -> [KT, W]
+        return jnp.broadcast_to(col, shape)
+
+    for i in range(levels):
+        out = _cc_core(S, _DSX, 8)
+        L, R = out[:4], out[4:]
+        tl = L[0] & one
+        tr = R[0] & one
+        L[0] = L[0] & ~one
+        R[0] = R[0] & ~one
+        msk = jnp.uint32(0) - T
+        for w in range(4):
+            cw = bcast(scw_ref[:, 4 * i + w : 4 * i + w + 1], L[w].shape)
+            L[w] = L[w] ^ (cw & msk)
+            R[w] = R[w] ^ (cw & msk)
+        tl = tl ^ (bcast(tcw_ref[:, 2 * i : 2 * i + 1], T.shape) & T)
+        tr = tr ^ (bcast(tcw_ref[:, 2 * i + 1 : 2 * i + 2], T.shape) & T)
+        # Children go in BLOCK order [all-L | all-R], not interleaved: a
+        # strided lane-interleave between unrolled ChaCha cores sends the
+        # XLA (interpret-mode) compiler into the weeds, and block order is
+        # a pure concat.  The leaf order is restored by one static gather
+        # outside the kernel (deinterleave_leaves).
+        S = [jnp.concatenate([L[w], R[w]], axis=1) for w in range(4)]
+        T = jnp.concatenate([tl, tr], axis=1)
+    out = _cc_core(S, _DSL, 16)
+    msk = jnp.uint32(0) - T
+    for j in range(16):
+        fj = bcast(fcw_ref[:, j : j + 1], T.shape)
+        out_refs[j][:] = out[j] ^ (fj & msk)
+
+
+def expand_plan(nu: int, k: int, max_leaf_nodes: int):
+    """Single source of the expansion-kernel routing decision: returns
+    (eligible, entry_level, padded_k).  Eligible needs nu >= 7 (the kernel
+    entry must be >= 128 nodes wide) and the PADDED key count's leaf
+    materialization under the cap — the 8-key sublane padding is real
+    memory, so the cap must see it.  Used by eval_full_device AND bench.py
+    so the scoreboard times exactly the production routing."""
+    kp = k + (-k) % _EKT
+    eligible = nu >= 7 and (kp << nu) <= max_leaf_nodes
+    return eligible, max(7, nu - _EXP_LEVELS), kp
+
+
+def _expand_raw(s0, s1, s2, s3, T, scw_p, tcw_p, fcw_p, levels):
+    K, W = T.shape
+    sspec = pl.BlockSpec((_EKT, _EWT), lambda k, w: (k, w))
+    cw_spec = pl.BlockSpec((_EKT, 128), lambda k, w: (k, 0))
+    out_spec = pl.BlockSpec((_EKT, _EWT << levels), lambda k, w: (k, w))
+    kern = functools.partial(_expand_kernel, levels=levels)
+    return pl.pallas_call(
+        kern,
+        grid=(K // _EKT, W // _EWT),
+        in_specs=[sspec] * 5 + [cw_spec] * 3,
+        out_specs=[out_spec] * 16,
+        out_shape=[jax.ShapeDtypeStruct((K, W << levels), jnp.uint32)] * 16,
+        interpret=not _on_tpu(),
+    )(s0, s1, s2, s3, T, scw_p, tcw_p, fcw_p)
+
+
+def deinterleave_leaves(x, levels):
+    """Restore ascending leaf order of one expand-kernel output word.
+
+    Inside a tile the kernel emits children in block order, so local
+    position = j' * WT + w with j' = (b_levels .. b_1) — the level-choice
+    bits in REVERSE significance.  The true local leaf index is
+    w * 2^levels + (b_1 .. b_levels).  One static bit-reversal gather +
+    axis swap per output word fixes it; XLA fuses this into the output
+    stack pass."""
+    if levels == 0:
+        return x
+    k = x.shape[0]
+    n2 = 1 << levels
+    rev = np.zeros(n2, np.int32)
+    for j in range(n2):
+        rev[j] = int(format(j, f"0{levels}b")[::-1], 2)
+    x = x.reshape(k, -1, n2, _EWT)[:, :, rev, :]
+    return jnp.swapaxes(x, 2, 3).reshape(k, -1)
+
+
+def expand_operands(kb, first_level: int):
+    """Per-key CW operands for kernel levels ``first_level..nu-1`` plus the
+    final CWs, lane-padded to the 128-wide block the kernel reads.
+    Memoized per (key batch, first_level)."""
+    cache = getattr(kb, "_expand_ops", None)
+    if cache is None:
+        cache = {}
+        try:
+            kb._expand_ops = cache
+        except AttributeError:
+            pass
+    if first_level in cache:
+        return cache[first_level]
+    k, nu = kb.k, kb.nu
+    levels = nu - first_level
+    scw_p = np.zeros((k, 128), np.uint32)
+    tcw_p = np.zeros((k, 128), np.uint32)
+    if levels:
+        scw_p[:, : 4 * levels] = kb.scw[:, first_level:].reshape(k, 4 * levels)
+        tcw_p[:, : 2 * levels] = kb.tcw[:, first_level:].reshape(k, 2 * levels)
+    fcw_p = np.zeros((k, 128), np.uint32)
+    fcw_p[:, :16] = kb.fcw
+    ops = (jnp.asarray(scw_p), jnp.asarray(tcw_p), jnp.asarray(fcw_p))
+    cache[first_level] = ops
+    return ops
