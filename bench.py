@@ -69,14 +69,22 @@ def measure_baseline() -> float:
         return FALLBACK_BASELINE
 
 
-def _marginal_time(f1, fR, args, r: int, repeats: int = 6) -> float:
-    """Best-of slope between an R-chained and a 1-chained dispatch.
+def _marginal_time(
+    f1, fR, args, r: int, repeats: int = 6, stat: str = "min"
+) -> float:
+    """Slope between an R-chained and a 1-chained dispatch.
 
     A tunnel-latency spike during the 1-chain dispatch can push t1 above tR
     and make a repeat's slope non-positive; such repeats measure the tunnel,
     not the device, and are discarded.  If every repeat is corrupted the
     whole measurement is infra-broken — raise rather than return nonsense
-    (main() degrades that to a structured infra record)."""
+    (main() degrades that to a structured infra record).
+
+    ``stat``: 'min' (best-of, fine when the per-call signal is well above
+    dispatch jitter) or 'median' — required when one expansion is ~1 ms:
+    with signal that small the min over noisy slopes biases optimistic and
+    can report rates beyond HBM bandwidth (seen: a 4.8 Tleaves/s artifact
+    vs the ~1.07 T physical number)."""
     np.asarray(f1(*args))  # compile + warm
     np.asarray(fR(*args))
     slopes = []
@@ -88,9 +96,13 @@ def _marginal_time(f1, fR, args, r: int, repeats: int = 6) -> float:
         np.asarray(fR(*args))
         tR = time.perf_counter() - t0
         slopes.append((tR - t1) / (r - 1))
-    positive = [s for s in slopes if s > 0]
+    if stat not in ("min", "median"):
+        raise ValueError(f"unknown stat {stat!r}; use 'min' or 'median'")
+    positive = sorted(s for s in slopes if s > 0)
     if not positive:
         raise RuntimeError(f"all timing slopes non-positive: {slopes}")
+    if stat == "median":
+        return positive[len(positive) // 2]
     return min(positive)
 
 
@@ -160,8 +172,16 @@ def bench_fast(jax, jnp, rng) -> float:
 
         return f
 
-    r = 9 if use_kernel else 5  # ~1 ms/expansion needs a deeper chain
-    dt = _marginal_time(chained(1), chained(r), args, r)
+    if use_kernel:
+        # ~1 ms/expansion: deep chain + median so dispatch jitter can't
+        # manufacture super-HBM rates.
+        r = 33
+        dt = _marginal_time(
+            chained(1), chained(r), args, r, repeats=8, stat="median"
+        )
+    else:
+        r = 5
+        dt = _marginal_time(chained(1), chained(r), args, r)
     return K * (1 << LOG_N) / dt
 
 

@@ -159,6 +159,20 @@ def _finish_chunk_cc_jit(n_levels, first, S, T, scw, tcw, fcw):
 MAX_LEAF_NODES = 1 << 23  # 512 MB of leaf words per chunk
 
 
+def _finish_pk(nu, first, S, T, scw_p, tcw_p, fcw_p):
+    """Kernel tail shared by the one-shot and chunked paths: levels
+    first..nu-1 + leaf conversion in the VMEM kernel, leaf order restored,
+    words stacked to the [K, W, 16] output contract."""
+    from ..ops import chacha_pallas as cp
+
+    levels = nu - first
+    outs = cp._expand_raw(
+        S[0], S[1], S[2], S[3], T, scw_p, tcw_p, fcw_p, levels
+    )
+    outs = [cp.deinterleave_leaves(o, levels) for o in outs]
+    return jnp.stack(outs, axis=2)
+
+
 @partial(jax.jit, static_argnums=(0, 1))
 def _eval_full_pk_jit(nu, first, seeds, ts, scw, tcw, scw_p, tcw_p, fcw_p):
     """Hybrid expansion: XLA level steps for levels 0..first-1 (widths too
@@ -167,20 +181,18 @@ def _eval_full_pk_jit(nu, first, seeds, ts, scw, tcw, scw_p, tcw_p, fcw_p):
     VMEM (ops/chacha_pallas.expand kernel) — the XLA round loop's ~12
     full-state HBM round trips per level collapse to state-in once,
     leaves out once.  -> uint32[K, 2^nu, 16]."""
-    from ..ops import chacha_pallas as cp
-
     S = [seeds[:, i : i + 1] for i in range(4)]
     T = ts[:, None]
     for i in range(first):
         S, T = _level_step_cc(
             S, T, [scw[:, i, w] for w in range(4)], tcw[:, i, 0], tcw[:, i, 1]
         )
-    levels = nu - first
-    outs = cp._expand_raw(
-        S[0], S[1], S[2], S[3], T, scw_p, tcw_p, fcw_p, levels
-    )
-    outs = [cp.deinterleave_leaves(o, levels) for o in outs]
-    return jnp.stack(outs, axis=2)
+    return _finish_pk(nu, first, S, T, scw_p, tcw_p, fcw_p)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _finish_pk_jit(nu, first, s0, s1, s2, s3, T, scw_p, tcw_p, fcw_p):
+    return _finish_pk(nu, first, [s0, s1, s2, s3], T, scw_p, tcw_p, fcw_p)
 
 
 def _eval_full_pallas_device(kb: KeyBatchFast, entry_level: int):
@@ -197,6 +209,32 @@ def _eval_full_pallas_device(kb: KeyBatchFast, entry_level: int):
         *cp.expand_operands(pk, entry_level),
     )
     return words[: kb.k]
+
+
+def _eval_full_pallas_chunked(kb: KeyBatchFast, entry_level: int, n_chunks: int):
+    """Kernel path for domains whose leaves exceed the materialization cap:
+    one XLA prefix to ``entry_level``, then the kernel finishes node-range
+    chunks of the entry state (independent GGM subtrees) under one compiled
+    function per chunk shape.  Mirrors the XLA chunk loop below."""
+    from ..ops import chacha_pallas as cp
+    from ..parallel.sharding import _pad_fast_batch
+
+    pk = _pad_fast_batch(kb, (-kb.k) % cp._EKT)
+    nu, s = pk.nu, entry_level
+    seeds, ts, scw, tcw, _ = pk.device_args()
+    S, T = _expand_prefix_cc_jit(s, seeds, ts, scw, tcw)
+    ops = cp.expand_operands(pk, s)
+    wc = (1 << s) // n_chunks
+    outs = []
+    for j in range(n_chunks):
+        sl = slice(j * wc, (j + 1) * wc)
+        outs.append(
+            _finish_pk_jit(
+                nu, s, S[0][:, sl], S[1][:, sl], S[2][:, sl], S[3][:, sl],
+                T[:, sl], *ops,
+            )
+        )
+    return jnp.concatenate(outs, axis=1)[: kb.k]
 
 
 def eval_full_device(
@@ -221,8 +259,14 @@ def eval_full_device(
     if backend not in ("xla", "pallas"):
         raise ValueError(f"dpf-fast: unknown backend {backend!r}")
     eligible, entry_level, _ = cp.expand_plan(nu, kb.k, max_leaf_nodes)
-    if backend == "pallas" and eligible:
-        return _eval_full_pallas_device(kb, entry_level)
+    if backend == "pallas":
+        if eligible:
+            return _eval_full_pallas_device(kb, entry_level)
+        ok_c, s_c, _, n_chunks = cp.expand_plan_chunked(
+            nu, kb.k, max_leaf_nodes
+        )
+        if ok_c:
+            return _eval_full_pallas_chunked(kb, s_c, n_chunks)
     args = kb.device_args()
     if total <= max_leaf_nodes:
         return _eval_full_cc_jit(nu, *args)

@@ -197,7 +197,10 @@ class PirServer:
             padk(queries.scw), padk(queries.tcw), padk(queries.fcw),
         )
         if self.mesh is None:
-            fn = _pir_single_fast(self.nu, self.chunk_rows, n_chunks)
+            fn = _pir_single_fast(
+                self.nu, self.chunk_rows, n_chunks,
+                _pir_fast_entry_level(self.nu, padded.k),
+            )
         else:
             fn = _pir_sharded_fast(
                 self.mesh, self.nu, self.subtree_levels, self.chunk_rows, n_chunks
@@ -274,19 +277,44 @@ def _pir_single(nu: int, chunk_rows: int, n_chunks: int, backend: str = "xla"):
     return jax.jit(body)
 
 
-@cache
-def _pir_single_fast(nu: int, chunk_rows: int, n_chunks: int):
-    from .dpf_chacha import _convert_leaves_cc, _level_step_cc
+def _fast_expand_sel(nu, entry, seeds, ts, scw, tcw, fcw):
+    """Traceable fast-profile expansion -> selection words uint32[K, W*16]
+    in ascending row order.  ``entry >= 0`` routes levels entry..nu-1 plus
+    leaf conversion through the VMEM expand kernel (models/dpf_chacha
+    _finish_pk; the kernel's lane-padded CW operands are built in-graph —
+    a few tiny pad ops against ~GBs of leaf words); entry < 0 is the pure
+    XLA pipeline."""
+    from .dpf_chacha import _convert_leaves_cc, _finish_pk, _level_step_cc
 
-    def body(seeds, ts, scw, tcw, fcw, db_words):
-        S = [seeds[:, i : i + 1] for i in range(4)]
-        T = ts[:, None]
-        for i in range(nu):
-            S, T = _level_step_cc(
-                S, T, [scw[:, i, w] for w in range(4)], tcw[:, i, 0], tcw[:, i, 1]
-            )
+    S = [seeds[:, i : i + 1] for i in range(4)]
+    T = ts[:, None]
+    for i in range(entry if entry >= 0 else nu):
+        S, T = _level_step_cc(
+            S, T, [scw[:, i, w] for w in range(4)], tcw[:, i, 0], tcw[:, i, 1]
+        )
+    if entry < 0:
         leaves = _convert_leaves_cc(S, T, [fcw[:, j] for j in range(16)])
-        sel = leaves.reshape(leaves.shape[0], -1)  # [K, W*16] ascending rows
+        return leaves.reshape(leaves.shape[0], -1)
+    from ..ops.chacha_pallas import cw_operands
+
+    K = seeds.shape[0]
+    words = _finish_pk(nu, entry, S, T, *cw_operands(scw, tcw, fcw, entry, nu))
+    return words.reshape(K, -1)
+
+
+def _pir_fast_entry_level(nu: int, k: int) -> int:
+    """Expand-kernel entry level for the PIR pipeline, or -1 for XLA."""
+    from ..ops import chacha_pallas as cp
+
+    if cp.expand_backend() != "pallas" or not cp.kernel_usable(nu, k):
+        return -1
+    return cp.entry_level(nu)
+
+
+@cache
+def _pir_single_fast(nu: int, chunk_rows: int, n_chunks: int, entry: int = -1):
+    def body(seeds, ts, scw, tcw, fcw, db_words):
+        sel = _fast_expand_sel(nu, entry, seeds, ts, scw, tcw, fcw)
         return _parity_matmul(sel, db_words, chunk_rows, n_chunks)
 
     return jax.jit(body)

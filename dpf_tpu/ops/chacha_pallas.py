@@ -400,8 +400,45 @@ def expand_plan(nu: int, k: int, max_leaf_nodes: int):
     memory, so the cap must see it.  Used by eval_full_device AND bench.py
     so the scoreboard times exactly the production routing."""
     kp = k + (-k) % _EKT
-    eligible = nu >= 7 and (kp << nu) <= max_leaf_nodes
-    return eligible, max(7, nu - _EXP_LEVELS), kp
+    eligible = kernel_usable(nu, kp) and (kp << nu) <= max_leaf_nodes
+    return eligible, entry_level(nu), kp
+
+
+def kernel_usable(nu: int, k: int, subtree_levels: int = 0) -> bool:
+    """Structural eligibility for the expand kernel: the (shard-local)
+    kernel entry must be >= 128 nodes wide and the key axis must tile the
+    8-key sublane quantum.  Shared by every route (eval_full, chunked,
+    sharded, PIR)."""
+    return (nu - subtree_levels) >= 7 and k % _EKT == 0
+
+
+def entry_level(nu: int, floor: int = 7) -> int:
+    """The kernel's entry tree level: deep enough that at most
+    _EXP_LEVELS levels are fused, never narrower than 2^floor nodes.
+    Single source of the formula for every route."""
+    return max(floor, nu - _EXP_LEVELS)
+
+
+# Cap on padded-key lanes materialized at the kernel entry level by the
+# chunked path's prefix expansion (kp * 2^s state words x 5 arrays).
+_MAX_PREFIX_LANES = 1 << 24
+
+
+def expand_plan_chunked(nu: int, k: int, max_leaf_nodes: int):
+    """Routing plan for domains whose full leaf materialization exceeds the
+    cap: expand an XLA prefix to ``entry_level``, then run the kernel over
+    node-range chunks of the entry state (each chunk an independent set of
+    GGM subtrees — zero cross-chunk dependence).  Returns (eligible,
+    entry_level, padded_k, n_chunks).  The entry level rises with the
+    chunk count so every chunk keeps a >= 128-node kernel entry."""
+    kp = k + (-k) % _EKT
+    total = kp << nu
+    n_chunks = -(-total // max_leaf_nodes)
+    chunk_bits = max(0, (n_chunks - 1).bit_length())
+    s = entry_level(nu, 7 + chunk_bits)
+    if not kernel_usable(nu, kp) or s > nu or (kp << s) > _MAX_PREFIX_LANES:
+        return False, s, kp, 0
+    return True, s, kp, 1 << chunk_bits
 
 
 def _expand_raw(s0, s1, s2, s3, T, scw_p, tcw_p, fcw_p, levels):
@@ -440,6 +477,29 @@ def deinterleave_leaves(x, levels):
     return jnp.swapaxes(x, 2, 3).reshape(k, -1)
 
 
+def cw_operands(scw, tcw, fcw, first_level: int, nu: int):
+    """Lane-padded per-key CW operands for kernel levels
+    ``first_level..nu-1`` plus the final CWs — THE layout the kernel's
+    128-wide cw blocks read (rows: 4*i+w seed-CW words, 2*i t-CWs, 16
+    final-CW words).  Accepts numpy or traced jnp arrays ([K, nu, 4],
+    [K, nu, 2], [K, 16] uint32), so the memoized host path
+    (expand_operands) and the in-graph routes (PIR, sharded) share one
+    definition."""
+    k = fcw.shape[0]
+    levels = nu - first_level
+    scw_p = jnp.zeros((k, 128), jnp.uint32)
+    tcw_p = jnp.zeros((k, 128), jnp.uint32)
+    if levels:
+        scw_p = scw_p.at[:, : 4 * levels].set(
+            jnp.asarray(scw)[:, first_level:].reshape(k, 4 * levels)
+        )
+        tcw_p = tcw_p.at[:, : 2 * levels].set(
+            jnp.asarray(tcw)[:, first_level:].reshape(k, 2 * levels)
+        )
+    fcw_p = jnp.zeros((k, 128), jnp.uint32).at[:, :16].set(jnp.asarray(fcw))
+    return scw_p, tcw_p, fcw_p
+
+
 def expand_operands(kb, first_level: int):
     """Per-key CW operands for kernel levels ``first_level..nu-1`` plus the
     final CWs, lane-padded to the 128-wide block the kernel reads.
@@ -453,15 +513,8 @@ def expand_operands(kb, first_level: int):
             pass
     if first_level in cache:
         return cache[first_level]
-    k, nu = kb.k, kb.nu
-    levels = nu - first_level
-    scw_p = np.zeros((k, 128), np.uint32)
-    tcw_p = np.zeros((k, 128), np.uint32)
-    if levels:
-        scw_p[:, : 4 * levels] = kb.scw[:, first_level:].reshape(k, 4 * levels)
-        tcw_p[:, : 2 * levels] = kb.tcw[:, first_level:].reshape(k, 2 * levels)
-    fcw_p = np.zeros((k, 128), np.uint32)
-    fcw_p[:, :16] = kb.fcw
-    ops = (jnp.asarray(scw_p), jnp.asarray(tcw_p), jnp.asarray(fcw_p))
+    ops = cw_operands(
+        kb.scw, kb.tcw.astype(np.uint32), kb.fcw, first_level, kb.nu
+    )
     cache[first_level] = ops
     return ops

@@ -209,18 +209,34 @@ def expand_subtree_local_cc(seeds, ts, scw, tcw, nu: int, subtree_levels: int):
 
 
 @cache
-def _sharded_eval_full_fast(mesh: Mesh, nu: int, subtree_levels: int):
+def _sharded_eval_full_fast(
+    mesh: Mesh, nu: int, subtree_levels: int, entry: int = -1
+):
     """Sharded fast-profile evaluator for a (mesh, domain) bucket.
 
     The fast profile's state is word-oriented ([K, W] uint32 per seed word,
     models/dpf_chacha.py), so the key batch shards on axis 0 and the leaf
     axis slices each key's subtree on the node axis — same zero-comms
-    decomposition as the bit-plane path."""
-    from ..models.dpf_chacha import _convert_leaves_cc
+    decomposition as the bit-plane path.  ``entry >= 0`` finishes levels
+    entry..nu-1 plus leaf conversion per shard in the VMEM expand kernel
+    (models/dpf_chacha._finish_pk) — the same kernel the single-chip path
+    runs; the per-shard CW operands are lane-padded in-graph."""
+    from ..models.dpf_chacha import _convert_leaves_cc, _finish_pk
 
     def body(seeds, ts, scw, tcw, fcw):
-        S, T = expand_subtree_local_cc(seeds, ts, scw, tcw, nu, subtree_levels)
-        return _convert_leaves_cc(S, T, [fcw[:, j] for j in range(16)])
+        if entry < 0:
+            S, T = expand_subtree_local_cc(
+                seeds, ts, scw, tcw, nu, subtree_levels
+            )
+            return _convert_leaves_cc(S, T, [fcw[:, j] for j in range(16)])
+        S, T = expand_subtree_local_cc(
+            seeds, ts, scw, tcw, entry, subtree_levels
+        )
+        from ..ops.chacha_pallas import cw_operands
+
+        return _finish_pk(
+            nu, entry, S, T, *cw_operands(scw, tcw, fcw, entry, nu)
+        )
 
     sharded = jax.shard_map(
         body,
@@ -233,8 +249,24 @@ def _sharded_eval_full_fast(mesh: Mesh, nu: int, subtree_levels: int):
             P(KEYS_AXIS, None),
         ),
         out_specs=P(KEYS_AXIS, LEAF_AXIS, None),
+        check_vma=False,
     )
     return jax.jit(sharded)
+
+
+def _sharded_fast_entry_level(
+    nu: int, subtree_levels: int, k_per_shard: int
+) -> int:
+    """Expand-kernel entry level for a shard (or -1 for the XLA pipeline):
+    the shard's kernel entry must be >= 128 nodes wide, which sits
+    ``subtree_levels`` deeper than in the single-chip plan."""
+    from ..ops import chacha_pallas as cp
+
+    if cp.expand_backend() != "pallas" or not cp.kernel_usable(
+        nu, k_per_shard, subtree_levels
+    ):
+        return -1
+    return cp.entry_level(nu, subtree_levels + 7)
 
 
 def eval_full_sharded_fast(kb, mesh: Mesh) -> np.ndarray:
@@ -242,11 +274,18 @@ def eval_full_sharded_fast(kb, mesh: Mesh) -> np.ndarray:
     uint8[K, out_bytes] (out_bytes = 2^(log_n-3), minimum 64).
 
     ``kb`` is a :class:`~dpf_tpu.models.keys_chacha.KeyBatchFast`; the key
-    batch is zero-padded to a multiple of the ``keys`` axis."""
+    batch is zero-padded to a multiple of the ``keys`` axis (times the
+    kernel's 8-key sublane tile when the kernel route is eligible)."""
+    from ..ops import chacha_pallas as cp
+
     n_keys = mesh.shape[KEYS_AXIS]
     c = leaf_axis_levels(mesh, kb.nu, kb.log_n)
-    padded = _pad_fast_batch(kb, (-kb.k) % n_keys)
-    fn = _sharded_eval_full_fast(mesh, kb.nu, c)
+    quantum = n_keys
+    if cp.expand_backend() == "pallas" and kb.nu - c >= 7:
+        quantum = n_keys * cp._EKT
+    padded = _pad_fast_batch(kb, (-kb.k) % quantum)
+    entry = _sharded_fast_entry_level(kb.nu, c, padded.k // n_keys)
+    fn = _sharded_eval_full_fast(mesh, kb.nu, c, entry)
     words = np.asarray(fn(*padded.device_args()))
     return np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)
 

@@ -117,6 +117,20 @@ def test_expand_kernel_matches_xla(log_n, k):
     assert (bits[np.arange(k), alphas.astype(np.int64)] == 1).all()
 
 
+def test_expand_kernel_chunked_matches_unchunked():
+    """A leaf cap that forces the chunked kernel path (XLA prefix + kernel
+    per node-range chunk) must reproduce the one-shot result exactly."""
+    log_n, k = 20, 3  # kp=8, nu=11; cap 2^12 -> 4 chunks, entry level 9
+    rng = np.random.default_rng(30)
+    alphas = rng.integers(0, 1 << log_n, size=k, dtype=np.uint64)
+    ka, _ = gen_batch(alphas, log_n, rng=rng)
+    ok, s, kp, n_chunks = cp.expand_plan_chunked(ka.nu, k, 1 << 12)
+    assert ok and n_chunks == 4 and s == 9
+    got = dc.eval_full(ka, max_leaf_nodes=1 << 12, backend="pallas")
+    want = dc.eval_full(ka, backend="xla")
+    assert (got == want).all()
+
+
 def test_eval_points_routes_and_pads(monkeypatch):
     """eval_points must give identical bits via both backends, including a
     query count that needs padding to the 8-row tile quantum."""

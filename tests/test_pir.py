@@ -127,3 +127,24 @@ def test_pir_config4_full_scale_traces():
         jax.ShapeDtypeStruct((dom, row_bytes // 4), u32),  # db words
     )
     assert out.shape == (K, row_bytes // 4) and out.dtype == u32
+
+
+def test_pir_fast_profile_kernel_path(monkeypatch):
+    """Force the VMEM expand-kernel route inside the PIR graph (off-TPU it
+    runs in Pallas interpreter mode) and check against the XLA route."""
+    monkeypatch.setenv("DPF_TPU_FAST", "pallas")
+    rng = np.random.default_rng(23)
+    n_rows, row_bytes, K = 1 << 16, 8, 8  # nu = 7, K % 8 == 0 -> kernel
+    db = rng.integers(0, 256, size=(n_rows, row_bytes), dtype=np.uint8)
+    idx = rng.integers(0, n_rows, size=K, dtype=np.uint64)
+    qa, qb = pir_query(idx, n_rows, rng=rng, profile="fast")
+    from dpf_tpu.models.pir import _pir_fast_entry_level
+
+    srv = PirServer(db, profile="fast")
+    assert _pir_fast_entry_level(srv.nu, K) == 7
+    ans_a, ans_b = srv.answer(qa), srv.answer(qb)
+    got = pir_reconstruct(ans_a, ans_b)
+    np.testing.assert_array_equal(got, db[idx.astype(np.int64)])
+    monkeypatch.setenv("DPF_TPU_FAST", "xla")
+    srv2 = PirServer(db, profile="fast")
+    np.testing.assert_array_equal(ans_a, srv2.answer(qa))
