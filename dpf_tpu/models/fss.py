@@ -300,10 +300,10 @@ def ge_full_from_dpf(kb) -> np.ndarray:
     from .keys_chacha import KeyBatchFast
 
     if isinstance(kb, KeyBatchFast):
-        from .dpf_chacha import _eval_full_cc_jit
+        from .dpf_chacha import eval_full_device as eval_full_device_cc
 
-        # [K, W, 16], ascending bit order
-        words = _eval_full_cc_jit(kb.nu, *kb.device_args())
+        # [K, W, 16], ascending bit order (VMEM expand kernel on TPU)
+        words = eval_full_device_cc(kb)
     else:
         words = eval_full_device(DeviceKeys(kb))  # [Kpad, W, 4]
     scanned = _prefix_xor_words(words.reshape(words.shape[0], -1))

@@ -136,10 +136,25 @@ def _permute_rows(S, perm):
     return jnp.concatenate([S[p : p + 1] for p in perm])
 
 
+# SubBytes evaluation width: 16 = all bytes in one circuit instance (each
+# boolean temp is [16, B] = 2 vregs at the 128-lane tile); 8 = two
+# sequential half-circuits whose temps are single vregs — the BP113
+# middle section keeps ~40+ values live, so halving the per-value
+# footprint is the difference between fitting the register file and
+# spilling to VMEM.  Selected by end-to-end A/B (scripts/bench_compat_ab).
+_SBOX_SPLIT = True
+
+
 def _sub_bytes_bm(S):
     s = S.reshape(8, 16, -1)
-    y = sbox_bp113([s[7 - i] for i in range(8)])  # circuit is MSB-first
-    return jnp.concatenate(y[::-1]).reshape(128, -1)
+    if not _SBOX_SPLIT:
+        y = sbox_bp113([s[7 - i] for i in range(8)])  # circuit is MSB-first
+        return jnp.concatenate(y[::-1]).reshape(128, -1)
+    outs = []
+    for h in (0, 8):
+        y = sbox_bp113([s[7 - i, h : h + 8] for i in range(8)])
+        outs.append(jnp.stack(y[::-1]))  # [8, 8, B]
+    return jnp.concatenate(outs, axis=1).reshape(128, -1)
 
 
 def _shift_rows_bm(S):
