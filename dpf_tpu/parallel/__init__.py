@@ -1,6 +1,8 @@
 """Multi-chip parallelism: mesh construction, sharded evaluation, and the
-parity all-reduce collective."""
+parity all-reduce collective.  Multi-HOST execution (DCN-coordinated
+meshes, per-process input placement) lives in ``multihost``."""
 
+from . import multihost
 from .sharding import (
     KEYS_AXIS,
     LEAF_AXIS,
@@ -15,6 +17,7 @@ from .sharding import (
 __all__ = [
     "KEYS_AXIS",
     "LEAF_AXIS",
+    "multihost",
     "eval_full_sharded",
     "eval_full_sharded_fast",
     "eval_points_sharded",

@@ -1,0 +1,152 @@
+"""Multi-host execution: DCN-coordinated meshes + per-process key placement.
+
+The reference is a single-process library (no NCCL/MPI — SURVEY §5.8); its
+TPU-native equivalent is JAX's multi-controller runtime: every host runs
+this same program, `jax.distributed.initialize` wires the processes over
+DCN, `jax.devices()` becomes the GLOBAL device list, and the existing
+`shard_map` evaluators (sharding.py) run unchanged — XLA routes collectives
+over ICI within a slice and DCN across hosts.  The one genuinely new piece
+multi-host needs is INPUT PLACEMENT: a host must materialize only the key
+shards that live on its own devices.  `distribute_fast_batch` does that
+with `jax.make_array_from_callback`, whose callback is invoked only for
+addressable shards — on a 4-host pod each host touches 1/4 of the key
+batch; in a single process it degrades to ordinary device_put, so the same
+code path is exercised by the CPU-mesh tests.
+
+Usage (same program on every host):
+
+    from dpf_tpu.parallel import multihost as mh
+    mh.init_multihost()                       # no-op single-process
+    mesh = make_mesh(n_keys, n_leaf)          # global devices
+    args = mh.distribute_fast_batch(kb, mesh)
+    words = mh.eval_full_distributed(kb, mesh, args)
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .sharding import (
+    KEYS_AXIS,
+    LEAF_AXIS,
+    _fast_pad_quantum,
+    _pad_fast_batch,
+    _sharded_eval_full_fast,
+    _sharded_fast_entry_level,
+    leaf_axis_levels,
+)
+
+# Environment markers of a managed multi-process launch; any of these
+# present means jax.distributed.initialize()'s cluster auto-detection has
+# something to detect.
+_CLUSTER_ENV = (
+    "JAX_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "TPU_WORKER_HOSTNAMES",
+    "SLURM_JOB_ID",
+    "OMPI_COMM_WORLD_SIZE",
+)
+
+
+def init_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> int:
+    """Join the multi-controller runtime; returns this process's index.
+
+    Three modes, so the same binary serves one chip or a pod:
+    explicit arguments -> initialize with them; no arguments but a managed
+    launch detected in the environment (Cloud TPU pod, Slurm, Open MPI) ->
+    jax.distributed's cluster auto-detection; neither -> single-process
+    no-op.  Must run before any other JAX API, once per process."""
+    if coordinator_address is not None or num_processes is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    elif any(os.environ.get(v) for v in _CLUSTER_ENV):
+        jax.distributed.initialize()
+    return jax.process_index()
+
+
+def _fast_in_shardings(mesh: Mesh):
+    """NamedShardings matching _sharded_eval_full_fast's in_specs."""
+    keys2 = NamedSharding(mesh, P(KEYS_AXIS, None))
+    return (
+        keys2,  # seeds [K, 4]
+        NamedSharding(mesh, P(KEYS_AXIS)),  # ts [K]
+        NamedSharding(mesh, P(KEYS_AXIS, None, None)),  # scw [K, nu, 4]
+        NamedSharding(mesh, P(KEYS_AXIS, None, None)),  # tcw [K, nu, 2]
+        keys2,  # fcw [K, 16]
+    )
+
+
+def distribute_fast_batch(kb, mesh: Mesh):
+    """Materialize a fast-profile key batch as globally-sharded arrays.
+
+    Each process's callback is invoked only for the shards on its own
+    addressable devices, so on a multi-host pod a host touches only its
+    slice of the key axis (the host-side analogue of the evaluators'
+    zero-communication key-batch data parallelism).  The key batch is
+    padded exactly as eval_full_sharded_fast pads it, so the returned
+    arrays feed the same compiled evaluator."""
+    c = leaf_axis_levels(mesh, kb.nu, kb.log_n)
+    quantum = _fast_pad_quantum(mesh, kb.nu, c)
+    padded = _pad_fast_batch(kb, (-kb.k) % quantum)
+    host = (
+        np.asarray(padded.seeds),
+        np.asarray(padded.ts, dtype=np.uint32),
+        np.asarray(padded.scw),
+        np.asarray(padded.tcw, dtype=np.uint32),
+        np.asarray(padded.fcw),
+    )
+    out = []
+    for arr, sh in zip(host, _fast_in_shardings(mesh)):
+        out.append(
+            jax.make_array_from_callback(
+                arr.shape, sh, lambda idx, a=arr: a[idx]
+            )
+        )
+    return tuple(out)
+
+
+def eval_full_distributed(kb, mesh: Mesh, args=None) -> np.ndarray:
+    """Sharded full-domain evaluation from pre-distributed operands ->
+    uint8[K, out_bytes] of this batch's keys, fully materialized on every
+    process.  Single-process, the output shards are all addressable and
+    fetch directly; on a pod the per-host shards are exchanged once over
+    DCN (``multihost_utils.process_allgather``) so each host holds the
+    complete logical result — skip that cost by consuming the returned
+    jax.Array of ``eval_full_distributed_device`` shard-locally instead.
+
+    ``args`` defaults to ``distribute_fast_batch(kb, mesh)``; pass the
+    cached tuple to amortize placement across calls."""
+    words = eval_full_distributed_device(kb, mesh, args)
+    if not words.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        words = multihost_utils.process_allgather(words, tiled=True)
+    words = np.asarray(words)
+    return np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)
+
+
+def eval_full_distributed_device(kb, mesh: Mesh, args=None):
+    """As :func:`eval_full_distributed`, but returns the globally-sharded
+    ``jax.Array`` of leaf words [K_padded, 2^nu, 16] without any cross-host
+    gather — the form a sharded consumer (e.g. a PIR parity matmul over the
+    same mesh) wants."""
+    if args is None:
+        args = distribute_fast_batch(kb, mesh)
+    n_keys = mesh.shape[KEYS_AXIS]
+    c = leaf_axis_levels(mesh, kb.nu, kb.log_n)
+    kp = args[0].shape[0]
+    entry = _sharded_fast_entry_level(kb.nu, c, kp // n_keys)
+    fn = _sharded_eval_full_fast(mesh, kb.nu, c, entry)
+    return fn(*args)

@@ -269,6 +269,20 @@ def _sharded_fast_entry_level(
     return cp.entry_level(nu, subtree_levels + 7)
 
 
+def _fast_pad_quantum(mesh: Mesh, nu: int, subtree_levels: int) -> int:
+    """Key-axis padding quantum for the sharded fast evaluator: whole lane
+    words per shard, times the expand kernel's 8-key sublane tile when the
+    kernel route is structurally possible.  Single source for
+    eval_full_sharded_fast AND multihost.distribute_fast_batch, so input
+    placement and the compiled evaluator can never disagree on K."""
+    from ..ops import chacha_pallas as cp
+
+    n_keys = mesh.shape[KEYS_AXIS]
+    if cp.expand_backend() == "pallas" and nu - subtree_levels >= 7:
+        return n_keys * cp._EKT
+    return n_keys
+
+
 def eval_full_sharded_fast(kb, mesh: Mesh) -> np.ndarray:
     """Sharded full-domain evaluation of a fast-profile key batch ->
     uint8[K, out_bytes] (out_bytes = 2^(log_n-3), minimum 64).
@@ -276,13 +290,9 @@ def eval_full_sharded_fast(kb, mesh: Mesh) -> np.ndarray:
     ``kb`` is a :class:`~dpf_tpu.models.keys_chacha.KeyBatchFast`; the key
     batch is zero-padded to a multiple of the ``keys`` axis (times the
     kernel's 8-key sublane tile when the kernel route is eligible)."""
-    from ..ops import chacha_pallas as cp
-
     n_keys = mesh.shape[KEYS_AXIS]
     c = leaf_axis_levels(mesh, kb.nu, kb.log_n)
-    quantum = n_keys
-    if cp.expand_backend() == "pallas" and kb.nu - c >= 7:
-        quantum = n_keys * cp._EKT
+    quantum = _fast_pad_quantum(mesh, kb.nu, c)
     padded = _pad_fast_batch(kb, (-kb.k) % quantum)
     entry = _sharded_fast_entry_level(kb.nu, c, padded.k // n_keys)
     fn = _sharded_eval_full_fast(mesh, kb.nu, c, entry)
