@@ -419,17 +419,51 @@ def eval_points_sharded(
 
 
 @cache
-def _sharded_eval_points_fast(mesh: Mesh, nu: int, log_n: int):
+def _sharded_eval_points_fast(
+    mesh: Mesh, nu: int, log_n: int, qt: int = 0
+):
     """Fast-profile pointwise walk sharded over the ``keys`` axis.  State is
-    query-major [Q, K] (models/dpf_chacha.py), so the key axis is LAST."""
+    query-major [Q, K] (models/dpf_chacha.py), so the key axis is LAST.
+
+    ``qt > 0`` routes each shard's walk through the Pallas whole-walk
+    kernel (ops/chacha_pallas._walk_raw) with that query tile — the same
+    kernel the single-chip path runs; the per-shard key-minor operands
+    (rows x K) are built in-graph from the sharded key material (tiny
+    transposes against the walk itself)."""
+    from ..core import chacha_np as cc
     from ..models.dpf_chacha import _eval_points_cc_body
 
     def body(seeds, ts, scw, tcw, fcw, xs_hi, xs_lo):
-        return _eval_points_cc_body(
-            nu, log_n, seeds, ts, scw, tcw, fcw, xs_hi, xs_lo
-        )
+        if not qt:
+            return _eval_points_cc_body(
+                nu, log_n, seeds, ts, scw, tcw, fcw, xs_hi, xs_lo
+            )
+        from ..ops import chacha_pallas as cp
 
-    hi_spec = P(None, KEYS_AXIS) if log_n > 32 else P(None, None)
+        k = seeds.shape[0]
+        meta = jnp.stack(
+            [
+                ts,
+                jnp.full((k,), log_n, jnp.uint32),
+                jnp.full((k,), cc.LEAF_BITS - 1, jnp.uint32),
+            ]
+        )
+        seeds_t = seeds.T
+        if nu:
+            scw_t = jnp.moveaxis(scw, 0, 2).reshape(4 * nu, k)
+            tcw_t = jnp.moveaxis(tcw, 0, 2).reshape(2 * nu, k)
+        else:
+            scw_t = jnp.zeros((4, k), jnp.uint32)
+            tcw_t = jnp.zeros((2, k), jnp.uint32)
+        bits = cp._walk_raw(
+            meta, seeds_t, scw_t, tcw_t, fcw.T, xs_lo, xs_hi,
+            log_n, nu, qt,
+        )
+        return bits.astype(jnp.uint8)
+
+    # Kernel routes shard the hi operand with the keys even when it is the
+    # never-read [1, K] dummy (the kernel's block spec is key-minor).
+    hi_spec = P(None, None) if (log_n <= 32 and not qt) else P(None, KEYS_AXIS)
     return jax.jit(
         jax.shard_map(
             body,
@@ -447,8 +481,12 @@ def _sharded_eval_points_fast(mesh: Mesh, nu: int, log_n: int):
 
 def eval_points_sharded_fast(kb, xs: np.ndarray, mesh: Mesh) -> np.ndarray:
     """Sharded batched pointwise evaluation (fast profile):
-    xs uint64[K, Q] -> uint8[K, Q], key batch sharded over ``keys``."""
+    xs uint64[K, Q] -> uint8[K, Q], key batch sharded over ``keys``.
+    Each shard walks via the Pallas whole-walk kernel when its key count
+    tiles the kernel's 128-key lane quantum (pad target), else the XLA
+    body."""
     from ..models.dpf_chacha import _split_queries
+    from ..ops import chacha_pallas as cp
 
     xs = np.asarray(xs, dtype=np.uint64)
     if xs.ndim != 2 or xs.shape[0] != kb.k:
@@ -457,11 +495,21 @@ def eval_points_sharded_fast(kb, xs: np.ndarray, mesh: Mesh) -> np.ndarray:
         raise ValueError("dpf-fast: query index out of domain")
     n_keys = mesh.shape[KEYS_AXIS]
     K, Q = xs.shape
-    pad = (-K) % n_keys
+    use_kernel = cp.points_backend() == "pallas"
+    quantum = n_keys * cp._KT if use_kernel else n_keys
+    pad = (-K) % quantum
     padded = _pad_fast_batch(kb, pad)
     if pad:
         xs = np.concatenate([xs, np.zeros((pad, Q), np.uint64)])
-    xs_hi, xs_lo = _split_queries(xs, kb.log_n)  # [Q, Kpad]
-    fn = _sharded_eval_points_fast(mesh, kb.nu, kb.log_n)
+    pad_q = (-Q) % 8 if use_kernel else 0
+    if pad_q:
+        xs = np.concatenate(
+            [xs, np.zeros((xs.shape[0], pad_q), np.uint64)], axis=1
+        )
+    xs_hi, xs_lo = _split_queries(xs, kb.log_n)  # [Qp, Kpad]
+    qt = cp._qtile(xs_lo.shape[0]) if use_kernel else 0
+    if use_kernel and kb.log_n <= 32:
+        xs_hi = jnp.zeros((1, padded.k), jnp.uint32)  # never read
+    fn = _sharded_eval_points_fast(mesh, kb.nu, kb.log_n, qt)
     bits = np.asarray(fn(*padded.device_args(), xs_hi, xs_lo))
-    return bits.T[:K]
+    return bits.T[:K, :Q]

@@ -159,3 +159,28 @@ def test_eval_points_sharded_fast_matches(log_n):
         kb_, xs, mesh
     )
     np.testing.assert_array_equal(got, (xs == alphas[:, None]).astype(np.uint8))
+
+
+def test_eval_points_sharded_fast_kernel_route(monkeypatch):
+    """Force the Pallas whole-walk kernel inside the sharded fast pointwise
+    path (interpreter mode off-TPU): per-shard keys pad to the 128-key
+    lane quantum and results must match the XLA route bit-for-bit."""
+    from dpf_tpu.models import keys_chacha as kc
+    from dpf_tpu.parallel import eval_points_sharded_fast
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = make_mesh(4, 1, devices=jax.devices()[:4])
+    rng = np.random.default_rng(55)
+    log_n, K, Q = 14, 10, 13  # K pads 10 -> 512, Q pads 13 -> 16
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, kb = kc.gen_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    xs[:, 0] = alphas
+    monkeypatch.setenv("DPF_TPU_POINTS", "xla")
+    want = eval_points_sharded_fast(ka, xs, mesh)
+    monkeypatch.setenv("DPF_TPU_POINTS", "pallas")
+    got = eval_points_sharded_fast(ka, xs, mesh)
+    np.testing.assert_array_equal(got, want)
+    rec = got ^ eval_points_sharded_fast(kb, xs, mesh)
+    np.testing.assert_array_equal(rec, (xs == alphas[:, None]).astype(np.uint8))
