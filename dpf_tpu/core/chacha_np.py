@@ -135,11 +135,25 @@ def prg_expand(seeds: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 
     Control bits ride as the LSB of each child's word 0 (caller extracts
     and clears, reference prg semantics dpf/dpf.go:59-69)."""
+    left, right, _ = prg_expand_v(seeds)
+    return left, right
+
+
+def prg_expand_v(
+    seeds: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Node PRG with a VALUE word: uint32[..., 4] -> (left, right, v).
+
+    ``v`` (output word 8 of the same ChaCha block that yields the two
+    children — free, the permutation computes all 16 words anyway) is the
+    per-node pseudorandom value the DCF construction (models/dcf.py)
+    accumulates along the evaluation path; only its LSB is used for the
+    single-bit comparison payload."""
     key = np.concatenate(
         [seeds, np.broadcast_to(DS_EXPAND, seeds.shape)], axis=-1
     )
     out = chacha_block(key, rounds=ROUNDS)
-    return out[..., 0:4], out[..., 4:8]
+    return out[..., 0:4], out[..., 4:8], out[..., 8]
 
 
 def convert_leaf(seeds: np.ndarray) -> np.ndarray:

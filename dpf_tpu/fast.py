@@ -24,6 +24,12 @@ from .core import chacha_np as _cc
 from .core.chacha_np import key_len
 from .models.dpf_chacha import eval_full as _eval_full_dev
 from .models.dpf_chacha import eval_points as _eval_points_dev
+from .models.dcf import (
+    DcfKeyBatch,
+    eval_lt_points as dcf_eval_lt_points,
+    gen_lt_batch as dcf_gen_lt_batch,
+)
+from .models.dcf import key_len as dcf_key_len
 from .models.keys_chacha import KeyBatchFast, gen_batch
 
 __all__ = [
@@ -35,6 +41,11 @@ __all__ = [
     "eval_full_batch",
     "eval_points_batch",
     "key_len",
+    # one-key-per-gate comparison (DCF; models/dcf.py)
+    "DcfKeyBatch",
+    "dcf_gen_lt_batch",
+    "dcf_eval_lt_points",
+    "dcf_key_len",
 ]
 
 

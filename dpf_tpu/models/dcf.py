@@ -1,0 +1,301 @@
+"""Distributed Comparison Function (DCF): one key per comparison gate.
+
+The FSS gates in models/fss.py build ``1{x < alpha}`` from ``log_n``
+independent DPF keys per gate — the construction available on top of a
+plain point-function library like the reference (dpf/dpf.go exposes only
+Gen/Eval/EvalFull).  The DCF (Boyle–Gilboa–Ishai, "Function Secret
+Sharing: Improvements and Extensions", CCS 2016, §3.2; optimized in
+Boyle et al., "Function Secret Sharing for Mixed-Mode and Fixed-Point
+Secure Computation", EUROCRYPT 2021) shares the whole comparison in ONE
+GGM tree: the key is a DPF-style key plus one extra correction bit per
+level and a 512-bit leaf correction — ~log_n times smaller keys and
+~log_n times less evaluation work than the per-level construction.
+
+Construction (XOR shares, payload beta = 1, fast-profile tree shape —
+ChaCha12 node PRG, 512-bit early-termination leaves):
+
+  - The node PRG emits (left child, right child, v) where v is one extra
+    pseudorandom word of the same ChaCha block (core/chacha_np.
+    prg_expand_v) — the per-node value.
+  - Gen walks alpha's path exactly like DPF Gen (same seed/control-bit
+    correction words) and additionally publishes per level i
+        VCW_i = v(s0_i) ^ v(s1_i) ^ alpha_i          (LSBs)
+    where s0_i, s1_i are the two parties' on-path seeds.
+  - Eval(x) walks x's path; at level i each party computes its node's
+    (l, r, v) and, WHEN x_i = 0 (descending left), accumulates
+        acc ^= v ^ t * VCW_i.
+    On-path nodes (x and alpha agree so far) contribute
+    v0 ^ v1 ^ VCW_i = alpha_i; off-path nodes cancel (identical seeds).
+    Summing over levels: acc0 ^ acc1 = 1 exactly when the first
+    differing bit j has x_j = 0 and alpha_j = 1 — i.e. 1{x < alpha} —
+    decided at most once, at the first divergence.
+  - The bottom LEAF_LOG bits resolve inside the leaf block: the final
+    correction FVCW = convert(s0) ^ convert(s1) ^ LT(alpha_low) (bits
+    j < alpha_low set), and each party accumulates bit x_low of
+    convert(s) ^ t * FVCW.  On-path leaf -> share of 1{x_low <
+    alpha_low}; off-path leaves cancel.
+
+Key layout (to_bytes, per key): seed(16) | t(1) | nu * (sCW(16) | tL(1) |
+tR(1) | VCW(1)) | FVCW(64)  ->  81 + 19 * nu bytes; one key per gate vs
+``log_n * (81 + 18 nu)`` for the per-level construction.
+
+Evaluation is a batched root-to-leaf walk with the same structure as
+models/dpf_chacha._eval_points_cc_body plus the accumulator, and routes
+through the Pallas whole-walk kernel on TPU (ops/chacha_pallas.py, dcf
+mode).  The compat (AES) profile has no DCF: its 2-call fixed-key MMO PRG
+has no spare output word, and reference key compatibility pins its wire
+format — comparison on compat keys stays the per-level construction in
+models/fss.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..core import chacha_np as cc
+from .dpf_chacha import _split_queries
+
+
+@dataclass
+class DcfKeyBatch:
+    """One party's share of K comparison gates ``1{x < alpha}``."""
+
+    log_n: int
+    seeds: np.ndarray  # uint32 [K, 4]
+    ts: np.ndarray  # uint8  [K]
+    scw: np.ndarray  # uint32 [K, nu, 4]
+    tcw: np.ndarray  # uint8  [K, nu, 2]
+    vcw: np.ndarray  # uint8  [K, nu]   (LSB per level)
+    fvcw: np.ndarray  # uint32 [K, 16]
+    _device_args: object = field(default=None, repr=False, compare=False)
+
+    @property
+    def k(self) -> int:
+        return self.seeds.shape[0]
+
+    @property
+    def nu(self) -> int:
+        return cc.nu_of(self.log_n)
+
+    def to_bytes(self) -> list[bytes]:
+        k, nu = self.k, self.nu
+        cws = np.concatenate(
+            [
+                self.scw.view(np.uint8).reshape(k, nu, 16),
+                self.tcw,
+                self.vcw[:, :, None],
+            ],
+            axis=2,
+        )
+        out = np.concatenate(
+            [
+                self.seeds.view(np.uint8).reshape(k, 16),
+                self.ts[:, None],
+                cws.reshape(k, 19 * nu),
+                self.fvcw.view(np.uint8).reshape(k, 64),
+            ],
+            axis=1,
+        )
+        return [bytes(row) for row in out]
+
+    @classmethod
+    def from_bytes(cls, keys: list[bytes], log_n: int) -> "DcfKeyBatch":
+        nu = cc.nu_of(log_n)
+        want = key_len(log_n)
+        arr = np.empty((len(keys), want), dtype=np.uint8)
+        for i, b in enumerate(keys):
+            if len(b) != want:
+                raise ValueError(f"dcf: key {i} length {len(b)} != {want}")
+            arr[i] = np.frombuffer(bytes(b), dtype=np.uint8)
+        seeds = arr[:, :16].copy().view("<u4")
+        ts = arr[:, 16].copy()
+        cws = arr[:, 17 : 17 + 19 * nu].reshape(len(keys), nu, 19)
+        scw = np.ascontiguousarray(cws[:, :, :16]).view("<u4")
+        tcw = cws[:, :, 16:18].copy()
+        vcw = cws[:, :, 18].copy()
+        fvcw = arr[:, -64:].copy().view("<u4")
+        if (
+            (ts > 1).any()
+            or (tcw > 1).any()
+            or (vcw > 1).any()
+            or (seeds[:, 0] & 1).any()
+            or (scw[:, :, 0] & 1).any()
+        ):
+            raise ValueError("dcf: non-canonical key")
+        return cls(log_n, seeds, ts, scw, tcw, vcw, fvcw)
+
+    def device_args(self):
+        """Memoized device operands (control bytes widened to uint32)."""
+        if self._device_args is not None:
+            return self._device_args
+        import jax.numpy as jnp
+
+        args = (
+            jnp.asarray(self.seeds),
+            jnp.asarray(self.ts.astype(np.uint32)),
+            jnp.asarray(self.scw),
+            jnp.asarray(self.tcw.astype(np.uint32)),
+            jnp.asarray(self.vcw.astype(np.uint32)),
+            jnp.asarray(self.fvcw),
+        )
+        self._device_args = args
+        return args
+
+
+def key_len(log_n: int) -> int:
+    """Serialized DCF key size: 17 + 19*nu + 64 bytes."""
+    return 17 + 19 * cc.nu_of(log_n) + 64
+
+
+def _lt_leaf_mask(low: np.ndarray) -> np.ndarray:
+    """uint64[K] in-leaf thresholds -> uint32[K, 16] blocks with bits
+    j < low set (LSB-first within words, ascending words)."""
+    j = np.arange(cc.LEAF_BITS, dtype=np.uint64)
+    bits = (j[None, :] < low[:, None]).astype(np.uint8)
+    w = bits.reshape(-1, 16, 32).astype(np.uint32)
+    return (w << np.arange(32, dtype=np.uint32)).sum(-1, dtype=np.uint32)
+
+
+def gen_lt_batch(
+    alphas: np.ndarray | list[int],
+    log_n: int,
+    rng: np.random.Generator | None = None,
+) -> tuple[DcfKeyBatch, DcfKeyBatch]:
+    """Vectorized DCF Gen for K gates ``1{x < alpha}`` -> (key_a, key_b).
+
+    Identical walk to keys_chacha.gen_batch (the DPF seed/control-bit
+    machinery is unchanged) plus the per-level value CW and the in-leaf
+    comparison correction."""
+    alphas = np.asarray(alphas, dtype=np.uint64)
+    K = alphas.shape[0]
+    if log_n > 63 or log_n < 1 or (alphas >> np.uint64(log_n)).any():
+        raise ValueError("dcf: invalid parameters")
+    nu = cc.nu_of(log_n)
+
+    raw = cc.gen_root_seeds(2 * K, rng)
+    s0 = np.ascontiguousarray(raw[:K]).view("<u4")
+    s1 = np.ascontiguousarray(raw[K:]).view("<u4")
+    t0 = (s0[:, 0] & 1).astype(np.uint8)
+    t1 = t0 ^ 1
+    s0[:, 0] &= ~np.uint32(1)
+    s1[:, 0] &= ~np.uint32(1)
+    root0, rt0 = s0.copy(), t0.copy()
+    root1, rt1 = s1.copy(), t1.copy()
+
+    scw_all = np.zeros((K, nu, 4), dtype=np.uint32)
+    tcw_all = np.zeros((K, nu, 2), dtype=np.uint8)
+    vcw_all = np.zeros((K, nu), dtype=np.uint8)
+
+    for i in range(nu):
+        l0, r0, v0 = cc.prg_expand_v(s0)
+        l1, r1, v1 = cc.prg_expand_v(s1)
+        t0l, t0r = (l0[:, 0] & 1).astype(np.uint8), (r0[:, 0] & 1).astype(np.uint8)
+        t1l, t1r = (l1[:, 0] & 1).astype(np.uint8), (r1[:, 0] & 1).astype(np.uint8)
+        for a in (l0, r0, l1, r1):
+            a[:, 0] &= ~np.uint32(1)
+
+        bit = ((alphas >> np.uint64(log_n - 1 - i)) & np.uint64(1)).astype(np.uint8)
+        vcw_all[:, i] = (v0 ^ v1 ^ bit.astype(np.uint32)) & 1
+        b = bit[:, None].astype(bool)
+        scw = np.where(b, l0 ^ l1, r0 ^ r1)  # LOSE side
+        tlcw = (t0l ^ t1l ^ bit ^ 1).astype(np.uint8)
+        trcw = (t0r ^ t1r ^ bit).astype(np.uint8)
+        scw_all[:, i] = scw
+        tcw_all[:, i, 0] = tlcw
+        tcw_all[:, i, 1] = trcw
+
+        keep_s0 = np.where(b, r0, l0)
+        keep_s1 = np.where(b, r1, l1)
+        keep_t0 = np.where(bit, t0r, t0l).astype(np.uint8)
+        keep_t1 = np.where(bit, t1r, t1l).astype(np.uint8)
+        keep_tcw = np.where(bit, trcw, tlcw).astype(np.uint8)
+
+        s0 = keep_s0 ^ (t0[:, None].astype(np.uint32) * scw)
+        s1 = keep_s1 ^ (t1[:, None].astype(np.uint32) * scw)
+        t0 = keep_t0 ^ (t0 * keep_tcw)
+        t1 = keep_t1 ^ (t1 * keep_tcw)
+
+    conv0 = cc.convert_leaf(s0)
+    conv1 = cc.convert_leaf(s1)
+    low = alphas & np.uint64(cc.LEAF_BITS - 1) if log_n >= cc.LEAF_LOG else alphas
+    fvcw = conv0 ^ conv1 ^ _lt_leaf_mask(low)
+
+    def mk(root, rt):
+        return DcfKeyBatch(
+            log_n, root, rt, scw_all.copy(), tcw_all.copy(),
+            vcw_all.copy(), fvcw,
+        )
+
+    return mk(root0, rt0), mk(root1, rt1)
+
+
+def eval_points_np(kb: DcfKeyBatch, xs: np.ndarray) -> np.ndarray:
+    """Pure-NumPy spec evaluation: xs uint64[K, Q] -> uint8[K, Q].
+    Slow; the executable reference the device paths differential-test
+    against."""
+    xs = np.asarray(xs, dtype=np.uint64)
+    K, Q = xs.shape
+    if K != kb.k:
+        raise ValueError("dcf: xs first axis must match key batch")
+    if (xs >> np.uint64(kb.log_n)).any():
+        raise ValueError("dcf: query index out of domain")
+    n, nu = kb.log_n, kb.nu
+    s = np.repeat(kb.seeds[:, None, :], Q, axis=1).reshape(K * Q, 4)
+    t = np.repeat(kb.ts.astype(np.uint32)[:, None], Q, axis=1).reshape(-1)
+    acc = np.zeros(K * Q, np.uint32)
+    xf = xs.reshape(-1)
+    kidx = np.repeat(np.arange(K), Q)
+    for i in range(nu):
+        l, r, v = cc.prg_expand_v(s)
+        tl = l[:, 0] & 1
+        tr = r[:, 0] & 1
+        l[:, 0] &= ~np.uint32(1)
+        r[:, 0] &= ~np.uint32(1)
+        vcw = kb.vcw[kidx, i].astype(np.uint32)
+        xbit = ((xf >> np.uint64(n - 1 - i)) & np.uint64(1)).astype(np.uint32)
+        acc ^= (v ^ (t * vcw)) & np.uint32(1) & (1 - xbit)
+        scw = kb.scw[kidx, i]
+        tcw = kb.tcw[kidx, i].astype(np.uint32)
+        go_r = xbit[:, None].astype(bool)
+        s = np.where(go_r, r, l) ^ (t[:, None] * scw)
+        t = np.where(xbit.astype(bool), tr, tl) ^ (t * np.where(
+            xbit.astype(bool), tcw[:, 1], tcw[:, 0]
+        ))
+    block = cc.convert_leaf(s) ^ (t[:, None] * kb.fvcw[kidx])
+    low = (xf & np.uint64(cc.LEAF_BITS - 1)).astype(np.int64)
+    if n < cc.LEAF_LOG:
+        low = xf.astype(np.int64)
+    sel = block[np.arange(K * Q), low >> 5]
+    acc ^= (sel >> (low & 31).astype(np.uint32)) & 1
+    return acc.astype(np.uint8).reshape(K, Q)
+
+
+def eval_lt_points(kb: DcfKeyBatch, xs: np.ndarray) -> np.ndarray:
+    """Batched comparison-share evaluation: xs uint64[K, Q] -> uint8[K, Q]
+    with  eval(ka) ^ eval(kb) == 1{x < alpha}  per gate.
+
+    Routes through the Pallas whole-walk kernel on TPU (DCF mode) when the
+    key count tiles the kernel's lane quantum; else the XLA body."""
+    xs = np.asarray(xs, dtype=np.uint64)
+    if xs.ndim != 2 or xs.shape[0] != kb.k:
+        raise ValueError("dcf: xs must be [K, Q]")
+    if (xs >> np.uint64(kb.log_n)).any():
+        raise ValueError("dcf: query index out of domain")
+    from ..ops import chacha_pallas as cp
+
+    if cp.points_backend() == "pallas" and cp.usable(kb.k):
+        return cp.eval_points_walk_dcf(kb, xs)
+    return _eval_points_xla(kb, xs)
+
+
+def _eval_points_xla(kb: DcfKeyBatch, xs: np.ndarray) -> np.ndarray:
+    from .dpf_chacha import _eval_points_cc_jit
+
+    seeds, ts, scw, tcw, vcw, fvcw = kb.device_args()
+    xs_hi, xs_lo = _split_queries(xs, kb.log_n)
+    bits = _eval_points_cc_jit(
+        kb.nu, kb.log_n, seeds, ts, scw, tcw, fvcw, xs_hi, xs_lo, 0, vcw
+    )
+    return np.asarray(bits).T

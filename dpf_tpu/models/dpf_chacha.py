@@ -79,6 +79,13 @@ def _prg_expand(seed, unroll=False):
     return out[0:4], out[4:8]
 
 
+def _prg_expand_v(seed, unroll=False):
+    """4x[K, W] -> (left 4x, right 4x, value word) — the DCF node PRG
+    (core/chacha_np.prg_expand_v semantics)."""
+    out = _chacha_core(seed, _DSX, 9, unroll)
+    return out[0:4], out[4:8], out[8]
+
+
 def _convert(seed, unroll=False):
     """4x[K, W] -> 16 output words (the leaf's 512 bits)."""
     return _chacha_core(seed, _DSL, 16, unroll)
@@ -297,7 +304,8 @@ def eval_full(
 
 
 def _eval_points_cc_body(
-    nu, log_n, seeds, ts, scw, tcw, fcw, xs_hi, xs_lo, level_groups=0
+    nu, log_n, seeds, ts, scw, tcw, fcw, xs_hi, xs_lo, level_groups=0,
+    vcw=None,
 ):
     """Query-major path walk: xs_hi/xs_lo uint32[Q, K] (the query index
     split in halves — JAX runs 32-bit by default and the domain index can
@@ -322,7 +330,16 @@ def _eval_points_cc_body(
     constant ``1{walk level j <= block level i}`` — so the host never
     replicates the query tensor n times (for n=32 gates that replication
     plus its upload cost more than the whole device walk).
+
+    ``vcw`` (uint32[K, nu] per-level value CWs) switches the walk into DCF
+    mode (models/dcf.py): the node PRG's value word accumulates on left
+    descents and the leaf bit folds into the accumulator; ``fcw`` then
+    carries the DCF's final value correction.  Mutually exclusive with
+    ``level_groups``.
     """
+    dcf = vcw is not None
+    if dcf and level_groups:
+        raise ValueError("dcf walk does not support level grouping")
     low = xs_lo & np.uint32(cc.LEAF_BITS - 1)
     if level_groups:
         K = seeds.shape[0]
@@ -337,8 +354,12 @@ def _eval_points_cc_body(
         shp = low.shape
     S = [jnp.broadcast_to(seeds[None, :, i], shp) for i in range(4)]
     T = jnp.broadcast_to(ts[None, :], shp)
+    acc = jnp.zeros(shp, jnp.uint32)
     for i in range(nu):
-        L, R = _prg_expand(S, unroll=_POINTS_UNROLL)
+        if dcf:
+            L, R, v = _prg_expand_v(S, unroll=_POINTS_UNROLL)
+        else:
+            L, R = _prg_expand(S, unroll=_POINTS_UNROLL)
         tl = L[0] & np.uint32(1)
         tr = R[0] & np.uint32(1)
         L[0] = L[0] & ~np.uint32(1)
@@ -356,6 +377,12 @@ def _eval_points_cc_body(
         if level_groups:
             keep = jnp.asarray((key_level >= i).astype(np.uint32))  # [K//... G-tiled]
             pbit = jnp.tile(pbit, (1, K // G)) & keep[None, :]
+        if dcf:
+            acc = acc ^ (
+                (v ^ (vcw[None, :, i] & T))
+                & np.uint32(1)
+                & (np.uint32(1) - pbit)
+            )
         bm = jnp.uint32(0) - pbit
         S = [(R[w] & bm) | (L[w] & ~bm) for w in range(4)]
         T = (tr & bm) | (tl & ~bm)
@@ -365,7 +392,8 @@ def _eval_points_cc_body(
     widx = (low >> 5) & 15
     w = jnp.stack(out, axis=2)  # [Q, K, 16]
     sel = jnp.take_along_axis(w, widx[:, :, None].astype(jnp.int32), axis=2)[:, :, 0]
-    return ((sel >> (low & 31)) & 1).astype(jnp.uint8)
+    bit = (sel >> (low & 31)) & 1
+    return ((acc ^ bit) if dcf else bit).astype(jnp.uint8)
 
 
 _eval_points_cc_jit = partial(jax.jit, static_argnums=(0, 1, 9))(

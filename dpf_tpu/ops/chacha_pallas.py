@@ -120,9 +120,14 @@ def _cc_core(S, ds, n_out):
 
 
 def _walk_kernel(
-    meta_ref, seeds_ref, scw_ref, tcw_ref, fcw_ref, xs_lo_ref, xs_hi_ref,
-    out_ref, *, nu, log_n,
+    meta_ref, seeds_ref, scw_ref, tcw_ref, vcw_ref, fcw_ref, xs_lo_ref,
+    xs_hi_ref, out_ref, *, nu, log_n, dcf=False,
 ):
+    """Whole-walk kernel.  ``dcf`` adds the DCF value accumulator
+    (models/dcf.py): the node PRG emits one extra word whose LSB,
+    corrected by the per-level VCW (vcw_ref, row i) and the parent control
+    bit, XOR-accumulates whenever the query descends left; the leaf bit
+    then folds into the accumulator instead of being the output itself."""
     QT, KT = out_ref.shape
     one = np.uint32(1)
     ts = meta_ref[0:1, :]
@@ -133,11 +138,12 @@ def _walk_kernel(
         jnp.broadcast_to(seeds_ref[w : w + 1, :], (QT, KT)) for w in range(4)
     )
     T = jnp.broadcast_to(ts, (QT, KT))
+    acc = jnp.zeros((QT, KT), jnp.uint32)
 
     def level(i, carry):
-        S0, S1, S2, S3, T = carry
-        out = _cc_core([S0, S1, S2, S3], _DSX, 8)
-        L, R = out[:4], out[4:]
+        S0, S1, S2, S3, T, acc = carry
+        out = _cc_core([S0, S1, S2, S3], _DSX, 9 if dcf else 8)
+        L, R = out[:4], out[4:8]
         tl = L[0] & one
         tr = R[0] & one
         L[0] = L[0] & ~one
@@ -162,18 +168,21 @@ def _walk_kernel(
             pbit = jnp.where(bu >= np.uint32(32), p_hi, p_lo)
         keep = jnp.where(kl >= iu, one, np.uint32(0))
         pbit = pbit & keep
+        if dcf:
+            vcw_i = vcw_ref[pl.ds(i, 1), :]  # [1, KT]
+            acc = acc ^ ((out[8] ^ (vcw_i & T)) & one & (one - pbit))
         bm = jnp.uint32(0) - pbit
         S0, S1, S2, S3 = ((R[w] & bm) | (L[w] & ~bm) for w in range(4))
         T = (tr & bm) | (tl & ~bm)
-        return S0, S1, S2, S3, T
+        return S0, S1, S2, S3, T, acc
 
-    carry = (*S, T)
+    carry = (*S, T, acc)
     if _UNROLL_LEVELS:
         for i in range(nu):
             carry = level(i, carry)
     else:
         carry = lax.fori_loop(0, nu, level, carry)
-    S0, S1, S2, S3, T = carry
+    S0, S1, S2, S3, T, acc = carry
     out = _cc_core([S0, S1, S2, S3], _DSL, 16)
     msk = jnp.uint32(0) - T
     low = xs_lo & np.uint32(cc.LEAF_BITS - 1) & lowmask
@@ -182,28 +191,34 @@ def _walk_kernel(
     for j in range(16):
         oj = out[j] ^ (fcw_ref[j : j + 1, :] & msk)
         sel = sel | (oj & (jnp.uint32(0) - (widx == j).astype(jnp.uint32)))
-    out_ref[:] = (sel >> (low & np.uint32(31))) & one
+    out_ref[:] = acc ^ ((sel >> (low & np.uint32(31))) & one)
 
 
-def _walk_raw(meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi, log_n, nu, qt):
+def _walk_raw(
+    meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi, log_n, nu, qt,
+    vcw_t=None, dcf=False,
+):
     Q, K = xs_lo.shape
+    if vcw_t is None:  # never read when dcf=False
+        vcw_t = jnp.zeros((1, K), jnp.uint32)
     qspec = pl.BlockSpec((qt, _KT), lambda q, k: (q, k))
 
     def rows(n):
         return pl.BlockSpec((n, _KT), lambda q, k: (0, k))
 
-    kern = functools.partial(_walk_kernel, nu=nu, log_n=log_n)
+    kern = functools.partial(_walk_kernel, nu=nu, log_n=log_n, dcf=dcf)
     return pl.pallas_call(
         kern,
         grid=(Q // qt, K // _KT),
         in_specs=[
             rows(3), rows(4), rows(scw_t.shape[0]), rows(tcw_t.shape[0]),
-            rows(16), qspec, qspec if log_n > 32 else rows(1),
+            rows(vcw_t.shape[0]), rows(16), qspec,
+            qspec if log_n > 32 else rows(1),
         ],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((Q, K), jnp.uint32),
         interpret=not _on_tpu(),
-    )(meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi)
+    )(meta, seeds_t, scw_t, tcw_t, vcw_t, fcw_t, xs_lo, xs_hi)
 
 
 @functools.partial(jax.jit, static_argnums=(7, 8, 9))
@@ -239,26 +254,11 @@ def _walk_call_reduced(
 # ---------------------------------------------------------------------------
 
 
-def walk_operands(kb, groups: int = 0):
-    """Transposed device operands for the walk kernel, memoized per key
-    batch (key material is immutable once evaluated; the FSS layouts also
-    depend only on (k, log_n, groups))."""
-    cache = getattr(kb, "_walk_ops", None)
-    if cache is None:
-        cache = {}
-        try:
-            kb._walk_ops = cache
-        except AttributeError:  # frozen dataclass; recompute per call
-            pass
-    if groups in cache:
-        return cache[groups]
+def _walk_common_operands(kb, key_level, lowmask):
+    """(meta, seeds_t, scw_t, tcw_t) in the kernel's key-minor layout —
+    shared by the DPF (walk_operands) and DCF (dcf_walk_operands) routes
+    so the operand layout has one definition."""
     k, nu = kb.k, kb.nu
-    if groups:
-        g = k // (groups * kb.log_n)
-        key_level, lowmask = cc.grouped_masks(k, g, kb.log_n)
-    else:
-        key_level = np.full(k, kb.log_n, np.uint32)
-        lowmask = np.full(k, cc.LEAF_BITS - 1, np.uint32)
     meta = jnp.asarray(
         np.stack([kb.ts.astype(np.uint32), key_level, lowmask])
     )
@@ -273,6 +273,30 @@ def walk_operands(kb, groups: int = 0):
     else:  # never read by the kernel (level loop is empty)
         scw_t = jnp.zeros((4, k), jnp.uint32)
         tcw_t = jnp.zeros((2, k), jnp.uint32)
+    return meta, seeds_t, scw_t, tcw_t
+
+
+def walk_operands(kb, groups: int = 0):
+    """Transposed device operands for the walk kernel, memoized per key
+    batch (key material is immutable once evaluated; the FSS layouts also
+    depend only on (k, log_n, groups))."""
+    cache = getattr(kb, "_walk_ops", None)
+    if cache is None:
+        cache = {}
+        try:
+            kb._walk_ops = cache
+        except AttributeError:  # frozen dataclass; recompute per call
+            pass
+    if groups in cache:
+        return cache[groups]
+    k = kb.k
+    if groups:
+        g = k // (groups * kb.log_n)
+        key_level, lowmask = cc.grouped_masks(k, g, kb.log_n)
+    else:
+        key_level = np.full(k, kb.log_n, np.uint32)
+        lowmask = np.full(k, cc.LEAF_BITS - 1, np.uint32)
+    meta, seeds_t, scw_t, tcw_t = _walk_common_operands(kb, key_level, lowmask)
     fcw_t = jnp.asarray(np.ascontiguousarray(kb.fcw.T))
     ops = (meta, seeds_t, scw_t, tcw_t, fcw_t)
     cache[groups] = ops
@@ -518,3 +542,68 @@ def expand_operands(kb, first_level: int):
     )
     cache[first_level] = ops
     return ops
+
+
+# ---------------------------------------------------------------------------
+# DCF (models/dcf.py) kernel route
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(8, 9, 10))
+def _walk_call_dcf(
+    meta, seeds_t, scw_t, tcw_t, vcw_t, fvcw_t, xs_lo, xs_hi, log_n, nu, qt
+):
+    return _walk_raw(
+        meta, seeds_t, scw_t, tcw_t, fvcw_t, xs_lo, xs_hi, log_n, nu, qt,
+        vcw_t=vcw_t, dcf=True,
+    ).astype(jnp.uint8)
+
+
+def dcf_walk_operands(kb):
+    """Key-minor operands for the DCF walk kernel, memoized per batch."""
+    ops = getattr(kb, "_walk_ops_dcf", None)
+    if ops is not None:
+        return ops
+    k, nu = kb.k, kb.nu
+    meta, seeds_t, scw_t, tcw_t = _walk_common_operands(
+        kb,
+        np.full(k, kb.log_n, np.uint32),  # keep: always
+        np.full(k, cc.LEAF_BITS - 1, np.uint32),
+    )
+    if nu:
+        vcw_t = jnp.asarray(
+            np.ascontiguousarray(kb.vcw.astype(np.uint32).T)
+        )
+    else:
+        vcw_t = jnp.zeros((1, k), jnp.uint32)
+    fvcw_t = jnp.asarray(np.ascontiguousarray(kb.fvcw.T))
+    ops = (meta, seeds_t, scw_t, tcw_t, vcw_t, fvcw_t)
+    try:
+        kb._walk_ops_dcf = ops
+    except AttributeError:
+        pass
+    return ops
+
+
+def eval_points_walk_dcf(kb, xs: np.ndarray) -> np.ndarray:
+    """DCF comparison-share walk via the Pallas kernel: xs uint64[K, Q] ->
+    uint8[K, Q] (same contract as models/dcf.eval_lt_points, which routes
+    here on TPU)."""
+    k = kb.k
+    ops = dcf_walk_operands(kb)
+    xs_t = np.ascontiguousarray(xs.T)
+    q = xs_t.shape[0]
+    pad_q = (-q) % 8
+    if pad_q:
+        xs_t = np.concatenate(
+            [xs_t, np.zeros((pad_q,) + xs_t.shape[1:], xs_t.dtype)]
+        )
+    xs_lo = jnp.asarray((xs_t & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    if kb.log_n > 32:
+        xs_hi = jnp.asarray((xs_t >> np.uint64(32)).astype(np.uint32))
+    else:
+        xs_hi = jnp.zeros((1, k), jnp.uint32)  # never read
+    bits = _walk_call_dcf(
+        *ops, xs_lo, xs_hi, kb.log_n, kb.nu, _qtile(xs_lo.shape[0])
+    )
+    return np.asarray(bits)[:q].T

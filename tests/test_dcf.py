@@ -1,0 +1,101 @@
+"""DCF (one-key comparison gates, models/dcf.py): reconstruction against
+the predicate, spec-vs-device differential, codec, and edge cases."""
+
+import numpy as np
+import pytest
+
+from dpf_tpu.models import dcf
+
+
+@pytest.mark.parametrize("log_n", [4, 9, 12, 33])
+def test_dcf_reconstruction(log_n):
+    rng = np.random.default_rng(log_n)
+    K, Q = 6, 64
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    alphas[0] = 0  # never-true gate
+    alphas[1] = (1 << log_n) - 1  # true for all but the max point
+    ka, kb = dcf.gen_lt_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    xs[:, 0] = alphas  # boundary: alpha itself is NOT < alpha
+    xs[:, 1] = np.maximum(alphas, np.uint64(1)) - np.uint64(1)  # just below
+    ra = dcf.eval_lt_points(ka, xs)
+    rb = dcf.eval_lt_points(kb, xs)
+    want = (xs < alphas[:, None]).astype(np.uint8)
+    np.testing.assert_array_equal(ra ^ rb, want)
+
+
+def test_dcf_exhaustive_small_domain():
+    log_n = 8
+    rng = np.random.default_rng(3)
+    alphas = np.array([0, 1, 127, 128, 255], dtype=np.uint64)
+    ka, kb = dcf.gen_lt_batch(alphas, log_n, rng=rng)
+    xs = np.broadcast_to(
+        np.arange(256, dtype=np.uint64), (5, 256)
+    ).copy()
+    rec = dcf.eval_lt_points(ka, xs) ^ dcf.eval_lt_points(kb, xs)
+    np.testing.assert_array_equal(rec, (xs < alphas[:, None]).astype(np.uint8))
+
+
+def test_dcf_device_matches_numpy_spec():
+    log_n = 14
+    rng = np.random.default_rng(7)
+    K, Q = 5, 40
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, _ = dcf.gen_lt_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    got = dcf.eval_lt_points(ka, xs)
+    want = dcf.eval_points_np(ka, xs)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_dcf_codec_roundtrip():
+    log_n = 20
+    rng = np.random.default_rng(9)
+    alphas = rng.integers(0, 1 << log_n, size=4, dtype=np.uint64)
+    ka, _ = dcf.gen_lt_batch(alphas, log_n, rng=rng)
+    blobs = ka.to_bytes()
+    assert all(len(b) == dcf.key_len(log_n) for b in blobs)
+    kb2 = dcf.DcfKeyBatch.from_bytes(blobs, log_n)
+    for f in ("seeds", "ts", "scw", "tcw", "vcw", "fvcw"):
+        np.testing.assert_array_equal(getattr(ka, f), getattr(kb2, f))
+
+
+def test_dcf_rejects_bad_inputs():
+    rng = np.random.default_rng(1)
+    ka, _ = dcf.gen_lt_batch(np.array([3], np.uint64), 10, rng=rng)
+    with pytest.raises(ValueError, match="domain"):
+        dcf.eval_lt_points(ka, np.array([[1 << 10]], np.uint64))
+    with pytest.raises(ValueError, match="invalid"):
+        dcf.gen_lt_batch(np.array([1 << 12], np.uint64), 10)
+    blob = bytearray(ka.to_bytes()[0])
+    blob[16] = 2  # non-canonical t byte
+    with pytest.raises(ValueError, match="non-canonical"):
+        dcf.DcfKeyBatch.from_bytes([bytes(blob)], 10)
+
+
+def test_dcf_key_size_advantage():
+    # One key per gate vs log_n per-level DPF keys (models/fss.py route).
+    from dpf_tpu.core.chacha_np import key_len as dpf_key_len
+
+    log_n = 32
+    assert dcf.key_len(log_n) < dpf_key_len(log_n) * log_n / 20
+
+
+def test_dcf_kernel_route_matches_xla(monkeypatch):
+    """Force the Pallas DCF walk kernel (interpreter mode off-TPU): must
+    match the XLA body bit-for-bit and reconstruct the predicate."""
+    from dpf_tpu.ops import chacha_pallas as cp
+
+    log_n = 13
+    rng = np.random.default_rng(31)
+    K, Q = 128, 16  # K tiles the kernel's 128-key lane quantum
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, kb = dcf.gen_lt_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    xs[:, 0] = alphas
+    got = cp.eval_points_walk_dcf(ka, xs)
+    monkeypatch.setenv("DPF_TPU_POINTS", "xla")
+    want = dcf.eval_lt_points(ka, xs)
+    np.testing.assert_array_equal(got, want)
+    rec = got ^ cp.eval_points_walk_dcf(kb, xs)
+    np.testing.assert_array_equal(rec, (xs < alphas[:, None]).astype(np.uint8))
