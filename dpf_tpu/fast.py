@@ -26,7 +26,9 @@ from .models.dpf_chacha import eval_full as _eval_full_dev
 from .models.dpf_chacha import eval_points as _eval_points_dev
 from .models.dcf import (
     DcfKeyBatch,
+    eval_interval_points as dcf_eval_interval_points,
     eval_lt_points as dcf_eval_lt_points,
+    gen_interval_batch as dcf_gen_interval_batch,
     gen_lt_batch as dcf_gen_lt_batch,
 )
 from .models.dcf import key_len as dcf_key_len
@@ -45,6 +47,8 @@ __all__ = [
     "DcfKeyBatch",
     "dcf_gen_lt_batch",
     "dcf_eval_lt_points",
+    "dcf_gen_interval_batch",
+    "dcf_eval_interval_points",
     "dcf_key_len",
 ]
 

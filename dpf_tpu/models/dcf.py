@@ -299,3 +299,44 @@ def _eval_points_xla(kb: DcfKeyBatch, xs: np.ndarray) -> np.ndarray:
         kb.nu, kb.log_n, seeds, ts, scw, tcw, fvcw, xs_hi, xs_lo, 0, vcw
     )
     return np.asarray(bits).T
+
+
+def gen_interval_batch(
+    lo: np.ndarray | list[int],
+    hi: np.ndarray | list[int],
+    log_n: int,
+    rng: np.random.Generator | None = None,
+):
+    """K interval gates ``1{lo <= x <= hi}`` from TWO DCFs per gate
+    (``lt_{hi+1} ^ lt_{lo}``; the ``hi = 2^n - 1`` wrap edge becomes an
+    always-0 upper gate plus a public constant on party A — models/fss.py
+    semantics, at DCF key sizes).  Returns two (upper, lower, const)
+    triples; evaluate with :func:`eval_interval_points`."""
+    lo = np.asarray(lo, dtype=np.uint64)
+    hi = np.asarray(hi, dtype=np.uint64)
+    if lo.shape != hi.shape or lo.ndim != 1:
+        raise ValueError("dcf: lo/hi must be 1-D and equal length")
+    if (lo > hi).any():
+        raise ValueError("dcf: lo > hi")
+    top = (np.uint64(1) << np.uint64(log_n)) - np.uint64(1)
+    if (hi > top).any():
+        raise ValueError("dcf: hi out of domain")
+    wrap = hi == top
+    upper_alpha = np.where(wrap, np.uint64(0), hi + np.uint64(1))
+    ua, ub = gen_lt_batch(upper_alpha, log_n, rng=rng)
+    la, lb = gen_lt_batch(lo, log_n, rng=rng)
+    const_a = wrap.astype(np.uint8)
+    const_b = np.zeros_like(const_a)
+    return (ua, la, const_a), (ub, lb, const_b)
+
+
+def eval_interval_points(ik, xs: np.ndarray) -> np.ndarray:
+    """Evaluate interval shares at xs uint64[K, Q] -> uint8[K, Q]; ``ik``
+    is one party's (upper, lower, const) triple from
+    :func:`gen_interval_batch`."""
+    upper, lower, const = ik
+    return (
+        eval_lt_points(upper, xs)
+        ^ eval_lt_points(lower, xs)
+        ^ const[:, None]
+    )

@@ -99,3 +99,22 @@ def test_dcf_kernel_route_matches_xla(monkeypatch):
     np.testing.assert_array_equal(got, want)
     rec = got ^ cp.eval_points_walk_dcf(kb, xs)
     np.testing.assert_array_equal(rec, (xs < alphas[:, None]).astype(np.uint8))
+
+
+def test_dcf_interval_reconstruction():
+    log_n = 12
+    rng = np.random.default_rng(60)
+    K, Q = 6, 128
+    lo = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    hi = np.minimum(
+        lo + rng.integers(0, 300, size=K).astype(np.uint64),
+        np.uint64((1 << log_n) - 1),
+    )
+    hi[0] = np.uint64((1 << log_n) - 1)  # wrap edge
+    lo[1] = hi[1]  # single-point interval
+    ia, ib = dcf.gen_interval_batch(lo, hi, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    xs[:, 0], xs[:, 1] = lo, hi  # boundaries inclusive
+    rec = dcf.eval_interval_points(ia, xs) ^ dcf.eval_interval_points(ib, xs)
+    want = ((xs >= lo[:, None]) & (xs <= hi[:, None])).astype(np.uint8)
+    np.testing.assert_array_equal(rec, want)
