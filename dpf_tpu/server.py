@@ -18,6 +18,10 @@ Endpoints (all POST, binary bodies, profile/params in the query string):
   /v1/eval_points_batch?log_n=N&k=K&q=Q[&profile=fast]
         body: K concatenated keys || K*Q little-endian uint64 indices
         -> K*Q bytes of 0/1 bits (row-major [K, Q])
+  /v1/dcf_gen?log_n=N&k=K                     body: K uint64 alphas
+        -> K DCF keys (party A) || K DCF keys (party B)  (fast profile)
+  /v1/dcf_eval_points?log_n=N&k=K&q=Q         body: keys || uint64 indices
+        -> K*Q comparison-share bits (models/dcf.py; one key per gate)
   /healthz                                    -> "ok"
 
 Batched endpoints amortize the device dispatch exactly like the in-process
@@ -112,6 +116,32 @@ class _Handler(BaseHTTPRequestHandler):
                 xs = np.frombuffer(body[k * kl :], dtype="<u8").reshape(k, nq)
                 out = api.eval_points_batch(
                     batch_cls.from_bytes(keys, log_n), xs
+                )
+                self._reply(200, np.ascontiguousarray(out).tobytes())
+            elif route == "/v1/dcf_gen":
+                from .models import dcf
+
+                k = int(q["k"])
+                if len(body) != k * 8:
+                    raise ValueError(f"body must be {k}*8 alpha bytes")
+                alphas = np.frombuffer(body, dtype="<u8")
+                da, db = dcf.gen_lt_batch(alphas, log_n)
+                self._reply(
+                    200, b"".join(da.to_bytes()) + b"".join(db.to_bytes())
+                )
+            elif route == "/v1/dcf_eval_points":
+                from .models import dcf
+
+                k, nq = int(q["k"]), int(q["q"])
+                kl = dcf.key_len(log_n)
+                if len(body) != k * kl + k * nq * 8:
+                    raise ValueError(
+                        f"body must be {k}*{kl} key bytes + {k}*{nq}*8 index bytes"
+                    )
+                keys = [bytes(body[i * kl : (i + 1) * kl]) for i in range(k)]
+                xs = np.frombuffer(body[k * kl :], dtype="<u8").reshape(k, nq)
+                out = dcf.eval_lt_points(
+                    dcf.DcfKeyBatch.from_bytes(keys, log_n), xs
                 )
                 self._reply(200, np.ascontiguousarray(out).tobytes())
             else:

@@ -102,3 +102,28 @@ def test_eval_points_batch_endpoint_both_profiles(srv):
     with pytest.raises(urllib.error.HTTPError) as ei:
         _post(f"{srv}/v1/eval_points_batch?log_n=9&k=2&q=1", b"\x00")
     assert ei.value.code == 400
+
+
+def test_dcf_endpoints(srv):
+    from dpf_tpu.models import dcf as dcf_mod
+
+    log_n, k, q = 11, 3, 5
+    alphas = np.array([17, 900, 2047], dtype="<u8")
+    blob = _post(f"{srv}/v1/dcf_gen?log_n={log_n}&k={k}", alphas.tobytes())
+    kl = dcf_mod.key_len(log_n)
+    assert len(blob) == 2 * k * kl
+    xs = np.array(
+        [[a, max(int(a) - 1, 0), 0, (1 << log_n) - 1, int(a)] for a in alphas],
+        dtype="<u8",
+    )
+    halves = []
+    for h in (0, 1):
+        body = blob[h * k * kl : (h + 1) * k * kl] + xs.tobytes()
+        halves.append(
+            _post(f"{srv}/v1/dcf_eval_points?log_n={log_n}&k={k}&q={q}", body)
+        )
+    rec = (
+        np.frombuffer(halves[0], np.uint8) ^ np.frombuffer(halves[1], np.uint8)
+    ).reshape(k, q)
+    want = (xs < alphas[:, None]).astype(np.uint8)
+    np.testing.assert_array_equal(rec, want)
