@@ -330,13 +330,35 @@ def gen_interval_batch(
     return (ua, la, const_a), (ub, lb, const_b)
 
 
+def _concat_batches(a: DcfKeyBatch, b: DcfKeyBatch) -> DcfKeyBatch:
+    return DcfKeyBatch(
+        a.log_n,
+        np.concatenate([a.seeds, b.seeds]),
+        np.concatenate([a.ts, b.ts]),
+        np.concatenate([a.scw, b.scw]),
+        np.concatenate([a.tcw, b.tcw]),
+        np.concatenate([a.vcw, b.vcw]),
+        np.concatenate([a.fvcw, b.fvcw]),
+    )
+
+
 def eval_interval_points(ik, xs: np.ndarray) -> np.ndarray:
     """Evaluate interval shares at xs uint64[K, Q] -> uint8[K, Q]; ``ik``
     is one party's (upper, lower, const) triple from
-    :func:`gen_interval_batch`."""
-    upper, lower, const = ik
-    return (
-        eval_lt_points(upper, xs)
-        ^ eval_lt_points(lower, xs)
-        ^ const[:, None]
-    )
+    :func:`gen_interval_batch`.  Both gate sets evaluate in ONE device
+    launch (a fused 2K-key batch, built lazily and reused — its
+    device-resident operands amortize across calls)."""
+    upper, lower, const = ik[0], ik[1], ik[2]
+    xs = np.asarray(xs, dtype=np.uint64)
+    if xs.ndim != 2 or xs.shape[0] != upper.k:
+        raise ValueError("dcf: xs must be [K, Q]")
+    both = getattr(upper, "_interval_both", None)
+    if both is None:
+        both = _concat_batches(upper, lower)
+        try:
+            upper._interval_both = both
+        except AttributeError:
+            pass
+    bits = eval_lt_points(both, np.concatenate([xs, xs]))
+    k = upper.k
+    return bits[:k] ^ bits[k:] ^ const[:, None]
