@@ -117,6 +117,61 @@ def distribute_fast_batch(kb, mesh: Mesh):
     return tuple(out)
 
 
+def _compat_in_shardings(mesh: Mesh):
+    """NamedShardings matching _sharded_eval_full's in_specs (compat
+    profile: bit-plane tensors with the packed key-word axis LAST)."""
+    keyed = NamedSharding(mesh, P(None, None, KEYS_AXIS))
+    rowed = NamedSharding(mesh, P(None, KEYS_AXIS))
+    return (keyed, rowed, keyed, rowed, rowed, keyed)
+
+
+def distribute_compat_batch(kb, mesh: Mesh):
+    """Compat-profile analogue of :func:`distribute_fast_batch`: the
+    DeviceKeys plane tensors (models/dpf.DeviceKeys — packed 32 keys per
+    lane word) materialized shard-locally over the global mesh.  Returns
+    (args, k_padded)."""
+    from ..models.dpf import DeviceKeys
+
+    n_keys = mesh.shape[KEYS_AXIS]
+    dk = DeviceKeys(kb, pad_to=32 * n_keys)
+    host = (
+        np.asarray(dk.seed_planes), np.asarray(dk.t_words),
+        np.asarray(dk.scw_planes), np.asarray(dk.tl_words),
+        np.asarray(dk.tr_words), np.asarray(dk.fcw_planes),
+    )
+    out = []
+    for arr, sh in zip(host, _compat_in_shardings(mesh)):
+        out.append(
+            jax.make_array_from_callback(
+                arr.shape, sh, lambda idx, a=arr: a[idx]
+            )
+        )
+    return tuple(out)
+
+
+def eval_full_distributed_compat(
+    kb, mesh: Mesh, args=None, backend: str | None = None
+) -> np.ndarray:
+    """Compat-profile sharded full-domain evaluation from pre-distributed
+    plane operands -> uint8[K, out_bytes], fully materialized per process
+    (cross-host gather as in :func:`eval_full_distributed`)."""
+    from ..models.dpf import default_backend
+    from .sharding import _sharded_eval_full
+
+    if args is None:
+        args = distribute_compat_batch(kb, mesh)
+    backend = backend or default_backend()
+    c = leaf_axis_levels(mesh, kb.nu, kb.log_n)
+    fn = _sharded_eval_full(mesh, kb.nu, c, backend)
+    words = fn(*args)
+    if not words.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        words = multihost_utils.process_allgather(words, tiled=True)
+    words = np.asarray(words)
+    return np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)
+
+
 def eval_full_distributed(kb, mesh: Mesh, args=None) -> np.ndarray:
     """Sharded full-domain evaluation from pre-distributed operands ->
     uint8[K, out_bytes] of this batch's keys, fully materialized on every

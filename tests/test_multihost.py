@@ -56,3 +56,26 @@ def test_eval_full_distributed_matches_spec():
     bits = np.unpackbits(rec, axis=1, bitorder="little")[:, : 1 << log_n]
     assert (bits.sum(axis=1) == 1).all()
     assert (bits[np.arange(k), alphas.astype(np.int64)] == 1).all()
+
+
+def test_eval_full_distributed_compat_matches_spec():
+    from dpf_tpu.core import spec
+    from dpf_tpu.core.keys import gen_batch as gen_compat
+
+    mesh = _mesh_or_skip(4, 2)
+    rng = np.random.default_rng(42)
+    log_n, k = 10, 7
+    alphas = rng.integers(0, 1 << log_n, size=k, dtype=np.uint64)
+    ka, kb = gen_compat(alphas, log_n, rng=rng)
+    got = mh.eval_full_distributed_compat(ka, mesh)
+    want = np.stack(
+        [
+            np.frombuffer(spec.eval_full(b, log_n), np.uint8)
+            for b in ka.to_bytes()
+        ]
+    )
+    np.testing.assert_array_equal(got, want)
+    rec = got ^ mh.eval_full_distributed_compat(kb, mesh)
+    bits = np.unpackbits(rec, axis=1, bitorder="little")[:, : 1 << log_n]
+    assert (bits.sum(axis=1) == 1).all()
+    assert (bits[np.arange(k), alphas.astype(np.int64)] == 1).all()
