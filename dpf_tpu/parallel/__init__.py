@@ -8,6 +8,7 @@ from .sharding import (
     LEAF_AXIS,
     eval_full_sharded,
     eval_full_sharded_fast,
+    eval_lt_points_sharded,
     eval_points_sharded,
     eval_points_sharded_fast,
     make_mesh,
@@ -20,6 +21,7 @@ __all__ = [
     "multihost",
     "eval_full_sharded",
     "eval_full_sharded_fast",
+    "eval_lt_points_sharded",
     "eval_points_sharded",
     "eval_points_sharded_fast",
     "make_mesh",

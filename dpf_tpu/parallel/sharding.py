@@ -513,3 +513,97 @@ def eval_points_sharded_fast(kb, xs: np.ndarray, mesh: Mesh) -> np.ndarray:
     fn = _sharded_eval_points_fast(mesh, kb.nu, kb.log_n, qt)
     bits = np.asarray(fn(*padded.device_args(), xs_hi, xs_lo))
     return bits.T[:K, :Q]
+
+
+@cache
+def _sharded_dcf_points(mesh: Mesh, nu: int, log_n: int, qt: int):
+    """DCF comparison walk sharded over the ``keys`` axis (one key per
+    gate, models/dcf.py), via the whole-walk kernel's dcf mode per shard;
+    key-minor operands built in-graph like the DPF route above."""
+    from ..core import chacha_np as cc
+    from ..models.dpf_chacha import _eval_points_cc_body
+
+    def body(seeds, ts, scw, tcw, vcw, fvcw, xs_hi, xs_lo):
+        if not qt:
+            return _eval_points_cc_body(
+                nu, log_n, seeds, ts, scw, tcw, fvcw, xs_hi, xs_lo, 0, vcw
+            )
+        from ..ops import chacha_pallas as cp
+
+        k = seeds.shape[0]
+        meta = jnp.stack(
+            [
+                ts,
+                jnp.full((k,), log_n, jnp.uint32),
+                jnp.full((k,), cc.LEAF_BITS - 1, jnp.uint32),
+            ]
+        )
+        if nu:
+            scw_t = jnp.moveaxis(scw, 0, 2).reshape(4 * nu, k)
+            tcw_t = jnp.moveaxis(tcw, 0, 2).reshape(2 * nu, k)
+            vcw_t = vcw.T
+        else:
+            scw_t = jnp.zeros((4, k), jnp.uint32)
+            tcw_t = jnp.zeros((2, k), jnp.uint32)
+            vcw_t = jnp.zeros((1, k), jnp.uint32)
+        bits = cp._walk_raw(
+            meta, seeds.T, scw_t, tcw_t, fvcw.T, xs_lo, xs_hi,
+            log_n, nu, qt, vcw_t=vcw_t, dcf=True,
+        )
+        return bits.astype(jnp.uint8)
+
+    hi_spec = P(None, None) if (log_n <= 32 and not qt) else P(None, KEYS_AXIS)
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                P(KEYS_AXIS, None), P(KEYS_AXIS), P(KEYS_AXIS, None, None),
+                P(KEYS_AXIS, None, None), P(KEYS_AXIS, None),
+                P(KEYS_AXIS, None), hi_spec, P(None, KEYS_AXIS),
+            ),
+            out_specs=P(None, KEYS_AXIS),
+            check_vma=False,
+        )
+    )
+
+
+def eval_lt_points_sharded(kb, xs: np.ndarray, mesh: Mesh) -> np.ndarray:
+    """Sharded DCF comparison evaluation: xs uint64[K, Q] -> uint8[K, Q]
+    shares of ``1{x < alpha}``, one gate per key, key batch sharded over
+    the ``keys`` axis (zero cross-chip communication)."""
+    from ..models.dcf import DcfKeyBatch
+    from ..models.dpf_chacha import _split_queries
+    from ..ops import chacha_pallas as cp
+
+    xs = np.asarray(xs, dtype=np.uint64)
+    if xs.ndim != 2 or xs.shape[0] != kb.k:
+        raise ValueError("dcf: xs must be [K, Q]")
+    if (xs >> np.uint64(kb.log_n)).any():
+        raise ValueError("dcf: query index out of domain")
+    n_keys = mesh.shape[KEYS_AXIS]
+    K, Q = xs.shape
+    use_kernel = cp.points_backend() == "pallas"
+    quantum = n_keys * cp._KT if use_kernel else n_keys
+    pad = (-K) % quantum
+    if pad:
+        def padk(a):
+            return np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+
+        kb = DcfKeyBatch(
+            kb.log_n, padk(kb.seeds), padk(kb.ts), padk(kb.scw),
+            padk(kb.tcw), padk(kb.vcw), padk(kb.fvcw),
+        )
+        xs = np.concatenate([xs, np.zeros((pad, Q), np.uint64)])
+    pad_q = (-Q) % 8 if use_kernel else 0
+    if pad_q:
+        xs = np.concatenate(
+            [xs, np.zeros((xs.shape[0], pad_q), np.uint64)], axis=1
+        )
+    xs_hi, xs_lo = _split_queries(xs, kb.log_n)
+    qt = cp._qtile(xs_lo.shape[0]) if use_kernel else 0
+    if use_kernel and kb.log_n <= 32:
+        xs_hi = jnp.zeros((1, kb.k), jnp.uint32)  # never read
+    fn = _sharded_dcf_points(mesh, kb.nu, kb.log_n, qt)
+    bits = np.asarray(fn(*kb.device_args(), xs_hi, xs_lo))
+    return bits.T[:K, :Q]

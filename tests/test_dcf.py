@@ -118,3 +118,31 @@ def test_dcf_interval_reconstruction():
     rec = dcf.eval_interval_points(ia, xs) ^ dcf.eval_interval_points(ib, xs)
     want = ((xs >= lo[:, None]) & (xs <= hi[:, None])).astype(np.uint8)
     np.testing.assert_array_equal(rec, want)
+
+
+def test_dcf_sharded_matches_single(monkeypatch):
+    """Sharded DCF evaluation (keys axis) must match the single-chip result
+    through both per-shard routes (XLA and, with forced padding to the
+    kernel quantum, the Pallas dcf walk)."""
+    import jax
+
+    from dpf_tpu.parallel import eval_lt_points_sharded, make_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = make_mesh(4, 1, devices=jax.devices()[:4])
+    log_n, K, Q = 12, 10, 13
+    rng = np.random.default_rng(70)
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, kb = dcf.gen_lt_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    xs[:, 0] = alphas
+    monkeypatch.setenv("DPF_TPU_POINTS", "xla")
+    want = dcf.eval_lt_points(ka, xs)
+    got_xla = eval_lt_points_sharded(ka, xs, mesh)
+    np.testing.assert_array_equal(got_xla, want)
+    monkeypatch.setenv("DPF_TPU_POINTS", "pallas")
+    got_pl = eval_lt_points_sharded(ka, xs, mesh)  # K pads 10 -> 512
+    np.testing.assert_array_equal(got_pl, want)
+    rec = got_pl ^ eval_lt_points_sharded(kb, xs, mesh)
+    np.testing.assert_array_equal(rec, (xs < alphas[:, None]).astype(np.uint8))
