@@ -186,8 +186,15 @@ class PirServer:
     def _answer_fast(self, queries, n_chunks: int) -> np.ndarray:
         from .keys_chacha import KeyBatchFast
 
-        k_shards = 1 if self.mesh is None else self.mesh.shape[KEYS_AXIS]
-        pad = (-queries.k) % k_shards
+        if self.mesh is None:
+            k_shards, pad = 1, 0
+        else:
+            from ..parallel.sharding import _fast_pad_quantum
+
+            k_shards = self.mesh.shape[KEYS_AXIS]
+            pad = (-queries.k) % _fast_pad_quantum(
+                self.mesh, self.nu, self.subtree_levels
+            )
 
         def padk(a):
             return np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
@@ -202,8 +209,14 @@ class PirServer:
                 _pir_fast_entry_level(self.nu, padded.k),
             )
         else:
+            from ..parallel.sharding import _sharded_fast_entry_level
+
             fn = _pir_sharded_fast(
-                self.mesh, self.nu, self.subtree_levels, self.chunk_rows, n_chunks
+                self.mesh, self.nu, self.subtree_levels, self.chunk_rows,
+                n_chunks,
+                _sharded_fast_entry_level(
+                    self.nu, self.subtree_levels, padded.k // k_shards
+                ),
             )
         words = np.asarray(fn(*padded.device_args(), self.db_words))
         return (
@@ -322,14 +335,27 @@ def _pir_single_fast(nu: int, chunk_rows: int, n_chunks: int, entry: int = -1):
 
 @cache
 def _pir_sharded_fast(
-    mesh: Mesh, nu: int, subtree_levels: int, chunk_rows: int, n_chunks: int
+    mesh: Mesh, nu: int, subtree_levels: int, chunk_rows: int, n_chunks: int,
+    entry: int = -1,
 ):
     from ..parallel.sharding import expand_subtree_local_cc
-    from .dpf_chacha import _convert_leaves_cc
+    from .dpf_chacha import _convert_leaves_cc, _finish_pk
 
     def body(seeds, ts, scw, tcw, fcw, db_words):
-        S, T = expand_subtree_local_cc(seeds, ts, scw, tcw, nu, subtree_levels)
-        leaves = _convert_leaves_cc(S, T, [fcw[:, j] for j in range(16)])
+        if entry < 0:
+            S, T = expand_subtree_local_cc(
+                seeds, ts, scw, tcw, nu, subtree_levels
+            )
+            leaves = _convert_leaves_cc(S, T, [fcw[:, j] for j in range(16)])
+        else:  # VMEM expand kernel per shard (same route as eval_full)
+            from ..ops.chacha_pallas import cw_operands
+
+            S, T = expand_subtree_local_cc(
+                seeds, ts, scw, tcw, entry, subtree_levels
+            )
+            leaves = _finish_pk(
+                nu, entry, S, T, *cw_operands(scw, tcw, fcw, entry, nu)
+            )
         sel = leaves.reshape(leaves.shape[0], -1)
         part = _parity_matmul(sel, db_words, chunk_rows, n_chunks)
         return xor_allreduce(part, LEAF_AXIS)

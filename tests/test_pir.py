@@ -148,3 +148,24 @@ def test_pir_fast_profile_kernel_path(monkeypatch):
     monkeypatch.setenv("DPF_TPU_FAST", "xla")
     srv2 = PirServer(db, profile="fast")
     np.testing.assert_array_equal(ans_a, srv2.answer(qa))
+
+
+def test_pir_sharded_fast_kernel_route(monkeypatch):
+    """Force the VMEM expand kernel inside the SHARDED fast PIR graph
+    (interpreter mode off-TPU) and check against the XLA route."""
+    monkeypatch.setenv("DPF_TPU_FAST", "xla")
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = make_mesh(2, 2, devices=jax.devices()[:4])
+    rng = np.random.default_rng(29)
+    n_rows, row_bytes, K = 1 << 17, 4, 3  # nu=8, leaf axis c=1 -> entry 8
+    db = rng.integers(0, 256, size=(n_rows, row_bytes), dtype=np.uint8)
+    idx = rng.integers(0, n_rows, size=K, dtype=np.uint64)
+    qa, qb = pir_query(idx, n_rows, rng=rng, profile="fast")
+    want_a = PirServer(db, mesh=mesh, profile="fast").answer(qa)
+    monkeypatch.setenv("DPF_TPU_FAST", "pallas")
+    srv = PirServer(db, mesh=mesh, profile="fast")
+    got_a = srv.answer(qa)  # K pads 3 -> 16 (2 shards x 8)
+    np.testing.assert_array_equal(got_a, want_a)
+    rows = pir_reconstruct(got_a, srv.answer(qb))
+    np.testing.assert_array_equal(rows, db[idx.astype(np.int64)])
