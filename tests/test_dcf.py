@@ -146,3 +146,32 @@ def test_dcf_sharded_matches_single(monkeypatch):
     np.testing.assert_array_equal(got_pl, want)
     rec = got_pl ^ eval_lt_points_sharded(kb, xs, mesh)
     np.testing.assert_array_equal(rec, (xs < alphas[:, None]).astype(np.uint8))
+
+
+# Frozen wire-format vectors: deterministic-seed Gen must reproduce these
+# key-blob and spec-evaluation hashes byte-for-byte.  They pin the
+# serialized DCF key layout (dcf.py module docstring) against accidental
+# drift — stored gate keys must stay readable across refactors.
+_FROZEN = [
+    (8, 1, "14acbe434df26160be9ebe65c55f017d341127bca3a1c64562b3459833d96e4a",
+     "29b9e2fda6decd2b3322bc2f16980a65bea98d0f53b4a6f9e20f571dc0e84c54"),
+    (20, 2, "67a22b1b7fe0b965faf51ddeb97731dbe180c91e2969b334d180e42c0464eea4",
+     "ca31a30f1b250dbfc89be5207766096a51b2e27ef7095d65c0a088a6359c1db4"),
+    (33, 3, "484813746b5c80b7032f2bf4dc01a69f512d8b633db5ef7cca7aad5e375d267c",
+     "75fcce774cba9a4ce5a3c12674fc8deeb08e8af3c9eec9e1c0179d6a0e8ba1a5"),
+]
+
+
+@pytest.mark.parametrize("log_n,seed,key_sha,out_sha", _FROZEN)
+def test_dcf_golden_vectors(log_n, seed, key_sha, out_sha):
+    import hashlib
+
+    rng = np.random.default_rng(seed)
+    alphas = rng.integers(0, 1 << log_n, size=3, dtype=np.uint64)
+    ka, _ = dcf.gen_lt_batch(
+        alphas, log_n, rng=np.random.default_rng(seed + 100)
+    )
+    assert hashlib.sha256(b"".join(ka.to_bytes())).hexdigest() == key_sha
+    xs = rng.integers(0, 1 << log_n, size=(3, 8), dtype=np.uint64)
+    bits = dcf.eval_points_np(ka, xs)
+    assert hashlib.sha256(bits.tobytes()).hexdigest() == out_sha
