@@ -172,6 +172,54 @@ def eval_full_distributed_compat(
     return np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)
 
 
+def distribute_dcf_batch(kb, mesh: Mesh, qt_hint: int = 0):
+    """DCF analogue of :func:`distribute_fast_batch`: one comparison gate
+    per key, sharded over the ``keys`` axis.  Pads the gate count to the
+    sharded evaluator's quantum (the walk kernel's 128-key lane tile per
+    shard when the kernel route is on).  Returns (args, padded_k)."""
+    from ..models.dcf import DcfKeyBatch
+    from ..ops import chacha_pallas as cp
+
+    n_keys = mesh.shape[KEYS_AXIS]
+    use_kernel = cp.points_backend() == "pallas"
+    quantum = n_keys * cp._KT if use_kernel else n_keys
+    pad = (-kb.k) % quantum
+    if pad:
+
+        def padk(a):
+            return np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+
+        kb = DcfKeyBatch(
+            kb.log_n, padk(kb.seeds), padk(kb.ts), padk(kb.scw),
+            padk(kb.tcw), padk(kb.vcw), padk(kb.fvcw),
+        )
+    host = (
+        np.asarray(kb.seeds),
+        np.asarray(kb.ts, dtype=np.uint32),
+        np.asarray(kb.scw),
+        np.asarray(kb.tcw, dtype=np.uint32),
+        np.asarray(kb.vcw, dtype=np.uint32),
+        np.asarray(kb.fvcw),
+    )
+    keys2 = NamedSharding(mesh, P(KEYS_AXIS, None))
+    shardings = (
+        keys2,
+        NamedSharding(mesh, P(KEYS_AXIS)),
+        NamedSharding(mesh, P(KEYS_AXIS, None, None)),
+        NamedSharding(mesh, P(KEYS_AXIS, None, None)),
+        keys2,
+        keys2,
+    )
+    out = []
+    for arr, sh in zip(host, shardings):
+        out.append(
+            jax.make_array_from_callback(
+                arr.shape, sh, lambda idx, a=arr: a[idx]
+            )
+        )
+    return tuple(out), kb.k
+
+
 def eval_full_distributed(kb, mesh: Mesh, args=None) -> np.ndarray:
     """Sharded full-domain evaluation from pre-distributed operands ->
     uint8[K, out_bytes] of this batch's keys, fully materialized on every
@@ -190,6 +238,49 @@ def eval_full_distributed(kb, mesh: Mesh, args=None) -> np.ndarray:
         words = multihost_utils.process_allgather(words, tiled=True)
     words = np.asarray(words)
     return np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)
+
+
+def eval_lt_points_distributed(kb, mesh: Mesh, xs, args=None) -> np.ndarray:
+    """Distributed DCF comparison evaluation: xs uint64[K, Q] -> uint8
+    [K, Q] shares of ``1{x < alpha}``.  Queries are placed shard-locally
+    with their gates (each host materializes only its own columns of the
+    transposed query tensor); results gather per process as in
+    :func:`eval_full_distributed`."""
+    from ..ops import chacha_pallas as cp
+    from .sharding import _sharded_dcf_points
+
+    xs = np.asarray(xs, dtype=np.uint64)
+    if xs.ndim != 2 or xs.shape[0] != kb.k:
+        raise ValueError("dcf: xs must be [K, Q]")
+    if (xs >> np.uint64(kb.log_n)).any():
+        raise ValueError("dcf: query index out of domain")
+    if args is None:
+        args = distribute_dcf_batch(kb, mesh)
+    ops, kp = args
+    K, Q = xs.shape
+    use_kernel = cp.points_backend() == "pallas"
+    xs_t = np.zeros((Q + ((-Q) % 8 if use_kernel else 0), kp), np.uint64)
+    xs_t[:Q, :K] = xs.T
+    qsh = NamedSharding(mesh, P(None, KEYS_AXIS))
+
+    def place(a):
+        return jax.make_array_from_callback(
+            a.shape, qsh, lambda idx, arr=a: arr[idx]
+        )
+
+    xs_lo = place((xs_t & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    if kb.log_n > 32:
+        xs_hi = place((xs_t >> np.uint64(32)).astype(np.uint32))
+    else:
+        xs_hi = place(np.zeros((1, kp), np.uint32))  # never read
+    qt = cp._qtile(xs_t.shape[0]) if use_kernel else 0
+    fn = _sharded_dcf_points(mesh, kb.nu, kb.log_n, qt)
+    bits = fn(*ops, xs_hi, xs_lo)
+    if not bits.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        bits = multihost_utils.process_allgather(bits, tiled=True)
+    return np.asarray(bits).T[:K, :Q]
 
 
 def eval_full_distributed_device(kb, mesh: Mesh, args=None):

@@ -79,3 +79,20 @@ def test_eval_full_distributed_compat_matches_spec():
     bits = np.unpackbits(rec, axis=1, bitorder="little")[:, : 1 << log_n]
     assert (bits.sum(axis=1) == 1).all()
     assert (bits[np.arange(k), alphas.astype(np.int64)] == 1).all()
+
+
+def test_eval_lt_points_distributed_matches():
+    from dpf_tpu.models import dcf
+
+    mesh = _mesh_or_skip(4, 1)
+    rng = np.random.default_rng(43)
+    log_n, k, q = 14, 10, 13
+    alphas = rng.integers(0, 1 << log_n, size=k, dtype=np.uint64)
+    ka, kb_ = dcf.gen_lt_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(k, q), dtype=np.uint64)
+    xs[:, 0] = alphas
+    got = mh.eval_lt_points_distributed(ka, mesh, xs)
+    want = dcf.eval_lt_points(ka, xs)
+    np.testing.assert_array_equal(got, want)
+    rec = got ^ mh.eval_lt_points_distributed(kb_, mesh, xs)
+    np.testing.assert_array_equal(rec, (xs < alphas[:, None]).astype(np.uint8))
