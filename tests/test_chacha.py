@@ -178,3 +178,20 @@ def test_sharded_fast_kernel_route_matches(monkeypatch):
     bits = np.unpackbits(rec, axis=1, bitorder="little")[:, : 1 << log_n]
     assert (bits.sum(axis=1) == 1).all()
     assert (bits[np.arange(K), alphas.astype(np.int64)] == 1).all()
+
+
+def test_fast_pointwise_max_domain_log_n_63():
+    """Domain limit edge for the fast profile's pointwise walk (both the
+    high/low index split and the in-leaf select at 63-bit indices)."""
+    log_n = 63
+    rng = np.random.default_rng(63)
+    alphas = rng.integers(0, 1 << log_n, size=2, dtype=np.uint64)
+    ka, kb = kc.gen_batch(alphas, log_n, rng=rng)
+    xs = np.stack(
+        [
+            np.array([0, a, a ^ 1, (1 << 63) - 1], np.uint64)
+            for a in alphas
+        ]
+    )
+    rec = dc.eval_points(ka, xs) ^ dc.eval_points(kb, xs)
+    np.testing.assert_array_equal(rec, (xs == alphas[:, None]).astype(np.uint8))

@@ -175,3 +175,20 @@ def test_dcf_golden_vectors(log_n, seed, key_sha, out_sha):
     xs = rng.integers(0, 1 << log_n, size=(3, 8), dtype=np.uint64)
     bits = dcf.eval_points_np(ka, xs)
     assert hashlib.sha256(bits.tobytes()).hexdigest() == out_sha
+
+
+def test_dcf_max_domain_log_n_63():
+    """The reference's documented domain limit (dpf/dpf.go:72, log_n <= 63):
+    descent-bit extraction must be correct through the full uint64 range."""
+    log_n = 63
+    rng = np.random.default_rng(63)
+    alphas = rng.integers(0, 1 << log_n, size=2, dtype=np.uint64)
+    ka, kb = dcf.gen_lt_batch(alphas, log_n, rng=rng)
+    xs = np.stack(
+        [
+            np.array([0, a - 1 if a else 0, a, a + 1, (1 << 63) - 1], np.uint64)
+            for a in alphas
+        ]
+    )
+    rec = dcf.eval_lt_points(ka, xs) ^ dcf.eval_lt_points(kb, xs)
+    np.testing.assert_array_equal(rec, (xs < alphas[:, None]).astype(np.uint8))
