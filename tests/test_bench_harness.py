@@ -35,3 +35,39 @@ def test_bench_emits_one_json_line_on_infra_failure():
     assert rec["infra"] is True
     assert rec["value"] == 0
     assert "unit" in rec and "vs_baseline" in rec and "detail" in rec
+
+
+def test_bench_watchdog_converts_hang_to_infra_record():
+    """A wedged device tunnel HANGS (it does not error); the parent
+    watchdog must kill the child at the deadline and still emit exactly
+    one structured infra record."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("DPF_TPU_BENCH_CHILD", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DPF_TPU_BENCH_TIMEOUT"] = "3"
+    # Simulate the hang: make the child block before any measurement by
+    # pointing its entry at a sleep via sitecustomize on PYTHONPATH.
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        with open(os.path.join(td, "sitecustomize.py"), "w") as f:
+            f.write(
+                "import os, time\n"
+                "if os.environ.get('DPF_TPU_BENCH_CHILD'):\n"
+                "    time.sleep(60)\n"
+            )
+        env["PYTHONPATH"] = td + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env,
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert rec["infra"] is True and "timed out" in rec["detail"]
