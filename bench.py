@@ -266,20 +266,66 @@ def _infra_record(detail: str) -> str:
     )
 
 
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 def _watchdog_main() -> None:
     """Parent-process watchdog: a WEDGED device tunnel doesn't error — it
     HANGS inside the first device call (observed live: ``jax.devices()``
     blocks indefinitely when the axon tunnel drops mid-session), which no
     try/except can catch.  Running the measurement in a child with a hard
-    timeout is the only way to guarantee the one-JSON-line contract."""
-    try:
-        timeout = float(os.environ.get("DPF_TPU_BENCH_TIMEOUT", "2700"))
-    except ValueError:
-        timeout = 2700.0
+    timeout is the only way to guarantee the one-JSON-line contract.
+
+    Two children, one total budget:
+      1. a PROBE that only imports jax and lists devices — a wedged tunnel
+         is detected in ~2-4 minutes instead of only at the full deadline
+         (healthy ``jax.devices()`` takes ~10-20 s; the probe is retried
+         once so a single slow-but-healthy init can't abort the run);
+      2. the measurement itself, with the probe's elapsed time DEDUCTED so
+         total wall time is bounded by DPF_TPU_BENCH_TIMEOUT alone (default
+         900 s — a healthy warm-cache run takes minutes, and r03 showed a
+         2700 s cap can exceed the caller's own budget, producing an empty
+         record where the caller's kill wins the race).
+    """
+    timeout = _env_float("DPF_TPU_BENCH_TIMEOUT", 900.0)
+    probe_timeout = _env_float("DPF_TPU_BENCH_PROBE_TIMEOUT", 120.0)
     import subprocess
 
     env = dict(os.environ)
     env["DPF_TPU_BENCH_CHILD"] = "1"
+
+    if probe_timeout > 0:
+        penv = dict(os.environ)
+        penv.pop("DPF_TPU_BENCH_CHILD", None)
+        penv["DPF_TPU_BENCH_PROBE"] = "1"
+        t_probe0 = time.perf_counter()
+        hung = 0
+        for _ in range(2):
+            try:
+                subprocess.run(
+                    [sys.executable, "-c", "import jax; jax.devices()"],
+                    env=penv,
+                    capture_output=True,
+                    text=True,
+                    timeout=probe_timeout,
+                )
+                break
+            except subprocess.TimeoutExpired:
+                hung += 1
+        if hung >= 2:
+            print(_infra_record(
+                f"device probe (jax.devices()) hung past {probe_timeout:.0f}s"
+                " twice — wedged device tunnel"
+            ))
+            return
+        # A probe that *errors* (rather than hangs) falls through: the
+        # measurement child retries with backoff and degrades to its own
+        # structured infra record if the backend stays unusable.
+        timeout = max(60.0, timeout - (time.perf_counter() - t_probe0))
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],

@@ -71,3 +71,43 @@ def test_bench_watchdog_converts_hang_to_infra_record():
     assert len(lines) == 1, proc.stdout
     rec = json.loads(lines[0])
     assert rec["infra"] is True and "timed out" in rec["detail"]
+
+
+def test_bench_probe_detects_wedged_tunnel_fast():
+    """The probe child (import jax; jax.devices()) must convert a wedged
+    tunnel into an infra record within the PROBE timeout — minutes, not
+    the full measurement deadline."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("DPF_TPU_BENCH_CHILD", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DPF_TPU_BENCH_PROBE_TIMEOUT"] = "3"
+    # Generous full deadline: the point is that the probe fires first.
+    env["DPF_TPU_BENCH_TIMEOUT"] = "600"
+    import tempfile
+    import time
+
+    with tempfile.TemporaryDirectory() as td:
+        with open(os.path.join(td, "sitecustomize.py"), "w") as f:
+            f.write(
+                "import os, time\n"
+                "if os.environ.get('DPF_TPU_BENCH_PROBE'):\n"
+                "    time.sleep(60)\n"
+            )
+        env["PYTHONPATH"] = td + os.pathsep + env.get("PYTHONPATH", "")
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env,
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        elapsed = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    assert rec["infra"] is True and "probe" in rec["detail"]
+    assert elapsed < 60, f"probe path took {elapsed:.0f}s"
