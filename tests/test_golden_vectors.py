@@ -79,12 +79,39 @@ def test_golden_vectors_second_sourced_by_native_backend():
 
 
 def test_fixed_prf_round_keys_frozen():
-    # The two fixed PRF keys' expanded round keys, as baked into kernels.
+    """The two fixed PRF keys' expanded round keys, as baked into kernels.
+
+    Digests are HARDCODED (generated once from the FIPS-197-pinned key
+    schedule, cross-checked by the AES-NI path's test vectors): a bug
+    introduced into ``expand_key`` must fail here, so the assertion cannot
+    be the same computation on both sides."""
     from dpf_tpu.core import aes_np
 
     assert (
         hashlib.sha256(aes_np.ROUND_KEYS_L.tobytes()).hexdigest()
-        == hashlib.sha256(aes_np.expand_key(aes_np.PRF_KEY_L).tobytes()).hexdigest()
+        == "90a19e8650087b6632b242ae24152db668967c199eda800f288904ad0066095f"
     )
+    assert (
+        hashlib.sha256(aes_np.ROUND_KEYS_R.tobytes()).hexdigest()
+        == "6e22a9bb11ff3d924ab54e5eb4047d7bbf8053193a47e6ab062919043e90e317"
+    )
+    assert aes_np.ROUND_KEYS_L.shape == (11, 16)
     assert aes_np.ROUND_KEYS_L[0].tobytes() == aes_np.PRF_KEY_L
     assert aes_np.ROUND_KEYS_R[0].tobytes() == aes_np.PRF_KEY_R
+
+
+def test_fixed_prf_round_key_masks_frozen():
+    """The bit-plane packing of the round keys (round_key_masks), as
+    broadcast into every bitsliced kernel — frozen the same way, so a
+    packing change (bit order, plane order) fails loudly."""
+    from dpf_tpu.ops import aes_bitslice as ab
+
+    assert ab.RK_MASKS_L.shape == (11, 128) and ab.RK_MASKS_L.dtype == np.uint32
+    assert (
+        hashlib.sha256(ab.RK_MASKS_L.tobytes()).hexdigest()
+        == "8da39593d02dc7bfe5fc8396b16eb9eaab9a6ab857d0e804f438d8450b9d49e0"
+    )
+    assert (
+        hashlib.sha256(ab.RK_MASKS_R.tobytes()).hexdigest()
+        == "06fd98cff6a50e28cd8c2a80e4af56000293bec411d43524b7172d95f81724df"
+    )
