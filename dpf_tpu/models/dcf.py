@@ -352,11 +352,15 @@ def eval_interval_points(ik, xs: np.ndarray) -> np.ndarray:
     xs = np.asarray(xs, dtype=np.uint64)
     if xs.ndim != 2 or xs.shape[0] != upper.k:
         raise ValueError("dcf: xs must be [K, Q]")
-    both = getattr(upper, "_interval_both", None)
-    if both is None:
+    # The memo is keyed on the *pair*: reusing a fused batch built against a
+    # different lower half would silently return wrong interval shares.
+    cached = getattr(upper, "_interval_both", None)
+    if cached is not None and cached[0] is lower:
+        both = cached[1]
+    else:
         both = _concat_batches(upper, lower)
         try:
-            upper._interval_both = both
+            upper._interval_both = (lower, both)
         except AttributeError:
             pass
     bits = eval_lt_points(both, np.concatenate([xs, xs]))

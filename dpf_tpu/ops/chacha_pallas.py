@@ -199,6 +199,13 @@ def _walk_raw(
     vcw_t=None, dcf=False,
 ):
     Q, K = xs_lo.shape
+    # Callers are responsible for padding; an indivisible shape here would
+    # silently run a truncated (or empty) grid and return wrong shares.
+    if K % _KT != 0 or qt <= 0 or Q % qt != 0:
+        raise ValueError(
+            f"_walk_raw needs K % {_KT} == 0 and Q % qt == 0, "
+            f"got K={K}, Q={Q}, qt={qt} (caller padding mismatch)"
+        )
     if vcw_t is None:  # never read when dcf=False
         vcw_t = jnp.zeros((1, K), jnp.uint32)
     qspec = pl.BlockSpec((qt, _KT), lambda q, k: (q, k))

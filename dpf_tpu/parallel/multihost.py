@@ -172,7 +172,7 @@ def eval_full_distributed_compat(
     return np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)
 
 
-def distribute_dcf_batch(kb, mesh: Mesh, qt_hint: int = 0):
+def distribute_dcf_batch(kb, mesh: Mesh):
     """DCF analogue of :func:`distribute_fast_batch`: one comparison gate
     per key, sharded over the ``keys`` axis.  Pads the gate count to the
     sharded evaluator's quantum (the walk kernel's 128-key lane tile per
