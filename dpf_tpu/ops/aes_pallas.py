@@ -24,6 +24,7 @@ Mosaic path is in use.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -38,7 +39,7 @@ from .aes_bitslice import (
     aes128_mmo_planes,
     prg_planes,
 )
-from .sbox_circuit import sbox_bp113
+from .sbox_circuit import sbox_bp113, sbox_bp113_lowlive
 
 # Lane tile.  128 lanes measured ~2x faster than 256 END-TO-END at the
 # headline config (scripts/bench_compat_ab.py on v5e: 22.9 vs 11.7
@@ -144,15 +145,28 @@ def _permute_rows(S, perm):
 # spilling to VMEM.  Selected by end-to-end A/B (scripts/bench_compat_ab).
 _SBOX_SPLIT = True
 
+# S-box circuit inside the bit-major kernels: "bp113" (113 gates, peak
+# 29 live values under emission order) or "lowlive" (the register-budgeted
+# rematerializing schedule — 156 ops, peak 24; see sbox_circuit and
+# scripts/sbox_liveness.py).  Selected by end-to-end A/B on hardware;
+# DPF_TPU_SBOX overrides for experiments.
+_SBOX_IMPLS = {"bp113": sbox_bp113, "lowlive": sbox_bp113_lowlive}
+_SBOX = os.environ.get("DPF_TPU_SBOX", "bp113")
+if _SBOX not in _SBOX_IMPLS:
+    raise ValueError(
+        f"DPF_TPU_SBOX={_SBOX!r} unknown; choose from {sorted(_SBOX_IMPLS)}"
+    )
+
 
 def _sub_bytes_bm(S):
+    sbox = _SBOX_IMPLS[_SBOX]
     s = S.reshape(8, 16, -1)
     if not _SBOX_SPLIT:
-        y = sbox_bp113([s[7 - i] for i in range(8)])  # circuit is MSB-first
+        y = sbox([s[7 - i] for i in range(8)])  # circuit is MSB-first
         return jnp.concatenate(y[::-1]).reshape(128, -1)
     outs = []
     for h in (0, 8):
-        y = sbox_bp113([s[7 - i, h : h + 8] for i in range(8)])
+        y = sbox([s[7 - i, h : h + 8] for i in range(8)])
         outs.append(jnp.stack(y[::-1]))  # [8, 8, B]
     return jnp.concatenate(outs, axis=1).reshape(128, -1)
 

@@ -151,6 +151,184 @@ def sbox_bp113(x):
     return [s0, s1, s2, s3, s4, s5, s6, s7]
 
 
+def sbox_bp113_lowlive(x):
+    """Forward AES S-box, register-budgeted schedule: same GF(2^4) tower
+    math as :func:`sbox_bp113`, restructured for a small live set.
+
+    Rationale (measured with scripts/sbox_liveness.py): the plain BP113
+    transcription peaks at 29 live values (36 with the 8 inputs pinned)
+    because its 22 shared y-signals each have one consumer in the early
+    t-products and one in the z-products ~70 gates later, so they stay
+    live across the entire nonlinear middle section.  On the TPU VPU each
+    live value is a vector register (an (8, 128) vreg in the split
+    bit-major kernel); a cut that size spills to VMEM and the kernel runs
+    at a third of the chip's demonstrated uint32 op rate
+    (README "working set" analysis).
+
+    This schedule rematerializes the y-signals instead of holding them —
+    the Käsper-Schwabe register-budget idea (CHES 2009), rederived for a
+    3-operand SSA target so the budget shows up as DAG width rather than
+    explicit register moves:
+
+      phase A: t-products, consuming freshly computed y's; carries only
+               t21..t24 forward,
+      phase B: the GF(2^4) inversion core (working set ~10),
+      phase C: z-products with each y recomputed from the inputs via
+               short XOR identities (e.g. y15 = x0^x3^x4^x6,
+               y11 = y16^t0, y10 = y11^y17), interleaved with the shared
+               output-XOR tree so each z dies within a few gates.
+
+    ~36 extra XORs (149 ops vs 113) buy a peak of 17 live values (25
+    inputs-pinned) — recomputation is issue-rate-cheap, spills are not.
+    Exhaustively verified against the from-first-principles table in
+    tests/test_aes_bitslice.py alongside the other circuits.
+    """
+    (x0, x1, x2, x3, x4, x5, x6, x7) = x
+
+    # --- phase A: shared-signal products, y's computed on demand --------
+    y13 = x0 ^ x6
+    y14 = x3 ^ x5
+    y12 = y13 ^ y14
+    y15 = (y12 ^ x4) ^ x5
+    t2 = y12 & y15
+    t0 = x1 ^ x2
+    y8 = x0 ^ x5
+    y6 = y15 ^ x7
+    y3 = (t0 ^ y8) ^ (x6 ^ x7)
+    t3 = y3 & y6
+    t4 = t3 ^ t2
+    y1 = t0 ^ x7
+    y4 = y1 ^ x3
+    t5 = y4 & x7
+    t6 = t5 ^ t2
+    y16 = (x2 ^ x6) ^ (x4 ^ x5)
+    t7 = y13 & y16
+    y5 = y1 ^ x6
+    t8 = y5 & y1
+    t9 = t8 ^ t7
+    y11 = y16 ^ t0
+    y2 = y1 ^ x0
+    y7 = y11 ^ x7
+    t10 = y2 & y7
+    t11 = t10 ^ t7
+    y9 = x0 ^ x3
+    t12 = y9 & y11
+    y17 = y14 ^ (x0 ^ x2)
+    t13 = y14 & y17
+    t14 = t13 ^ t12
+    y10 = y11 ^ y17
+    t15 = y8 & y10
+    t16 = t15 ^ t12
+    t17 = t4 ^ t14
+    t18 = t6 ^ t16
+    t19 = t9 ^ t14
+    t20 = t11 ^ t16
+    y20 = y11 ^ y9
+    t21 = t17 ^ y20
+    y19 = y16 ^ (x1 ^ x3)
+    t22 = t18 ^ y19
+    y18 = x0 ^ y16
+    t24 = t20 ^ y18
+    y21 = y18 ^ x6
+    t23 = t19 ^ y21
+
+    # --- phase B: GF(2^4) inversion core (identical to BP113) ----------
+    t25 = t21 ^ t22
+    t26 = t21 & t23
+    t27 = t24 ^ t26
+    t28 = t25 & t27
+    t29 = t28 ^ t22
+    t30 = t23 ^ t24
+    t31 = t22 ^ t26
+    t32 = t31 & t30
+    t33 = t32 ^ t24
+    t34 = t23 ^ t33
+    t35 = t27 ^ t33
+    t36 = t24 & t35
+    t37 = t36 ^ t34
+    t38 = t27 ^ t36
+    t39 = t29 & t38
+    t40 = t25 ^ t39
+    t41 = t40 ^ t37
+    t42 = t29 ^ t33
+    t43 = t29 ^ t40
+    t44 = t33 ^ t37
+    t45 = t42 ^ t41
+
+    # --- phase C: z-products with rematerialized y's, streamed into the
+    # shared output tree (t46..t67 exactly as in BP113, reordered so each
+    # z dies within a few gates of its creation) -------------------------
+    c_t0 = x1 ^ x2
+    c_y16 = (x2 ^ x6) ^ (x4 ^ x5)
+    c_y11 = c_y16 ^ c_t0
+    z6 = t42 & c_y11
+    c_y9 = x0 ^ x3
+    z15 = t42 & c_y9
+    c_y14 = x3 ^ x5
+    z16 = t45 & c_y14
+    c_y17 = c_y14 ^ (x0 ^ x2)
+    z7 = t45 & c_y17
+    t46 = z15 ^ z16
+    t54 = z6 ^ z7
+    c_y10 = c_y11 ^ c_y17
+    z8 = t41 & c_y10
+    c_y8 = x0 ^ x5
+    z17 = t41 & c_y8
+    t52 = z7 ^ z8
+    t55 = z16 ^ z17
+    c_y7 = c_y11 ^ x7
+    z5 = t29 & c_y7
+    c_y1 = c_t0 ^ x7
+    c_y2 = c_y1 ^ x0
+    z14 = t29 & c_y2
+    z4 = t40 & c_y1
+    c_y5 = c_y1 ^ x6
+    z13 = t40 & c_y5
+    t48 = z5 ^ z13
+    t58 = z4 ^ t46
+    z2 = t33 & x7
+    c_y4 = c_y1 ^ x3
+    z11 = t33 & c_y4
+    t51 = z2 ^ z5
+    c2_y16 = (x2 ^ x6) ^ (x4 ^ x5)  # remat: frees c_y16's 40-gate hold
+    z3 = t43 & c2_y16
+    c_y13 = x0 ^ x6
+    z12 = t43 & c_y13
+    t50 = z2 ^ z12
+    t56 = z12 ^ t48
+    t59 = z3 ^ t54
+    t64 = z4 ^ t59
+    c_y15 = (x0 ^ x3) ^ (x4 ^ x6)
+    z0 = t44 & c_y15
+    c_y12 = (c_y15 ^ x4) ^ x5
+    z9 = t44 & c_y12
+    t53 = z0 ^ z3
+    t57 = t50 ^ t53
+    t60 = t46 ^ t57
+    t61 = z14 ^ t57
+    s7 = ~(t48 ^ t60)
+    c_y6 = c_y15 ^ x7
+    z1 = t37 & c_y6
+    c_y3 = ((x0 ^ x1) ^ (x2 ^ x5)) ^ (x6 ^ x7)  # remat, not c_y5^c_y8
+    z10 = t37 & c_y3
+    t47 = z10 ^ z11
+    t49 = z9 ^ z10
+    t62 = t52 ^ t58
+    t63 = t49 ^ t58
+    t65 = t61 ^ t62
+    t66 = z1 ^ t63
+    s0 = t59 ^ t63
+    s6 = ~(t56 ^ t62)
+    t67 = t64 ^ t65
+    s3 = t53 ^ t66
+    s4 = t51 ^ t66
+    s5 = t47 ^ t65
+    s1 = ~(t64 ^ s3)
+    s2 = ~(t55 ^ t67)
+
+    return [s0, s1, s2, s3, s4, s5, s6, s7]
+
+
 # ---------------------------------------------------------------------------
 # Fallback circuit derived from first principles: inversion in GF(2^8) via a
 # square-and-multiply addition chain for x^254, with bitsliced schoolbook

@@ -5,8 +5,11 @@ chip shows distinct per-process performance modes); this times the REAL
 chained eval_full graph (same method as bench.py) under different knobs:
 
     python scripts/bench_compat_ab.py pallas:256 pallas:512 xla
+    python scripts/bench_compat_ab.py pallas_bm:128:bp113 pallas_bm:128:lowlive
 
-Each arg is backend[:BT].  Prints Gleaves/s per variant.
+Each arg is backend[:BT[:sbox]] (sbox: bp113 | lowlive).  Prints Gleaves/s
+per variant.  Variants run interleaved-in-one-process so the shared
+device's contention swings hit all of them alike.
 """
 
 import sys
@@ -59,6 +62,8 @@ def main():
         backend = parts[0]
         if len(parts) > 1:
             aes_pallas._BT = int(parts[1])
+        if len(parts) > 2:
+            aes_pallas._SBOX = parts[2]
         jax.clear_caches()
         f1, f3 = chained(1, backend), chained(3, backend)
         np.asarray(f1(*args))

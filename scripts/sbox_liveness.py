@@ -1,0 +1,101 @@
+"""Offline liveness analysis of the bitsliced S-box circuits.
+
+Traces a circuit function (sbox_circuit-style: 8 planes in, 8 out, ops
+``^ & ~``) with a recording value type, then reports:
+
+  - op counts (AND/XOR/NOT),
+  - the max live-value cut under the emission order (the SSA schedule a
+    compiler's list scheduler starts from),
+  - the cut profile (live count after each op).
+
+The "live set" here counts circuit VALUES (inputs + temps still needed);
+in the split bit-major kernel each value is one (8,128) vreg, so the cut
+is directly comparable to the register file size.  This is the tool used
+to design the register-budgeted schedule (sbox_bp113_lowlive): the BP113
+transcription's natural cut is far above a Käsper-Schwabe-style budget
+because the 22 shared y-signals stay live across the whole middle
+section (each has one consumer in the t-products and one in the
+z-products ~70 gates later).
+"""
+
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+class Rec:
+    """Recording operand: building block for tracing the circuit DAG."""
+
+    __slots__ = ("idx",)
+    trace: list = []  # (op, in_idxs) per node; inputs are op None
+
+    def __init__(self, op, ins):
+        self.idx = len(Rec.trace)
+        Rec.trace.append((op, ins))
+
+    def __xor__(self, o):
+        return Rec("xor", (self.idx, o.idx))
+
+    def __and__(self, o):
+        return Rec("and", (self.idx, o.idx))
+
+    def __or__(self, o):
+        return Rec("or", (self.idx, o.idx))
+
+    def __invert__(self):
+        return Rec("not", (self.idx,))
+
+
+def trace(fn):
+    Rec.trace = []
+    xs = [Rec(None, ()) for _ in range(8)]
+    outs = fn(xs)
+    return list(Rec.trace), [o.idx for o in outs]
+
+
+def analyze(fn, name: str, keep_inputs_live: bool = False):
+    tr, out_idxs = trace(fn)
+    last_use = {}
+    for i, (op, ins) in enumerate(tr):
+        for j in ins:
+            last_use[j] = i
+    for j in out_idxs:
+        last_use[j] = len(tr)  # outputs live to the end
+    if keep_inputs_live:
+        for j in range(8):
+            last_use[j] = len(tr)
+    n_and = sum(1 for op, _ in tr if op == "and")
+    n_xor = sum(1 for op, _ in tr if op == "xor")
+    n_not = sum(1 for op, _ in tr if op == "not")
+    live = set(range(8))
+    peak, profile = len(live), []
+    for i in range(8, len(tr)):
+        live.add(i)
+        live = {v for v in live if last_use.get(v, -1) > i}
+        # value i itself must be retained if used later
+        profile.append(len(live))
+        peak = max(peak, len(live))
+    print(
+        f"{name}: {len(tr) - 8} ops ({n_and} AND, {n_xor} XOR, {n_not} NOT),"
+        f" peak live = {peak}"
+    )
+    return peak, profile
+
+
+if __name__ == "__main__":
+    from dpf_tpu.ops.sbox_circuit import sbox_bp113
+
+    analyze(sbox_bp113, "bp113 (inputs die at last use)")
+    analyze(sbox_bp113, "bp113 (inputs pinned live)", keep_inputs_live=True)
+    try:
+        from dpf_tpu.ops.sbox_circuit import sbox_bp113_lowlive
+
+        analyze(sbox_bp113_lowlive, "lowlive (inputs die at last use)")
+        analyze(
+            sbox_bp113_lowlive, "lowlive (inputs pinned live)",
+            keep_inputs_live=True,
+        )
+    except ImportError:
+        print("sbox_bp113_lowlive not present yet")

@@ -5,13 +5,17 @@ import pytest
 
 from dpf_tpu.core import aes_np
 from dpf_tpu.ops import aes_bitslice as bs
-from dpf_tpu.ops.sbox_circuit import sbox_algebraic, sbox_bp113
+from dpf_tpu.ops.sbox_circuit import (
+    sbox_algebraic,
+    sbox_bp113,
+    sbox_bp113_lowlive,
+)
 
 
 def test_sbox_circuits_exhaustive():
     xs = np.arange(256, dtype=np.uint8)
     planes = [((xs >> (7 - b)) & 1).astype(np.uint32) for b in range(8)]
-    for fn in (sbox_bp113, sbox_algebraic):
+    for fn in (sbox_bp113, sbox_bp113_lowlive, sbox_algebraic):
         out = fn(planes)
         got = np.zeros(256, dtype=np.uint8)
         for b in range(8):

@@ -90,6 +90,28 @@ def test_bm_kernels_match_xla():
     np.testing.assert_array_equal(np.asarray(R0), np.asarray(R1)[np.argsort(to_bm)])
 
 
+def test_bm_kernels_lowlive_sbox_match_xla(monkeypatch):
+    """The register-budgeted S-box schedule must be bit-identical inside
+    the bit-major PRG kernel (jit caches are cleared because the variant
+    is selected by module global, not a traced value)."""
+    import jax
+
+    monkeypatch.setattr(aes_pallas, "_SBOX", "lowlive")
+    jax.clear_caches()
+    to_bm = np.array(aes_pallas._TO_BM)
+    S = _rand_planes(256, seed=9)
+    L0, R0 = prg_planes(S)
+    L1, R1 = aes_pallas.prg_planes_pallas_bm(S[to_bm])
+    inv = np.argsort(to_bm)
+    np.testing.assert_array_equal(np.asarray(L0), np.asarray(L1)[inv])
+    np.testing.assert_array_equal(np.asarray(R0), np.asarray(R1)[inv])
+    np.testing.assert_array_equal(
+        np.asarray(aes128_mmo_planes(S, RK_MASKS_L)),
+        np.asarray(aes_pallas.mmo_planes_pallas_bm_canon(S[to_bm])),
+    )
+    jax.clear_caches()  # don't leak lowlive-compiled graphs to other tests
+
+
 def test_eval_full_pallas_bm_backend_matches_spec():
     # End-to-end with the level state held in bit-major order, including the
     # chunked path (max_plane_words forces a prefix/finish split).
