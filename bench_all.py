@@ -117,6 +117,154 @@ def main():
     _emit(f"1-key eval_full n={n1} (fast)", (1 << n1) / dt / 1e9,
           "Gleaves/sec", baseline)
 
+    # ---- config 1b: single-key EvalFull, n=28 — the reference's own
+    # BenchmarkEvalFull config (dpf/dpf_test.go:7-21), exercising the
+    # big-domain paths: compat splits into subtree chunks finished by one
+    # lax.scan program; fast runs the expand kernel at full width. --------
+    n1b = 28 if not small else 18
+    ka28, _ = kc.gen_batch(
+        np.array([0x0DDC0FFEE % (1 << n1b)], np.uint64), n1b, rng=rng
+    )
+    el28, s28, _kp28 = cp.expand_plan(ka28.nu, ka28.k, MAX_LEAF_NODES)
+    use_k28 = cp.expand_backend() == "pallas" and el28
+    if use_k28:
+        ka28p = _pad_fast_batch(ka28, (-ka28.k) % cp._EKT)
+        a28 = ka28p.device_args()
+        ops28 = cp.expand_operands(ka28p, s28)
+    else:
+        a28 = ka28.device_args()
+
+    def chained28(r):
+        @jax.jit
+        def f(seeds, ts, scw, tcw, fcw):
+            acc = jnp.uint32(0)
+            for _ in range(r):
+                if use_k28:
+                    w = _eval_full_pk_jit(
+                        ka28.nu, s28, seeds ^ acc, ts, scw, tcw, *ops28
+                    )
+                else:
+                    w = _eval_full_cc_jit(ka28.nu, seeds ^ acc, ts, scw, tcw, fcw)
+                acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
+            return acc
+
+        return f
+
+    r28 = 5 if not small else 3
+    dt = _marginal_time(chained28(1), chained28(r28), a28, r28, repeats=5,
+                        stat="median")
+    _emit(f"1-key eval_full n={n1b} (fast)", (1 << n1b) / dt / 1e9,
+          "Gleaves/sec", baseline)
+
+    # Compat at n=28: 2^(n-7) plane words exceed MAX_PLANE_WORDS, so this
+    # times the real chunked pipeline (prefix + scan-finish, one dispatch).
+    from dpf_tpu.core.keys import gen_batch as _gen_compat28
+    from dpf_tpu.models.dpf import (
+        MAX_PLANE_WORDS,
+        DeviceKeys as _DK,
+        _BM_BACKENDS as _BMB,
+        _expand_prefix_jit,
+        _eval_full_jit as _compat_full_jit,
+        _finish_chunks_scan_jit,
+        _scw_to_bm,
+        default_backend as _compat_backend,
+    )
+
+    kac28, _ = _gen_compat28(
+        np.array([0x0DDC0FFEE % (1 << n1b)], np.uint64), n1b, rng=rng
+    )
+    dk28 = _DK(kac28)
+    bk28 = _compat_backend()
+    kp28 = dk28.k_padded // 32
+    total28 = (1 << dk28.nu) * kp28
+    scw28 = dk28.scw_planes
+    if total28 > MAX_PLANE_WORDS and bk28 in _BMB:
+        scw28 = _scw_to_bm(scw28)
+    if total28 > MAX_PLANE_WORDS:
+        c28 = min(
+            (-(-total28 // MAX_PLANE_WORDS) - 1).bit_length(), dk28.nu
+        )
+    else:
+        c28 = 0
+
+    def chained28c(r):
+        @jax.jit
+        def f(seed_planes, t_words, scw_raw, scw_fin, tl_w, tr_w, fcw_planes):
+            acc = jnp.uint32(0)
+            for _ in range(r):
+                if c28:
+                    S, T = _expand_prefix_jit(
+                        c28, seed_planes ^ acc, t_words, scw_raw, tl_w,
+                        tr_w, bk28,
+                    )
+                    w = _finish_chunks_scan_jit(
+                        dk28.nu - c28, c28, S, T, scw_fin, tl_w, tr_w,
+                        fcw_planes, bk28,
+                    )
+                else:
+                    w = _compat_full_jit(
+                        dk28.nu, seed_planes ^ acc, t_words, scw_raw,
+                        tl_w, tr_w, fcw_planes, bk28,
+                    )
+                acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
+            return acc
+
+        return f
+
+    a28c = (
+        dk28.seed_planes, dk28.t_words, dk28.scw_planes, scw28,
+        dk28.tl_words, dk28.tr_words, dk28.fcw_planes,
+    )
+    r28c = 3
+    dt = _marginal_time(chained28c(1), chained28c(r28c), a28c, r28c,
+                        repeats=5, stat="median")
+    _emit(f"1-key eval_full n={n1b} (compat, chunked)",
+          (1 << n1b) / dt / 1e9, "Gleaves/sec", baseline)
+
+    # Fast profile through ITS chunked route (expand_plan_chunked) needs
+    # the leaf cap exceeded: 32 keys at n=28 (1 GB of leaf words, 2 scan
+    # chunks through the VMEM kernel).
+    k28f = 32 if not small else 4
+    ka28f, _ = kc.gen_batch(
+        rng.integers(0, 1 << n1b, size=k28f, dtype=np.uint64), n1b, rng=rng
+    )
+    okc, sc28, _w, nch28 = cp.expand_plan_chunked(
+        ka28f.nu, ka28f.k, MAX_LEAF_NODES
+    )
+    use_kc28 = cp.expand_backend() == "pallas" and okc
+    if use_kc28:
+        from dpf_tpu.models.dpf_chacha import (
+            _expand_prefix_cc_jit,
+            _finish_pk_chunks_jit,
+        )
+
+        ka28fp = _pad_fast_batch(ka28f, (-ka28f.k) % cp._EKT)
+        a28f = ka28fp.device_args()
+        ops28f = cp.expand_operands(ka28fp, sc28)
+        wc28 = (1 << sc28) // nch28
+
+        def chained28f(r):
+            @jax.jit
+            def f(seeds, ts, scw, tcw, fcw):
+                acc = jnp.uint32(0)
+                for _ in range(r):
+                    S, T = _expand_prefix_cc_jit(
+                        sc28, seeds ^ acc, ts, scw, tcw
+                    )
+                    w = _finish_pk_chunks_jit(
+                        ka28fp.nu, sc28, nch28, wc28, *S, T, *ops28f
+                    )
+                    acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
+                return acc
+
+            return f
+
+        r28f = 3
+        dt = _marginal_time(chained28f(1), chained28f(r28f), a28f, r28f,
+                            repeats=5, stat="median")
+        _emit(f"{k28f}-key eval_full n={n1b} (fast, chunked kernel)",
+              k28f * (1 << n1b) / dt / 1e9, "Gleaves/sec", baseline)
+
     # ---- config 2: 1024-key EvalFull, n=20 — the headline, both profiles ----
     if small:
         # Shrunken smoke: the full config on CPU would take hours.

@@ -231,18 +231,46 @@ def _expand_prefix_jit(
     return S, T
 
 
-@partial(jax.jit, static_argnums=(0, 1, 8))
-def _finish_chunk_jit(
-    n_levels, first, S, T, scw_planes, tl_w, tr_w, fcw_planes, backend="xla"
+def _finish_chunk_body(
+    n_levels, first, S, T, scw_planes, tl_w, tr_w, fcw_planes, backend
 ):
     """S and scw_planes must already be in the backend's plane order (the
-    chunk loop in eval_full_device permutes the CWs once for pallas_bm, not
+    chunk scan in eval_full_device permutes the CWs once for pallas_bm, not
     once per chunk)."""
     for i in range(n_levels):
         S, T = _level_step(
             S, T, scw_planes[first + i], tl_w[first + i], tr_w[first + i], backend
         )
     return _convert_leaves(S, T, fcw_planes, backend)
+
+
+@partial(jax.jit, static_argnums=(0, 1, 8))
+def _finish_chunks_scan_jit(
+    n_levels, first, S, T, scw_planes, tl_w, tr_w, fcw_planes, backend="xla"
+):
+    """Finish ALL 2^c subtree chunks in ONE compiled function.
+
+    A Python chunk loop costs 2 dispatches per chunk (slice + finish);
+    through a high-RTT device tunnel that dominates big-domain expansions
+    (the round-3 review's 'dispatch storm').  ``lax.scan`` keeps the
+    per-chunk memory profile — one [128, Wc, kp] working set per
+    iteration, outputs accumulating in the stacked result buffer exactly
+    like the old jnp.concatenate — while issuing a single program.
+
+    S: [128, C, kp] prefix state, T: [C, kp] -> uint32[Kpad, C * Wc, 4].
+    """
+    Sx = jnp.moveaxis(S, 1, 0)[:, :, None, :]  # [C, 128, 1, kp]
+    Tx = T[:, None, :]  # [C, 1, kp]
+
+    def body(_, st):
+        Sj, Tj = st
+        return None, _finish_chunk_body(
+            n_levels, first, Sj, Tj, scw_planes, tl_w, tr_w, fcw_planes,
+            backend,
+        )
+
+    _, ys = jax.lax.scan(body, None, (Sx, Tx))  # [C, Kpad, Wc, 4]
+    return jnp.moveaxis(ys, 0, 1).reshape(ys.shape[1], -1, ys.shape[3])
 
 
 # ---------------------------------------------------------------------------
@@ -287,15 +315,9 @@ def eval_full_device(
     if backend in _BM_BACKENDS:
         # One permute for all chunks; S from the prefix is already bit-major.
         scw = _scw_to_bm(scw)
-    outs = []
-    for j in range(1 << c):
-        outs.append(
-            _finish_chunk_jit(
-                nu - c, c, S[:, j : j + 1, :], T[j : j + 1, :],
-                scw, dk.tl_words, dk.tr_words, dk.fcw_planes, backend,
-            )
-        )
-    return jnp.concatenate(outs, axis=1)
+    return _finish_chunks_scan_jit(
+        nu - c, c, S, T, scw, dk.tl_words, dk.tr_words, dk.fcw_planes, backend
+    )
 
 
 def eval_full(

@@ -150,14 +150,32 @@ def _expand_prefix_cc_jit(n_levels, seeds, ts, scw, tcw):
     return S, T
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _finish_chunk_cc_jit(n_levels, first, S, T, scw, tcw, fcw):
+def _finish_chunk_cc_body(n_levels, first, S, T, scw, tcw, fcw):
     for i in range(n_levels):
         j = first + i
         S, T = _level_step_cc(
             S, T, [scw[:, j, w] for w in range(4)], tcw[:, j, 0], tcw[:, j, 1]
         )
     return _convert_leaves_cc(S, T, [fcw[:, j] for j in range(16)])
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _finish_chunks_cc_scan_jit(n_levels, first, s0, s1, s2, s3, T, scw, tcw, fcw):
+    """All subtree chunks in ONE compiled function (lax.scan over the node
+    axis) — one dispatch instead of 2 per chunk; per-iteration working set
+    unchanged (see models/dpf._finish_chunks_scan_jit for the rationale).
+
+    s0..s3/T: uint32[K, C] prefix state -> uint32[K, C * Wc, 16]."""
+    xs = tuple(jnp.moveaxis(s, 1, 0)[:, :, None] for s in (s0, s1, s2, s3, T))
+
+    def body(_, st):
+        *Sj, Tj = st
+        return None, _finish_chunk_cc_body(
+            n_levels, first, list(Sj), Tj, scw, tcw, fcw
+        )
+
+    _, ys = jax.lax.scan(body, None, xs)  # [C, K, Wc, 16]
+    return jnp.moveaxis(ys, 0, 1).reshape(ys.shape[1], -1, ys.shape[3])
 
 
 # Soft cap on K * 2^nu leaf nodes per compiled expansion (each leaf is 64 B
@@ -202,6 +220,26 @@ def _finish_pk_jit(nu, first, s0, s1, s2, s3, T, scw_p, tcw_p, fcw_p):
     return _finish_pk(nu, first, [s0, s1, s2, s3], T, scw_p, tcw_p, fcw_p)
 
 
+@partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _finish_pk_chunks_jit(
+    nu, first, n_chunks, wc, s0, s1, s2, s3, T, scw_p, tcw_p, fcw_p
+):
+    """Kernel tail over ALL node-range chunks in ONE compiled function
+    (lax.scan; see models/dpf._finish_chunks_scan_jit for why).  State
+    arrays are uint32[K, n_chunks * wc] -> uint32[K, n_chunks * Wc, 16]."""
+    xs = tuple(
+        jnp.moveaxis(a.reshape(a.shape[0], n_chunks, wc), 1, 0)
+        for a in (s0, s1, s2, s3, T)
+    )
+
+    def body(_, st):
+        *Sj, Tj = st
+        return None, _finish_pk(nu, first, list(Sj), Tj, scw_p, tcw_p, fcw_p)
+
+    _, ys = jax.lax.scan(body, None, xs)  # [C, K, Wc, 16]
+    return jnp.moveaxis(ys, 0, 1).reshape(ys.shape[1], -1, ys.shape[3])
+
+
 def _eval_full_pallas_device(kb: KeyBatchFast, entry_level: int):
     """Kernel-path full expansion; requires nu >= 7 (the kernel entry level
     must be at least 128 nodes wide).  Pads the key axis to the kernel's
@@ -232,16 +270,8 @@ def _eval_full_pallas_chunked(kb: KeyBatchFast, entry_level: int, n_chunks: int)
     S, T = _expand_prefix_cc_jit(s, seeds, ts, scw, tcw)
     ops = cp.expand_operands(pk, s)
     wc = (1 << s) // n_chunks
-    outs = []
-    for j in range(n_chunks):
-        sl = slice(j * wc, (j + 1) * wc)
-        outs.append(
-            _finish_pk_jit(
-                nu, s, S[0][:, sl], S[1][:, sl], S[2][:, sl], S[3][:, sl],
-                T[:, sl], *ops,
-            )
-        )
-    return jnp.concatenate(outs, axis=1)[: kb.k]
+    words = _finish_pk_chunks_jit(nu, s, n_chunks, wc, *S, T, *ops)
+    return words[: kb.k]
 
 
 def eval_full_device(
@@ -281,13 +311,7 @@ def eval_full_device(
     n_chunks = -(-total // max_leaf_nodes)
     c = min((n_chunks - 1).bit_length(), nu)
     S, T = _expand_prefix_cc_jit(c, seeds, ts, scw, tcw)
-    outs = []
-    for j in range(1 << c):
-        Sj = [s[:, j : j + 1] for s in S]
-        outs.append(
-            _finish_chunk_cc_jit(nu - c, c, Sj, T[:, j : j + 1], scw, tcw, fcw)
-        )
-    return jnp.concatenate(outs, axis=1)
+    return _finish_chunks_cc_scan_jit(nu - c, c, *S, T, scw, tcw, fcw)
 
 
 def eval_full(
