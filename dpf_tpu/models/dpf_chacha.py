@@ -191,10 +191,11 @@ def _finish_pk(nu, first, S, T, scw_p, tcw_p, fcw_p):
     from ..ops import chacha_pallas as cp
 
     levels = nu - first
+    wt = min(cp._EWT, T.shape[1])  # entry node-tile width (small trees < 128)
     outs = cp._expand_raw(
         S[0], S[1], S[2], S[3], T, scw_p, tcw_p, fcw_p, levels
     )
-    outs = [cp.deinterleave_leaves(o, levels) for o in outs]
+    outs = [cp.deinterleave_leaves(o, levels, wt) for o in outs]
     return jnp.stack(outs, axis=2)
 
 
@@ -241,8 +242,9 @@ def _finish_pk_chunks_jit(
 
 
 def _eval_full_pallas_device(kb: KeyBatchFast, entry_level: int):
-    """Kernel-path full expansion; requires nu >= 7 (the kernel entry level
-    must be at least 128 nodes wide).  Pads the key axis to the kernel's
+    """Kernel-path full expansion: classic route (entry >= 7, 128-node-wide
+    tiles) or the whole-tree entry-0 route for small domains
+    (chacha_pallas.small_tree_entry).  Pads the key axis to the kernel's
     8-key sublane tile and slices the padding back off."""
     from ..ops import chacha_pallas as cp
     from ..parallel.sharding import _pad_fast_batch

@@ -423,15 +423,54 @@ def _expand_kernel(
         out_refs[j][:] = out[j] ^ (fj & msk)
 
 
+# Whole-tree (entry-0) kernel coverage: one program per key tile runs ALL
+# nu levels + leaf conversion with lanes filling as the tree doubles.  The
+# leaf tile is 2^nu lanes, so the VMEM bound that allows _EXP_LEVELS=5 at a
+# 128-lane entry (128 << 5 = 4096 leaf lanes) allows nu <= 12 here.
+_EXP_SMALL_MAX_NU = 12
+
+
+def small_tree_entry(nu: int):
+    """Entry level for the whole-tree small-domain route, or None when the
+    classic >=128-lane-entry route (or XLA) should be used instead.
+
+    ``auto``: entry 0 only where the classic kernel is ineligible
+    (nu < 7) — a single fused program beats nu separate XLA level
+    launches for latency-bound tiny expansions (BASELINE config 1's
+    failure mode).  ``small`` forces entry 0 for every nu <= 12 (A/B
+    experiments); ``classic`` disables the small route entirely."""
+    mode = os.environ.get("DPF_TPU_EXPAND_ENTRY", "auto")
+    if mode not in ("auto", "small", "classic"):
+        raise ValueError("DPF_TPU_EXPAND_ENTRY must be auto|small|classic")
+    if mode == "classic" or not 1 <= nu <= _EXP_SMALL_MAX_NU:
+        return None
+    # TPU-only: XLA:CPU's compile time explodes exponentially in the
+    # number of narrow-lane concat levels (W=1 entry, levels=2 exceeds
+    # 8 minutes; measured 2026-07-30), so interpret mode cannot run this
+    # route.  Its only small-route-specific math (deinterleave at
+    # wt < 128) is covered host-side in tests; the kernel body is shared
+    # with the classic route, which interpret mode does cover.
+    if not _on_tpu():
+        return None
+    if mode == "auto" and nu >= 7:
+        return None
+    return 0
+
+
 def expand_plan(nu: int, k: int, max_leaf_nodes: int):
     """Single source of the expansion-kernel routing decision: returns
-    (eligible, entry_level, padded_k).  Eligible needs nu >= 7 (the kernel
-    entry must be >= 128 nodes wide) and the PADDED key count's leaf
-    materialization under the cap — the 8-key sublane padding is real
-    memory, so the cap must see it.  Used by eval_full_device AND bench.py
-    so the scoreboard times exactly the production routing."""
+    (eligible, entry_level, padded_k).  Eligible needs a >= 128-node-wide
+    kernel entry (nu >= 7) OR the whole-tree small-domain route
+    (small_tree_entry), and the PADDED key count's leaf materialization
+    under the cap — the 8-key sublane padding is real memory, so the cap
+    must see it.  Used by eval_full_device AND bench.py so the scoreboard
+    times exactly the production routing."""
     kp = k + (-k) % _EKT
-    eligible = kernel_usable(nu, kp) and (kp << nu) <= max_leaf_nodes
+    fits = (kp << nu) <= max_leaf_nodes
+    small = small_tree_entry(nu)
+    if small is not None and fits:
+        return True, small, kp
+    eligible = kernel_usable(nu, kp) and fits
     return eligible, entry_level(nu), kp
 
 
@@ -474,13 +513,16 @@ def expand_plan_chunked(nu: int, k: int, max_leaf_nodes: int):
 
 def _expand_raw(s0, s1, s2, s3, T, scw_p, tcw_p, fcw_p, levels):
     K, W = T.shape
-    sspec = pl.BlockSpec((_EKT, _EWT), lambda k, w: (k, w))
+    # Small trees (W < 128 at entry — the whole-tree entry-0 route) run one
+    # narrower program per key tile; lanes fill as the levels double W.
+    wt = min(_EWT, W)
+    sspec = pl.BlockSpec((_EKT, wt), lambda k, w: (k, w))
     cw_spec = pl.BlockSpec((_EKT, 128), lambda k, w: (k, 0))
-    out_spec = pl.BlockSpec((_EKT, _EWT << levels), lambda k, w: (k, w))
+    out_spec = pl.BlockSpec((_EKT, wt << levels), lambda k, w: (k, w))
     kern = functools.partial(_expand_kernel, levels=levels)
     return pl.pallas_call(
         kern,
-        grid=(K // _EKT, W // _EWT),
+        grid=(K // _EKT, W // wt),
         in_specs=[sspec] * 5 + [cw_spec] * 3,
         out_specs=[out_spec] * 16,
         out_shape=[jax.ShapeDtypeStruct((K, W << levels), jnp.uint32)] * 16,
@@ -488,7 +530,7 @@ def _expand_raw(s0, s1, s2, s3, T, scw_p, tcw_p, fcw_p, levels):
     )(s0, s1, s2, s3, T, scw_p, tcw_p, fcw_p)
 
 
-def deinterleave_leaves(x, levels):
+def deinterleave_leaves(x, levels, wt: int = _EWT):
     """Restore ascending leaf order of one expand-kernel output word.
 
     Inside a tile the kernel emits children in block order, so local
@@ -496,7 +538,8 @@ def deinterleave_leaves(x, levels):
     bits in REVERSE significance.  The true local leaf index is
     w * 2^levels + (b_1 .. b_levels).  One static bit-reversal gather +
     axis swap per output word fixes it; XLA fuses this into the output
-    stack pass."""
+    stack pass.  ``wt`` is the kernel's entry node-tile width (= _EWT for
+    the classic route, the entry node count for small trees)."""
     if levels == 0:
         return x
     k = x.shape[0]
@@ -504,7 +547,7 @@ def deinterleave_leaves(x, levels):
     rev = np.zeros(n2, np.int32)
     for j in range(n2):
         rev[j] = int(format(j, f"0{levels}b")[::-1], 2)
-    x = x.reshape(k, -1, n2, _EWT)[:, :, rev, :]
+    x = x.reshape(k, -1, n2, wt)[:, :, rev, :]
     return jnp.swapaxes(x, 2, 3).reshape(k, -1)
 
 

@@ -1,0 +1,90 @@
+"""End-to-end A/B of the expand kernel's entry level on real hardware.
+
+Variants (same process, interleaved, shared contention — the only
+trustworthy comparison on this device):
+
+    python scripts/bench_small_tree_ab.py
+
+  * config 1 (1 key, n=16, nu=7):  classic entry 7 (levels fused: 0 — the
+    kernel only converts; 7 XLA level launches) vs small entry 0 (whole
+    tree + convert in ONE program).  The latency-bound config the round-3
+    review flagged at 0.14x baseline.
+  * config 2 shape (1024 keys, n=20, nu=11): classic entry 7 (4 fused
+    levels after a 7-level XLA prefix) vs small entry 0 (11 fused levels,
+    2048-lane leaf tiles).  Decides whether the headline route should
+    change too.
+
+Chained-marginal-slope, deep chains + median (see bench.py).
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def measure(jax, jnp, ka, entry_env: str, r: int, reps: int = 8):
+    os.environ["DPF_TPU_EXPAND_ENTRY"] = entry_env
+    from dpf_tpu.models.dpf_chacha import MAX_LEAF_NODES, _eval_full_pk_jit
+    from dpf_tpu.ops import chacha_pallas as cp
+    from dpf_tpu.parallel.sharding import _pad_fast_batch
+
+    from bench import _marginal_time
+
+    ok, s, _kp = cp.expand_plan(ka.nu, ka.k, MAX_LEAF_NODES)
+    assert ok, (entry_env, ka.nu, ka.k)
+    pk = _pad_fast_batch(ka, (-ka.k) % cp._EKT)
+    args = pk.device_args()
+    ops = cp.expand_operands(pk, s)
+
+    def chained(n):
+        @jax.jit
+        def f(seeds, ts, scw, tcw, fcw):
+            acc = jnp.uint32(0)
+            for _ in range(n):
+                w = _eval_full_pk_jit(pk.nu, s, seeds ^ acc, ts, scw, tcw, *ops)
+                acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
+            return acc
+
+        return f
+
+    dt = _marginal_time(chained(1), chained(r), args, r, repeats=reps,
+                        stat="median")
+    return dt, s
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from dpf_tpu.models import keys_chacha as kc
+
+    rng = np.random.default_rng(7)
+    configs = [
+        ("config1 1key n=16", 16, 1, 65),
+        ("config2 1024key n=20", 20, 1024, 17),
+    ]
+    for name, log_n, k, r in configs:
+        alphas = rng.integers(0, 1 << log_n, size=k, dtype=np.uint64)
+        ka, _ = kc.gen_batch(alphas, log_n, rng=rng)
+        # Interleave the variants twice: A B A B guards against the
+        # device's mid-process performance-mode swings.
+        for _round in range(2):
+            for env in ("classic", "small"):
+                dt, s = measure(jax, jnp, ka, env, r)
+                gl = k * (1 << log_n) / dt / 1e9
+                print(
+                    f"{name:22s} {env:8s} entry={s:2d} "
+                    f"{gl:8.2f} Gleaves/s ({dt * 1e6:8.1f} us/expansion)",
+                    flush=True,
+                )
+
+
+if __name__ == "__main__":
+    main()

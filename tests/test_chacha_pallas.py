@@ -3,6 +3,8 @@
 pointwise body.  Off-TPU the kernel runs in Pallas interpreter mode, so
 these exercise the real kernel program on the CPU mesh."""
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -115,6 +117,84 @@ def test_expand_kernel_matches_xla(log_n, k):
     bits = np.unpackbits(rec, axis=1, bitorder="little")
     assert (bits.sum(axis=1) == 1).all()
     assert (bits[np.arange(k), alphas.astype(np.int64)] == 1).all()
+
+
+def test_small_tree_plan_gating(monkeypatch):
+    """Routing contract of the whole-tree entry-0 route: active only on
+    TPU (XLA:CPU interpret compile explodes on narrow-lane concat levels),
+    auto limits it to nu < 7, 'small' extends it to nu <= 12, 'classic'
+    disables it.  No kernel execution — the plan decision only."""
+    cap = 1 << 23
+    # Off-TPU (this CI): always the classic plan.
+    for nu in (2, 5, 7, 11):
+        ok, entry, _ = cp.expand_plan(nu, 3, cap)
+        assert entry != 0 or not ok
+    monkeypatch.setattr(cp, "_on_tpu", lambda: True)
+    assert cp.expand_plan(5, 3, cap)[:2] == (True, 0)  # auto, nu<7
+    assert cp.expand_plan(2, 3, cap)[:2] == (True, 0)
+    ok, entry, _ = cp.expand_plan(11, 3, cap)  # auto, nu>=7: classic
+    assert ok and entry == 7
+    monkeypatch.setenv("DPF_TPU_EXPAND_ENTRY", "small")
+    assert cp.expand_plan(11, 3, cap)[:2] == (True, 0)
+    assert cp.expand_plan(12, 3, cap)[:2] == (True, 0)
+    assert cp.expand_plan(13, 3, cap)[1] == 8  # beyond the lane cap
+    monkeypatch.setenv("DPF_TPU_EXPAND_ENTRY", "classic")
+    ok, entry, _ = cp.expand_plan(5, 3, cap)
+    assert not ok or entry != 0
+    monkeypatch.setenv("DPF_TPU_EXPAND_ENTRY", "bogus")
+    with pytest.raises(ValueError, match="DPF_TPU_EXPAND_ENTRY"):
+        cp.expand_plan(5, 3, cap)
+
+
+def test_deinterleave_wt_restores_order():
+    """The small-route-specific math: deinterleave_leaves at wt < 128.
+
+    Simulate the kernel's block-order emission on the host — local
+    position j'*wt + w where j' is the level-choice bits in REVERSE
+    significance — and check the gather restores ascending leaf order for
+    several (wt, levels) shapes including multi-tile ones."""
+    rng = np.random.default_rng(3)
+    for k, wt, ntiles, levels in [
+        (2, 1, 1, 3), (3, 4, 1, 2), (2, 2, 3, 4), (1, 128, 2, 2)
+    ]:
+        W = wt * ntiles
+        n2 = 1 << levels
+        true_leaf = np.zeros((k, W * n2), np.uint32)
+        emitted = np.zeros((k, W * n2), np.uint32)
+        vals = rng.integers(0, 1 << 32, size=(k, W, n2), dtype=np.uint64)
+        for t in range(ntiles):
+            for w in range(wt):
+                for j in range(n2):
+                    jrev = int(format(j, f"0{levels}b")[::-1], 2)
+                    node = t * wt + w  # entry-level node index
+                    v = vals[:, node, j]
+                    true_leaf[:, node * n2 + j] = v
+                    emitted[:, (t * n2 + jrev) * wt + w] = v
+        got = np.asarray(cp.deinterleave_leaves(jnp.asarray(emitted), levels, wt))
+        np.testing.assert_array_equal(got, true_leaf)
+
+
+@pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="small-tree kernel route is TPU-only (see small_tree_entry)",
+)
+@pytest.mark.parametrize("log_n", [11, 14, 16])
+def test_expand_kernel_small_tree_matches_xla_tpu(log_n):
+    """On real hardware the whole-tree entry-0 route must be byte-identical
+    to the XLA pipeline."""
+    nu = log_n - 9
+    ok, entry, _kp = cp.expand_plan(nu, 3, 1 << 23)
+    assert ok and entry == 0, (ok, entry)
+    rng = np.random.default_rng(40 + log_n)
+    alphas = rng.integers(0, 1 << log_n, size=3, dtype=np.uint64)
+    ka, kb = gen_batch(alphas, log_n, rng=rng)
+    got = dc.eval_full(ka, backend="pallas")
+    want = dc.eval_full(ka, backend="xla")
+    assert (got == want).all()
+    rec = got ^ dc.eval_full(kb, backend="pallas")
+    bits = np.unpackbits(rec, axis=1, bitorder="little")
+    assert (bits.sum(axis=1) == 1).all()
+    assert (bits[np.arange(3), alphas.astype(np.int64)] == 1).all()
 
 
 def test_expand_kernel_chunked_matches_unchunked():
