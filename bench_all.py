@@ -393,19 +393,32 @@ def main():
     xs_lo3c = jnp.asarray((xs_p & np.uint64(0xFFFFFFFF)).astype(np.uint32))
     xs_hi3c = jnp.zeros((1, 1), jnp.uint32)
     masks3 = _point_masks(kac3)
+    # Same route production takes: the whole-walk kernel on TPU
+    # (DPF_TPU_POINTS_AES), the per-level XLA body otherwise.
+    from dpf_tpu.models.dpf import _eval_points_walk_jit
+    from dpf_tpu.ops import aes_pallas
+
+    use_aes_walk = aes_pallas.walk_backend() == "pallas" and k3 % 8 == 0
 
     def chained3c(r):
         @jax.jit
         def f(sm, tm, scwm, tlm, trm, fcwm, xs_hi, xs_lo):
             acc = jnp.uint32(0)
             for _ in range(r):
-                bits = _eval_points_jit(
-                    kac3.nu, n3, sm, tm, scwm, tlm, trm, fcwm, xs_hi,
-                    xs_lo ^ (acc & 1), qp3, bk3,
-                )
-                acc = acc ^ jnp.bitwise_xor.reduce(
-                    bits.astype(jnp.uint32), axis=None
-                )
+                if use_aes_walk:
+                    packed = _eval_points_walk_jit(
+                        kac3.nu, n3, sm, tm, scwm, tlm, trm, fcwm, xs_hi,
+                        xs_lo ^ (acc & 1), qp3,
+                    )
+                    acc = acc ^ jnp.bitwise_xor.reduce(packed, axis=None)
+                else:
+                    bits = _eval_points_jit(
+                        kac3.nu, n3, sm, tm, scwm, tlm, trm, fcwm, xs_hi,
+                        xs_lo ^ (acc & 1), qp3, bk3,
+                    )
+                    acc = acc ^ jnp.bitwise_xor.reduce(
+                        bits.astype(jnp.uint32), axis=None
+                    )
             return acc
 
         return f

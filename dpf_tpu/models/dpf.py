@@ -381,7 +381,12 @@ def eval_points(
     can exceed 2^32), from which the per-level packed path words are built
     on device.  ``backend`` picks the PRG kernel exactly as in eval_full
     (default: the platform's measured-fastest).
-    """
+
+    On TPU the whole walk runs as ONE Pallas program per (key, query-word)
+    tile with the bitsliced state resident in VMEM
+    (ops/aes_pallas._walk_kernel_bm; DPF_TPU_POINTS_AES=xla to disable) —
+    the XLA body round-trips the [128, K, qp] state through HBM at every
+    level."""
     xs = np.asarray(xs, dtype=np.uint64)
     K, Q = xs.shape
     if K != kb.k:
@@ -389,6 +394,11 @@ def eval_points(
     if (xs >> np.uint64(kb.log_n)).any():
         raise ValueError("dpf: query index out of domain")
     backend = backend or default_backend()
+    # The whole-walk kernel replaces the per-level pipeline only for the
+    # TPU-default (bit-major) backend family; an explicit backend="xla"
+    # keeps the XLA body (A/B and differential reference).
+    if backend in _BM_BACKENDS and aes_pallas.walk_backend() == "pallas":
+        return _eval_points_walk_compat(kb, xs)
     pad_q = (-Q) % 32
     if pad_q:
         xs = np.concatenate([xs, np.zeros((K, pad_q), np.uint64)], axis=1)
@@ -404,6 +414,80 @@ def eval_points(
         kb.nu, kb.log_n, *_point_masks(kb), xs_hi, xs_lo, qp, backend
     )
     return np.asarray(bits)[:, :Q]
+
+
+def _eval_points_walk_compat(kb: KeyBatch, xs: np.ndarray) -> np.ndarray:
+    """Whole-walk kernel route: pads keys to the kernel's 8-key sublane
+    tile and queries to whole packed words, returns uint8[K, Q]."""
+    K, Q = xs.shape
+    kpad = (-kb.k) % aes_pallas._PKT
+    if kpad:
+        from ..parallel.sharding import _pad_compat_batch
+
+        kb = _pad_compat_batch(kb, kpad)
+    pad_q = (-Q) % 32
+    if pad_q:
+        xs = np.concatenate(
+            [xs, np.zeros((K, pad_q), np.uint64)], axis=1
+        )
+    if kpad:
+        xs = np.concatenate(
+            [xs, np.zeros((kpad, xs.shape[1]), np.uint64)], axis=0
+        )
+    qp = xs.shape[1] // 32
+    xs_lo = jnp.asarray((xs & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    if kb.log_n > 32:
+        xs_hi = jnp.asarray((xs >> np.uint64(32)).astype(np.uint32))
+    else:
+        xs_hi = jnp.zeros((1, 1), jnp.uint32)
+    packed = _eval_points_walk_jit(
+        kb.nu, kb.log_n, *_point_masks(kb), xs_hi, xs_lo, qp
+    )
+    packed = np.asarray(packed)  # [Kpad, qp]
+    bits = (
+        (packed[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
+    ).astype(np.uint8).reshape(packed.shape[0], -1)
+    return bits[:K, :Q]
+
+
+def _eval_points_walk_body(
+    nu, log_n, seed_masks, t_masks, scw_masks, tl_masks, tr_masks,
+    fcw_masks, xs_hi, xs_lo, qp,
+):
+    """Operand prep for the whole-walk kernel: per-level packed descent
+    words and the leaf-select one-hot masks are built HERE (plain XLA, one
+    pass over the query tensor) so the kernel itself is log_n-agnostic."""
+    K = seed_masks.shape[1]
+    lane = jnp.arange(32, dtype=jnp.uint32)
+
+    def packw(pb):  # 0/1 uint32[K, Q] -> packed uint32[K, qp]
+        return (pb.reshape(K, qp, 32) << lane).sum(-1, dtype=jnp.uint32)
+
+    pws = []
+    for i in range(nu):
+        b = log_n - 1 - i
+        if b >= 32:
+            pb = (xs_hi >> np.uint32(b - 32)) & np.uint32(1)
+        else:
+            pb = (xs_lo >> np.uint32(b)) & np.uint32(1)
+        pws.append(packw(pb))
+    pw = (
+        jnp.stack(pws) if nu else jnp.zeros((0, K, qp), jnp.uint32)
+    )
+    low = xs_lo & np.uint32(127)
+    sel = jnp.stack(
+        [packw((low == np.uint32(p)).astype(jnp.uint32)) for p in range(128)]
+    )  # [128, K, qp]
+    perm = jnp.asarray(aes_pallas._TO_BM)
+    return aes_pallas.eval_points_walk_planes(
+        seed_masks[perm], t_masks, scw_masks[:, perm], tl_masks, tr_masks,
+        fcw_masks, pw, sel, nu,
+    )
+
+
+_eval_points_walk_jit = partial(jax.jit, static_argnums=(0, 1, 10))(
+    _eval_points_walk_body
+)
 
 
 def _eval_points_body(

@@ -158,45 +158,60 @@ if _SBOX not in _SBOX_IMPLS:
     )
 
 
+# The bit-major circuit helpers are rank-generic: the plane axis is axis 0
+# (128), and any trailing dims ride along untouched — [128, B] in the
+# 2D kernels, [128, KT, QT] in the pointwise walk kernel (where splitting
+# only the plane axis keeps the (sublane, lane) block layout intact; a
+# flat reshape would be a physical relayout per op).
+
+
+def _rk_col(rk, rnd, tail_ndim):
+    return rk[rnd].reshape((128,) + (1,) * tail_ndim)
+
+
 def _sub_bytes_bm(S):
     sbox = _SBOX_IMPLS[_SBOX]
-    s = S.reshape(8, 16, -1)
+    tail = S.shape[1:]
+    s = S.reshape(8, 16, *tail)
     if not _SBOX_SPLIT:
         y = sbox([s[7 - i] for i in range(8)])  # circuit is MSB-first
-        return jnp.concatenate(y[::-1]).reshape(128, -1)
+        return jnp.concatenate(y[::-1]).reshape(128, *tail)
     outs = []
     for h in (0, 8):
         y = sbox([s[7 - i, h : h + 8] for i in range(8)])
-        outs.append(jnp.stack(y[::-1]))  # [8, 8, B]
-    return jnp.concatenate(outs, axis=1).reshape(128, -1)
+        outs.append(jnp.stack(y[::-1]))  # [8, 8, *tail]
+    return jnp.concatenate(outs, axis=1).reshape(128, *tail)
 
 
 def _shift_rows_bm(S):
-    s = S.reshape(8, 16, -1)
+    tail = S.shape[1:]
+    s = S.reshape(8, 16, *tail)
     return jnp.concatenate(
         [s[:, p : p + 1] for p in _SHIFT_PERM], axis=1
-    ).reshape(128, -1)
+    ).reshape(128, *tail)
 
 
-def _xtime_bm(a):  # [8, 16, B] -> bit-rotate + carry (reduction poly 0x11B)
+def _xtime_bm(a):  # [8, 16, *tail] bit-rotate + carry (reduction poly 0x11B)
     a0, a1, a2, a3, a4, a5, a6, a7 = (a[i : i + 1] for i in range(8))
     return jnp.concatenate([a7, a0 ^ a7, a1, a2 ^ a7, a3 ^ a7, a4, a5, a6])
 
 
 def _mix_columns_bm(S):
-    s = S.reshape(8, 4, 4, -1)  # [bit, col, row, B]
+    tail = S.shape[1:]
+    s = S.reshape(8, 4, 4, *tail)  # [bit, col, row, *tail]
     r1 = jnp.concatenate([s[:, :, 1:], s[:, :, :1]], axis=2)
     r2 = jnp.concatenate([s[:, :, 2:], s[:, :, :2]], axis=2)
     r3 = jnp.concatenate([s[:, :, 3:], s[:, :, :3]], axis=2)
-    f = lambda x: _xtime_bm(x.reshape(8, 16, -1)).reshape(s.shape)  # noqa: E731
-    return (f(s) ^ f(r1) ^ r1 ^ r2 ^ r3).reshape(128, -1)
+    f = lambda x: _xtime_bm(x.reshape(8, 16, *tail)).reshape(s.shape)  # noqa: E731
+    return (f(s) ^ f(r1) ^ r1 ^ r2 ^ r3).reshape(128, *tail)
 
 
 def _encrypt_bm(S, rk):
-    S = S ^ rk[0][:, None]
+    nd = S.ndim - 1
+    S = S ^ _rk_col(rk, 0, nd)
     for rnd in range(1, 10):
-        S = _mix_columns_bm(_shift_rows_bm(_sub_bytes_bm(S))) ^ rk[rnd][:, None]
-    return _shift_rows_bm(_sub_bytes_bm(S)) ^ rk[10][:, None]
+        S = _mix_columns_bm(_shift_rows_bm(_sub_bytes_bm(S))) ^ _rk_col(rk, rnd, nd)
+    return _shift_rows_bm(_sub_bytes_bm(S)) ^ _rk_col(rk, 10, nd)
 
 
 def _prg_kernel_bm(s_ref, rk_ref, l_ref, r_ref):
@@ -305,3 +320,145 @@ def mmo_planes_pallas_bm_canon(S: jax.Array) -> jax.Array:
     if S.shape[1] % _MIN_B:
         return aes128_mmo_planes(S[jnp.asarray(_FROM_BM)], RK_MASKS_L)
     return _tiled_call(S, _mmo_canon_kernel_bm, 1, True)
+
+
+# ---------------------------------------------------------------------------
+# Whole-walk pointwise kernel (compat profile)
+#
+# The VMEM-resident analogue of ops/chacha_pallas._walk_kernel for the
+# reference-key-compatible cipher (the reference's Eval loop,
+# dpf/dpf.go:171-211, batched): the XLA pointwise body round-trips the
+# full bitsliced state ([128, K, qp], 16 MB at BASELINE config 3) through
+# HBM at every level; here the state stays in VMEM for the whole walk.
+#
+# Layout: a program's state is [128 planes, KT keys, QT query-words] —
+# keys on sublanes, packed query words on lanes, the plane axis vectorized
+# over (KT, QT) vreg slabs (the rank-generic _encrypt_bm above).  The
+# per-level descent masks (packed path words) and the leaf bit-select
+# one-hot masks are precomputed on device OUTSIDE the kernel from the
+# query indices — the kernel itself is log_n-agnostic (no 64-bit index
+# handling inside).
+# ---------------------------------------------------------------------------
+
+_PKT = 8  # walk key tile (sublanes)
+_PQT = 128  # max walk query-word tile (lanes)
+
+
+def walk_backend() -> str:
+    """'pallas' | 'xla' for the compat pointwise walk (env
+    DPF_TPU_POINTS_AES)."""
+    env = os.environ.get("DPF_TPU_POINTS_AES", "auto")
+    if env not in ("auto", "xla", "pallas"):
+        raise ValueError("DPF_TPU_POINTS_AES must be auto|xla|pallas")
+    if env != "auto":
+        return env
+    return "pallas" if _on_tpu() else "xla"
+
+
+def _walk_kernel_bm(
+    seeds_ref, t_ref, scw_ref, tlcw_ref, trcw_ref, fcw_ref, pw_ref,
+    sel_ref, rk_ref, o_ref, *, nu,
+):
+    kt, qt = o_ref.shape
+    rk = rk_ref[:]
+    scw = scw_ref[:]  # [nu, 128, KT, 1]
+    tlcw = tlcw_ref[:]  # [nu, KT, 1]
+    trcw = trcw_ref[:]
+    pw = pw_ref[:]  # [nu, KT, QT]
+    S0 = jnp.broadcast_to(seeds_ref[:], (128, kt, qt))
+    T0 = jnp.broadcast_to(t_ref[:][0], (kt, qt))
+
+    def level(i, carry):
+        S, T = carry
+        L = _encrypt_bm(S, rk[0]) ^ S
+        R = _encrypt_bm(S, rk[1]) ^ S
+        # Plane 0 is the packed control-bit PLANE (bit j = instance j's t);
+        # extract it whole and zero it whole — unlike the fast walk kernel,
+        # whose lanes each hold one instance's literal state word.
+        tl = L[0]
+        tr = R[0]
+        zero = jnp.zeros_like(L[0:1])
+        L = jnp.concatenate([zero, L[1:]])
+        R = jnp.concatenate([zero, R[1:]])
+        cw = jax.lax.dynamic_index_in_dim(scw, i, 0, keepdims=False)
+        cwm = cw & T[None]
+        L = L ^ cwm
+        R = R ^ cwm
+        tl = tl ^ (jax.lax.dynamic_index_in_dim(tlcw, i, 0, False) & T)
+        tr = tr ^ (jax.lax.dynamic_index_in_dim(trcw, i, 0, False) & T)
+        go = jax.lax.dynamic_index_in_dim(pw, i, 0, False)  # [KT, QT]
+        S = (R & go[None]) | (L & ~go[None])
+        T = (tr & go) | (tl & ~go)
+        return S, T
+
+    S, T = jax.lax.fori_loop(0, nu, level, (S0, T0))
+    C = _encrypt_bm(S, rk[0]) ^ S
+    C = _permute_rows(C, _FROM_BM)  # bit-major -> canonical plane order
+    C = C ^ (fcw_ref[:] & T[None])
+    # Leaf bit select: sel one-hot over planes per packed query bit.
+    o_ref[:] = jax.lax.reduce(
+        C & sel_ref[:], np.uint32(0), jax.lax.bitwise_or, (0,)
+    )
+
+
+def walk_qt(qp: int) -> int:
+    """Largest query-word lane tile dividing qp (cap _PQT)."""
+    qt = min(qp, _PQT)
+    while qp % qt:
+        qt -= 1
+    return qt
+
+
+def eval_points_walk_planes(
+    seeds_bm, t_words, scw_bm, tl_w, tr_w, fcw_canon, pw, sel, nu: int
+):
+    """Pallas whole-walk pointwise evaluation from prepared operands.
+
+    seeds_bm uint32[128, K] (bit-major root seed planes), t_words
+    uint32[K] (0/1), scw_bm uint32[nu, 128, K] (bit-major), tl_w/tr_w
+    uint32[nu, K], fcw_canon uint32[128, K] (canonical), pw uint32[nu, K,
+    qp] packed per-level descent words, sel uint32[128, K, qp] leaf-select
+    one-hot masks -> uint32[K, qp] packed output bits.  K % 8 == 0; the
+    caller (models/dpf.eval_points) pads keys and queries."""
+    K = seeds_bm.shape[1]
+    qp = pw.shape[2] if nu else sel.shape[2]
+    qt = walk_qt(qp)
+    n1 = max(nu, 1)  # zero-level walks still need non-empty level refs
+
+    def rows3(n):  # [n, K, 1] per-key column blocks
+        return pl.BlockSpec((n, _PKT, 1), lambda k, q: (0, k, 0))
+
+    def rows4(n):
+        return pl.BlockSpec((n, 128, _PKT, 1), lambda k, q: (0, 0, k, 0))
+
+    qblock = pl.BlockSpec((n1, _PKT, qt), lambda k, q: (0, k, q))
+    planes_q = pl.BlockSpec((128, _PKT, qt), lambda k, q: (0, k, q))
+    kern = functools.partial(_walk_kernel_bm, nu=nu)
+    return pl.pallas_call(
+        kern,
+        grid=(K // _PKT, qp // qt),
+        in_specs=[
+            pl.BlockSpec((128, _PKT, 1), lambda k, q: (0, k, 0)),  # seeds
+            rows3(1),  # t
+            rows4(n1),  # scw
+            rows3(n1),  # tlcw
+            rows3(n1),  # trcw
+            pl.BlockSpec((128, _PKT, 1), lambda k, q: (0, k, 0)),  # fcw
+            qblock,  # pw
+            planes_q,  # sel
+            pl.BlockSpec((2, 11, 128), lambda k, q: (0, 0, 0)),  # rk
+        ],
+        out_specs=pl.BlockSpec((_PKT, qt), lambda k, q: (k, q)),
+        out_shape=jax.ShapeDtypeStruct((K, qp), jnp.uint32),
+        interpret=not _on_tpu(),
+    )(
+        seeds_bm[:, :, None],
+        t_words[None, :, None],
+        scw_bm[:, :, :, None] if nu else jnp.zeros((1, 128, K, 1), jnp.uint32),
+        tl_w[:, :, None] if nu else jnp.zeros((1, K, 1), jnp.uint32),
+        tr_w[:, :, None] if nu else jnp.zeros((1, K, 1), jnp.uint32),
+        fcw_canon[:, :, None],
+        pw if nu else jnp.zeros((1, K, qp), jnp.uint32),
+        sel,
+        jnp.asarray(_RK_BOTH_BM),
+    )

@@ -349,15 +349,30 @@ def _pad_compat_batch(kb: KeyBatch, pad: int) -> KeyBatch:
 
 
 @cache
-def _sharded_eval_points(mesh: Mesh, nu: int, log_n: int, qp: int, backend: str):
+def _sharded_eval_points(
+    mesh: Mesh, nu: int, log_n: int, qp: int, backend: str,
+    use_walk_kernel: bool = False,
+):
     """Compat pointwise walk sharded over the ``keys`` axis.  Queries travel
     with their keys (each shard walks its own (key, query) lanes); meshes
     with a leaf axis recompute redundantly across it.  xs_hi shards with
     the keys when the domain needs the high index half (log_n > 32); below
-    that it is the replicated [1, 1] dummy."""
-    from ..models.dpf import _eval_points_body
+    that it is the replicated [1, 1] dummy.  ``use_walk_kernel`` routes
+    each shard through the VMEM whole-walk kernel (the single-chip TPU
+    default; caller guarantees per-shard key counts tile it), returning
+    the same unpacked uint8 bits."""
+    from ..models.dpf import _eval_points_body, _eval_points_walk_body
 
     def body(seed_m, t_m, scw_m, tl_m, tr_m, fcw_m, xs_hi, xs_lo):
+        if use_walk_kernel:
+            packed = _eval_points_walk_body(
+                nu, log_n, seed_m, t_m, scw_m, tl_m, tr_m, fcw_m,
+                xs_hi, xs_lo, qp,
+            )
+            k = packed.shape[0]
+            lane = jnp.arange(32, dtype=jnp.uint32)
+            bits = (packed[:, :, None] >> lane) & jnp.uint32(1)
+            return bits.reshape(k, qp * 32).astype(jnp.uint8)
         return _eval_points_body(
             nu, log_n, seed_m, t_m, scw_m, tl_m, tr_m, fcw_m,
             xs_hi, xs_lo, qp, backend,
@@ -398,7 +413,14 @@ def eval_points_sharded(
         raise ValueError("dpf: query index out of domain")
     n_keys = mesh.shape[KEYS_AXIS]
     K, Q = xs.shape
-    pad = (-K) % n_keys
+    from ..ops import aes_pallas
+
+    use_walk = (
+        aes_pallas.walk_backend() == "pallas" and backend in _BM_BACKENDS
+    )
+    # Per-shard key counts must tile the walk kernel's 8-key sublane tile.
+    quantum = n_keys * (aes_pallas._PKT if use_walk else 1)
+    pad = (-K) % quantum
     kb = _pad_compat_batch(kb, pad)
     if pad:
         xs = np.concatenate([xs, np.zeros((pad, Q), np.uint64)])
@@ -413,7 +435,7 @@ def eval_points_sharded(
         xs_hi = jnp.asarray((xs >> np.uint64(32)).astype(np.uint32))
     else:
         xs_hi = jnp.zeros((1, 1), jnp.uint32)
-    fn = _sharded_eval_points(mesh, kb.nu, kb.log_n, qp, backend)
+    fn = _sharded_eval_points(mesh, kb.nu, kb.log_n, qp, backend, use_walk)
     bits = np.asarray(fn(*_point_masks(kb), xs_hi, xs_lo))
     return bits[:K, :Q]
 

@@ -90,6 +90,32 @@ def test_bm_kernels_match_xla():
     np.testing.assert_array_equal(np.asarray(R0), np.asarray(R1)[np.argsort(to_bm)])
 
 
+@pytest.mark.parametrize("log_n", [6, 13, 33])
+def test_compat_walk_kernel_matches_spec(monkeypatch, log_n):
+    """The whole-walk pointwise kernel (DPF_TPU_POINTS_AES=pallas,
+    interpreter mode here) must match the byte-exact spec bit-for-bit and
+    reconstruct the indicator — covering the no-level edge (log_n=6), key
+    and query padding, and the uint32 index boundary (log_n=33)."""
+    from dpf_tpu.models.dpf import _eval_points_walk_compat
+
+    rng = np.random.default_rng(60 + log_n)
+    K, Q = 5, 13  # pads keys 5 -> 8 and queries 13 -> 32
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, kb = gen_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    xs[:, 0] = alphas
+    got_a = _eval_points_walk_compat(ka, xs)
+    for i in range(K):
+        for j in range(Q):
+            assert got_a[i, j] == spec.eval_point(
+                ka.to_bytes()[i], int(xs[i, j]), log_n
+            ), (i, j)
+    rec = got_a ^ _eval_points_walk_compat(kb, xs)
+    np.testing.assert_array_equal(
+        rec, (xs == alphas[:, None]).astype(np.uint8)
+    )
+
+
 def test_bm_kernels_lowlive_sbox_match_xla(monkeypatch):
     """The register-budgeted S-box schedule must be bit-identical inside
     the bit-major PRG kernel (jit caches are cleared because the variant

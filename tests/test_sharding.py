@@ -143,6 +143,29 @@ def test_eval_points_sharded_matches_spec(log_n):
             )
 
 
+def test_eval_points_sharded_compat_walk_kernel_route(monkeypatch):
+    """Force the compat whole-walk kernel inside the sharded pointwise
+    path (interpreter mode off-TPU): per-shard keys pad to the 8-key
+    sublane quantum and results must match the XLA route bit-for-bit."""
+    from dpf_tpu.parallel import eval_points_sharded
+
+    rng = np.random.default_rng(91)
+    log_n, K, Q = 12, 5, 7  # K pads 5 -> 32 (4 shards x 8)
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, kb_ = dpf_tpu.gen_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    xs[:, 0] = alphas
+    mesh = make_mesh(4, 1, devices=jax.devices()[:4])
+    want = eval_points_sharded(ka, xs, mesh)
+    monkeypatch.setenv("DPF_TPU_POINTS_AES", "pallas")
+    got = eval_points_sharded(ka, xs, mesh)
+    np.testing.assert_array_equal(got, want)
+    rec = got ^ eval_points_sharded(kb_, xs, mesh)
+    np.testing.assert_array_equal(
+        rec, (xs == alphas[:, None]).astype(np.uint8)
+    )
+
+
 @pytest.mark.parametrize("log_n", [11, 33])
 def test_eval_points_sharded_fast_matches(log_n):
     from dpf_tpu.models.keys_chacha import gen_batch as gen_fast
