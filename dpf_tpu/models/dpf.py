@@ -394,10 +394,13 @@ def eval_points(
     if (xs >> np.uint64(kb.log_n)).any():
         raise ValueError("dpf: query index out of domain")
     backend = backend or default_backend()
-    # The whole-walk kernel replaces the per-level pipeline only for the
+    # The whole-walk kernel replaces the per-level pipeline for the
     # TPU-default (bit-major) backend family; an explicit backend="xla"
-    # keeps the XLA body (A/B and differential reference).
-    if backend in _BM_BACKENDS and aes_pallas.walk_backend() == "pallas":
+    # keeps the XLA body (A/B and differential reference) unless
+    # DPF_TPU_POINTS_AES=pallas forces the kernel outright.
+    if aes_pallas.walk_backend() == "pallas" and (
+        backend in _BM_BACKENDS or aes_pallas.walk_forced()
+    ):
         return _eval_points_walk_compat(kb, xs)
     pad_q = (-Q) % 32
     if pad_q:
@@ -487,6 +490,127 @@ def _eval_points_walk_body(
 
 _eval_points_walk_jit = partial(jax.jit, static_argnums=(0, 1, 10))(
     _eval_points_walk_body
+)
+
+
+def eval_points_level_grouped(
+    kb: KeyBatch, xs: np.ndarray, groups: int, reduce: bool = False,
+    backend: str | None = None,
+) -> np.ndarray:
+    """FSS-support pointwise evaluation over level-major key groups
+    (compat profile; mirror of dpf_chacha.eval_points_level_grouped).
+
+    ``kb`` holds ``groups * log_n * G`` keys arranged as ``groups``
+    repeats of ``log_n`` level-major blocks of ``G`` gates (models/fss.py
+    layout); ``xs`` is the RAW gate queries uint64[G, Q].  Key ``i*G + g``
+    of each group is evaluated at xs[g] with its low ``log_n - 1 - i``
+    bits zeroed (the dyadic-prefix query).  On TPU the masking folds into
+    the whole-walk kernel's operand prep ON DEVICE — neither the host nor
+    the wire sees the level-replicated query tensor; otherwise the masked
+    queries are expanded host-side and walked by the XLA body.
+    -> uint8[groups * log_n * G, Q], or uint8[G, Q] with ``reduce`` (the
+    level/group XOR-fold happens on device on the kernel route)."""
+    xs = np.asarray(xs, dtype=np.uint64)
+    if xs.ndim != 2:
+        raise ValueError("dpf: xs must be [G, Q]")
+    G, Q = xs.shape
+    n = kb.log_n
+    if kb.k != groups * n * G:
+        raise ValueError("dpf: key count != groups * log_n * G")
+    if (xs >> np.uint64(n)).any():
+        raise ValueError("dpf: query index out of domain")
+    backend = backend or default_backend()
+    use_walk = (
+        aes_pallas.walk_backend() == "pallas"
+        and (backend in _BM_BACKENDS or aes_pallas.walk_forced())
+        and kb.k % aes_pallas._PKT == 0
+    )
+    if not use_walk:
+        shifts = (
+            np.uint64(n) - np.uint64(1)
+            - np.arange(n, dtype=np.uint64)
+        )[:, None, None]
+        qexp = ((xs[None] >> shifts) << shifts).reshape(n * G, Q)
+        if groups > 1:
+            qexp = np.concatenate([qexp] * groups)
+        bits = eval_points(kb, qexp, backend=backend)
+        if not reduce:
+            return bits
+        return np.bitwise_xor.reduce(
+            bits.reshape(groups * n, G, Q), axis=0
+        )
+    pad_q = (-Q) % 32
+    if pad_q:
+        xs = np.concatenate([xs, np.zeros((G, pad_q), np.uint64)], axis=1)
+    qp = xs.shape[1] // 32
+    xs_lo = jnp.asarray((xs & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    if n > 32:
+        xs_hi = jnp.asarray((xs >> np.uint64(32)).astype(np.uint32))
+    else:
+        xs_hi = jnp.zeros((1, 1), jnp.uint32)
+    packed = np.asarray(_grouped_walk_jit(
+        kb.nu, n, groups, G, *_point_masks(kb), xs_hi, xs_lo, qp, reduce
+    ))
+    bits = (
+        (packed[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
+    ).astype(np.uint8).reshape(packed.shape[0], -1)
+    return bits[:, :Q]
+
+
+def _grouped_walk_body(
+    nu, log_n, groups, G, seed_masks, t_masks, scw_masks, tl_masks,
+    tr_masks, fcw_masks, xs_hi, xs_lo, qp, reduce,
+):
+    """Kernel-route prep for level-grouped gates: per-block descent words
+    are the raw path bits ANDed with the static ``walk level <= block
+    level`` keep matrix, and the leaf-select masks use each block's
+    statically masked low bits — the dyadic-prefix replication never
+    materializes as query uploads."""
+    n = log_n
+    B = groups * n
+    K = B * G
+    lane = jnp.arange(32, dtype=jnp.uint32)
+
+    def packw(pb, k):
+        return (pb.reshape(k, qp, 32) << lane).sum(-1, dtype=jnp.uint32)
+
+    pws = []
+    for j in range(nu):
+        b = n - 1 - j
+        if b >= 32:
+            pb = (xs_hi >> np.uint32(b - 32)) & np.uint32(1)
+        else:
+            pb = (xs_lo >> np.uint32(b)) & np.uint32(1)
+        pw_raw = packw(pb, G)[None]  # [1, G, qp]
+        keep = np.array(
+            [1 if j <= (bi % n) else 0 for bi in range(B)], np.uint32
+        )
+        pws.append((pw_raw * keep[:, None, None]).reshape(K, qp))
+    pw = jnp.stack(pws) if nu else jnp.zeros((0, K, qp), jnp.uint32)
+    lowmask = np.array(
+        [(~((1 << max(0, n - 1 - (bi % n))) - 1)) & 127 for bi in range(B)],
+        np.uint32,
+    )
+    low_b = (xs_lo & np.uint32(127))[None] & lowmask[:, None, None]
+    low_k = low_b.reshape(K, -1)
+    sel = jnp.stack(
+        [packw((low_k == np.uint32(p)).astype(jnp.uint32), K)
+         for p in range(128)]
+    )
+    perm = jnp.asarray(aes_pallas._TO_BM)
+    packed = aes_pallas.eval_points_walk_planes(
+        seed_masks[perm], t_masks, scw_masks[:, perm], tl_masks, tr_masks,
+        fcw_masks, pw, sel, nu,
+    )  # [K, qp]
+    if reduce:
+        packed = jax.lax.reduce(
+            packed.reshape(B, G, qp), np.uint32(0), jax.lax.bitwise_xor, (0,)
+        )
+    return packed
+
+
+_grouped_walk_jit = partial(jax.jit, static_argnums=(0, 1, 2, 3, 12, 13))(
+    _grouped_walk_body
 )
 
 

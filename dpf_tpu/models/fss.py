@@ -70,8 +70,9 @@ def _profile_funcs(profile: str):
         return gb, ep, KeyBatchFast, kl, grouped
     if profile == "compat":
         from ..core.spec import key_len as kl
+        from .dpf import eval_points_level_grouped as grouped_c
 
-        return gen_batch, eval_points, KeyBatch, kl, None
+        return gen_batch, eval_points, KeyBatch, kl, grouped_c
     raise ValueError(f"fss: unknown profile {profile!r}")
 
 __all__ = [
@@ -191,9 +192,9 @@ def eval_lt_points(ck: CmpKeyBatch, xs: np.ndarray) -> np.ndarray:
 
     One device launch over all ``n * G`` level-DPFs; the level
     XOR-reduction collapses the unique matching level into the predicate.
-    The fast profile masks the dyadic-prefix queries on device
+    Both profiles mask the dyadic-prefix queries on device
     (eval_points_level_grouped) — the raw [G, Q] queries are all that
-    crosses the wire; the compat profile expands them host-side."""
+    crosses the wire; off-TPU the compat profile expands them host-side."""
     xs = np.asarray(xs, dtype=np.uint64)
     if xs.ndim != 2 or xs.shape[0] != ck.g:
         raise ValueError("fss: xs must be [G, Q]")

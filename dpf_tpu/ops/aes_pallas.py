@@ -355,6 +355,13 @@ def walk_backend() -> str:
     return "pallas" if _on_tpu() else "xla"
 
 
+def walk_forced() -> bool:
+    """True when DPF_TPU_POINTS_AES=pallas explicitly — an override that
+    engages the walk kernel even for a non-bit-major ``backend`` argument
+    (interpreter-mode tests and A/B runs)."""
+    return os.environ.get("DPF_TPU_POINTS_AES") == "pallas"
+
+
 def _walk_kernel_bm(
     seeds_ref, t_ref, scw_ref, tlcw_ref, trcw_ref, fcw_ref, pw_ref,
     sel_ref, rk_ref, o_ref, *, nu,

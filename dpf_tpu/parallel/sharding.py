@@ -415,8 +415,8 @@ def eval_points_sharded(
     K, Q = xs.shape
     from ..ops import aes_pallas
 
-    use_walk = (
-        aes_pallas.walk_backend() == "pallas" and backend in _BM_BACKENDS
+    use_walk = aes_pallas.walk_backend() == "pallas" and (
+        backend in _BM_BACKENDS or aes_pallas.walk_forced()
     )
     # Per-shard key counts must tile the walk kernel's 8-key sublane tile.
     quantum = n_keys * (aes_pallas._PKT if use_walk else 1)

@@ -201,6 +201,56 @@ def test_grouped_eval_matches_host_expanded_queries():
         np.testing.assert_array_equal(got, want)
 
 
+def test_compat_grouped_walk_kernel_matches_host_expanded(monkeypatch):
+    """The COMPAT grouped route with on-device dyadic-prefix masking
+    (whole-walk kernel, forced into interpreter mode here) must match the
+    host-expanded masked-query evaluation bit-for-bit, for plain lt gates
+    (groups=1) and the fused interval batch (groups=2), across a domain
+    whose masks reach into the 128-bit leaf (log_n=6, nu=0) and one with
+    real walk levels."""
+    from dpf_tpu.models.dpf import eval_points, eval_points_level_grouped
+    from dpf_tpu.models.fss import (
+        _masked_prefix_queries,
+        eval_interval_points,
+        gen_interval_batch,
+        gen_lt_batch,
+    )
+
+    rng = np.random.default_rng(53)
+    for log_n, G in ((6, 4), (12, 2)):
+        # groups * log_n * G multiple of 8 so the kernel route engages.
+        Q = 5
+        alphas = rng.integers(0, 1 << log_n, size=G, dtype=np.uint64)
+        ca, cb = gen_lt_batch(alphas, log_n, rng=rng, profile="compat")
+        xs = rng.integers(0, 1 << log_n, size=(G, Q), dtype=np.uint64)
+        xs[:, 0] = alphas
+        want = eval_points(
+            ca.levels, _masked_prefix_queries(xs, log_n), backend="xla"
+        )
+        monkeypatch.setenv("DPF_TPU_POINTS_AES", "pallas")
+        got = eval_points_level_grouped(
+            ca.levels, xs, groups=1, backend="pallas_bm"
+        )
+        np.testing.assert_array_equal(got, want)
+        monkeypatch.delenv("DPF_TPU_POINTS_AES")
+
+    # Interval gates (groups=2) end-to-end through the kernel route.
+    log_n = 12
+    lo = np.array([0, 100], dtype=np.uint64)
+    hi = np.array([50, (1 << log_n) - 1], dtype=np.uint64)
+    ia, ib = gen_interval_batch(lo, hi, log_n, rng=rng, profile="compat")
+    xs = rng.integers(0, 1 << log_n, size=(2, 8), dtype=np.uint64)
+    xs[:, :2] = np.stack([lo, hi], axis=1)
+    want = eval_interval_points(ia, xs) ^ eval_interval_points(ib, xs)
+    monkeypatch.setenv("DPF_TPU_POINTS_AES", "pallas")
+    ia._both = ib._both = None  # rebuild so the kernel route sees the batch
+    got = eval_interval_points(ia, xs) ^ eval_interval_points(ib, xs)
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(
+        got, ((xs >= lo[:, None]) & (xs <= hi[:, None])).astype(np.uint8)
+    )
+
+
 def test_interval_fast_profile_deep_domain():
     """groups=2 on-device masking with real walk levels (log_n > LEAF_LOG):
     the log_n=9 interval test has nu=0 and never exercises the descent
