@@ -551,6 +551,59 @@ def main():
     _emit(f"FSS lt-gate n={n5} {g5} gates x {q5} pts (fast, device)",
           g5 * q5 / dt / 1e6, "Mgate-evals/sec")
 
+    # Compat-profile gates (the reference's own cipher): same workload
+    # through the level-grouped compat route.  1024 gates, not 4096: the
+    # compat bit-plane key masks cost nu*128*4 B per level-DPF key
+    # (~430 MB at 1024 gates x 32 levels) and the shared device's HBM is
+    # not all ours.
+    g5c = 1024 if not small else 16
+    cac, _cbc = gen_lt_batch(
+        rng.integers(0, 1 << n5, size=g5c, dtype=np.uint64), n5, rng=rng,
+        profile="compat",
+    )
+    xs5c = xs5[:g5c]
+    from dpf_tpu.models.dpf import (
+        _grouped_walk_jit,
+        eval_points_level_grouped as grouped_compat,
+    )
+
+    dt = _timed_host_call(lambda: grouped_compat(
+        cac.levels, xs5c, groups=1, reduce=True
+    ))
+    _emit(f"FSS lt-gate n={n5} {g5c} gates x {q5} pts (compat, incl. dispatch)",
+          g5c * q5 / dt / 1e6, "Mgate-evals/sec")
+
+    kc5 = cac.levels.k
+    if use_aes_walk and kc5 % 8 == 0:
+        xs5p = xs5c if q5 % 32 == 0 else np.concatenate(
+            [xs5c, np.zeros((g5c, (-q5) % 32), np.uint64)], axis=1
+        )
+        qp5c = xs5p.shape[1] // 32
+        xs5c_lo = jnp.asarray((xs5p & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+        xs5c_hi = jnp.zeros((1, 1), jnp.uint32)
+        masks5c = _point_masks(cac.levels)
+
+        def chained5c(r):
+            @jax.jit
+            def f(sm, tm, scwm, tlm, trm, fcwm, xs_hi, xs_lo):
+                acc = jnp.uint32(0)
+                for _ in range(r):
+                    packed = _grouped_walk_jit(
+                        cac.levels.nu, n5, 1, g5c, sm, tm, scwm, tlm, trm,
+                        fcwm, xs_hi, xs_lo ^ (acc & 1), qp5c, True,
+                    )
+                    acc = acc ^ jnp.bitwise_xor.reduce(packed, axis=None)
+                return acc
+
+            return f
+
+        a5c = (*masks5c, xs5c_hi, xs5c_lo)
+        r5c = 9 if not small else 3
+        dt = _marginal_time(chained5c(1), chained5c(r5c), a5c, r5c,
+                            repeats=6, stat="median")
+        _emit(f"FSS lt-gate n={n5} {g5c} gates x {q5} pts (compat, device)",
+              g5c * q5 / dt / 1e6, "Mgate-evals/sec")
+
     # Same workload via the one-key-per-gate DCF (models/dcf.py): ~log_n x
     # less evaluation work and ~30x smaller keys than the per-level route.
     from dpf_tpu.models import dcf as dcf_mod
