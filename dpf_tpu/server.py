@@ -22,6 +22,11 @@ Endpoints (all POST, binary bodies, profile/params in the query string):
         -> K DCF keys (party A) || K DCF keys (party B)  (fast profile)
   /v1/dcf_eval_points?log_n=N&k=K&q=Q         body: keys || uint64 indices
         -> K*Q comparison-share bits (models/dcf.py; one key per gate)
+  /v1/dcf_interval_gen?log_n=N&k=K            body: K uint64 lo || K uint64 hi
+        -> party A blob || party B blob, each 2K DCF keys (upper, lower)
+           || K public const bytes
+  /v1/dcf_interval_eval?log_n=N&k=K&q=Q       body: one party blob || indices
+        -> K*Q interval-share bits (1{lo <= x <= hi} after XOR)
   /healthz                                    -> "ok"
 
 Batched endpoints amortize the device dispatch exactly like the in-process
@@ -143,6 +148,51 @@ class _Handler(BaseHTTPRequestHandler):
                 out = dcf.eval_lt_points(
                     dcf.DcfKeyBatch.from_bytes(keys, log_n), xs
                 )
+                self._reply(200, np.ascontiguousarray(out).tobytes())
+            elif route == "/v1/dcf_interval_gen":
+                from .models import dcf
+
+                k = int(q["k"])
+                if len(body) != k * 16:
+                    raise ValueError(f"body must be {k}*8 lo + {k}*8 hi bytes")
+                bounds = np.frombuffer(body, dtype="<u8")
+                ia, ib = dcf.gen_interval_batch(bounds[:k], bounds[k:], log_n)
+
+                def blob(ik):
+                    u, lo_, c = ik
+                    return (
+                        b"".join(u.to_bytes()) + b"".join(lo_.to_bytes())
+                        + c.astype("<u1").tobytes()
+                    )
+
+                self._reply(200, blob(ia) + blob(ib))
+            elif route == "/v1/dcf_interval_eval":
+                from .models import dcf
+
+                k, nq = int(q["k"]), int(q["q"])
+                kl = dcf.key_len(log_n)
+                blob_len = 2 * k * kl + k
+                if len(body) != blob_len + k * nq * 8:
+                    raise ValueError(
+                        f"body must be {blob_len} interval-share bytes "
+                        f"(2*{k}*{kl} keys + {k} consts) + {k}*{nq}*8 "
+                        "index bytes"
+                    )
+
+                def keys_at(off):
+                    return dcf.DcfKeyBatch.from_bytes(
+                        [bytes(body[off + i * kl : off + (i + 1) * kl])
+                         for i in range(k)],
+                        log_n,
+                    )
+
+                upper = keys_at(0)
+                lower = keys_at(k * kl)
+                const = np.frombuffer(
+                    body[2 * k * kl : blob_len], dtype="<u1"
+                )
+                xs = np.frombuffer(body[blob_len:], dtype="<u8").reshape(k, nq)
+                out = dcf.eval_interval_points((upper, lower, const), xs)
                 self._reply(200, np.ascontiguousarray(out).tobytes())
             else:
                 self._reply(404, b"not found", "text/plain")

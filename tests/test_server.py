@@ -127,3 +127,34 @@ def test_dcf_endpoints(srv):
     ).reshape(k, q)
     want = (xs < alphas[:, None]).astype(np.uint8)
     np.testing.assert_array_equal(rec, want)
+
+
+def test_dcf_interval_endpoints(srv):
+    from dpf_tpu.models import dcf as dcf_mod
+
+    log_n, k, q = 10, 3, 6
+    lo = np.array([0, 100, 512], dtype="<u8")
+    hi = np.array([0, 400, (1 << log_n) - 1], dtype="<u8")
+    blob = _post(
+        f"{srv}/v1/dcf_interval_gen?log_n={log_n}&k={k}",
+        lo.tobytes() + hi.tobytes(),
+    )
+    kl = dcf_mod.key_len(log_n)
+    half = 2 * k * kl + k
+    assert len(blob) == 2 * half
+    xs = np.array(
+        [[l, h, (int(h) + 1) % (1 << log_n), 0, (1 << log_n) - 1, int(l)]
+         for l, h in zip(lo, hi)],
+        dtype="<u8",
+    )
+    halves = []
+    for h in (0, 1):
+        body = blob[h * half : (h + 1) * half] + xs.tobytes()
+        halves.append(_post(
+            f"{srv}/v1/dcf_interval_eval?log_n={log_n}&k={k}&q={q}", body
+        ))
+    rec = (
+        np.frombuffer(halves[0], np.uint8) ^ np.frombuffer(halves[1], np.uint8)
+    ).reshape(k, q)
+    want = ((xs >= lo[:, None]) & (xs <= hi[:, None])).astype(np.uint8)
+    np.testing.assert_array_equal(rec, want)
