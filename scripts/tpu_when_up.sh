@@ -1,0 +1,36 @@
+#!/bin/bash
+# Round-4 TPU validation sequence: waits for the axon tunnel to come back,
+# then runs correctness checks, the A/B experiments, and the full bench
+# matrix in one shot (each step hard-capped — the tunnel can wedge again
+# mid-sequence).  Logs under /tmp/tpu_r4/.
+set -u
+cd /root/repo
+OUT=/tmp/tpu_r4
+mkdir -p "$OUT"
+
+echo "waiting for tunnel..." | tee "$OUT/status"
+while true; do
+  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    break
+  fi
+  sleep 240
+done
+echo "tunnel up at $(date)" | tee -a "$OUT/status"
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 to=$2; shift 2
+  echo "=== $name start $(date +%H:%M:%S)" | tee -a "$OUT/status"
+  timeout "$to" "$@" >"$OUT/$name.log" 2>&1
+  echo "=== $name rc=$? end $(date +%H:%M:%S)" | tee -a "$OUT/status"
+}
+
+run tpu_checks      2400 python scripts/tpu_checks.py
+run smalltree_test  1800 python -m pytest \
+    "tests/test_chacha_pallas.py::test_expand_kernel_small_tree_matches_xla_tpu" -q
+run sbox_ab         2400 python scripts/bench_compat_ab.py \
+    pallas_bm:128:bp113 pallas_bm:128:lowlive \
+    pallas_bm:128:bp113 pallas_bm:128:lowlive
+run smalltree_ab    2400 python scripts/bench_small_tree_ab.py
+run bench_all       5400 python bench_all.py
+run bench           1200 python bench.py
+echo "sequence complete $(date)" | tee -a "$OUT/status"
