@@ -150,6 +150,144 @@ func (c *Client) EvalPointsBatch(keys []DPFkey, xs [][]uint64, logN uint) ([][]b
 	return res, nil
 }
 
+// DcfGen generates K one-key-per-gate comparison key pairs: evaluating a
+// pair's shares at x and XORing them yields 1{x < alphas[i]}
+// (models/dcf.py; fast-profile keys, ~30x smaller than per-level FSS
+// gates).  Returns the two parties' key slices.
+func (c *Client) DcfGen(alphas []uint64, logN uint) ([]DPFkey, []DPFkey, error) {
+	if len(alphas) == 0 {
+		return nil, nil, nil
+	}
+	body := make([]byte, 0, 8*len(alphas))
+	for _, a := range alphas {
+		body = binary.LittleEndian.AppendUint64(body, a)
+	}
+	out, err := c.post(
+		fmt.Sprintf("/v1/dcf_gen?log_n=%d&k=%d", logN, len(alphas)), body)
+	if err != nil {
+		return nil, nil, err
+	}
+	n := len(alphas)
+	if len(out) == 0 || len(out)%(2*n) != 0 {
+		return nil, nil, fmt.Errorf("dpftpu: bad dcf_gen reply length %d", len(out))
+	}
+	kl := len(out) / (2 * n)
+	split := func(off int) []DPFkey {
+		keys := make([]DPFkey, n)
+		for i := range keys {
+			keys[i] = DPFkey(out[off+i*kl : off+(i+1)*kl])
+		}
+		return keys
+	}
+	return split(0), split(n * kl), nil
+}
+
+// DcfEvalPoints evaluates K comparison shares at Q points each in one
+// round trip; reply bit [i][j] XORed across parties is 1{xs[i][j] < alpha_i}.
+func (c *Client) DcfEvalPoints(keys []DPFkey, xs [][]uint64, logN uint) ([][]byte, error) {
+	if len(keys) == 0 {
+		return nil, nil
+	}
+	if len(xs) != len(keys) {
+		return nil, fmt.Errorf("dpftpu: xs rows != key count")
+	}
+	kl := len(keys[0])
+	nq := len(xs[0])
+	body := make([]byte, 0, kl*len(keys)+8*nq*len(keys))
+	for _, k := range keys {
+		if len(k) != kl {
+			return nil, fmt.Errorf("dpftpu: inconsistent key lengths")
+		}
+		body = append(body, k...)
+	}
+	for _, row := range xs {
+		if len(row) != nq {
+			return nil, fmt.Errorf("dpftpu: inconsistent query row lengths")
+		}
+		for _, x := range row {
+			body = binary.LittleEndian.AppendUint64(body, x)
+		}
+	}
+	out, err := c.post(fmt.Sprintf(
+		"/v1/dcf_eval_points?log_n=%d&k=%d&q=%d", logN, len(keys), nq), body)
+	if err != nil {
+		return nil, err
+	}
+	if len(out) != len(keys)*nq {
+		return nil, fmt.Errorf("dpftpu: bad dcf points reply length %d", len(out))
+	}
+	res := make([][]byte, len(keys))
+	for i := range keys {
+		res[i] = out[i*nq : (i+1)*nq]
+	}
+	return res, nil
+}
+
+// DcfIntervalGen generates K interval gates 1{lo[i] <= x <= hi[i]} and
+// returns the two parties' shares as opaque blobs (upper+lower DCF key
+// sets plus the public wrap-edge constant; pass a blob unchanged to
+// DcfIntervalEval).
+func (c *Client) DcfIntervalGen(lo, hi []uint64, logN uint) ([]byte, []byte, error) {
+	if len(lo) != len(hi) {
+		return nil, nil, fmt.Errorf("dpftpu: lo/hi length mismatch")
+	}
+	if len(lo) == 0 {
+		return nil, nil, nil
+	}
+	body := make([]byte, 0, 16*len(lo))
+	for _, v := range lo {
+		body = binary.LittleEndian.AppendUint64(body, v)
+	}
+	for _, v := range hi {
+		body = binary.LittleEndian.AppendUint64(body, v)
+	}
+	out, err := c.post(
+		fmt.Sprintf("/v1/dcf_interval_gen?log_n=%d&k=%d", logN, len(lo)), body)
+	if err != nil {
+		return nil, nil, err
+	}
+	if len(out) == 0 || len(out)%2 != 0 {
+		return nil, nil, fmt.Errorf(
+			"dpftpu: bad dcf_interval_gen reply length %d", len(out))
+	}
+	h := len(out) / 2
+	return out[:h], out[h:], nil
+}
+
+// DcfIntervalEval evaluates one party's interval blob (from
+// DcfIntervalGen) at Q points per gate; XORing the parties' replies
+// yields 1{lo_i <= xs[i][j] <= hi_i}.
+func (c *Client) DcfIntervalEval(blob []byte, xs [][]uint64, logN uint) ([][]byte, error) {
+	if len(xs) == 0 {
+		return nil, nil
+	}
+	nq := len(xs[0])
+	body := make([]byte, 0, len(blob)+8*nq*len(xs))
+	body = append(body, blob...)
+	for _, row := range xs {
+		if len(row) != nq {
+			return nil, fmt.Errorf("dpftpu: inconsistent query row lengths")
+		}
+		for _, x := range row {
+			body = binary.LittleEndian.AppendUint64(body, x)
+		}
+	}
+	out, err := c.post(fmt.Sprintf(
+		"/v1/dcf_interval_eval?log_n=%d&k=%d&q=%d", logN, len(xs), nq), body)
+	if err != nil {
+		return nil, err
+	}
+	if len(out) != len(xs)*nq {
+		return nil, fmt.Errorf(
+			"dpftpu: bad dcf interval reply length %d", len(out))
+	}
+	res := make([][]byte, len(xs))
+	for i := range xs {
+		res[i] = out[i*nq : (i+1)*nq]
+	}
+	return res, nil
+}
+
 // EvalFullBatch expands K shares in one round trip — the entry point that
 // amortizes the device dispatch and where the TPU speedup lives.  All keys
 // must have the same logN; the reply is the K concatenated expansions.
