@@ -100,6 +100,15 @@ def _load():
         lib.dpfn_cc_eval_points_batch.argtypes = [
             u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, u64p, ctypes.c_uint64, u8p,
         ]
+        # DCF (one-key-per-gate comparison, models/dcf.py layout).
+        lib.dpfn_dcf_key_len.restype = ctypes.c_uint64
+        lib.dpfn_dcf_key_len.argtypes = [ctypes.c_uint64]
+        lib.dpfn_dcf_gen.restype = ctypes.c_int
+        lib.dpfn_dcf_gen.argtypes = [ctypes.c_uint64, ctypes.c_uint64, u8p, u8p, u8p, u8p]
+        lib.dpfn_dcf_eval_points_batch.restype = ctypes.c_int
+        lib.dpfn_dcf_eval_points_batch.argtypes = [
+            u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, u64p, ctypes.c_uint64, u8p,
+        ]
         _lib = lib
         return _lib
 
@@ -287,4 +296,55 @@ def cc_eval_points_batch(keys: list[bytes], xs: np.ndarray, log_n: int) -> np.nd
     )
     if rc:
         raise ValueError(f"dpf-fast: native eval_points_batch failed (rc={rc})")
+    return out
+
+
+# --------------------------------------------------------------------------
+# DCF (one-key-per-gate comparison): native mirrors of models/dcf.py
+# --------------------------------------------------------------------------
+
+
+def dcf_gen(
+    alpha: int, log_n: int, rng: np.random.Generator | None = None
+) -> tuple[bytes, bytes]:
+    """Native DCF Gen for one gate ``1{x < alpha}`` (key layout:
+    models/dcf.py — seed | t | nu*(sCW|tL|tR|VCW) | FVCW)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    if rng is None:
+        seeds = np.frombuffer(os.urandom(32), dtype=np.uint8).copy()
+    else:
+        seeds = rng.integers(0, 256, size=32, dtype=np.uint8)
+    klen = int(lib.dpfn_dcf_key_len(log_n))
+    ka = np.empty(klen, np.uint8)
+    kb = np.empty(klen, np.uint8)
+    rc = lib.dpfn_dcf_gen(alpha, log_n, _u8ptr(seeds[:16]), _u8ptr(seeds[16:]),
+                          _u8ptr(ka), _u8ptr(kb))
+    if rc:
+        raise ValueError("dcf: invalid parameters")
+    return ka.tobytes(), kb.tobytes()
+
+
+def dcf_eval_points_batch(keys: list[bytes], xs: np.ndarray, log_n: int) -> np.ndarray:
+    """Native DCF comparison walk: keys (one per gate) evaluated at xs
+    uint64[K, Q] -> uint8[K, Q] shares."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    klen = int(lib.dpfn_dcf_key_len(log_n))
+    arr = np.frombuffer(b"".join(keys), dtype=np.uint8)
+    if arr.size != klen * len(keys):
+        raise ValueError("dcf: bad key length in batch")
+    xs = np.ascontiguousarray(xs, dtype=np.uint64)
+    k, q = xs.shape
+    if k != len(keys):
+        raise ValueError("xs first axis must match number of keys")
+    out = np.empty((k, q), np.uint8)
+    rc = lib.dpfn_dcf_eval_points_batch(
+        _u8ptr(arr), k, klen, log_n,
+        xs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), q, _u8ptr(out),
+    )
+    if rc:
+        raise ValueError(f"dcf: native eval_points_batch failed (rc={rc})")
     return out

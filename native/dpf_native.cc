@@ -775,3 +775,164 @@ int dpfn_cc_eval_points_batch(const uint8_t* keys, uint64_t n_keys,
 }
 
 }  // extern "C"
+
+// ===========================================================================
+// DCF (one-key-per-gate comparison, fast-profile tree) — native mirror of
+// dpf_tpu/models/dcf.py.  Keys: seed(16) | t(1) | nu*(sCW(16)|tL(1)|tR(1)|
+// VCW(1)) | FVCW(64).  The node PRG is the same ChaCha block as cc::expand
+// with one extra output word (the per-node value); Gen publishes its
+// per-level LSB correction, Eval accumulates it on left descents, and the
+// in-leaf threshold resolves against the FVCW-corrected leaf block.
+// ===========================================================================
+
+namespace dcf {
+
+inline uint64_t klen(uint64_t log_n) {
+  return 17 + 19 * cc::levels(log_n) + 64;
+}
+
+// (left, right, value-word LSB) from one 9-word ChaCha expand block.
+inline void expand_v(const uint32_t seed[4], uint32_t l[4], uint32_t r[4],
+                     uint32_t* v) {
+  uint32_t out[9];
+  cc::block(seed, cc::kDsExpand, out, 9);
+  std::memcpy(l, out, 16);
+  std::memcpy(r, out + 4, 16);
+  *v = out[8];
+}
+
+inline bool canonical(const uint8_t* key, uint64_t log_n) {
+  const uint64_t lv = cc::levels(log_n);
+  if (key[0] & 1 || key[16] > 1) return false;
+  for (uint64_t i = 0; i < lv; i++) {
+    const uint8_t* cw = key + 17 + 19 * i;
+    if (cw[0] & 1 || cw[16] > 1 || cw[17] > 1 || cw[18] > 1) return false;
+  }
+  return true;
+}
+
+}  // namespace dcf
+
+extern "C" {
+
+uint64_t dpfn_dcf_key_len(uint64_t log_n) { return dcf::klen(log_n); }
+
+int dpfn_dcf_gen(uint64_t alpha, uint64_t log_n, const uint8_t* seed0,
+                 const uint8_t* seed1, uint8_t* ka, uint8_t* kb) {
+  if (log_n > 63 || log_n < 1 || alpha >> log_n) return -1;
+  const uint64_t lv = cc::levels(log_n);
+
+  uint32_t s0[4], s1[4];
+  cc::load4(seed0, s0);
+  cc::load4(seed1, s1);
+  int t0 = s0[0] & 1, t1 = t0 ^ 1;
+  s0[0] &= ~1u;
+  s1[0] &= ~1u;
+  cc::store4(ka, s0);
+  ka[16] = static_cast<uint8_t>(t0);
+  cc::store4(kb, s1);
+  kb[16] = static_cast<uint8_t>(t1);
+  uint8_t* cw_out = ka + 17;
+
+  for (uint64_t i = 0; i < lv; i++) {
+    uint32_t l0[4], r0[4], l1[4], r1[4], v0, v1;
+    dcf::expand_v(s0, l0, r0, &v0);
+    dcf::expand_v(s1, l1, r1, &v1);
+    int t0l = l0[0] & 1, t0r = r0[0] & 1, t1l = l1[0] & 1, t1r = r1[0] & 1;
+    l0[0] &= ~1u;
+    r0[0] &= ~1u;
+    l1[0] &= ~1u;
+    r1[0] &= ~1u;
+
+    const uint32_t bit = (alpha >> (log_n - 1 - i)) & 1;
+    uint32_t scw[4];
+    std::memcpy(scw, bit ? l0 : r0, 16);
+    cc::xor4(scw, bit ? l1 : r1);
+    const uint8_t tlcw = static_cast<uint8_t>(t0l ^ t1l ^ bit ^ 1);
+    const uint8_t trcw = static_cast<uint8_t>(t0r ^ t1r ^ bit);
+    cc::store4(cw_out, scw);
+    cw_out[16] = tlcw;
+    cw_out[17] = trcw;
+    cw_out[18] = static_cast<uint8_t>((v0 ^ v1 ^ bit) & 1);
+
+    std::memcpy(s0, bit ? r0 : l0, 16);
+    std::memcpy(s1, bit ? r1 : l1, 16);
+    const int keep_t0 = bit ? t0r : t0l;
+    const int keep_t1 = bit ? t1r : t1l;
+    const uint8_t keep_tcw = bit ? trcw : tlcw;
+    if (t0) cc::xor4(s0, scw);
+    if (t1) cc::xor4(s1, scw);
+    t0 = keep_t0 ^ (t0 ? keep_tcw : 0);
+    t1 = keep_t1 ^ (t1 ? keep_tcw : 0);
+    cw_out += 19;
+  }
+
+  uint32_t c0[16], c1[16];
+  cc::convert(s0, c0);
+  cc::convert(s1, c1);
+  for (int i = 0; i < 16; i++) c0[i] ^= c1[i];
+  // In-leaf threshold mask: bits j < alpha_low set (LSB-first).
+  const uint64_t low = log_n >= cc::kLeafLog ? (alpha & 511) : alpha;
+  for (uint64_t j = 0; j < low; j++) c0[j >> 5] ^= 1u << (j & 31);
+  std::memcpy(cw_out, c0, 64);
+  std::memcpy(kb + 17, ka + 17, 19 * lv + 64);
+  return 0;
+}
+
+// Comparison-share walk: out bits uint8[n_keys * n_points], one key per
+// gate (same layout as dpfn_cc_eval_points_batch).
+int dpfn_dcf_eval_points_batch(const uint8_t* keys, uint64_t n_keys,
+                               uint64_t key_len, uint64_t log_n,
+                               const uint64_t* xs, uint64_t n_points,
+                               uint8_t* out_bits) {
+  if (log_n > 63 || log_n < 1 || key_len != dcf::klen(log_n)) return -1;
+  const uint64_t lv = cc::levels(log_n);
+  for (uint64_t i = 0; i < n_keys; i++) {
+    const uint8_t* key = keys + i * key_len;
+    if (!dcf::canonical(key, log_n)) return -4;
+    const uint8_t* fvcw = key + key_len - 64;
+    for (uint64_t j = 0; j < n_points; j++) {
+      const uint64_t x = xs[i * n_points + j];
+      if (x >> log_n) return -3;
+      uint32_t s[4];
+      cc::load4(key, s);
+      int t = key[16];
+      uint32_t acc = 0;
+      for (uint64_t d = 0; d < lv; d++) {
+        const uint8_t* cw = key + 17 + 19 * d;
+        uint32_t l[4], r[4], v;
+        dcf::expand_v(s, l, r, &v);
+        int tl = l[0] & 1, tr = r[0] & 1;
+        l[0] &= ~1u;
+        r[0] &= ~1u;
+        const uint32_t xbit = (x >> (log_n - 1 - d)) & 1;
+        if (!xbit) acc ^= (v ^ (t ? cw[18] : 0)) & 1;
+        if (t) {
+          uint32_t scw[4];
+          cc::load4(cw, scw);
+          cc::xor4(l, scw);
+          cc::xor4(r, scw);
+          tl ^= cw[16];
+          tr ^= cw[17];
+        }
+        std::memcpy(s, xbit ? r : l, 16);
+        t = xbit ? tr : tl;
+      }
+      uint32_t leaf[16];
+      cc::convert(s, leaf);
+      if (t) {
+        for (int w = 0; w < 16; w++) {
+          uint32_t v;
+          std::memcpy(&v, fvcw + 4 * w, 4);
+          leaf[w] ^= v;
+        }
+      }
+      const uint64_t low = log_n >= cc::kLeafLog ? (x & 511) : x;
+      acc ^= (leaf[low >> 5] >> (low & 31)) & 1;
+      out_bits[i * n_points + j] = static_cast<uint8_t>(acc & 1);
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -177,6 +177,39 @@ def test_dcf_golden_vectors(log_n, seed, key_sha, out_sha):
     assert hashlib.sha256(bits.tobytes()).hexdigest() == out_sha
 
 
+def test_dcf_native_second_source():
+    """The C++ backend must regenerate byte-identical DCF keys from the
+    same rng draws and agree with the NumPy spec evaluation — an
+    independent implementation pinning the wire format and the
+    comparison semantics (like the DPF golden-vector second source)."""
+    from dpf_tpu.backends import cpu_native as cn
+
+    if not cn.available():
+        pytest.skip(f"native backend unavailable: {cn.load_error()}")
+    rng = np.random.default_rng(91)
+    for log_n, alpha in ((8, 200), (20, 777777), (33, (1 << 33) - 1)):
+        r1 = np.random.default_rng(log_n)
+        r2 = np.random.default_rng(log_n)
+        ka_py, kb_py = dcf.gen_lt_batch(
+            np.array([alpha], np.uint64), log_n, rng=r1
+        )
+        ka_n, kb_n = cn.dcf_gen(alpha, log_n, rng=r2)
+        assert ka_py.to_bytes()[0] == ka_n, f"key A bytes drifted n={log_n}"
+        assert kb_py.to_bytes()[0] == kb_n, f"key B bytes drifted n={log_n}"
+        xs = rng.integers(0, 1 << log_n, size=(1, 9), dtype=np.uint64)
+        xs[0, :3] = (alpha, max(alpha - 1, 0), 0)
+        got_a = cn.dcf_eval_points_batch([ka_n], xs, log_n)
+        got_b = cn.dcf_eval_points_batch([kb_n], xs, log_n)
+        np.testing.assert_array_equal(
+            got_a, dcf.eval_points_np(ka_py, xs), f"native eval A n={log_n}"
+        )
+        np.testing.assert_array_equal(
+            got_a ^ got_b,
+            (xs < np.uint64(alpha)).astype(np.uint8),
+            f"native reconstruction n={log_n}",
+        )
+
+
 def test_dcf_max_domain_log_n_63():
     """The reference's documented domain limit (dpf/dpf.go:72, log_n <= 63):
     descent-bit extraction must be correct through the full uint64 range."""
