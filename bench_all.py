@@ -671,6 +671,39 @@ def main():
     _emit(f"FSS lt-gate n={n5} {g5} gates x {q5} pts (DCF, device)",
           g5 * q5 / dt / 1e6, "Mgate-evals/sec")
 
+    # Single-core native baseline for the same gate workload (the C++ DCF
+    # walk, one gate-point at a time — what one CPU core does with the
+    # identical keys): gives config 5 a measured reference point the way
+    # measure_baseline() does for the expansion configs.
+    try:
+        from dpf_tpu.backends import cpu_native as cn
+
+        if cn.available():
+            gb = min(g5, 64)
+            rngb = np.random.default_rng(5)
+            pairs = [
+                cn.dcf_gen(int(a), n5, rng=rngb)
+                for a in rngb.integers(0, 1 << n5, size=gb, dtype=np.uint64)
+            ]
+            keysb = [p[0] for p in pairs]
+            xsb = rngb.integers(0, 1 << n5, size=(gb, q5), dtype=np.uint64)
+            cn.dcf_eval_points_batch(keysb[:4], xsb[:4], n5)  # warm
+            best = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                cn.dcf_eval_points_batch(keysb, xsb, n5)
+                best = min(best, time.perf_counter() - t0)
+            _emit(
+                f"FSS lt-gate n={n5} {gb} gates x {q5} pts "
+                "(DCF, native 1-core baseline)",
+                gb * q5 / best / 1e6, "Mgate-evals/sec",
+            )
+    except Exception as e:  # baseline is best-effort, never fails the run
+        print(json.dumps({
+            "metric": "dcf native baseline", "value": 0, "unit": "",
+            "detail": f"skipped: {type(e).__name__}: {e}",
+        }), flush=True)
+
 
 if __name__ == "__main__":
     main()
