@@ -81,8 +81,17 @@ def comparison_gates():
         (fast.dcf_eval_lt_points(da, xs) ^ fast.dcf_eval_lt_points(db, xs))
         == want
     ).all()
+
+    # Interval gates 1{lo <= x <= hi} (two DCFs per gate + a public const).
+    from dpf_tpu.models.dcf import eval_interval_points, gen_interval_batch
+
+    lo = np.array([500, 0], dtype=np.uint64)
+    hi = np.array([1500, 60000], dtype=np.uint64)
+    ia, ib = gen_interval_batch(lo, hi, log_n)
+    got = eval_interval_points(ia, xs) ^ eval_interval_points(ib, xs)
+    assert (got == ((xs >= lo[:, None]) & (xs <= hi[:, None]))).all()
     print(
-        "compare  : per-level FSS and one-key DCF ok "
+        "compare  : per-level FSS, one-key DCF, and interval gates ok "
         f"(DCF key {fast.dcf_key_len(log_n)} B/gate)"
     )
 
