@@ -398,10 +398,15 @@ def eval_points(
     # TPU-default (bit-major) backend family; an explicit backend="xla"
     # keeps the XLA body (A/B and differential reference) unless
     # DPF_TPU_POINTS_AES=pallas forces the kernel outright.
-    if aes_pallas.walk_backend() == "pallas" and (
-        backend in _BM_BACKENDS or aes_pallas.walk_forced()
+    if (
+        not _WALK_KERNEL_BROKEN
+        and aes_pallas.walk_backend() == "pallas"
+        and (backend in _BM_BACKENDS or aes_pallas.walk_forced())
     ):
-        return _eval_points_walk_compat(kb, xs)
+        try:
+            return _eval_points_walk_compat(kb, xs)
+        except Exception as e:  # noqa: BLE001
+            _walk_kernel_degraded(e)
     pad_q = (-Q) % 32
     if pad_q:
         xs = np.concatenate([xs, np.zeros((K, pad_q), np.uint64)], axis=1)
@@ -417,6 +422,30 @@ def eval_points(
         kb.nu, kb.log_n, *_point_masks(kb), xs_hi, xs_lo, qp, backend
     )
     return np.asarray(bits)[:, :Q]
+
+
+# Sticky failure latch for the compat walk kernel: a Mosaic lowering
+# failure on some hardware should degrade the serving path to the XLA
+# body ONCE (recompiling a failing kernel on every call is not a
+# fallback), never kill it.
+_WALK_KERNEL_BROKEN = False
+
+
+def _walk_kernel_degraded(e: Exception) -> None:
+    """Latch a walk-kernel failure so callers fall back to the XLA route.
+    Forced experiments (DPF_TPU_POINTS_AES=pallas) re-raise so A/Bs and
+    tests never silently measure the fallback."""
+    global _WALK_KERNEL_BROKEN
+    import warnings
+
+    if aes_pallas.walk_forced():
+        raise e
+    _WALK_KERNEL_BROKEN = True
+    warnings.warn(
+        f"compat walk kernel unavailable, using the XLA body: {e}",
+        RuntimeWarning,
+        stacklevel=3,
+    )
 
 
 def _eval_points_walk_compat(kb: KeyBatch, xs: np.ndarray) -> np.ndarray:
@@ -521,7 +550,8 @@ def eval_points_level_grouped(
         raise ValueError("dpf: query index out of domain")
     backend = backend or default_backend()
     use_walk = (
-        aes_pallas.walk_backend() == "pallas"
+        not _WALK_KERNEL_BROKEN
+        and aes_pallas.walk_backend() == "pallas"
         and (backend in _BM_BACKENDS or aes_pallas.walk_forced())
         and kb.k % aes_pallas._PKT == 0
     )
@@ -548,9 +578,15 @@ def eval_points_level_grouped(
         xs_hi = jnp.asarray((xs >> np.uint64(32)).astype(np.uint32))
     else:
         xs_hi = jnp.zeros((1, 1), jnp.uint32)
-    packed = np.asarray(_grouped_walk_jit(
-        kb.nu, n, groups, G, *_point_masks(kb), xs_hi, xs_lo, qp, reduce
-    ))
+    try:
+        packed = np.asarray(_grouped_walk_jit(
+            kb.nu, n, groups, G, *_point_masks(kb), xs_hi, xs_lo, qp, reduce
+        ))
+    except Exception as e:  # noqa: BLE001
+        _walk_kernel_degraded(e)
+        return eval_points_level_grouped(
+            kb, xs[:, :Q], groups, reduce, backend
+        )
     bits = (
         (packed[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
     ).astype(np.uint8).reshape(packed.shape[0], -1)

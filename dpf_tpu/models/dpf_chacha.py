@@ -299,6 +299,14 @@ def eval_full_device(
         raise ValueError(f"dpf-fast: unknown backend {backend!r}")
     eligible, entry_level, _ = cp.expand_plan(nu, kb.k, max_leaf_nodes)
     if backend == "pallas":
+        if eligible and entry_level == 0:
+            # TPU-only whole-tree route, not coverable by interpreter
+            # tests: degrade to the classic plan if Mosaic rejects it.
+            try:
+                return _eval_full_pallas_device(kb, entry_level)
+            except Exception as e:  # noqa: BLE001
+                cp.small_tree_degraded(e)
+                return eval_full_device(kb, max_leaf_nodes, backend)
         if eligible:
             return _eval_full_pallas_device(kb, entry_level)
         ok_c, s_c, _, n_chunks = cp.expand_plan_chunked(

@@ -429,6 +429,28 @@ def _expand_kernel(
 # 128-lane entry (128 << 5 = 4096 leaf lanes) allows nu <= 12 here.
 _EXP_SMALL_MAX_NU = 12
 
+# Sticky failure latch: a Mosaic lowering failure of the narrow entry-0
+# program on some hardware degrades small domains to the classic plan
+# once, instead of recompiling a failing kernel per call.
+_SMALL_TREE_BROKEN = False
+
+
+def small_tree_degraded(e: Exception) -> None:
+    """Latch an entry-0 route failure (callers re-plan and take the
+    classic/XLA path).  An explicit DPF_TPU_EXPAND_ENTRY=small re-raises
+    so A/B experiments never silently measure the fallback."""
+    global _SMALL_TREE_BROKEN
+    import warnings
+
+    if os.environ.get("DPF_TPU_EXPAND_ENTRY") == "small":
+        raise e
+    _SMALL_TREE_BROKEN = True
+    warnings.warn(
+        f"whole-tree expand route unavailable, using the classic plan: {e}",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
 
 def small_tree_entry(nu: int):
     """Entry level for the whole-tree small-domain route, or None when the
@@ -443,6 +465,8 @@ def small_tree_entry(nu: int):
     if mode not in ("auto", "small", "classic"):
         raise ValueError("DPF_TPU_EXPAND_ENTRY must be auto|small|classic")
     if mode == "classic" or not 1 <= nu <= _EXP_SMALL_MAX_NU:
+        return None
+    if _SMALL_TREE_BROKEN:
         return None
     # TPU-only: XLA:CPU's compile time explodes exponentially in the
     # number of narrow-lane concat levels (W=1 entry, levels=2 exceeds

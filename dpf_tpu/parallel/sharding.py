@@ -415,28 +415,39 @@ def eval_points_sharded(
     K, Q = xs.shape
     from ..ops import aes_pallas
 
-    use_walk = aes_pallas.walk_backend() == "pallas" and (
-        backend in _BM_BACKENDS or aes_pallas.walk_forced()
+    from ..models import dpf as mdpf
+
+    use_walk = (
+        not mdpf._WALK_KERNEL_BROKEN
+        and aes_pallas.walk_backend() == "pallas"
+        and (backend in _BM_BACKENDS or aes_pallas.walk_forced())
     )
     # Per-shard key counts must tile the walk kernel's 8-key sublane tile.
     quantum = n_keys * (aes_pallas._PKT if use_walk else 1)
     pad = (-K) % quantum
-    kb = _pad_compat_batch(kb, pad)
+    kbp = _pad_compat_batch(kb, pad)
+    xsp = xs
     if pad:
-        xs = np.concatenate([xs, np.zeros((pad, Q), np.uint64)])
+        xsp = np.concatenate([xsp, np.zeros((pad, Q), np.uint64)])
     pad_q = (-Q) % 32
     if pad_q:
-        xs = np.concatenate(
-            [xs, np.zeros((xs.shape[0], pad_q), np.uint64)], axis=1
+        xsp = np.concatenate(
+            [xsp, np.zeros((xsp.shape[0], pad_q), np.uint64)], axis=1
         )
-    qp = xs.shape[1] // 32
-    xs_lo = jnp.asarray((xs & np.uint64(0xFFFFFFFF)).astype(np.uint32))
-    if kb.log_n > 32:
-        xs_hi = jnp.asarray((xs >> np.uint64(32)).astype(np.uint32))
+    qp = xsp.shape[1] // 32
+    xs_lo = jnp.asarray((xsp & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    if kbp.log_n > 32:
+        xs_hi = jnp.asarray((xsp >> np.uint64(32)).astype(np.uint32))
     else:
         xs_hi = jnp.zeros((1, 1), jnp.uint32)
-    fn = _sharded_eval_points(mesh, kb.nu, kb.log_n, qp, backend, use_walk)
-    bits = np.asarray(fn(*_point_masks(kb), xs_hi, xs_lo))
+    fn = _sharded_eval_points(mesh, kbp.nu, kbp.log_n, qp, backend, use_walk)
+    try:
+        bits = np.asarray(fn(*_point_masks(kbp), xs_hi, xs_lo))
+    except Exception as e:  # noqa: BLE001
+        if not use_walk:
+            raise
+        mdpf._walk_kernel_degraded(e)
+        return eval_points_sharded(kb, xs, mesh, backend)
     return bits[:K, :Q]
 
 

@@ -116,6 +116,34 @@ def test_compat_walk_kernel_matches_spec(monkeypatch, log_n):
     )
 
 
+def test_walk_kernel_failure_degrades_to_xla(monkeypatch):
+    """A Mosaic lowering failure of the (interpreter-untestable-on-TPU)
+    walk kernel must latch and degrade eval_points to the XLA body with a
+    warning — the serving path survives a kernel regression."""
+    from dpf_tpu.models import dpf as mdpf
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic lowering failure")
+
+    monkeypatch.setattr(aes_pallas, "walk_backend", lambda: "pallas")
+    monkeypatch.setattr(aes_pallas, "eval_points_walk_planes", boom)
+    monkeypatch.setattr(mdpf, "_WALK_KERNEL_BROKEN", False)
+    rng = np.random.default_rng(8)
+    log_n, K, Q = 10, 3, 4
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, _ = gen_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    want = eval_points(ka, xs, backend="xla")
+    with pytest.warns(RuntimeWarning, match="walk kernel unavailable"):
+        got = eval_points(ka, xs, backend="pallas_bm")
+    np.testing.assert_array_equal(got, want)
+    assert mdpf._WALK_KERNEL_BROKEN
+    # Latched: subsequent calls take the XLA body without re-attempting.
+    np.testing.assert_array_equal(
+        eval_points(ka, xs, backend="pallas_bm"), want
+    )
+
+
 def test_bm_kernels_lowlive_sbox_match_xla(monkeypatch):
     """The register-budgeted S-box schedule must be bit-identical inside
     the bit-major PRG kernel (jit caches are cleared because the variant
