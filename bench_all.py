@@ -22,12 +22,25 @@ here).  Timing methods:
 ``--scale small`` shrinks domains/batches for CPU smoke runs; ``full`` is
 the real TPU matrix (config 4 holds a 512 MB database plus ~2 GB of leaf
 selection words in HBM).
+
+Failure containment: each config section runs inside ``_section`` — an
+exception (the likely first-hardware-run mode: Mosaic rejecting a
+never-compiled kernel) emits an ``"error"`` row and the matrix CONTINUES;
+rows are flushed as they are produced so even a mid-run tunnel wedge
+leaves a usable partial record.  Every row carries a ``"route"`` field
+(which kernel/backend produced the number, S-box variant, sticky-latch
+state read at emit time) so a silently-latched fallback can never
+masquerade as a kernel measurement.  Test hooks:
+DPF_TPU_BENCH_ONLY=<substr>[,<substr>]  run only matching sections;
+DPF_TPU_BENCH_FORCE_FAIL=<substr>[,...] force matching sections to fail
+(exercised by tests/test_bench_harness.py).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -46,11 +59,104 @@ def _timed_host_call(fn, reps: int = 3) -> float:
     return best
 
 
-def _emit(name, value, unit, baseline=None):
+def _latch_flags() -> list[str]:
+    """Sticky-fallback latch state, read LIVE at emit time: a Mosaic
+    failure earlier in the run that silently degraded a kernel route to
+    XLA must be visible on every subsequent row."""
+    from dpf_tpu.models import dpf as mdpf
+    from dpf_tpu.ops import chacha_pallas as cp
+
+    flags = []
+    if mdpf._WALK_KERNEL_BROKEN:
+        flags.append("aes-walk-latched")
+    if cp._SMALL_TREE_BROKEN:
+        flags.append("small-tree-latched")
+    return flags
+
+
+def _route(base: str, sbox: bool = False) -> str:
+    if sbox:
+        from dpf_tpu.ops import aes_pallas
+
+        base = f"{base},sbox={aes_pallas._SBOX}"
+    return ",".join([base] + _latch_flags())
+
+
+def _compat_walk_eligible(k: int) -> bool:
+    """Mirror of the production kernel predicate in models/dpf.eval_points
+    (dpf.py:401-405) INCLUDING the sticky latch — evaluated at call time,
+    AFTER the host-row call, so a Mosaic failure that latched during that
+    call re-routes the device row to the XLA fallback production actually
+    serves (instead of re-invoking the broken kernel)."""
+    from dpf_tpu.models import dpf as mdpf
+    from dpf_tpu.ops import aes_pallas
+
+    return (
+        not mdpf._WALK_KERNEL_BROKEN
+        and aes_pallas.walk_backend() == "pallas"
+        and (
+            mdpf.default_backend() in mdpf._BM_BACKENDS
+            or aes_pallas.walk_forced()
+        )
+        and k % aes_pallas._PKT == 0
+    )
+
+
+def _skipped(name: str, why: str) -> None:
+    """Explicit ineligible-route row: a reader of a partial record must be
+    able to tell 'route not eligible here' from 'run died before this'."""
+    print(
+        json.dumps(
+            {
+                "metric": name,
+                "value": 0,
+                "unit": "",
+                "skipped": why,
+                "route": ",".join(["skipped"] + _latch_flags()),
+            }
+        ),
+        flush=True,
+    )
+
+
+def _emit(name, value, unit, baseline=None, route=None):
     row = {"metric": name, "value": round(value, 3), "unit": unit}
+    if route:
+        row["route"] = route
     if baseline:
         row["vs_baseline"] = round(value * 1e9 / baseline, 2)
     print(json.dumps(row), flush=True)
+
+
+_ONLY = [s for s in os.environ.get("DPF_TPU_BENCH_ONLY", "").split(",") if s]
+_FORCE_FAIL = [
+    s for s in os.environ.get("DPF_TPU_BENCH_FORCE_FAIL", "").split(",") if s
+]
+
+
+def _section(name: str, fn) -> None:
+    """Run one config section; an exception becomes an ``"error"`` row and
+    the matrix continues — the first full-scale hardware run must produce
+    a partial record, not a stack trace."""
+    if _ONLY and not any(s in name for s in _ONLY):
+        return
+    try:
+        if any(s in name for s in _FORCE_FAIL):
+            raise RuntimeError(f"forced failure (DPF_TPU_BENCH_FORCE_FAIL)")
+        fn()
+    except Exception as e:  # noqa: BLE001 — containment is the point
+        print(
+            json.dumps(
+                {
+                    "metric": name,
+                    "value": 0,
+                    "unit": "",
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                    "route": ",".join(["error"] + _latch_flags()),
+                }
+            ),
+            flush=True,
+        )
 
 
 def main():
@@ -65,174 +171,224 @@ def main():
     jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+    from dpf_tpu.core.keys import gen_batch as gen_compat
     from dpf_tpu.models import keys_chacha as kc
+    from dpf_tpu.models.dpf import (
+        _eval_points_jit,
+        _eval_points_walk_jit,
+        _grouped_walk_jit,
+        _point_masks,
+        default_backend as compat_backend,
+        eval_points as compat_points,
+        eval_points_level_grouped as grouped_compat,
+    )
     from dpf_tpu.models.dpf_chacha import (
+        MAX_LEAF_NODES,
         _eval_full_cc_jit,
+        _eval_full_pk_jit,
+        _eval_points_cc_jit,
+        _split_queries,
+        _use_walk_kernel,
         eval_points as fast_points,
     )
     from dpf_tpu.models.fss import eval_lt_points, gen_lt_batch
     from dpf_tpu.models.pir import PirServer, pir_query, pir_reconstruct
+    from dpf_tpu.ops import aes_pallas
+    from dpf_tpu.ops import chacha_pallas as cp
+    from dpf_tpu.parallel.sharding import _pad_fast_batch
 
     baseline = measure_baseline()
     rng = np.random.default_rng(99)
+
+    # Shared query inputs (pure numpy — drawn in the prelude so a failed
+    # section can't starve a later one of its inputs).
+    n3, k3, q3 = (30, 256, 4096) if not small else (30, 16, 64)
+    xs = rng.integers(0, 1 << n3, size=(k3, q3), dtype=np.uint64)
+    n5, g5, q5 = (32, 4096, 32) if not small else (32, 64, 32)
+    xs5 = rng.integers(0, 1 << n5, size=(g5, q5), dtype=np.uint64)
 
     # ---- config 1: single-key EvalFull, n=16 (fast profile) -----------------
     # Same kernel routing as production (expand_plan); the 1 key pads to the
     # kernel's 8-key sublane tile, so the measured work covers 8 keys while
     # only 2^n1 leaves are credited — the honest effective single-key rate.
-    from dpf_tpu.models.dpf_chacha import MAX_LEAF_NODES, _eval_full_pk_jit
-    from dpf_tpu.ops import chacha_pallas as cp
-    from dpf_tpu.parallel.sharding import _pad_fast_batch
+    def cfg1_fast():
+        n1 = 16 if not small else 12
+        ka, _ = kc.gen_batch(
+            np.array([123 % (1 << n1)], np.uint64), n1, rng=rng
+        )
+        eligible1, s1, _kp = cp.expand_plan(ka.nu, ka.k, MAX_LEAF_NODES)
+        use_kernel1 = cp.expand_backend() == "pallas" and eligible1
+        if use_kernel1:
+            ka_p = _pad_fast_batch(ka, (-ka.k) % cp._EKT)
+            a1 = ka_p.device_args()
+            ops1 = cp.expand_operands(ka_p, s1)
+        else:
+            a1 = ka.device_args()
 
-    n1 = 16 if not small else 12
-    ka, _ = kc.gen_batch(np.array([123 % (1 << n1)], np.uint64), n1, rng=rng)
-    eligible1, s1, _kp = cp.expand_plan(ka.nu, ka.k, MAX_LEAF_NODES)
-    use_kernel1 = cp.expand_backend() == "pallas" and eligible1
-    if use_kernel1:
-        ka_p = _pad_fast_batch(ka, (-ka.k) % cp._EKT)
-        a1 = ka_p.device_args()
-        ops1 = cp.expand_operands(ka_p, s1)
-    else:
-        a1 = ka.device_args()
+        def chained1(r):
+            @jax.jit
+            def f(seeds, ts, scw, tcw, fcw):
+                acc = jnp.uint32(0)
+                for _ in range(r):
+                    if use_kernel1:
+                        w = _eval_full_pk_jit(
+                            ka.nu, s1, seeds ^ acc, ts, scw, tcw, *ops1
+                        )
+                    else:
+                        w = _eval_full_cc_jit(
+                            ka.nu, seeds ^ acc, ts, scw, tcw, fcw
+                        )
+                    acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
+                return acc
 
-    def chained1(r):
-        @jax.jit
-        def f(seeds, ts, scw, tcw, fcw):
-            acc = jnp.uint32(0)
-            for _ in range(r):
-                if use_kernel1:
-                    w = _eval_full_pk_jit(
-                        ka.nu, s1, seeds ^ acc, ts, scw, tcw, *ops1
-                    )
-                else:
-                    w = _eval_full_cc_jit(ka.nu, seeds ^ acc, ts, scw, tcw, fcw)
-                acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
-            return acc
+            return f
 
-        return f
+        # Sub-ms expansions: deep chain + median (see bench._marginal_time).
+        dt = _marginal_time(chained1(1), chained1(65), a1, 65, repeats=8,
+                            stat="median")
+        _emit(f"1-key eval_full n={n1} (fast)", (1 << n1) / dt / 1e9,
+              "Gleaves/sec", baseline,
+              route=_route("pallas-expand" if use_kernel1 else "xla-levels"))
 
-    # Sub-ms expansions: deep chain + median (see bench._marginal_time).
-    dt = _marginal_time(chained1(1), chained1(65), a1, 65, repeats=8,
-                        stat="median")
-    _emit(f"1-key eval_full n={n1} (fast)", (1 << n1) / dt / 1e9,
-          "Gleaves/sec", baseline)
+    _section("cfg1-fast-n16", cfg1_fast)
 
     # ---- config 1b: single-key EvalFull, n=28 — the reference's own
     # BenchmarkEvalFull config (dpf/dpf_test.go:7-21), exercising the
     # big-domain paths: compat splits into subtree chunks finished by one
     # lax.scan program; fast runs the expand kernel at full width. --------
     n1b = 28 if not small else 18
-    ka28, _ = kc.gen_batch(
-        np.array([0x0DDC0FFEE % (1 << n1b)], np.uint64), n1b, rng=rng
-    )
-    el28, s28, _kp28 = cp.expand_plan(ka28.nu, ka28.k, MAX_LEAF_NODES)
-    use_k28 = cp.expand_backend() == "pallas" and el28
-    if use_k28:
-        ka28p = _pad_fast_batch(ka28, (-ka28.k) % cp._EKT)
-        a28 = ka28p.device_args()
-        ops28 = cp.expand_operands(ka28p, s28)
-    else:
-        a28 = ka28.device_args()
 
-    def chained28(r):
-        @jax.jit
-        def f(seeds, ts, scw, tcw, fcw):
-            acc = jnp.uint32(0)
-            for _ in range(r):
-                if use_k28:
-                    w = _eval_full_pk_jit(
-                        ka28.nu, s28, seeds ^ acc, ts, scw, tcw, *ops28
-                    )
-                else:
-                    w = _eval_full_cc_jit(ka28.nu, seeds ^ acc, ts, scw, tcw, fcw)
-                acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
-            return acc
+    def cfg1b_fast():
+        ka28, _ = kc.gen_batch(
+            np.array([0x0DDC0FFEE % (1 << n1b)], np.uint64), n1b, rng=rng
+        )
+        el28, s28, _kp28 = cp.expand_plan(ka28.nu, ka28.k, MAX_LEAF_NODES)
+        use_k28 = cp.expand_backend() == "pallas" and el28
+        if use_k28:
+            ka28p = _pad_fast_batch(ka28, (-ka28.k) % cp._EKT)
+            a28 = ka28p.device_args()
+            ops28 = cp.expand_operands(ka28p, s28)
+        else:
+            a28 = ka28.device_args()
 
-        return f
+        def chained28(r):
+            @jax.jit
+            def f(seeds, ts, scw, tcw, fcw):
+                acc = jnp.uint32(0)
+                for _ in range(r):
+                    if use_k28:
+                        w = _eval_full_pk_jit(
+                            ka28.nu, s28, seeds ^ acc, ts, scw, tcw, *ops28
+                        )
+                    else:
+                        w = _eval_full_cc_jit(
+                            ka28.nu, seeds ^ acc, ts, scw, tcw, fcw
+                        )
+                    acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
+                return acc
 
-    r28 = 5 if not small else 3
-    dt = _marginal_time(chained28(1), chained28(r28), a28, r28, repeats=5,
-                        stat="median")
-    _emit(f"1-key eval_full n={n1b} (fast)", (1 << n1b) / dt / 1e9,
-          "Gleaves/sec", baseline)
+            return f
+
+        r28 = 5 if not small else 3
+        dt = _marginal_time(chained28(1), chained28(r28), a28, r28, repeats=5,
+                            stat="median")
+        _emit(f"1-key eval_full n={n1b} (fast)", (1 << n1b) / dt / 1e9,
+              "Gleaves/sec", baseline,
+              route=_route("pallas-expand" if use_k28 else "xla-levels"))
+
+    _section("cfg1b-fast-n28", cfg1b_fast)
 
     # Compat at n=28: 2^(n-7) plane words exceed MAX_PLANE_WORDS, so this
     # times the real chunked pipeline (prefix + scan-finish, one dispatch).
-    from dpf_tpu.core.keys import gen_batch as _gen_compat28
-    from dpf_tpu.models.dpf import (
-        MAX_PLANE_WORDS,
-        DeviceKeys as _DK,
-        _BM_BACKENDS as _BMB,
-        _expand_prefix_jit,
-        _eval_full_jit as _compat_full_jit,
-        _finish_chunks_scan_jit,
-        _scw_to_bm,
-        default_backend as _compat_backend,
-    )
-
-    kac28, _ = _gen_compat28(
-        np.array([0x0DDC0FFEE % (1 << n1b)], np.uint64), n1b, rng=rng
-    )
-    dk28 = _DK(kac28)
-    bk28 = _compat_backend()
-    kp28 = dk28.k_padded // 32
-    total28 = (1 << dk28.nu) * kp28
-    scw28 = dk28.scw_planes
-    if total28 > MAX_PLANE_WORDS and bk28 in _BMB:
-        scw28 = _scw_to_bm(scw28)
-    if total28 > MAX_PLANE_WORDS:
-        c28 = min(
-            (-(-total28 // MAX_PLANE_WORDS) - 1).bit_length(), dk28.nu
+    def cfg1b_compat():
+        from dpf_tpu.core.keys import gen_batch as _gen_compat28
+        from dpf_tpu.models.dpf import (
+            MAX_PLANE_WORDS,
+            DeviceKeys as _DK,
+            _BM_BACKENDS as _BMB,
+            _expand_prefix_jit,
+            _eval_full_jit as _compat_full_jit,
+            _finish_chunks_scan_jit,
+            _scw_to_bm,
         )
-    else:
-        c28 = 0
 
-    def chained28c(r):
-        @jax.jit
-        def f(seed_planes, t_words, scw_raw, scw_fin, tl_w, tr_w, fcw_planes):
-            acc = jnp.uint32(0)
-            for _ in range(r):
-                if c28:
-                    S, T = _expand_prefix_jit(
-                        c28, seed_planes ^ acc, t_words, scw_raw, tl_w,
-                        tr_w, bk28,
-                    )
-                    w = _finish_chunks_scan_jit(
-                        dk28.nu - c28, c28, S, T, scw_fin, tl_w, tr_w,
-                        fcw_planes, bk28,
-                    )
-                else:
-                    w = _compat_full_jit(
-                        dk28.nu, seed_planes ^ acc, t_words, scw_raw,
-                        tl_w, tr_w, fcw_planes, bk28,
-                    )
-                acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
-            return acc
+        kac28, _ = _gen_compat28(
+            np.array([0x0DDC0FFEE % (1 << n1b)], np.uint64), n1b, rng=rng
+        )
+        dk28 = _DK(kac28)
+        bk28 = compat_backend()
+        kp28 = dk28.k_padded // 32
+        total28 = (1 << dk28.nu) * kp28
+        scw28 = dk28.scw_planes
+        if total28 > MAX_PLANE_WORDS and bk28 in _BMB:
+            scw28 = _scw_to_bm(scw28)
+        if total28 > MAX_PLANE_WORDS:
+            c28 = min(
+                (-(-total28 // MAX_PLANE_WORDS) - 1).bit_length(), dk28.nu
+            )
+        else:
+            c28 = 0
 
-        return f
+        def chained28c(r):
+            @jax.jit
+            def f(seed_planes, t_words, scw_raw, scw_fin, tl_w, tr_w,
+                  fcw_planes):
+                acc = jnp.uint32(0)
+                for _ in range(r):
+                    if c28:
+                        S, T = _expand_prefix_jit(
+                            c28, seed_planes ^ acc, t_words, scw_raw, tl_w,
+                            tr_w, bk28,
+                        )
+                        w = _finish_chunks_scan_jit(
+                            dk28.nu - c28, c28, S, T, scw_fin, tl_w, tr_w,
+                            fcw_planes, bk28,
+                        )
+                    else:
+                        w = _compat_full_jit(
+                            dk28.nu, seed_planes ^ acc, t_words, scw_raw,
+                            tl_w, tr_w, fcw_planes, bk28,
+                        )
+                    acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
+                return acc
 
-    a28c = (
-        dk28.seed_planes, dk28.t_words, dk28.scw_planes, scw28,
-        dk28.tl_words, dk28.tr_words, dk28.fcw_planes,
-    )
-    r28c = 3
-    dt = _marginal_time(chained28c(1), chained28c(r28c), a28c, r28c,
-                        repeats=5, stat="median")
-    _emit(f"1-key eval_full n={n1b} (compat, chunked)",
-          (1 << n1b) / dt / 1e9, "Gleaves/sec", baseline)
+            return f
+
+        a28c = (
+            dk28.seed_planes, dk28.t_words, dk28.scw_planes, scw28,
+            dk28.tl_words, dk28.tr_words, dk28.fcw_planes,
+        )
+        r28c = 3
+        dt = _marginal_time(chained28c(1), chained28c(r28c), a28c, r28c,
+                            repeats=5, stat="median")
+        _emit(f"1-key eval_full n={n1b} (compat, chunked)",
+              (1 << n1b) / dt / 1e9, "Gleaves/sec", baseline,
+              route=_route(
+                  f"{bk28}{'-chunked' if c28 else ''}",
+                  sbox=bk28.startswith("pallas"),
+              ))
+
+    _section("cfg1b-compat-n28", cfg1b_compat)
 
     # Fast profile through ITS chunked route (expand_plan_chunked) needs
     # the leaf cap exceeded: 32 keys at n=28 (1 GB of leaf words, 2 scan
     # chunks through the VMEM kernel).
-    k28f = 32 if not small else 4
-    ka28f, _ = kc.gen_batch(
-        rng.integers(0, 1 << n1b, size=k28f, dtype=np.uint64), n1b, rng=rng
-    )
-    okc, sc28, _w, nch28 = cp.expand_plan_chunked(
-        ka28f.nu, ka28f.k, MAX_LEAF_NODES
-    )
-    use_kc28 = cp.expand_backend() == "pallas" and okc
-    if use_kc28:
+    def cfg1b_fast_chunked():
+        k28f = 32 if not small else 4
+        ka28f, _ = kc.gen_batch(
+            rng.integers(0, 1 << n1b, size=k28f, dtype=np.uint64), n1b,
+            rng=rng,
+        )
+        okc, sc28, _w, nch28 = cp.expand_plan_chunked(
+            ka28f.nu, ka28f.k, MAX_LEAF_NODES
+        )
+        use_kc28 = cp.expand_backend() == "pallas" and okc
+        if not use_kc28:
+            _skipped(
+                f"{k28f}-key eval_full n={n1b} (fast, chunked kernel)",
+                "route only exists on the pallas expand backend",
+            )
+            return
         from dpf_tpu.models.dpf_chacha import (
             _expand_prefix_cc_jit,
             _finish_pk_chunks_jit,
@@ -263,446 +419,512 @@ def main():
         dt = _marginal_time(chained28f(1), chained28f(r28f), a28f, r28f,
                             repeats=5, stat="median")
         _emit(f"{k28f}-key eval_full n={n1b} (fast, chunked kernel)",
-              k28f * (1 << n1b) / dt / 1e9, "Gleaves/sec", baseline)
+              k28f * (1 << n1b) / dt / 1e9, "Gleaves/sec", baseline,
+              route=_route("pallas-expand-chunked"))
+
+    _section("cfg1b-fast-chunked", cfg1b_fast_chunked)
 
     # ---- config 2: 1024-key EvalFull, n=20 — the headline, both profiles ----
-    if small:
-        # Shrunken smoke: the full config on CPU would take hours.
-        n2, k2 = 14, 64
-        kaf, _ = kc.gen_batch(
-            rng.integers(0, 1 << n2, size=k2, dtype=np.uint64), n2, rng=rng
-        )
-        a2 = kaf.device_args()
+    def cfg2():
+        if small:
+            # Shrunken smoke: the full config on CPU would take hours.
+            n2, k2 = 14, 64
+            kaf, _ = kc.gen_batch(
+                rng.integers(0, 1 << n2, size=k2, dtype=np.uint64), n2,
+                rng=rng,
+            )
+            a2 = kaf.device_args()
 
-        def chained2(r):
-            @jax.jit
-            def f(seeds, ts, scw, tcw, fcw):
-                acc = jnp.uint32(0)
-                for _ in range(r):
-                    w = _eval_full_cc_jit(kaf.nu, seeds ^ acc, ts, scw, tcw, fcw)
-                    acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
-                return acc
+            def chained2(r):
+                @jax.jit
+                def f(seeds, ts, scw, tcw, fcw):
+                    acc = jnp.uint32(0)
+                    for _ in range(r):
+                        w = _eval_full_cc_jit(
+                            kaf.nu, seeds ^ acc, ts, scw, tcw, fcw
+                        )
+                        acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
+                    return acc
 
-            return f
+                return f
 
-        dt = _marginal_time(chained2(1), chained2(3), a2, 3)
-        _emit(f"{k2}-key eval_full n={n2} (fast)", k2 * (1 << n2) / dt / 1e9,
-              "Gleaves/sec", baseline)
-    else:
-        # Same code as bench.py so scoreboard and matrix can't diverge.
-        fast2 = bench_fast(jax, jnp, np.random.default_rng(2026))
-        _emit("1024-key eval_full n=20 (fast)", fast2 / 1e9,
-              "Gleaves/sec", baseline)
-        compat2 = bench_compat(jax, jnp, np.random.default_rng(2026))
-        _emit("1024-key eval_full n=20 (compat)", compat2 / 1e9,
-              "Gleaves/sec", baseline)
+            dt = _marginal_time(chained2(1), chained2(3), a2, 3)
+            _emit(f"{k2}-key eval_full n={n2} (fast)",
+                  k2 * (1 << n2) / dt / 1e9, "Gleaves/sec", baseline,
+                  route=_route("xla-levels"))
+        else:
+            # Same code as bench.py so scoreboard and matrix can't diverge.
+            fast2 = bench_fast(jax, jnp, np.random.default_rng(2026))
+            _emit("1024-key eval_full n=20 (fast)", fast2 / 1e9,
+                  "Gleaves/sec", baseline,
+                  route=_route(f"bench.py:{cp.expand_backend()}"))
+            compat2 = bench_compat(jax, jnp, np.random.default_rng(2026))
+            bk2 = compat_backend()
+            _emit("1024-key eval_full n=20 (compat)", compat2 / 1e9,
+                  "Gleaves/sec", baseline,
+                  route=_route(f"bench.py:{bk2}",
+                               sbox=bk2.startswith("pallas")))
+
+    _section("cfg2-headline", cfg2)
 
     # ---- config 3: pointwise Eval, n=30, 256 keys x 4096 queries ------------
-    n3, k3, q3 = (30, 256, 4096) if not small else (30, 16, 64)
-    kap, _ = kc.gen_batch(
-        rng.integers(0, 1 << n3, size=k3, dtype=np.uint64), n3, rng=rng
-    )
-    xs = rng.integers(0, 1 << n3, size=(k3, q3), dtype=np.uint64)
-    dt = _timed_host_call(lambda: fast_points(kap, xs))
-    _emit(f"pointwise eval n={n3} {k3}x{q3} (fast, incl. dispatch)",
-          k3 * q3 / dt / 1e6, "Mqueries/sec")
-
-    # Device row: chain R walks in one compiled function, the output bits
-    # feeding the next round's query (bit-0 flip keeps the index in
-    # domain), same route the host call takes.
-    from dpf_tpu.models.dpf_chacha import (
-        _eval_points_cc_jit,
-        _split_queries,
-        _use_walk_kernel,
-    )
-    from dpf_tpu.ops import chacha_pallas as cp
-
-    if _use_walk_kernel(k3):
-        ops3 = cp.walk_operands(kap, 0)
-        xs_t = np.ascontiguousarray(xs.T)
-        pad_q = (-xs_t.shape[0]) % 8
-        if pad_q:
-            xs_t = np.concatenate(
-                [xs_t, np.zeros((pad_q, k3), np.uint64)]
-            )
-        xs_lo3 = jnp.asarray((xs_t & np.uint64(0xFFFFFFFF)).astype(np.uint32))
-        xs_hi3 = jnp.zeros((1, k3), jnp.uint32)
-        qt3 = cp._qtile(xs_lo3.shape[0])
-
-        def chained3(r):
-            @jax.jit
-            def f(meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi):
-                acc = jnp.uint32(0)
-                for _ in range(r):
-                    bits = cp._walk_raw(
-                        meta, seeds_t, scw_t, tcw_t, fcw_t,
-                        xs_lo ^ (acc & 1), xs_hi, n3, kap.nu, qt3,
-                    )
-                    acc = acc ^ jnp.bitwise_xor.reduce(bits, axis=None)
-                return acc
-
-            return f
-
-        a3 = (*ops3, xs_lo3, xs_hi3)
-    else:
-        xs_hi3, xs_lo3 = _split_queries(xs, n3)
-        a3 = (*kap.device_args(), xs_hi3, xs_lo3)
-
-        def chained3(r):
-            @jax.jit
-            def f(seeds, ts, scw, tcw, fcw, xs_hi, xs_lo):
-                acc = jnp.uint32(0)
-                for _ in range(r):
-                    bits = _eval_points_cc_jit(
-                        kap.nu, n3, seeds, ts, scw, tcw, fcw, xs_hi,
-                        xs_lo ^ (acc & 1),
-                    )
-                    acc = acc ^ jnp.bitwise_xor.reduce(
-                        bits.astype(jnp.uint32), axis=None
-                    )
-                return acc
-
-            return f
-
-    r3 = 17 if not small else 3
-    dt = _marginal_time(chained3(1), chained3(r3), a3, r3, repeats=8,
-                        stat="median")
-    _emit(f"pointwise eval n={n3} {k3}x{q3} (fast, device)",
-          k3 * q3 / dt / 1e6, "Mqueries/sec")
-
-    from dpf_tpu.core.keys import gen_batch as gen_compat
-    from dpf_tpu.models.dpf import (
-        _eval_points_jit,
-        _point_masks,
-        default_backend as compat_backend,
-        eval_points as compat_points,
-    )
-
-    kac3, _ = gen_compat(
-        rng.integers(0, 1 << n3, size=k3, dtype=np.uint64), n3, rng=rng
-    )
-    dt = _timed_host_call(lambda: compat_points(kac3, xs))
-    _emit(f"pointwise eval n={n3} {k3}x{q3} (compat, incl. dispatch)",
-          k3 * q3 / dt / 1e6, "Mqueries/sec")
-
-    bk3 = compat_backend()
-    qp3 = xs.shape[1] // 32 + (1 if xs.shape[1] % 32 else 0)
-    xs_p = xs if xs.shape[1] % 32 == 0 else np.concatenate(
-        [xs, np.zeros((k3, (-xs.shape[1]) % 32), np.uint64)], axis=1
-    )
-    xs_lo3c = jnp.asarray((xs_p & np.uint64(0xFFFFFFFF)).astype(np.uint32))
-    xs_hi3c = jnp.zeros((1, 1), jnp.uint32)
-    masks3 = _point_masks(kac3)
-    # Same route production takes: the whole-walk kernel on TPU
-    # (DPF_TPU_POINTS_AES), the per-level XLA body otherwise.
-    from dpf_tpu.models.dpf import _eval_points_walk_jit
-    from dpf_tpu.ops import aes_pallas
-
-    use_aes_walk = aes_pallas.walk_backend() == "pallas" and k3 % 8 == 0
-
-    def chained3c(r):
-        @jax.jit
-        def f(sm, tm, scwm, tlm, trm, fcwm, xs_hi, xs_lo):
-            acc = jnp.uint32(0)
-            for _ in range(r):
-                if use_aes_walk:
-                    packed = _eval_points_walk_jit(
-                        kac3.nu, n3, sm, tm, scwm, tlm, trm, fcwm, xs_hi,
-                        xs_lo ^ (acc & 1), qp3,
-                    )
-                    acc = acc ^ jnp.bitwise_xor.reduce(packed, axis=None)
-                else:
-                    bits = _eval_points_jit(
-                        kac3.nu, n3, sm, tm, scwm, tlm, trm, fcwm, xs_hi,
-                        xs_lo ^ (acc & 1), qp3, bk3,
-                    )
-                    acc = acc ^ jnp.bitwise_xor.reduce(
-                        bits.astype(jnp.uint32), axis=None
-                    )
-            return acc
-
-        return f
-
-    a3c = (*masks3, xs_hi3c, xs_lo3c)
-    r3c = 5 if not small else 3
-    dt = _marginal_time(chained3c(1), chained3c(r3c), a3c, r3c, repeats=6,
-                        stat="median")
-    _emit(f"pointwise eval n={n3} {k3}x{q3} (compat, device)",
-          k3 * q3 / dt / 1e6, "Mqueries/sec")
-
-    # ---- config 4: 2-server PIR, 2^24 x 32 B, 1k queries --------------------
-    nrows, rb, nq = (1 << 24, 32, 1024) if not small else (1 << 12, 32, 16)
-    db = rng.integers(0, 256, size=(nrows, rb), dtype=np.uint8)
-    idx = rng.integers(0, nrows, size=nq, dtype=np.uint64)
-    qa, qb = pir_query(idx, nrows, rng=rng, profile="fast")
-    srv = PirServer(db, profile="fast")
-    ans_a = []  # capture the last timed answer — a full 512 MB-DB pass each
-    dt = _timed_host_call(lambda: ans_a.append(srv.answer(qa)))
-    rows = pir_reconstruct(ans_a[-1], srv.answer(qb))
-    np.testing.assert_array_equal(rows, db[idx.astype(np.int64)])
-    _emit(f"2-server PIR {nrows}x{rb}B, {nq} queries (fast, incl. dispatch)",
-          nq / dt, "queries/sec")
-
-    # Device row: chain R expand->parity-matmul pipelines, the answer words
-    # feeding the next round's seeds — exactly the computation inside
-    # PirServer.answer, transfers and dispatch cancelled.
-    from dpf_tpu.models import pir as pir_mod
-
-    entry4 = pir_mod._pir_fast_entry_level(srv.nu, qa.k)
-    n_chunks4 = srv.dom // (srv.n_leaf * srv.chunk_rows)
-
-    def chained4(r):
-        @jax.jit
-        def f(seeds, ts, scw, tcw, fcw, db_words):
-            acc = jnp.uint32(0)
-            for _ in range(r):
-                sel = pir_mod._fast_expand_sel(
-                    srv.nu, entry4, seeds ^ acc, ts, scw, tcw, fcw
-                )
-                ans = pir_mod._parity_matmul(
-                    sel, db_words, srv.chunk_rows, n_chunks4
-                )
-                acc = acc ^ jnp.bitwise_xor.reduce(ans, axis=None)
-            return acc
-
-        return f
-
-    a4 = (*qa.device_args(), srv.db_words)
-    r4 = 4 if not small else 3
-    dt = _marginal_time(chained4(1), chained4(r4), a4, r4, repeats=5,
-                        stat="median")
-    _emit(f"2-server PIR {nrows}x{rb}B, {nq} queries (fast, device)",
-          nq / dt, "queries/sec")
-
-    # ---- config 5: FSS comparison gates, n=32, 4096 gates -------------------
-    n5, g5, q5 = (32, 4096, 32) if not small else (32, 64, 32)
-    ca, _cb = gen_lt_batch(
-        rng.integers(0, 1 << n5, size=g5, dtype=np.uint64), n5, rng=rng,
-        profile="fast",
-    )
-    xs5 = rng.integers(0, 1 << n5, size=(g5, q5), dtype=np.uint64)
-    dt = _timed_host_call(lambda: eval_lt_points(ca, xs5))
-    _emit(f"FSS lt-gate n={n5} {g5} gates x {q5} pts (fast, incl. dispatch)",
-          g5 * q5 / dt / 1e6, "Mgate-evals/sec")
-
-    # Device row: the level-grouped walk + on-device gate XOR-fold.
-    k5 = ca.levels.k
-    if _use_walk_kernel(k5):
-        ops5 = cp.walk_operands(ca.levels, 1)
-        xs5_t = np.ascontiguousarray(xs5.T)
-        pad_q5 = (-xs5_t.shape[0]) % 8
-        if pad_q5:
-            xs5_t = np.concatenate(
-                [xs5_t, np.zeros((pad_q5, g5), np.uint64)]
-            )
-        xs5_lo = jnp.tile(
-            jnp.asarray((xs5_t & np.uint64(0xFFFFFFFF)).astype(np.uint32)),
-            (1, k5 // g5),
+    def cfg3_fast():
+        kap, _ = kc.gen_batch(
+            rng.integers(0, 1 << n3, size=k3, dtype=np.uint64), n3, rng=rng
         )
-        xs5_hi = jnp.zeros((1, k5), jnp.uint32)
-        qt5 = cp._qtile(xs5_lo.shape[0])
+        dt = _timed_host_call(lambda: fast_points(kap, xs))
+        use_wk = _use_walk_kernel(k3)
+        _emit(f"pointwise eval n={n3} {k3}x{q3} (fast, incl. dispatch)",
+              k3 * q3 / dt / 1e6, "Mqueries/sec",
+              route=_route("pallas-walk" if use_wk else "xla-walk"))
 
-        def chained5(r):
-            @jax.jit
-            def f(meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi):
-                acc = jnp.uint32(0)
-                for _ in range(r):
-                    bits = cp._walk_raw(
-                        meta, seeds_t, scw_t, tcw_t, fcw_t,
-                        xs_lo ^ (acc & 1), xs_hi, n5, ca.levels.nu, qt5,
-                    )
-                    q, k = bits.shape
-                    gates = jax.lax.reduce(
-                        bits.reshape(q, k // g5, g5), np.uint32(0),
-                        jax.lax.bitwise_xor, (1,),
-                    )
-                    acc = acc ^ jnp.bitwise_xor.reduce(gates, axis=None)
-                return acc
+        # Device row: chain R walks in one compiled function, the output bits
+        # feeding the next round's query (bit-0 flip keeps the index in
+        # domain), same route the host call takes.
+        if use_wk:
+            ops3 = cp.walk_operands(kap, 0)
+            xs_t = np.ascontiguousarray(xs.T)
+            pad_q = (-xs_t.shape[0]) % 8
+            if pad_q:
+                xs_t = np.concatenate(
+                    [xs_t, np.zeros((pad_q, k3), np.uint64)]
+                )
+            xs_lo3 = jnp.asarray(
+                (xs_t & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            )
+            xs_hi3 = jnp.zeros((1, k3), jnp.uint32)
+            qt3 = cp._qtile(xs_lo3.shape[0])
 
-            return f
+            def chained3(r):
+                @jax.jit
+                def f(meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi):
+                    acc = jnp.uint32(0)
+                    for _ in range(r):
+                        bits = cp._walk_raw(
+                            meta, seeds_t, scw_t, tcw_t, fcw_t,
+                            xs_lo ^ (acc & 1), xs_hi, n3, kap.nu, qt3,
+                        )
+                        acc = acc ^ jnp.bitwise_xor.reduce(bits, axis=None)
+                    return acc
 
-        a5 = (*ops5, xs5_lo, xs5_hi)
-    else:
-        xs5_hi, xs5_lo = _split_queries(xs5, n5)
-        a5 = (*ca.levels.device_args(), xs5_hi, xs5_lo)
+                return f
 
-        def chained5(r):
-            @jax.jit
-            def f(seeds, ts, scw, tcw, fcw, xs_hi, xs_lo):
-                acc = jnp.uint32(0)
-                for _ in range(r):
-                    bits = _eval_points_cc_jit(
-                        ca.levels.nu, n5, seeds, ts, scw, tcw, fcw, xs_hi,
-                        xs_lo ^ (acc & 1), 1,
-                    )
-                    q, k = bits.shape
-                    gates = jax.lax.reduce(
-                        bits.astype(jnp.uint32).reshape(q, k // g5, g5),
-                        np.uint32(0), jax.lax.bitwise_xor, (1,),
-                    )
-                    acc = acc ^ jnp.bitwise_xor.reduce(gates, axis=None)
-                return acc
+            a3 = (*ops3, xs_lo3, xs_hi3)
+        else:
+            xs_hi3, xs_lo3 = _split_queries(xs, n3)
+            a3 = (*kap.device_args(), xs_hi3, xs_lo3)
 
-            return f
+            def chained3(r):
+                @jax.jit
+                def f(seeds, ts, scw, tcw, fcw, xs_hi, xs_lo):
+                    acc = jnp.uint32(0)
+                    for _ in range(r):
+                        bits = _eval_points_cc_jit(
+                            kap.nu, n3, seeds, ts, scw, tcw, fcw, xs_hi,
+                            xs_lo ^ (acc & 1),
+                        )
+                        acc = acc ^ jnp.bitwise_xor.reduce(
+                            bits.astype(jnp.uint32), axis=None
+                        )
+                    return acc
 
-    r5 = 33 if not small else 3
-    dt = _marginal_time(chained5(1), chained5(r5), a5, r5, repeats=8,
-                        stat="median")
-    _emit(f"FSS lt-gate n={n5} {g5} gates x {q5} pts (fast, device)",
-          g5 * q5 / dt / 1e6, "Mgate-evals/sec")
+                return f
 
-    # Compat-profile gates (the reference's own cipher): same workload
-    # through the level-grouped compat route.  1024 gates, not 4096: the
-    # compat bit-plane key masks cost nu*128*4 B per level-DPF key
-    # (~430 MB at 1024 gates x 32 levels) and the shared device's HBM is
-    # not all ours.
-    g5c = 1024 if not small else 16
-    cac, _cbc = gen_lt_batch(
-        rng.integers(0, 1 << n5, size=g5c, dtype=np.uint64), n5, rng=rng,
-        profile="compat",
-    )
-    xs5c = xs5[:g5c]
-    from dpf_tpu.models.dpf import (
-        _grouped_walk_jit,
-        eval_points_level_grouped as grouped_compat,
-    )
+        r3 = 17 if not small else 3
+        dt = _marginal_time(chained3(1), chained3(r3), a3, r3, repeats=8,
+                            stat="median")
+        _emit(f"pointwise eval n={n3} {k3}x{q3} (fast, device)",
+              k3 * q3 / dt / 1e6, "Mqueries/sec",
+              route=_route("pallas-walk" if use_wk else "xla-walk"))
 
-    dt = _timed_host_call(lambda: grouped_compat(
-        cac.levels, xs5c, groups=1, reduce=True
-    ))
-    _emit(f"FSS lt-gate n={n5} {g5c} gates x {q5} pts (compat, incl. dispatch)",
-          g5c * q5 / dt / 1e6, "Mgate-evals/sec")
+    _section("cfg3-fast", cfg3_fast)
 
-    kc5 = cac.levels.k
-    if use_aes_walk and kc5 % 8 == 0:
-        xs5p = xs5c if q5 % 32 == 0 else np.concatenate(
-            [xs5c, np.zeros((g5c, (-q5) % 32), np.uint64)], axis=1
+    def cfg3_compat():
+        kac3, _ = gen_compat(
+            rng.integers(0, 1 << n3, size=k3, dtype=np.uint64), n3, rng=rng
         )
-        qp5c = xs5p.shape[1] // 32
-        xs5c_lo = jnp.asarray((xs5p & np.uint64(0xFFFFFFFF)).astype(np.uint32))
-        xs5c_hi = jnp.zeros((1, 1), jnp.uint32)
-        masks5c = _point_masks(cac.levels)
+        dt = _timed_host_call(lambda: compat_points(kac3, xs))
+        # Read AFTER the host call: a Mosaic failure in it latches the
+        # kernel off, and both the label and the device row must follow.
+        use_aes_walk = _compat_walk_eligible(k3)
+        _emit(f"pointwise eval n={n3} {k3}x{q3} (compat, incl. dispatch)",
+              k3 * q3 / dt / 1e6, "Mqueries/sec",
+              route=_route(
+                  "aes-walk-kernel" if use_aes_walk else "xla-aes-walk",
+                  sbox=use_aes_walk,
+              ))
 
-        def chained5c(r):
+        bk3 = compat_backend()
+        qp3 = xs.shape[1] // 32 + (1 if xs.shape[1] % 32 else 0)
+        xs_p = xs if xs.shape[1] % 32 == 0 else np.concatenate(
+            [xs, np.zeros((k3, (-xs.shape[1]) % 32), np.uint64)], axis=1
+        )
+        xs_lo3c = jnp.asarray((xs_p & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+        xs_hi3c = jnp.zeros((1, 1), jnp.uint32)
+        masks3 = _point_masks(kac3)
+
+        # Same route production takes: the whole-walk kernel on TPU
+        # (DPF_TPU_POINTS_AES), the per-level XLA body otherwise.
+        def chained3c(r):
             @jax.jit
             def f(sm, tm, scwm, tlm, trm, fcwm, xs_hi, xs_lo):
                 acc = jnp.uint32(0)
                 for _ in range(r):
-                    packed = _grouped_walk_jit(
-                        cac.levels.nu, n5, 1, g5c, sm, tm, scwm, tlm, trm,
-                        fcwm, xs_hi, xs_lo ^ (acc & 1), qp5c, True,
-                    )
-                    acc = acc ^ jnp.bitwise_xor.reduce(packed, axis=None)
+                    if use_aes_walk:
+                        packed = _eval_points_walk_jit(
+                            kac3.nu, n3, sm, tm, scwm, tlm, trm, fcwm, xs_hi,
+                            xs_lo ^ (acc & 1), qp3,
+                        )
+                        acc = acc ^ jnp.bitwise_xor.reduce(packed, axis=None)
+                    else:
+                        bits = _eval_points_jit(
+                            kac3.nu, n3, sm, tm, scwm, tlm, trm, fcwm, xs_hi,
+                            xs_lo ^ (acc & 1), qp3, bk3,
+                        )
+                        acc = acc ^ jnp.bitwise_xor.reduce(
+                            bits.astype(jnp.uint32), axis=None
+                        )
                 return acc
 
             return f
 
-        a5c = (*masks5c, xs5c_hi, xs5c_lo)
-        r5c = 9 if not small else 3
-        dt = _marginal_time(chained5c(1), chained5c(r5c), a5c, r5c,
-                            repeats=6, stat="median")
-        _emit(f"FSS lt-gate n={n5} {g5c} gates x {q5} pts (compat, device)",
-              g5c * q5 / dt / 1e6, "Mgate-evals/sec")
+        a3c = (*masks3, xs_hi3c, xs_lo3c)
+        r3c = 5 if not small else 3
+        dt = _marginal_time(chained3c(1), chained3c(r3c), a3c, r3c, repeats=6,
+                            stat="median")
+        _emit(f"pointwise eval n={n3} {k3}x{q3} (compat, device)",
+              k3 * q3 / dt / 1e6, "Mqueries/sec",
+              route=_route(
+                  "aes-walk-kernel" if use_aes_walk else f"xla-{bk3}",
+                  sbox=use_aes_walk,
+              ))
+
+    _section("cfg3-compat", cfg3_compat)
+
+    # ---- config 4: 2-server PIR, 2^24 x 32 B, 1k queries --------------------
+    def cfg4():
+        nrows, rb, nq = (1 << 24, 32, 1024) if not small else (1 << 12, 32, 16)
+        db = rng.integers(0, 256, size=(nrows, rb), dtype=np.uint8)
+        idx = rng.integers(0, nrows, size=nq, dtype=np.uint64)
+        qa, qb = pir_query(idx, nrows, rng=rng, profile="fast")
+        srv = PirServer(db, profile="fast")
+        ans_a = []  # capture the last timed answer — a full 512 MB-DB pass
+        dt = _timed_host_call(lambda: ans_a.append(srv.answer(qa)))
+        rows = pir_reconstruct(ans_a[-1], srv.answer(qb))
+        np.testing.assert_array_equal(rows, db[idx.astype(np.int64)])
+        _emit(
+            f"2-server PIR {nrows}x{rb}B, {nq} queries (fast, incl. dispatch)",
+            nq / dt, "queries/sec",
+            route=_route("expand+parity-matmul"),
+        )
+
+        # Device row: chain R expand->parity-matmul pipelines, the answer
+        # words feeding the next round's seeds — exactly the computation
+        # inside PirServer.answer, transfers and dispatch cancelled.
+        from dpf_tpu.models import pir as pir_mod
+
+        entry4 = pir_mod._pir_fast_entry_level(srv.nu, qa.k)
+        n_chunks4 = srv.dom // (srv.n_leaf * srv.chunk_rows)
+
+        def chained4(r):
+            @jax.jit
+            def f(seeds, ts, scw, tcw, fcw, db_words):
+                acc = jnp.uint32(0)
+                for _ in range(r):
+                    sel = pir_mod._fast_expand_sel(
+                        srv.nu, entry4, seeds ^ acc, ts, scw, tcw, fcw
+                    )
+                    ans = pir_mod._parity_matmul(
+                        sel, db_words, srv.chunk_rows, n_chunks4
+                    )
+                    acc = acc ^ jnp.bitwise_xor.reduce(ans, axis=None)
+                return acc
+
+            return f
+
+        a4 = (*qa.device_args(), srv.db_words)
+        r4 = 4 if not small else 3
+        dt = _marginal_time(chained4(1), chained4(r4), a4, r4, repeats=5,
+                            stat="median")
+        _emit(f"2-server PIR {nrows}x{rb}B, {nq} queries (fast, device)",
+              nq / dt, "queries/sec",
+              route=_route("expand+parity-matmul"))
+
+    _section("cfg4-pir", cfg4)
+
+    # ---- config 5: FSS comparison gates, n=32, 4096 gates -------------------
+    def cfg5_fast():
+        ca, _cb = gen_lt_batch(
+            rng.integers(0, 1 << n5, size=g5, dtype=np.uint64), n5, rng=rng,
+            profile="fast",
+        )
+        dt = _timed_host_call(lambda: eval_lt_points(ca, xs5))
+        k5 = ca.levels.k
+        use_wk5 = _use_walk_kernel(k5)
+        _emit(
+            f"FSS lt-gate n={n5} {g5} gates x {q5} pts (fast, incl. dispatch)",
+            g5 * q5 / dt / 1e6, "Mgate-evals/sec",
+            route=_route("pallas-walk" if use_wk5 else "xla-walk"),
+        )
+
+        # Device row: the level-grouped walk + on-device gate XOR-fold.
+        if use_wk5:
+            ops5 = cp.walk_operands(ca.levels, 1)
+            xs5_t = np.ascontiguousarray(xs5.T)
+            pad_q5 = (-xs5_t.shape[0]) % 8
+            if pad_q5:
+                xs5_t = np.concatenate(
+                    [xs5_t, np.zeros((pad_q5, g5), np.uint64)]
+                )
+            xs5_lo = jnp.tile(
+                jnp.asarray(
+                    (xs5_t & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+                ),
+                (1, k5 // g5),
+            )
+            xs5_hi = jnp.zeros((1, k5), jnp.uint32)
+            qt5 = cp._qtile(xs5_lo.shape[0])
+
+            def chained5(r):
+                @jax.jit
+                def f(meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi):
+                    acc = jnp.uint32(0)
+                    for _ in range(r):
+                        bits = cp._walk_raw(
+                            meta, seeds_t, scw_t, tcw_t, fcw_t,
+                            xs_lo ^ (acc & 1), xs_hi, n5, ca.levels.nu, qt5,
+                        )
+                        q, k = bits.shape
+                        gates = jax.lax.reduce(
+                            bits.reshape(q, k // g5, g5), np.uint32(0),
+                            jax.lax.bitwise_xor, (1,),
+                        )
+                        acc = acc ^ jnp.bitwise_xor.reduce(gates, axis=None)
+                    return acc
+
+                return f
+
+            a5 = (*ops5, xs5_lo, xs5_hi)
+        else:
+            xs5_hi, xs5_lo = _split_queries(xs5, n5)
+            a5 = (*ca.levels.device_args(), xs5_hi, xs5_lo)
+
+            def chained5(r):
+                @jax.jit
+                def f(seeds, ts, scw, tcw, fcw, xs_hi, xs_lo):
+                    acc = jnp.uint32(0)
+                    for _ in range(r):
+                        bits = _eval_points_cc_jit(
+                            ca.levels.nu, n5, seeds, ts, scw, tcw, fcw,
+                            xs_hi, xs_lo ^ (acc & 1), 1,
+                        )
+                        q, k = bits.shape
+                        gates = jax.lax.reduce(
+                            bits.astype(jnp.uint32).reshape(q, k // g5, g5),
+                            np.uint32(0), jax.lax.bitwise_xor, (1,),
+                        )
+                        acc = acc ^ jnp.bitwise_xor.reduce(gates, axis=None)
+                    return acc
+
+                return f
+
+        r5 = 33 if not small else 3
+        dt = _marginal_time(chained5(1), chained5(r5), a5, r5, repeats=8,
+                            stat="median")
+        _emit(f"FSS lt-gate n={n5} {g5} gates x {q5} pts (fast, device)",
+              g5 * q5 / dt / 1e6, "Mgate-evals/sec",
+              route=_route("pallas-walk" if use_wk5 else "xla-walk"))
+
+    _section("cfg5-fast", cfg5_fast)
+
+    # Compat-profile gates (the reference's own cipher): same workload
+    # through the level-grouped compat route.  TWO gate counts: the full
+    # BASELINE 4096 (compat bit-plane key masks cost nu*128*4 B per
+    # level-DPF key — ~1.7 GB at 4096 gates x 32 levels, attempted in its
+    # own section so an HBM failure on the shared device degrades to an
+    # explicit error row, not a dead matrix) and the proven-footprint 1024.
+    def cfg5_compat(g5c):
+        cac, _cbc = gen_lt_batch(
+            rng.integers(0, 1 << n5, size=g5c, dtype=np.uint64), n5, rng=rng,
+            profile="compat",
+        )
+        xs5c = xs5[:g5c]
+        kc5 = cac.levels.k
+        dt = _timed_host_call(lambda: grouped_compat(
+            cac.levels, xs5c, groups=1, reduce=True
+        ))
+        # Read AFTER the host call (see _compat_walk_eligible).
+        use_aes_walk5 = _compat_walk_eligible(kc5)
+        _emit(
+            f"FSS lt-gate n={n5} {g5c} gates x {q5} pts "
+            "(compat, incl. dispatch)",
+            g5c * q5 / dt / 1e6, "Mgate-evals/sec",
+            route=_route(
+                "aes-walk-kernel" if use_aes_walk5 else "xla-aes-walk",
+                sbox=use_aes_walk5,
+            ),
+        )
+
+        if not use_aes_walk5:
+            _skipped(
+                f"FSS lt-gate n={n5} {g5c} gates x {q5} pts (compat, device)",
+                "compat walk kernel route not eligible on this platform",
+            )
+        else:
+            xs5p = xs5c if q5 % 32 == 0 else np.concatenate(
+                [xs5c, np.zeros((g5c, (-q5) % 32), np.uint64)], axis=1
+            )
+            qp5c = xs5p.shape[1] // 32
+            xs5c_lo = jnp.asarray(
+                (xs5p & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            )
+            xs5c_hi = jnp.zeros((1, 1), jnp.uint32)
+            masks5c = _point_masks(cac.levels)
+
+            def chained5c(r):
+                @jax.jit
+                def f(sm, tm, scwm, tlm, trm, fcwm, xs_hi, xs_lo):
+                    acc = jnp.uint32(0)
+                    for _ in range(r):
+                        packed = _grouped_walk_jit(
+                            cac.levels.nu, n5, 1, g5c, sm, tm, scwm, tlm,
+                            trm, fcwm, xs_hi, xs_lo ^ (acc & 1), qp5c, True,
+                        )
+                        acc = acc ^ jnp.bitwise_xor.reduce(packed, axis=None)
+                    return acc
+
+                return f
+
+            a5c = (*masks5c, xs5c_hi, xs5c_lo)
+            r5c = 9 if not small else 3
+            dt = _marginal_time(chained5c(1), chained5c(r5c), a5c, r5c,
+                                repeats=6, stat="median")
+            _emit(f"FSS lt-gate n={n5} {g5c} gates x {q5} pts "
+                  "(compat, device)",
+                  g5c * q5 / dt / 1e6, "Mgate-evals/sec",
+                  route=_route("aes-walk-kernel", sbox=True))
+
+    if not small:
+        _section("cfg5-compat-4096", lambda: cfg5_compat(4096))
+    _section("cfg5-compat-1024", lambda: cfg5_compat(1024 if not small else 16))
 
     # Same workload via the one-key-per-gate DCF (models/dcf.py): ~log_n x
     # less evaluation work and ~30x smaller keys than the per-level route.
-    from dpf_tpu.models import dcf as dcf_mod
+    def cfg5_dcf():
+        from dpf_tpu.models import dcf as dcf_mod
 
-    da, _db = dcf_mod.gen_lt_batch(
-        rng.integers(0, 1 << n5, size=g5, dtype=np.uint64), n5, rng=rng
-    )
-    dt = _timed_host_call(lambda: dcf_mod.eval_lt_points(da, xs5))
-    _emit(f"FSS lt-gate n={n5} {g5} gates x {q5} pts (DCF, incl. dispatch)",
-          g5 * q5 / dt / 1e6, "Mgate-evals/sec")
+        da, _db = dcf_mod.gen_lt_batch(
+            rng.integers(0, 1 << n5, size=g5, dtype=np.uint64), n5, rng=rng
+        )
+        use_dcf_kernel = cp.points_backend() == "pallas" and cp.usable(da.k)
+        dt = _timed_host_call(lambda: dcf_mod.eval_lt_points(da, xs5))
+        _emit(
+            f"FSS lt-gate n={n5} {g5} gates x {q5} pts (DCF, incl. dispatch)",
+            g5 * q5 / dt / 1e6, "Mgate-evals/sec",
+            route=_route(
+                "pallas-dcf-walk" if use_dcf_kernel else "xla-dcf-walk"
+            ),
+        )
 
-    # Device row: the one-key-per-gate DCF walk.
-    if cp.points_backend() == "pallas" and cp.usable(da.k):
-        opsd = cp.dcf_walk_operands(da)
-        xsd_t = np.ascontiguousarray(xs5.T)
-        pad_qd = (-xsd_t.shape[0]) % 8
-        if pad_qd:
-            xsd_t = np.concatenate(
-                [xsd_t, np.zeros((pad_qd, da.k), np.uint64)]
+        # Device row: the one-key-per-gate DCF walk.
+        if use_dcf_kernel:
+            opsd = cp.dcf_walk_operands(da)
+            xsd_t = np.ascontiguousarray(xs5.T)
+            pad_qd = (-xsd_t.shape[0]) % 8
+            if pad_qd:
+                xsd_t = np.concatenate(
+                    [xsd_t, np.zeros((pad_qd, da.k), np.uint64)]
+                )
+            xsd_lo = jnp.asarray(
+                (xsd_t & np.uint64(0xFFFFFFFF)).astype(np.uint32)
             )
-        xsd_lo = jnp.asarray((xsd_t & np.uint64(0xFFFFFFFF)).astype(np.uint32))
-        xsd_hi = jnp.zeros((1, da.k), jnp.uint32)
-        qtd = cp._qtile(xsd_lo.shape[0])
+            xsd_hi = jnp.zeros((1, da.k), jnp.uint32)
+            qtd = cp._qtile(xsd_lo.shape[0])
 
-        def chainedd(r):
-            @jax.jit
-            def f(meta, seeds_t, scw_t, tcw_t, vcw_t, fvcw_t, xs_lo, xs_hi):
-                acc = jnp.uint32(0)
-                for _ in range(r):
-                    bits = cp._walk_raw(
-                        meta, seeds_t, scw_t, tcw_t, fvcw_t,
-                        xs_lo ^ (acc & 1), xs_hi, n5, da.nu, qtd,
-                        vcw_t=vcw_t, dcf=True,
-                    )
-                    acc = acc ^ jnp.bitwise_xor.reduce(bits, axis=None)
-                return acc
+            def chainedd(r):
+                @jax.jit
+                def f(meta, seeds_t, scw_t, tcw_t, vcw_t, fvcw_t, xs_lo,
+                      xs_hi):
+                    acc = jnp.uint32(0)
+                    for _ in range(r):
+                        bits = cp._walk_raw(
+                            meta, seeds_t, scw_t, tcw_t, fvcw_t,
+                            xs_lo ^ (acc & 1), xs_hi, n5, da.nu, qtd,
+                            vcw_t=vcw_t, dcf=True,
+                        )
+                        acc = acc ^ jnp.bitwise_xor.reduce(bits, axis=None)
+                    return acc
 
-            return f
+                return f
 
-        ad = (*opsd, xsd_lo, xsd_hi)
-    else:
-        xsd_hi, xsd_lo = _split_queries(xs5, n5)
-        seeds_d, ts_d, scw_d, tcw_d, vcw_d, fvcw_d = da.device_args()
-        ad = (seeds_d, ts_d, scw_d, tcw_d, vcw_d, fvcw_d, xsd_hi, xsd_lo)
+            ad = (*opsd, xsd_lo, xsd_hi)
+        else:
+            xsd_hi, xsd_lo = _split_queries(xs5, n5)
+            seeds_d, ts_d, scw_d, tcw_d, vcw_d, fvcw_d = da.device_args()
+            ad = (seeds_d, ts_d, scw_d, tcw_d, vcw_d, fvcw_d, xsd_hi, xsd_lo)
 
-        def chainedd(r):
-            @jax.jit
-            def f(seeds, ts, scw, tcw, vcw, fvcw, xs_hi, xs_lo):
-                acc = jnp.uint32(0)
-                for _ in range(r):
-                    bits = _eval_points_cc_jit(
-                        da.nu, n5, seeds, ts, scw, tcw, fvcw, xs_hi,
-                        xs_lo ^ (acc & 1), 0, vcw,
-                    )
-                    acc = acc ^ jnp.bitwise_xor.reduce(
-                        bits.astype(jnp.uint32), axis=None
-                    )
-                return acc
+            def chainedd(r):
+                @jax.jit
+                def f(seeds, ts, scw, tcw, vcw, fvcw, xs_hi, xs_lo):
+                    acc = jnp.uint32(0)
+                    for _ in range(r):
+                        bits = _eval_points_cc_jit(
+                            da.nu, n5, seeds, ts, scw, tcw, fvcw, xs_hi,
+                            xs_lo ^ (acc & 1), 0, vcw,
+                        )
+                        acc = acc ^ jnp.bitwise_xor.reduce(
+                            bits.astype(jnp.uint32), axis=None
+                        )
+                    return acc
 
-            return f
+                return f
 
-    rd = 33 if not small else 3
-    dt = _marginal_time(chainedd(1), chainedd(rd), ad, rd, repeats=8,
-                        stat="median")
-    _emit(f"FSS lt-gate n={n5} {g5} gates x {q5} pts (DCF, device)",
-          g5 * q5 / dt / 1e6, "Mgate-evals/sec")
+        rd = 33 if not small else 3
+        dt = _marginal_time(chainedd(1), chainedd(rd), ad, rd, repeats=8,
+                            stat="median")
+        _emit(f"FSS lt-gate n={n5} {g5} gates x {q5} pts (DCF, device)",
+              g5 * q5 / dt / 1e6, "Mgate-evals/sec",
+              route=_route(
+                  "pallas-dcf-walk" if use_dcf_kernel else "xla-dcf-walk"
+              ))
+
+    _section("cfg5-dcf", cfg5_dcf)
 
     # Single-core native baseline for the same gate workload (the C++ DCF
     # walk, one gate-point at a time — what one CPU core does with the
     # identical keys): gives config 5 a measured reference point the way
     # measure_baseline() does for the expansion configs.
-    try:
+    def cfg5_dcf_native():
         from dpf_tpu.backends import cpu_native as cn
 
-        if cn.available():
-            gb = min(g5, 64)
-            rngb = np.random.default_rng(5)
-            pairs = [
-                cn.dcf_gen(int(a), n5, rng=rngb)
-                for a in rngb.integers(0, 1 << n5, size=gb, dtype=np.uint64)
-            ]
-            keysb = [p[0] for p in pairs]
-            xsb = rngb.integers(0, 1 << n5, size=(gb, q5), dtype=np.uint64)
-            cn.dcf_eval_points_batch(keysb[:4], xsb[:4], n5)  # warm
-            best = float("inf")
-            for _ in range(5):
-                t0 = time.perf_counter()
-                cn.dcf_eval_points_batch(keysb, xsb, n5)
-                best = min(best, time.perf_counter() - t0)
-            _emit(
-                f"FSS lt-gate n={n5} {gb} gates x {q5} pts "
-                "(DCF, native 1-core baseline)",
-                gb * q5 / best / 1e6, "Mgate-evals/sec",
-            )
-    except Exception as e:  # baseline is best-effort, never fails the run
-        print(json.dumps({
-            "metric": "dcf native baseline", "value": 0, "unit": "",
-            "detail": f"skipped: {type(e).__name__}: {e}",
-        }), flush=True)
+        if not cn.available():
+            print(json.dumps({
+                "metric": "dcf native baseline", "value": 0, "unit": "",
+                "detail": "skipped: native backend unavailable",
+            }), flush=True)
+            return
+        gb = min(g5, 64)
+        rngb = np.random.default_rng(5)
+        pairs = [
+            cn.dcf_gen(int(a), n5, rng=rngb)
+            for a in rngb.integers(0, 1 << n5, size=gb, dtype=np.uint64)
+        ]
+        keysb = [p[0] for p in pairs]
+        xsb = rngb.integers(0, 1 << n5, size=(gb, q5), dtype=np.uint64)
+        cn.dcf_eval_points_batch(keysb[:4], xsb[:4], n5)  # warm
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            cn.dcf_eval_points_batch(keysb, xsb, n5)
+            best = min(best, time.perf_counter() - t0)
+        _emit(
+            f"FSS lt-gate n={n5} {gb} gates x {q5} pts "
+            "(DCF, native 1-core baseline)",
+            gb * q5 / best / 1e6, "Mgate-evals/sec",
+            route="native-cpp-1core",
+        )
+
+    _section("cfg5-dcf-native", cfg5_dcf_native)
 
 
 if __name__ == "__main__":

@@ -1,19 +1,29 @@
 #!/bin/bash
-# Round-4 TPU validation sequence: waits for the axon tunnel to come back,
+# Round-5 TPU validation sequence: waits for the axon tunnel to come back,
 # then runs correctness checks, the A/B experiments, and the full bench
 # matrix in one shot (each step hard-capped — the tunnel can wedge again
-# mid-sequence).  Logs under /tmp/tpu_r4/.
+# mid-sequence).  Logs under /root/repo/tpu_logs/r5 and GIT-COMMITTED after
+# every step (round 4's watcher logged to volatile /tmp and died with its
+# session — both the location and the missing commit lost the evidence).
+# Run detached:  setsid nohup bash scripts/tpu_when_up.sh >/dev/null 2>&1 &
 set -u
 cd /root/repo
-OUT=/tmp/tpu_r4
+OUT=/root/repo/tpu_logs/r5
 mkdir -p "$OUT"
 
-echo "waiting for tunnel..." | tee "$OUT/status"
+save() {  # best-effort commit of the logs; a concurrent index lock is fine,
+          # the next step's save picks the files up
+  git add -A tpu_logs/r5 >/dev/null 2>&1 && \
+    git commit -q -m "tpu_logs r5: $1" >/dev/null 2>&1 || true
+}
+
+echo "watcher started $(date) pid=$$" | tee "$OUT/status"
 while true; do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     break
   fi
-  sleep 240
+  echo "probe failed $(date +%H:%M:%S)" >> "$OUT/status"
+  sleep 180
 done
 echo "tunnel up at $(date)" | tee -a "$OUT/status"
 
@@ -22,8 +32,12 @@ run() {  # run <name> <timeout_s> <cmd...>
   echo "=== $name start $(date +%H:%M:%S)" | tee -a "$OUT/status"
   timeout "$to" "$@" >"$OUT/$name.log" 2>&1
   echo "=== $name rc=$? end $(date +%H:%M:%S)" | tee -a "$OUT/status"
+  save "$name"
 }
 
+# Insurance number first (VERDICT r4 #8): a committed BENCH-style record
+# exists even if the tunnel wedges again mid-sequence.
+run bench_early     1200 python bench.py
 run tpu_checks      2400 python scripts/tpu_checks.py
 run smalltree_test  1800 python -m pytest \
     "tests/test_chacha_pallas.py::test_expand_kernel_small_tree_matches_xla_tpu" -q
@@ -31,6 +45,7 @@ run sbox_ab         2400 python scripts/bench_compat_ab.py \
     pallas_bm:128:bp113 pallas_bm:128:lowlive \
     pallas_bm:128:bp113 pallas_bm:128:lowlive
 run smalltree_ab    2400 python scripts/bench_small_tree_ab.py
-run bench_all       5400 python bench_all.py
-run bench           1200 python bench.py
+run bench_all       7200 python bench_all.py
 echo "sequence complete $(date)" | tee -a "$OUT/status"
+touch "$OUT/DONE"
+save "sequence complete"

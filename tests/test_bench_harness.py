@@ -37,6 +37,38 @@ def test_bench_emits_one_json_line_on_infra_failure():
     assert "unit" in rec and "vs_baseline" in rec and "detail" in rec
 
 
+def test_bench_all_completes_past_a_dead_row():
+    """bench_all.py must contain a per-section failure: a forced failure in
+    one config section emits an ``"error"`` row and the matrix CONTINUES to
+    later sections (the first full-scale hardware-run failure mode is
+    Mosaic rejecting one never-compiled kernel — that must yield a partial
+    record, not a dead matrix)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DPF_TPU_BENCH_ONLY"] = "cfg3"
+    env["DPF_TPU_BENCH_FORCE_FAIL"] = "cfg3-fast"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_all.py"),
+         "--scale", "small"],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = [json.loads(ln) for ln in proc.stdout.splitlines() if ln.strip()]
+    dead = [r for r in rows if r.get("error")]
+    assert len(dead) == 1 and dead[0]["metric"] == "cfg3-fast", rows
+    assert "forced failure" in dead[0]["error"]
+    # The matrix continued: the LATER compat section produced value rows,
+    # each carrying a route field.
+    live = [r for r in rows if "compat" in r.get("metric", "")]
+    assert len(live) == 2, rows
+    assert all(r["value"] > 0 and r.get("route") for r in live), rows
+
+
 def test_bench_watchdog_converts_hang_to_infra_record():
     """A wedged device tunnel HANGS (it does not error); the parent
     watchdog must kill the child at the deadline and still emit exactly

@@ -1,0 +1,32 @@
+"""examples/quickstart.py is the one self-checking file demonstrating every
+public surface (compat Gen/Eval/EvalFull, fast profile, FSS/DCF/interval
+gates, PIR, sharded mesh).  It must be exercised by the suite so it cannot
+silently drift from the APIs it demonstrates."""
+
+import os
+import subprocess
+import sys
+
+from _hermetic import hermetic_cpu_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_quickstart_runs_green():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "quickstart.py")],
+        env=hermetic_cpu_env(8),
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=1200,
+    )
+    assert proc.returncode == 0, (
+        proc.stdout[-2000:] + "\n" + proc.stderr[-2000:]
+    )
+    assert "all quickstart sections passed" in proc.stdout
+    # Every section reported its own success line.
+    for tag in ("compat", "fast", "compare", "PIR", "mesh"):
+        assert any(
+            ln.startswith(tag) for ln in proc.stdout.splitlines()
+        ), proc.stdout
