@@ -404,9 +404,32 @@ def main() -> None:
                 "vs_baseline": round(fast / baseline, 2),
                 "aes_compat_gleaves": round(compat / 1e9, 3),
                 "aes_compat_vs_baseline": round(compat / baseline, 2),
+                "route": _routes(),
             }
         )
     )
+
+
+def _routes() -> str:
+    """Which backends produced the two numbers (and the S-box variant),
+    read after the measurement so a mid-run latched degradation shows."""
+    try:
+        from dpf_tpu.models import dpf as mdpf
+        from dpf_tpu.ops import aes_pallas
+        from dpf_tpu.ops import chacha_pallas as cp
+
+        parts = [
+            f"fast={cp.expand_backend()}",
+            f"compat={mdpf.default_backend()}",
+            f"sbox={aes_pallas._SBOX}",
+        ]
+        if mdpf._WALK_KERNEL_BROKEN:
+            parts.append("aes-walk-latched")
+        if cp._SMALL_TREE_BROKEN:
+            parts.append("small-tree-latched")
+        return ",".join(parts)
+    except Exception:  # noqa: BLE001 — the record matters more
+        return "unknown"
 
 
 if __name__ == "__main__":

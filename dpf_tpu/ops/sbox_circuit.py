@@ -178,8 +178,12 @@ def sbox_bp113_lowlive(x):
                y11 = y16^t0, y10 = y11^y17), interleaved with the shared
                output-XOR tree so each z dies within a few gates.
 
-    ~36 extra XORs (149 ops vs 113) buy a peak of 17 live values (25
-    inputs-pinned) — recomputation is issue-rate-cheap, spills are not.
+    ~43 extra XORs (156 ops vs 113) buy a peak cut of 24 live values (26
+    inputs-pinned) vs BP113's 29 (36) — recomputation is issue-rate-cheap,
+    spills are not.  The binding region is phase C, whose cut is close to
+    inherent: 8 pinned inputs + the 9 tower coefficients (t29..t45, each
+    feeding two z-products) are live across the whole output
+    reconstruction, so ~17 is the floor for any schedule of this DAG.
     Exhaustively verified against the from-first-principles table in
     tests/test_aes_bitslice.py alongside the other circuits.
     """

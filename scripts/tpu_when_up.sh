@@ -12,9 +12,10 @@ OUT=/root/repo/tpu_logs/r5
 mkdir -p "$OUT"
 
 save() {  # best-effort commit of the logs; a concurrent index lock is fine,
-          # the next step's save picks the files up
+          # the next step's save picks the files up.  Pathspec'd commit so
+          # anything the builder session has staged stays staged.
   git add -A tpu_logs/r5 >/dev/null 2>&1 && \
-    git commit -q -m "tpu_logs r5: $1" >/dev/null 2>&1 || true
+    git commit -q -m "tpu_logs r5: $1" -- tpu_logs/r5 >/dev/null 2>&1 || true
 }
 
 echo "watcher started $(date) pid=$$" | tee "$OUT/status"
