@@ -146,6 +146,40 @@ def test_small_tree_plan_gating(monkeypatch):
         cp.expand_plan(5, 3, cap)
 
 
+def test_small_tree_failure_degrades_to_classic(monkeypatch):
+    """A Mosaic rejection of the (TPU-only, interpreter-untestable)
+    whole-tree entry-0 program must latch _SMALL_TREE_BROKEN and degrade
+    eval_full_device to the classic/XLA plan with a warning; an explicit
+    DPF_TPU_EXPAND_ENTRY=small re-raises so A/Bs never silently measure
+    the fallback.  Mirrors test_walk_kernel_failure_degrades_to_xla."""
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic lowering failure")
+
+    monkeypatch.delenv("DPF_TPU_EXPAND_ENTRY", raising=False)
+    monkeypatch.setattr(cp, "_on_tpu", lambda: True)
+    monkeypatch.setattr(cp, "_SMALL_TREE_BROKEN", False)
+    monkeypatch.setattr(dc, "_eval_full_pallas_device", boom)
+    rng = np.random.default_rng(4)
+    log_n = 10  # nu = 1: the auto small route engages under _on_tpu
+    alphas = rng.integers(0, 1 << log_n, size=2, dtype=np.uint64)
+    ka, _ = gen_batch(alphas, log_n, rng=rng)
+    want = np.asarray(dc.eval_full_device(ka, backend="xla"))
+    with pytest.warns(RuntimeWarning, match="whole-tree expand route"):
+        got = np.asarray(dc.eval_full_device(ka, backend="pallas"))
+    np.testing.assert_array_equal(got, want)
+    assert cp._SMALL_TREE_BROKEN
+    # Latched: the re-plan skips the small route without re-attempting.
+    np.testing.assert_array_equal(
+        np.asarray(dc.eval_full_device(ka, backend="pallas")), want
+    )
+    # Env-forced small experiments must see the raw failure.
+    monkeypatch.setattr(cp, "_SMALL_TREE_BROKEN", False)
+    monkeypatch.setenv("DPF_TPU_EXPAND_ENTRY", "small")
+    with pytest.raises(RuntimeError, match="synthetic lowering failure"):
+        dc.eval_full_device(ka, backend="pallas")
+
+
 def test_deinterleave_wt_restores_order():
     """The small-route-specific math: deinterleave_leaves at wt < 128.
 
