@@ -92,7 +92,7 @@ def _compat_walk_eligible(k: int) -> bool:
     from dpf_tpu.ops import aes_pallas
 
     return (
-        not mdpf._WALK_KERNEL_BROKEN
+        (not mdpf._WALK_KERNEL_BROKEN or aes_pallas.walk_forced())
         and aes_pallas.walk_backend() == "pallas"
         and (
             mdpf.default_backend() in mdpf._BM_BACKENDS

@@ -398,8 +398,12 @@ def eval_points(
     # TPU-default (bit-major) backend family; an explicit backend="xla"
     # keeps the XLA body (A/B and differential reference) unless
     # DPF_TPU_POINTS_AES=pallas forces the kernel outright.
+    # A latched failure disables the kernel for the DEFAULT routing only:
+    # DPF_TPU_POINTS_AES=pallas (walk_forced) keeps attempting it and
+    # re-raises on failure, so A/Bs and hardware validation never
+    # silently measure the XLA fallback.
     if (
-        not _WALK_KERNEL_BROKEN
+        (not _WALK_KERNEL_BROKEN or aes_pallas.walk_forced())
         and aes_pallas.walk_backend() == "pallas"
         and (backend in _BM_BACKENDS or aes_pallas.walk_forced())
     ):
@@ -550,7 +554,7 @@ def eval_points_level_grouped(
         raise ValueError("dpf: query index out of domain")
     backend = backend or default_backend()
     use_walk = (
-        not _WALK_KERNEL_BROKEN
+        (not _WALK_KERNEL_BROKEN or aes_pallas.walk_forced())
         and aes_pallas.walk_backend() == "pallas"
         and (backend in _BM_BACKENDS or aes_pallas.walk_forced())
         and kb.k % aes_pallas._PKT == 0

@@ -466,7 +466,11 @@ def small_tree_entry(nu: int):
         raise ValueError("DPF_TPU_EXPAND_ENTRY must be auto|small|classic")
     if mode == "classic" or not 1 <= nu <= _EXP_SMALL_MAX_NU:
         return None
-    if _SMALL_TREE_BROKEN:
+    # A latched failure disables the route for AUTO mode only: an explicit
+    # DPF_TPU_EXPAND_ENTRY=small must keep attempting the kernel (and
+    # re-raise on failure, see small_tree_degraded) so A/Bs and hardware
+    # validation never silently measure the classic fallback.
+    if _SMALL_TREE_BROKEN and mode != "small":
         return None
     # TPU-only: XLA:CPU's compile time explodes exponentially in the
     # number of narrow-lane concat levels (W=1 entry, levels=2 exceeds

@@ -418,7 +418,7 @@ def eval_points_sharded(
     from ..models import dpf as mdpf
 
     use_walk = (
-        not mdpf._WALK_KERNEL_BROKEN
+        (not mdpf._WALK_KERNEL_BROKEN or aes_pallas.walk_forced())
         and aes_pallas.walk_backend() == "pallas"
         and (backend in _BM_BACKENDS or aes_pallas.walk_forced())
     )

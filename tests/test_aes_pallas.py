@@ -142,6 +142,11 @@ def test_walk_kernel_failure_degrades_to_xla(monkeypatch):
     np.testing.assert_array_equal(
         eval_points(ka, xs, backend="pallas_bm"), want
     )
+    # But an env-FORCED kernel run overrides the latch and re-raises —
+    # A/Bs must never silently measure the fallback.
+    monkeypatch.setenv("DPF_TPU_POINTS_AES", "pallas")
+    with pytest.raises(RuntimeError, match="synthetic lowering failure"):
+        eval_points(ka, xs, backend="pallas_bm")
 
 
 def test_bm_kernels_lowlive_sbox_match_xla(monkeypatch):

@@ -173,8 +173,11 @@ def test_small_tree_failure_degrades_to_classic(monkeypatch):
     np.testing.assert_array_equal(
         np.asarray(dc.eval_full_device(ka, backend="pallas")), want
     )
-    # Env-forced small experiments must see the raw failure.
-    monkeypatch.setattr(cp, "_SMALL_TREE_BROKEN", False)
+    # Env-forced small experiments must see the raw failure — EVEN when a
+    # previous auto-mode failure already latched (the latch only disables
+    # the route for auto routing; A/Bs must never silently measure the
+    # classic fallback).
+    assert cp._SMALL_TREE_BROKEN
     monkeypatch.setenv("DPF_TPU_EXPAND_ENTRY", "small")
     with pytest.raises(RuntimeError, match="synthetic lowering failure"):
         dc.eval_full_device(ka, backend="pallas")
