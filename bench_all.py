@@ -816,7 +816,7 @@ def main():
         da, _db = dcf_mod.gen_lt_batch(
             rng.integers(0, 1 << n5, size=g5, dtype=np.uint64), n5, rng=rng
         )
-        use_dcf_kernel = cp.points_backend() == "pallas" and cp.usable(da.k)
+        use_dcf_kernel = dcf_mod.points_kernel_eligible(da.k)
         dt = _timed_host_call(lambda: dcf_mod.eval_lt_points(da, xs5))
         _emit(
             f"FSS lt-gate n={n5} {g5} gates x {q5} pts (DCF, incl. dispatch)",
@@ -889,6 +889,32 @@ def main():
               ))
 
     _section("cfg5-dcf", cfg5_dcf)
+
+    # Interval gates 1{lo <= x <= hi} (BASELINE config 5 names
+    # "comparison/interval gate"): two DCFs per gate evaluated as ONE
+    # fused 2K-key device launch (models/dcf.eval_interval_points).
+    def cfg5_interval():
+        from dpf_tpu.models import dcf as dcf_mod
+
+        lo5 = rng.integers(0, 1 << n5, size=g5, dtype=np.uint64)
+        width = rng.integers(0, 1 << 30, size=g5, dtype=np.uint64)
+        hi5 = np.minimum(lo5 + width, np.uint64((1 << n5) - 1))
+        ia, _ib = dcf_mod.gen_interval_batch(lo5, hi5, n5, rng=rng)
+        # The fused interval batch holds 2K keys (upper+lower halves).
+        use_dcf_kernel = dcf_mod.points_kernel_eligible(2 * g5)
+        dt = _timed_host_call(
+            lambda: dcf_mod.eval_interval_points(ia, xs5)
+        )
+        _emit(
+            f"FSS interval-gate n={n5} {g5} gates x {q5} pts "
+            "(DCF, incl. dispatch)",
+            g5 * q5 / dt / 1e6, "Mgate-evals/sec",
+            route=_route(
+                "pallas-dcf-walk" if use_dcf_kernel else "xla-dcf-walk"
+            ),
+        )
+
+    _section("cfg5-interval", cfg5_interval)
 
     # Single-core native baseline for the same gate workload (the C++ DCF
     # walk, one gate-point at a time — what one CPU core does with the

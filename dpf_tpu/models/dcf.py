@@ -272,6 +272,17 @@ def eval_points_np(kb: DcfKeyBatch, xs: np.ndarray) -> np.ndarray:
     return acc.astype(np.uint8).reshape(K, Q)
 
 
+def points_kernel_eligible(k: int) -> bool:
+    """THE routing predicate of :func:`eval_lt_points` (and, through the
+    fused 2K-key batch, :func:`eval_interval_points`): the Pallas
+    whole-walk kernel in DCF mode when the key count tiles the kernel's
+    lane quantum.  Exposed so benchmarks label their route rows from the
+    same predicate production routes on."""
+    from ..ops import chacha_pallas as cp
+
+    return cp.points_backend() == "pallas" and cp.usable(k)
+
+
 def eval_lt_points(kb: DcfKeyBatch, xs: np.ndarray) -> np.ndarray:
     """Batched comparison-share evaluation: xs uint64[K, Q] -> uint8[K, Q]
     with  eval(ka) ^ eval(kb) == 1{x < alpha}  per gate.
@@ -285,7 +296,7 @@ def eval_lt_points(kb: DcfKeyBatch, xs: np.ndarray) -> np.ndarray:
         raise ValueError("dcf: query index out of domain")
     from ..ops import chacha_pallas as cp
 
-    if cp.points_backend() == "pallas" and cp.usable(kb.k):
+    if points_kernel_eligible(kb.k):
         return cp.eval_points_walk_dcf(kb, xs)
     return _eval_points_xla(kb, xs)
 
