@@ -1,43 +1,72 @@
 #!/bin/bash
-# Round-5 TPU validation sequence: waits for the axon tunnel to come back,
-# then runs correctness checks, the A/B experiments, and the full bench
-# matrix in one shot (each step hard-capped — the tunnel can wedge again
-# mid-sequence).  Logs under /root/repo/tpu_logs/r5 and GIT-COMMITTED after
-# every step (round 4's watcher logged to volatile /tmp and died with its
-# session — both the location and the missing commit lost the evidence).
+# Round-5 TPU validation sequence, wedge-resilient revision.
+#
+# The first r5 attempt showed the failure mode this version fixes: the
+# tunnel came up at 03:48, wedged again ~8 min into the first step, and
+# the serial sequence then burned 3 steps x 25 min each against a dead
+# device (the axon plugin blocks ~25 min inside backend init before
+# raising UNAVAILABLE).  Now every step is guarded:
+#   - probe (90 s jax.devices()) must pass IMMEDIATELY before each step,
+#     else re-enter the 3-min wait loop;
+#   - a step whose log shows a backend-init failure or whose rc is
+#     nonzero-by-infra is RETRIED (up to 5 attempts) instead of skipped —
+#     a wedge mid-step must not permanently eat that step's evidence;
+#   - steps that already produced their evidence (.done marker per step)
+#     are skipped on re-entry, so the watcher itself can be restarted.
+# Logs under /root/repo/tpu_logs/r5 and git-committed after every step.
 # Run detached:  setsid nohup bash scripts/tpu_when_up.sh >/dev/null 2>&1 &
 set -u
 cd /root/repo
 OUT=/root/repo/tpu_logs/r5
 mkdir -p "$OUT"
 
-save() {  # best-effort commit of the logs; a concurrent index lock is fine,
-          # the next step's save picks the files up.  Pathspec'd commit so
-          # anything the builder session has staged stays staged.
+save() {
   git add -A tpu_logs/r5 >/dev/null 2>&1 && \
     git commit -q -m "tpu_logs r5: $1" -- tpu_logs/r5 >/dev/null 2>&1 || true
 }
 
-echo "watcher started $(date) pid=$$" | tee "$OUT/status"
-while true; do
-  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-    break
-  fi
-  echo "probe failed $(date +%H:%M:%S)" >> "$OUT/status"
-  sleep 180
-done
-echo "tunnel up at $(date)" | tee -a "$OUT/status"
+probe() { timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; }
 
-run() {  # run <name> <timeout_s> <cmd...>
-  local name=$1 to=$2; shift 2
-  echo "=== $name start $(date +%H:%M:%S)" | tee -a "$OUT/status"
-  timeout "$to" "$@" >"$OUT/$name.log" 2>&1
-  echo "=== $name rc=$? end $(date +%H:%M:%S)" | tee -a "$OUT/status"
-  save "$name"
+wait_up() {
+  until probe; do
+    echo "probe failed $(date +%H:%M:%S)" >> "$OUT/status"
+    sleep 180
+  done
+  echo "tunnel up $(date +%H:%M:%S)" >> "$OUT/status"
 }
 
-# Insurance number first (VERDICT r4 #8): a committed BENCH-style record
-# exists even if the tunnel wedges again mid-sequence.
+infra_failed() {  # log shows the wedge/teardown signature, not a real verdict
+  grep -aq "Unable to initialize backend\|UNAVAILABLE: TPU backend\|wedged device tunnel" "$1"
+}
+
+run() {  # run <name> <timeout_s> <cmd...>; retries on infra failure
+  local name=$1 to=$2; shift 2
+  [ -e "$OUT/$name.done" ] && return 0
+  local attempt rc
+  for attempt in 1 2 3 4 5; do
+    wait_up
+    echo "=== $name attempt $attempt start $(date +%H:%M:%S)" | tee -a "$OUT/status"
+    timeout "$to" "$@" >"$OUT/$name.log" 2>&1
+    rc=$?
+    echo "=== $name attempt $attempt rc=$rc end $(date +%H:%M:%S)" | tee -a "$OUT/status"
+    save "$name attempt $attempt"
+    if [ "$rc" -eq 0 ] && ! infra_failed "$OUT/$name.log"; then
+      touch "$OUT/$name.done"; save "$name done"; return 0
+    fi
+    # rc!=0 without the infra signature is a REAL verdict (mismatch,
+    # failed check) — keep the log, mark done, move on; retrying would
+    # just reproduce it.
+    if [ "$rc" -ne 0 ] && [ "$rc" -ne 124 ] && ! infra_failed "$OUT/$name.log"; then
+      touch "$OUT/$name.done"; save "$name done (real failure rc=$rc)"; return "$rc"
+    fi
+  done
+  echo "=== $name gave up after 5 attempts" | tee -a "$OUT/status"
+  save "$name gave up"
+  return 1
+}
+
+echo "watcher(v2) started $(date) pid=$$" | tee -a "$OUT/status"
+
 run bench_early     1200 python bench.py
 run tpu_checks      2400 python scripts/tpu_checks.py
 run smalltree_test  1800 python -m pytest \
