@@ -16,9 +16,14 @@ conftest.py`` (in-process, before the first ``import jax``), and mirrored by
 from __future__ import annotations
 
 import os
+import sys
 
 # Env vars that must not reach a hermetic JAX process.
-_HOSTILE_VARS = ("PALLAS_AXON_POOL_IPS",)
+_HOSTILE_VARS = (
+    "PALLAS_AXON_POOL_IPS",
+    "PALLAS_AXON_REMOTE_COMPILE",
+    "PALLAS_AXON_TPU_GEN",
+)
 
 
 def hermetic_cpu_env(n_devices: int, base=None) -> dict:
@@ -41,8 +46,20 @@ def hermetic_cpu_env(n_devices: int, base=None) -> dict:
 def apply_hermetic_cpu_env(n_devices: int = 8) -> None:
     """Force the hermetic env onto ``os.environ`` in place.
 
-    Must run before the first ``import jax`` in the process."""
+    Must run before the first *backend use*.  Running before the first
+    ``import jax`` is no longer enough: this environment's interpreter
+    pre-imports jax + the axon plugin at startup (a site .pth), so
+    ``JAX_PLATFORMS=axon`` from the driver env is read before any user
+    code and an ``os.environ`` update alone is ignored — against a
+    wedged tunnel the first jax op then hangs ~25 min inside axon
+    backend init.  When jax is already imported, the platform must be
+    forced through ``jax.config``; ``XLA_FLAGS`` is still consumed at
+    lazy CPU-client init, so the environ update covers it."""
     env = hermetic_cpu_env(n_devices)
     for var in _HOSTILE_VARS:
         os.environ.pop(var, None)
     os.environ.update(env)
+    if "jax" in sys.modules:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

@@ -6,11 +6,14 @@
 # the serial sequence then burned 3 steps x 25 min each against a dead
 # device (the axon plugin blocks ~25 min inside backend init before
 # raising UNAVAILABLE).  Now every step is guarded:
+#   - single-instance flock: a restarted watcher cannot overlap a live
+#     one (two jax clients on the one tunnel corrupt each other);
 #   - probe (90 s jax.devices()) must pass IMMEDIATELY before each step,
 #     else re-enter the 3-min wait loop;
-#   - a step whose log shows a backend-init failure or whose rc is
-#     nonzero-by-infra is RETRIED (up to 5 attempts) instead of skipped —
-#     a wedge mid-step must not permanently eat that step's evidence;
+#   - a step whose log shows a backend-init failure is RETRIED (up to 5
+#     attempts, per-attempt log files so no attempt's evidence is ever
+#     truncated away); a bare step timeout (rc=124, no wedge signature)
+#     is retried ONCE — a genuinely slow step must not eat 5x its cap;
 #   - steps that already produced their evidence (.done marker per step)
 #     are skipped on re-entry, so the watcher itself can be restarted.
 # Logs under /root/repo/tpu_logs/r5 and git-committed after every step.
@@ -19,6 +22,12 @@ set -u
 cd /root/repo
 OUT=/root/repo/tpu_logs/r5
 mkdir -p "$OUT"
+
+exec 9>"$OUT/.lock"
+if ! flock -n 9; then
+  echo "another watcher instance holds $OUT/.lock — exiting" >&2
+  exit 1
+fi
 
 save() {
   git add -A tpu_logs/r5 >/dev/null 2>&1 && \
@@ -42,21 +51,33 @@ infra_failed() {  # log shows the wedge/teardown signature, not a real verdict
 run() {  # run <name> <timeout_s> <cmd...>; retries on infra failure
   local name=$1 to=$2; shift 2
   [ -e "$OUT/$name.done" ] && return 0
-  local attempt rc
+  local attempt rc log timeouts=0
   for attempt in 1 2 3 4 5; do
     wait_up
+    log="$OUT/$name.a$attempt.log"
     echo "=== $name attempt $attempt start $(date +%H:%M:%S)" | tee -a "$OUT/status"
-    timeout "$to" "$@" >"$OUT/$name.log" 2>&1
+    timeout "$to" "$@" >"$log" 2>&1
     rc=$?
     echo "=== $name attempt $attempt rc=$rc end $(date +%H:%M:%S)" | tee -a "$OUT/status"
-    save "$name attempt $attempt"
-    if [ "$rc" -eq 0 ] && ! infra_failed "$OUT/$name.log"; then
-      touch "$OUT/$name.done"; save "$name done"; return 0
+    # Latest attempt is also the canonical $name.log the decision rules read.
+    cp -f "$log" "$OUT/$name.log"
+    if [ "$rc" -eq 0 ] && ! infra_failed "$log"; then
+      touch "$OUT/$name.done"; save "$name done (attempt $attempt)"; return 0
+    fi
+    save "$name attempt $attempt rc=$rc"
+    if [ "$rc" -eq 124 ] && ! infra_failed "$log"; then
+      timeouts=$((timeouts + 1))
+      if [ "$timeouts" -ge 2 ]; then
+        echo "=== $name timed out twice without wedge signature — giving up" \
+          | tee -a "$OUT/status"
+        touch "$OUT/$name.done"; save "$name done (timeout x2)"; return 124
+      fi
+      continue
     fi
     # rc!=0 without the infra signature is a REAL verdict (mismatch,
     # failed check) — keep the log, mark done, move on; retrying would
     # just reproduce it.
-    if [ "$rc" -ne 0 ] && [ "$rc" -ne 124 ] && ! infra_failed "$OUT/$name.log"; then
+    if [ "$rc" -ne 0 ] && ! infra_failed "$log"; then
       touch "$OUT/$name.done"; save "$name done (real failure rc=$rc)"; return "$rc"
     fi
   done
