@@ -3,6 +3,7 @@
 # (if the axon tunnel is wedged, jax.devices() hangs in any process where the
 # plugin registers — unsetting PALLAS_AXON_POOL_IPS skips registration).
 exec env -u PALLAS_AXON_POOL_IPS \
+    -u PALLAS_AXON_REMOTE_COMPILE -u PALLAS_AXON_TPU_GEN \
     JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
     python -m pytest tests/ -q "$@"
