@@ -69,6 +69,28 @@ def measure_baseline() -> float:
         return FALLBACK_BASELINE
 
 
+def _chain_scan(jax, jnp, step, r: int):
+    """``jit(f(*args))`` running ``step(acc, *args) -> acc`` ``r`` times
+    serially via ``lax.scan``.
+
+    scan, not a Python loop: an unrolled r-deep chain compiles r copies of
+    the (large) expansion body — cold-compiling an unrolled r=33 graph
+    helped blow the round-5 first-contact bench past its 900 s deadline.
+    scan compiles the body ONCE; the serial dependence through ``acc`` is
+    the chain's point (it defeats CSE), so steady-state throughput is
+    unchanged.  Shared by bench.py, bench_all.py and the A/B scripts."""
+
+    @jax.jit
+    def f(*args):
+        def body(acc, _):
+            return step(acc, *args), None
+
+        acc, _ = jax.lax.scan(body, jnp.uint32(0), None, length=r)
+        return acc
+
+    return f
+
+
 def _marginal_time(
     f1, fR, args, r: int, repeats: int = 6, stat: str = "min"
 ) -> float:
@@ -156,21 +178,15 @@ def bench_fast(jax, jnp, rng) -> float:
     if use_kernel:
         kern_ops = cp.expand_operands(ka, s)
 
-    def chained(r):
-        @jax.jit
-        def f(seeds, ts, scw, tcw, fcw):
-            acc = jnp.uint32(0)
-            for _ in range(r):
-                if use_kernel:
-                    w = _eval_full_pk_jit(
-                        nu, s, seeds ^ acc, ts, scw, tcw, *kern_ops
-                    )
-                else:
-                    w = _eval_full_cc_jit(nu, seeds ^ acc, ts, scw, tcw, fcw)
-                acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
-            return acc
+    def step(acc, seeds, ts, scw, tcw, fcw):
+        if use_kernel:
+            w = _eval_full_pk_jit(nu, s, seeds ^ acc, ts, scw, tcw, *kern_ops)
+        else:
+            w = _eval_full_cc_jit(nu, seeds ^ acc, ts, scw, tcw, fcw)
+        return acc ^ jnp.bitwise_xor.reduce(w, axis=None)
 
-        return f
+    def chained(r):
+        return _chain_scan(jax, jnp, step, r)
 
     if use_kernel:
         # ~1 ms/expansion: deep chain + median so dispatch jitter can't
@@ -213,19 +229,15 @@ def bench_compat(jax, jnp, rng) -> float:
     )
     dk = DeviceKeys(ka)
 
-    def chained(r):
-        @jax.jit
-        def f(seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes):
-            acc = jnp.uint32(0)
-            for _ in range(r):
-                words = _eval_full_jit(
-                    dk.nu, seed_planes ^ acc, t_words, scw_planes,
-                    tl_w, tr_w, fcw_planes, backend,
-                )
-                acc = acc ^ jnp.bitwise_xor.reduce(words, axis=None)
-            return acc
+    def step(acc, seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes):
+        words = _eval_full_jit(
+            dk.nu, seed_planes ^ acc, t_words, scw_planes,
+            tl_w, tr_w, fcw_planes, backend,
+        )
+        return acc ^ jnp.bitwise_xor.reduce(words, axis=None)
 
-        return f
+    def chained(r):
+        return _chain_scan(jax, jnp, step, r)
 
     args = (
         dk.seed_planes, dk.t_words, dk.scw_planes,

@@ -45,7 +45,13 @@ import time
 
 import numpy as np
 
-from bench import _marginal_time, bench_compat, bench_fast, measure_baseline
+from bench import (
+    _chain_scan,
+    _marginal_time,
+    bench_compat,
+    bench_fast,
+    measure_baseline,
+)
 
 
 def _timed_host_call(fn, reps: int = 3) -> float:
@@ -225,23 +231,17 @@ def main():
         else:
             a1 = ka.device_args()
 
-        def chained1(r):
-            @jax.jit
-            def f(seeds, ts, scw, tcw, fcw):
-                acc = jnp.uint32(0)
-                for _ in range(r):
-                    if use_kernel1:
-                        w = _eval_full_pk_jit(
-                            ka.nu, s1, seeds ^ acc, ts, scw, tcw, *ops1
-                        )
-                    else:
-                        w = _eval_full_cc_jit(
-                            ka.nu, seeds ^ acc, ts, scw, tcw, fcw
-                        )
-                    acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
-                return acc
+        def step1(acc, seeds, ts, scw, tcw, fcw):
+            if use_kernel1:
+                w = _eval_full_pk_jit(
+                    ka.nu, s1, seeds ^ acc, ts, scw, tcw, *ops1
+                )
+            else:
+                w = _eval_full_cc_jit(ka.nu, seeds ^ acc, ts, scw, tcw, fcw)
+            return acc ^ jnp.bitwise_xor.reduce(w, axis=None)
 
-            return f
+        def chained1(r):
+            return _chain_scan(jax, jnp, step1, r)
 
         # Sub-ms expansions: deep chain + median (see bench._marginal_time).
         dt = _marginal_time(chained1(1), chained1(65), a1, 65, repeats=8,
@@ -271,23 +271,17 @@ def main():
         else:
             a28 = ka28.device_args()
 
-        def chained28(r):
-            @jax.jit
-            def f(seeds, ts, scw, tcw, fcw):
-                acc = jnp.uint32(0)
-                for _ in range(r):
-                    if use_k28:
-                        w = _eval_full_pk_jit(
-                            ka28.nu, s28, seeds ^ acc, ts, scw, tcw, *ops28
-                        )
-                    else:
-                        w = _eval_full_cc_jit(
-                            ka28.nu, seeds ^ acc, ts, scw, tcw, fcw
-                        )
-                    acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
-                return acc
+        def step28(acc, seeds, ts, scw, tcw, fcw):
+            if use_k28:
+                w = _eval_full_pk_jit(
+                    ka28.nu, s28, seeds ^ acc, ts, scw, tcw, *ops28
+                )
+            else:
+                w = _eval_full_cc_jit(ka28.nu, seeds ^ acc, ts, scw, tcw, fcw)
+            return acc ^ jnp.bitwise_xor.reduce(w, axis=None)
 
-            return f
+        def chained28(r):
+            return _chain_scan(jax, jnp, step28, r)
 
         r28 = 5 if not small else 3
         dt = _marginal_time(chained28(1), chained28(r28), a28, r28, repeats=5,
@@ -329,30 +323,26 @@ def main():
         else:
             c28 = 0
 
-        def chained28c(r):
-            @jax.jit
-            def f(seed_planes, t_words, scw_raw, scw_fin, tl_w, tr_w,
-                  fcw_planes):
-                acc = jnp.uint32(0)
-                for _ in range(r):
-                    if c28:
-                        S, T = _expand_prefix_jit(
-                            c28, seed_planes ^ acc, t_words, scw_raw, tl_w,
-                            tr_w, bk28,
-                        )
-                        w = _finish_chunks_scan_jit(
-                            dk28.nu - c28, c28, S, T, scw_fin, tl_w, tr_w,
-                            fcw_planes, bk28,
-                        )
-                    else:
-                        w = _compat_full_jit(
-                            dk28.nu, seed_planes ^ acc, t_words, scw_raw,
-                            tl_w, tr_w, fcw_planes, bk28,
-                        )
-                    acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
-                return acc
+        def step28c(acc, seed_planes, t_words, scw_raw, scw_fin, tl_w,
+                    tr_w, fcw_planes):
+            if c28:
+                S, T = _expand_prefix_jit(
+                    c28, seed_planes ^ acc, t_words, scw_raw, tl_w,
+                    tr_w, bk28,
+                )
+                w = _finish_chunks_scan_jit(
+                    dk28.nu - c28, c28, S, T, scw_fin, tl_w, tr_w,
+                    fcw_planes, bk28,
+                )
+            else:
+                w = _compat_full_jit(
+                    dk28.nu, seed_planes ^ acc, t_words, scw_raw,
+                    tl_w, tr_w, fcw_planes, bk28,
+                )
+            return acc ^ jnp.bitwise_xor.reduce(w, axis=None)
 
-            return f
+        def chained28c(r):
+            return _chain_scan(jax, jnp, step28c, r)
 
         a28c = (
             dk28.seed_planes, dk28.t_words, dk28.scw_planes, scw28,
@@ -399,21 +389,15 @@ def main():
         ops28f = cp.expand_operands(ka28fp, sc28)
         wc28 = (1 << sc28) // nch28
 
-        def chained28f(r):
-            @jax.jit
-            def f(seeds, ts, scw, tcw, fcw):
-                acc = jnp.uint32(0)
-                for _ in range(r):
-                    S, T = _expand_prefix_cc_jit(
-                        sc28, seeds ^ acc, ts, scw, tcw
-                    )
-                    w = _finish_pk_chunks_jit(
-                        ka28fp.nu, sc28, nch28, wc28, *S, T, *ops28f
-                    )
-                    acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
-                return acc
+        def step28f(acc, seeds, ts, scw, tcw, fcw):
+            S, T = _expand_prefix_cc_jit(sc28, seeds ^ acc, ts, scw, tcw)
+            w = _finish_pk_chunks_jit(
+                ka28fp.nu, sc28, nch28, wc28, *S, T, *ops28f
+            )
+            return acc ^ jnp.bitwise_xor.reduce(w, axis=None)
 
-            return f
+        def chained28f(r):
+            return _chain_scan(jax, jnp, step28f, r)
 
         r28f = 3
         dt = _marginal_time(chained28f(1), chained28f(r28f), a28f, r28f,
@@ -435,18 +419,12 @@ def main():
             )
             a2 = kaf.device_args()
 
-            def chained2(r):
-                @jax.jit
-                def f(seeds, ts, scw, tcw, fcw):
-                    acc = jnp.uint32(0)
-                    for _ in range(r):
-                        w = _eval_full_cc_jit(
-                            kaf.nu, seeds ^ acc, ts, scw, tcw, fcw
-                        )
-                        acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
-                    return acc
+            def step2(acc, seeds, ts, scw, tcw, fcw):
+                w = _eval_full_cc_jit(kaf.nu, seeds ^ acc, ts, scw, tcw, fcw)
+                return acc ^ jnp.bitwise_xor.reduce(w, axis=None)
 
-                return f
+            def chained2(r):
+                return _chain_scan(jax, jnp, step2, r)
 
             dt = _marginal_time(chained2(1), chained2(3), a2, 3)
             _emit(f"{k2}-key eval_full n={n2} (fast)",
@@ -495,40 +473,32 @@ def main():
             xs_hi3 = jnp.zeros((1, k3), jnp.uint32)
             qt3 = cp._qtile(xs_lo3.shape[0])
 
-            def chained3(r):
-                @jax.jit
-                def f(meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi):
-                    acc = jnp.uint32(0)
-                    for _ in range(r):
-                        bits = cp._walk_raw(
-                            meta, seeds_t, scw_t, tcw_t, fcw_t,
-                            xs_lo ^ (acc & 1), xs_hi, n3, kap.nu, qt3,
-                        )
-                        acc = acc ^ jnp.bitwise_xor.reduce(bits, axis=None)
-                    return acc
+            def step3(acc, meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi):
+                bits = cp._walk_raw(
+                    meta, seeds_t, scw_t, tcw_t, fcw_t,
+                    xs_lo ^ (acc & 1), xs_hi, n3, kap.nu, qt3,
+                )
+                return acc ^ jnp.bitwise_xor.reduce(bits, axis=None)
 
-                return f
+            def chained3(r):
+                return _chain_scan(jax, jnp, step3, r)
 
             a3 = (*ops3, xs_lo3, xs_hi3)
         else:
             xs_hi3, xs_lo3 = _split_queries(xs, n3)
             a3 = (*kap.device_args(), xs_hi3, xs_lo3)
 
-            def chained3(r):
-                @jax.jit
-                def f(seeds, ts, scw, tcw, fcw, xs_hi, xs_lo):
-                    acc = jnp.uint32(0)
-                    for _ in range(r):
-                        bits = _eval_points_cc_jit(
-                            kap.nu, n3, seeds, ts, scw, tcw, fcw, xs_hi,
-                            xs_lo ^ (acc & 1),
-                        )
-                        acc = acc ^ jnp.bitwise_xor.reduce(
-                            bits.astype(jnp.uint32), axis=None
-                        )
-                    return acc
+            def step3(acc, seeds, ts, scw, tcw, fcw, xs_hi, xs_lo):
+                bits = _eval_points_cc_jit(
+                    kap.nu, n3, seeds, ts, scw, tcw, fcw, xs_hi,
+                    xs_lo ^ (acc & 1),
+                )
+                return acc ^ jnp.bitwise_xor.reduce(
+                    bits.astype(jnp.uint32), axis=None
+                )
 
-                return f
+            def chained3(r):
+                return _chain_scan(jax, jnp, step3, r)
 
         r3 = 17 if not small else 3
         dt = _marginal_time(chained3(1), chained3(r3), a3, r3, repeats=8,
@@ -565,28 +535,23 @@ def main():
 
         # Same route production takes: the whole-walk kernel on TPU
         # (DPF_TPU_POINTS_AES), the per-level XLA body otherwise.
-        def chained3c(r):
-            @jax.jit
-            def f(sm, tm, scwm, tlm, trm, fcwm, xs_hi, xs_lo):
-                acc = jnp.uint32(0)
-                for _ in range(r):
-                    if use_aes_walk:
-                        packed = _eval_points_walk_jit(
-                            kac3.nu, n3, sm, tm, scwm, tlm, trm, fcwm, xs_hi,
-                            xs_lo ^ (acc & 1), qp3,
-                        )
-                        acc = acc ^ jnp.bitwise_xor.reduce(packed, axis=None)
-                    else:
-                        bits = _eval_points_jit(
-                            kac3.nu, n3, sm, tm, scwm, tlm, trm, fcwm, xs_hi,
-                            xs_lo ^ (acc & 1), qp3, bk3,
-                        )
-                        acc = acc ^ jnp.bitwise_xor.reduce(
-                            bits.astype(jnp.uint32), axis=None
-                        )
-                return acc
+        def step3c(acc, sm, tm, scwm, tlm, trm, fcwm, xs_hi, xs_lo):
+            if use_aes_walk:
+                packed = _eval_points_walk_jit(
+                    kac3.nu, n3, sm, tm, scwm, tlm, trm, fcwm, xs_hi,
+                    xs_lo ^ (acc & 1), qp3,
+                )
+                return acc ^ jnp.bitwise_xor.reduce(packed, axis=None)
+            bits = _eval_points_jit(
+                kac3.nu, n3, sm, tm, scwm, tlm, trm, fcwm, xs_hi,
+                xs_lo ^ (acc & 1), qp3, bk3,
+            )
+            return acc ^ jnp.bitwise_xor.reduce(
+                bits.astype(jnp.uint32), axis=None
+            )
 
-            return f
+        def chained3c(r):
+            return _chain_scan(jax, jnp, step3c, r)
 
         a3c = (*masks3, xs_hi3c, xs_lo3c)
         r3c = 5 if not small else 3
@@ -626,21 +591,17 @@ def main():
         entry4 = pir_mod._pir_fast_entry_level(srv.nu, qa.k)
         n_chunks4 = srv.dom // (srv.n_leaf * srv.chunk_rows)
 
-        def chained4(r):
-            @jax.jit
-            def f(seeds, ts, scw, tcw, fcw, db_words):
-                acc = jnp.uint32(0)
-                for _ in range(r):
-                    sel = pir_mod._fast_expand_sel(
-                        srv.nu, entry4, seeds ^ acc, ts, scw, tcw, fcw
-                    )
-                    ans = pir_mod._parity_matmul(
-                        sel, db_words, srv.chunk_rows, n_chunks4
-                    )
-                    acc = acc ^ jnp.bitwise_xor.reduce(ans, axis=None)
-                return acc
+        def step4(acc, seeds, ts, scw, tcw, fcw, db_words):
+            sel = pir_mod._fast_expand_sel(
+                srv.nu, entry4, seeds ^ acc, ts, scw, tcw, fcw
+            )
+            ans = pir_mod._parity_matmul(
+                sel, db_words, srv.chunk_rows, n_chunks4
+            )
+            return acc ^ jnp.bitwise_xor.reduce(ans, axis=None)
 
-            return f
+        def chained4(r):
+            return _chain_scan(jax, jnp, step4, r)
 
         a4 = (*qa.device_args(), srv.db_words)
         r4 = 4 if not small else 3
@@ -685,48 +646,40 @@ def main():
             xs5_hi = jnp.zeros((1, k5), jnp.uint32)
             qt5 = cp._qtile(xs5_lo.shape[0])
 
-            def chained5(r):
-                @jax.jit
-                def f(meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi):
-                    acc = jnp.uint32(0)
-                    for _ in range(r):
-                        bits = cp._walk_raw(
-                            meta, seeds_t, scw_t, tcw_t, fcw_t,
-                            xs_lo ^ (acc & 1), xs_hi, n5, ca.levels.nu, qt5,
-                        )
-                        q, k = bits.shape
-                        gates = jax.lax.reduce(
-                            bits.reshape(q, k // g5, g5), np.uint32(0),
-                            jax.lax.bitwise_xor, (1,),
-                        )
-                        acc = acc ^ jnp.bitwise_xor.reduce(gates, axis=None)
-                    return acc
+            def step5(acc, meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi):
+                bits = cp._walk_raw(
+                    meta, seeds_t, scw_t, tcw_t, fcw_t,
+                    xs_lo ^ (acc & 1), xs_hi, n5, ca.levels.nu, qt5,
+                )
+                q, k = bits.shape
+                gates = jax.lax.reduce(
+                    bits.reshape(q, k // g5, g5), np.uint32(0),
+                    jax.lax.bitwise_xor, (1,),
+                )
+                return acc ^ jnp.bitwise_xor.reduce(gates, axis=None)
 
-                return f
+            def chained5(r):
+                return _chain_scan(jax, jnp, step5, r)
 
             a5 = (*ops5, xs5_lo, xs5_hi)
         else:
             xs5_hi, xs5_lo = _split_queries(xs5, n5)
             a5 = (*ca.levels.device_args(), xs5_hi, xs5_lo)
 
-            def chained5(r):
-                @jax.jit
-                def f(seeds, ts, scw, tcw, fcw, xs_hi, xs_lo):
-                    acc = jnp.uint32(0)
-                    for _ in range(r):
-                        bits = _eval_points_cc_jit(
-                            ca.levels.nu, n5, seeds, ts, scw, tcw, fcw,
-                            xs_hi, xs_lo ^ (acc & 1), 1,
-                        )
-                        q, k = bits.shape
-                        gates = jax.lax.reduce(
-                            bits.astype(jnp.uint32).reshape(q, k // g5, g5),
-                            np.uint32(0), jax.lax.bitwise_xor, (1,),
-                        )
-                        acc = acc ^ jnp.bitwise_xor.reduce(gates, axis=None)
-                    return acc
+            def step5(acc, seeds, ts, scw, tcw, fcw, xs_hi, xs_lo):
+                bits = _eval_points_cc_jit(
+                    ca.levels.nu, n5, seeds, ts, scw, tcw, fcw,
+                    xs_hi, xs_lo ^ (acc & 1), 1,
+                )
+                q, k = bits.shape
+                gates = jax.lax.reduce(
+                    bits.astype(jnp.uint32).reshape(q, k // g5, g5),
+                    np.uint32(0), jax.lax.bitwise_xor, (1,),
+                )
+                return acc ^ jnp.bitwise_xor.reduce(gates, axis=None)
 
-                return f
+            def chained5(r):
+                return _chain_scan(jax, jnp, step5, r)
 
         r5 = 33 if not small else 3
         dt = _marginal_time(chained5(1), chained5(r5), a5, r5, repeats=8,
@@ -781,19 +734,15 @@ def main():
             xs5c_hi = jnp.zeros((1, 1), jnp.uint32)
             masks5c = _point_masks(cac.levels)
 
-            def chained5c(r):
-                @jax.jit
-                def f(sm, tm, scwm, tlm, trm, fcwm, xs_hi, xs_lo):
-                    acc = jnp.uint32(0)
-                    for _ in range(r):
-                        packed = _grouped_walk_jit(
-                            cac.levels.nu, n5, 1, g5c, sm, tm, scwm, tlm,
-                            trm, fcwm, xs_hi, xs_lo ^ (acc & 1), qp5c, True,
-                        )
-                        acc = acc ^ jnp.bitwise_xor.reduce(packed, axis=None)
-                    return acc
+            def step5c(acc, sm, tm, scwm, tlm, trm, fcwm, xs_hi, xs_lo):
+                packed = _grouped_walk_jit(
+                    cac.levels.nu, n5, 1, g5c, sm, tm, scwm, tlm,
+                    trm, fcwm, xs_hi, xs_lo ^ (acc & 1), qp5c, True,
+                )
+                return acc ^ jnp.bitwise_xor.reduce(packed, axis=None)
 
-                return f
+            def chained5c(r):
+                return _chain_scan(jax, jnp, step5c, r)
 
             a5c = (*masks5c, xs5c_hi, xs5c_lo)
             r5c = 9 if not small else 3
@@ -841,21 +790,17 @@ def main():
             xsd_hi = jnp.zeros((1, da.k), jnp.uint32)
             qtd = cp._qtile(xsd_lo.shape[0])
 
-            def chainedd(r):
-                @jax.jit
-                def f(meta, seeds_t, scw_t, tcw_t, vcw_t, fvcw_t, xs_lo,
-                      xs_hi):
-                    acc = jnp.uint32(0)
-                    for _ in range(r):
-                        bits = cp._walk_raw(
-                            meta, seeds_t, scw_t, tcw_t, fvcw_t,
-                            xs_lo ^ (acc & 1), xs_hi, n5, da.nu, qtd,
-                            vcw_t=vcw_t, dcf=True,
-                        )
-                        acc = acc ^ jnp.bitwise_xor.reduce(bits, axis=None)
-                    return acc
+            def stepd(acc, meta, seeds_t, scw_t, tcw_t, vcw_t, fvcw_t,
+                      xs_lo, xs_hi):
+                bits = cp._walk_raw(
+                    meta, seeds_t, scw_t, tcw_t, fvcw_t,
+                    xs_lo ^ (acc & 1), xs_hi, n5, da.nu, qtd,
+                    vcw_t=vcw_t, dcf=True,
+                )
+                return acc ^ jnp.bitwise_xor.reduce(bits, axis=None)
 
-                return f
+            def chainedd(r):
+                return _chain_scan(jax, jnp, stepd, r)
 
             ad = (*opsd, xsd_lo, xsd_hi)
         else:
@@ -863,21 +808,17 @@ def main():
             seeds_d, ts_d, scw_d, tcw_d, vcw_d, fvcw_d = da.device_args()
             ad = (seeds_d, ts_d, scw_d, tcw_d, vcw_d, fvcw_d, xsd_hi, xsd_lo)
 
-            def chainedd(r):
-                @jax.jit
-                def f(seeds, ts, scw, tcw, vcw, fvcw, xs_hi, xs_lo):
-                    acc = jnp.uint32(0)
-                    for _ in range(r):
-                        bits = _eval_points_cc_jit(
-                            da.nu, n5, seeds, ts, scw, tcw, fvcw, xs_hi,
-                            xs_lo ^ (acc & 1), 0, vcw,
-                        )
-                        acc = acc ^ jnp.bitwise_xor.reduce(
-                            bits.astype(jnp.uint32), axis=None
-                        )
-                    return acc
+            def stepd(acc, seeds, ts, scw, tcw, vcw, fvcw, xs_hi, xs_lo):
+                bits = _eval_points_cc_jit(
+                    da.nu, n5, seeds, ts, scw, tcw, fvcw, xs_hi,
+                    xs_lo ^ (acc & 1), 0, vcw,
+                )
+                return acc ^ jnp.bitwise_xor.reduce(
+                    bits.astype(jnp.uint32), axis=None
+                )
 
-                return f
+            def chainedd(r):
+                return _chain_scan(jax, jnp, stepd, r)
 
         rd = 33 if not small else 3
         dt = _marginal_time(chainedd(1), chainedd(rd), ad, rd, repeats=8,

@@ -44,18 +44,17 @@ def main():
     )
 
     def chained(r, backend):
-        @jax.jit
-        def f(seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes):
-            acc = jnp.uint32(0)
-            for _ in range(r):
-                words = _eval_full_jit(
-                    dk.nu, seed_planes ^ acc, t_words, scw_planes,
-                    tl_w, tr_w, fcw_planes, backend,
-                )
-                acc = acc ^ jnp.bitwise_xor.reduce(words, axis=None)
-            return acc
+        from bench import _chain_scan
 
-        return f
+        def step(acc, seed_planes, t_words, scw_planes, tl_w, tr_w,
+                 fcw_planes):
+            words = _eval_full_jit(
+                dk.nu, seed_planes ^ acc, t_words, scw_planes,
+                tl_w, tr_w, fcw_planes, backend,
+            )
+            return acc ^ jnp.bitwise_xor.reduce(words, axis=None)
+
+        return _chain_scan(jax, jnp, step, r)
 
     for spec_str in sys.argv[1:] or ["pallas:256"]:
         parts = spec_str.split(":")

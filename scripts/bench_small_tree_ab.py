@@ -41,15 +41,13 @@ def measure(jax, jnp, ka, entry_env: str, r: int, reps: int = 8):
     ops = cp.expand_operands(pk, s)
 
     def chained(n):
-        @jax.jit
-        def f(seeds, ts, scw, tcw, fcw):
-            acc = jnp.uint32(0)
-            for _ in range(n):
-                w = _eval_full_pk_jit(pk.nu, s, seeds ^ acc, ts, scw, tcw, *ops)
-                acc = acc ^ jnp.bitwise_xor.reduce(w, axis=None)
-            return acc
+        from bench import _chain_scan
 
-        return f
+        def step(acc, seeds, ts, scw, tcw, fcw):
+            w = _eval_full_pk_jit(pk.nu, s, seeds ^ acc, ts, scw, tcw, *ops)
+            return acc ^ jnp.bitwise_xor.reduce(w, axis=None)
+
+        return _chain_scan(jax, jnp, step, n)
 
     dt = _marginal_time(chained(1), chained(r), args, r, repeats=reps,
                         stat="median")
