@@ -15,6 +15,30 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_chain_scan_equals_manual_iteration():
+    """The _chain_scan helper (every benchmark chain) must equal r manual
+    applications of the step — the throughput slope is only meaningful if
+    the r-chain really runs the body r times with the carry threaded."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import _chain_scan
+
+    def step(acc, x, y):
+        return acc ^ jnp.bitwise_xor.reduce(x * (y + acc), axis=None)
+
+    x = jnp.asarray(np.arange(5, dtype=np.uint32))
+    y = jnp.asarray(np.arange(7, 12, dtype=np.uint32))
+    want = jnp.uint32(0)
+    for _ in range(4):
+        want = step(want, x, y)
+    got = _chain_scan(jax, jnp, step, 4)(x, y)
+    assert int(got) == int(want)
+    got1 = _chain_scan(jax, jnp, step, 1)(x, y)
+    assert int(got1) == int(step(jnp.uint32(0), x, y))
+
+
 def test_bench_emits_one_json_line_on_infra_failure():
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
