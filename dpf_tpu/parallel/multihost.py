@@ -40,17 +40,34 @@ from .sharding import (
     leaf_axis_levels,
 )
 
-# Environment markers of a managed multi-process launch; any of these
-# present means jax.distributed.initialize()'s cluster auto-detection has
-# something to detect.
-_CLUSTER_ENV = (
+# Environment markers of a managed multi-process launch.  An explicit
+# coordinator address is always decisive; worker-list/job markers count
+# only when they actually name MORE THAN ONE process — this round's
+# single-chip driver env sets TPU_WORKER_HOSTNAMES=localhost, and treating
+# that as a pod sends jax.distributed's auto-detection hunting for a
+# coordinator it cannot define.
+_COORDINATOR_ENV = (
     "JAX_COORDINATOR_ADDRESS",
     "COORDINATOR_ADDRESS",
     "MEGASCALE_COORDINATOR_ADDRESS",
-    "TPU_WORKER_HOSTNAMES",
-    "SLURM_JOB_ID",
-    "OMPI_COMM_WORLD_SIZE",
 )
+
+
+def _managed_launch() -> bool:
+    if any(os.environ.get(v) for v in _COORDINATOR_ENV):
+        return True
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hosts.split(",") if h.strip()]) > 1:
+        return True
+    def _int_env(name: str) -> int:
+        try:
+            return int(os.environ.get(name, "1"))
+        except ValueError:
+            return 1
+
+    if os.environ.get("SLURM_JOB_ID") and _int_env("SLURM_NTASKS") > 1:
+        return True
+    return _int_env("OMPI_COMM_WORLD_SIZE") > 1
 
 
 def init_multihost(
@@ -71,7 +88,7 @@ def init_multihost(
             num_processes=num_processes,
             process_id=process_id,
         )
-    elif any(os.environ.get(v) for v in _CLUSTER_ENV):
+    elif _managed_launch():
         jax.distributed.initialize()
     return jax.process_index()
 

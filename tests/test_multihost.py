@@ -24,6 +24,31 @@ def test_init_multihost_single_process_noop():
     assert mh.init_multihost() == jax.process_index() == 0
 
 
+def test_managed_launch_detection(monkeypatch):
+    """A lone TPU_WORKER_HOSTNAMES=localhost (this environment's driver
+    sets exactly that) is a single chip, not a pod; multi-worker lists and
+    explicit coordinator addresses are pods."""
+    for v in (
+        "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+        "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
+        "SLURM_JOB_ID", "SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE",
+    ):
+        monkeypatch.delenv(v, raising=False)
+    assert not mh._managed_launch()
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    assert not mh._managed_launch()
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host-a,host-b")
+    assert mh._managed_launch()
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+    assert mh._managed_launch()
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS")
+    monkeypatch.setenv("SLURM_JOB_ID", "99")
+    assert not mh._managed_launch()  # no task count -> single task
+    monkeypatch.setenv("SLURM_NTASKS", "4")
+    assert mh._managed_launch()
+
+
 def test_distribute_fast_batch_shards_key_axis():
     mesh = _mesh_or_skip(4, 2)
     rng = np.random.default_rng(40)
