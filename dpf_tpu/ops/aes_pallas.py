@@ -368,10 +368,6 @@ def _walk_kernel_bm(
 ):
     kt, qt = o_ref.shape
     rk = rk_ref[:]
-    scw = scw_ref[:]  # [nu, 128, KT, 1]
-    tlcw = tlcw_ref[:]  # [nu, KT, 1]
-    trcw = trcw_ref[:]
-    pw = pw_ref[:]  # [nu, KT, QT]
     S0 = jnp.broadcast_to(seeds_ref[:], (128, kt, qt))
     T0 = jnp.broadcast_to(t_ref[:][0], (kt, qt))
 
@@ -387,13 +383,16 @@ def _walk_kernel_bm(
         zero = jnp.zeros_like(L[0:1])
         L = jnp.concatenate([zero, L[1:]])
         R = jnp.concatenate([zero, R[1:]])
-        cw = jax.lax.dynamic_index_in_dim(scw, i, 0, keepdims=False)
+        # Mosaic can't lower dynamic_slice on VMEM *values*; dynamic
+        # indexing on a ref's leading dim is the supported idiom, so the
+        # per-level operands stay in their refs and are loaded per step.
+        cw = scw_ref[i]  # [128, KT, 1]
         cwm = cw & T[None]
         L = L ^ cwm
         R = R ^ cwm
-        tl = tl ^ (jax.lax.dynamic_index_in_dim(tlcw, i, 0, False) & T)
-        tr = tr ^ (jax.lax.dynamic_index_in_dim(trcw, i, 0, False) & T)
-        go = jax.lax.dynamic_index_in_dim(pw, i, 0, False)  # [KT, QT]
+        tl = tl ^ (tlcw_ref[i] & T)
+        tr = tr ^ (trcw_ref[i] & T)
+        go = pw_ref[i]  # [KT, QT]
         S = (R & go[None]) | (L & ~go[None])
         T = (tr & go) | (tl & ~go)
         return S, T
