@@ -8,8 +8,9 @@
 # raising UNAVAILABLE).  Now every step is guarded:
 #   - single-instance flock: a restarted watcher cannot overlap a live
 #     one (two jax clients on the one tunnel corrupt each other);
-#   - probe (90 s jax.devices()) must pass IMMEDIATELY before each step,
-#     else re-enter the 3-min wait loop;
+#   - probe (120 s fresh-process trivial jit — exercises the remote-compile
+#     endpoint, which can wedge while jax.devices() stays healthy) must
+#     pass IMMEDIATELY before each step, else re-enter the 3-min wait loop;
 #   - a step whose log shows a backend-init failure is RETRIED (up to 5
 #     attempts, per-attempt log files so no attempt's evidence is ever
 #     truncated away); a bare step timeout (rc=124, no wedge signature)
@@ -34,7 +35,14 @@ save() {
     git commit -q -m "tpu_logs r5: $1" -- tpu_logs/r5 >/dev/null 2>&1 || true
 }
 
-probe() { timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; }
+# The probe must exercise the remote-compile endpoint too: the 07:45 wedge
+# had jax.devices() healthy while /remote_compile refused connections.  A
+# fresh-process jit of a trivial graph goes through compile + execute.
+probe() {
+  timeout 120 python -c \
+    "import jax; jax.jit(lambda x: x + 1)(jax.numpy.int32(1)).block_until_ready()" \
+    >/dev/null 2>&1
+}
 
 wait_up() {
   until probe; do
@@ -44,8 +52,17 @@ wait_up() {
   echo "tunnel up $(date +%H:%M:%S)" >> "$OUT/status"
 }
 
-infra_failed() {  # log shows the wedge/teardown signature, not a real verdict
-  grep -aq "Unable to initialize backend\|UNAVAILABLE: TPU backend\|wedged device tunnel" "$1"
+infra_wedge_verdict() {  # an rc=0 run that nonetheless REPORTS a wedge
+  # (bench.py exits 0 with an infra JSON record instead of a number)
+  grep -aq "wedged device tunnel\|\"infra\": true" "$1"
+}
+
+infra_failed() {  # a FAILED run's log shows wedge/teardown, not a real verdict
+  # Signatures seen across rounds: backend-init failure, mid-run tunnel
+  # teardown (UNAVAILABLE transport errors, e.g. remote_compile connection
+  # refused at 07:45 r5), and bench.py's own wedge verdict.  Only consulted
+  # when rc!=0 — an rc=0 log may mention a recovered transient error.
+  grep -aq "Unable to initialize backend\|UNAVAILABLE\|Connection refused\|Connection Failed\|wedged device tunnel" "$1"
 }
 
 run() {  # run <name> <timeout_s> <cmd...>; retries on infra failure
@@ -61,7 +78,7 @@ run() {  # run <name> <timeout_s> <cmd...>; retries on infra failure
     echo "=== $name attempt $attempt rc=$rc end $(date +%H:%M:%S)" | tee -a "$OUT/status"
     # Latest attempt is also the canonical $name.log the decision rules read.
     cp -f "$log" "$OUT/$name.log"
-    if [ "$rc" -eq 0 ] && ! infra_failed "$log"; then
+    if [ "$rc" -eq 0 ] && ! infra_wedge_verdict "$log"; then
       touch "$OUT/$name.done"; save "$name done (attempt $attempt)"; return 0
     fi
     save "$name attempt $attempt rc=$rc"
