@@ -93,6 +93,55 @@ def test_bench_all_completes_past_a_dead_row():
     assert all(r["value"] > 0 and r.get("route") for r in live), rows
 
 
+def test_bench_all_ledger_resumes_without_remeasuring(tmp_path):
+    """With DPF_TPU_BENCH_LEDGER, a matrix interrupted by a tunnel death
+    must RESUME: sections measured by a prior attempt replay their stored
+    rows verbatim, sections that died with a transport-signature error
+    re-measure.  (This environment's tunnel wedges in windows shorter
+    than a full matrix run — without resume, no window ever completes.)"""
+    ledger = str(tmp_path / "ledger.jsonl")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DPF_TPU_BENCH_ONLY"] = "cfg3"
+    env["DPF_TPU_BENCH_LEDGER"] = ledger
+    env["DPF_TPU_BENCH_LEDGER_KEY"] = "pinned-test-key"
+    env["DPF_TPU_BENCH_FORCE_FAIL"] = "cfg3-fast:transient"
+    run = lambda: subprocess.run(  # noqa: E731
+        [sys.executable, os.path.join(REPO, "bench_all.py"),
+         "--scale", "small"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=900,
+    )
+    p1 = run()
+    assert p1.returncode == 0, p1.stderr[-2000:]
+    rows1 = [json.loads(ln) for ln in p1.stdout.splitlines() if ln.strip()]
+    dead = [r for r in rows1 if r.get("error")]
+    assert len(dead) == 1 and "UNAVAILABLE" in dead[0]["error"], rows1
+    live1 = [r for r in rows1 if "compat" in r.get("metric", "")]
+    assert len(live1) == 2 and all(r["value"] > 0 for r in live1), rows1
+    # Transient error NOT recorded; the compat section (both rows) is.
+    recorded = [json.loads(ln) for ln in open(ledger) if ln.strip()]
+    assert [r.get("section") for r in recorded] == [None, "cfg3-compat"], (
+        recorded
+    )
+
+    del env["DPF_TPU_BENCH_FORCE_FAIL"]
+    p2 = run()
+    assert p2.returncode == 0, p2.stderr[-2000:]
+    rows2 = [json.loads(ln) for ln in p2.stdout.splitlines() if ln.strip()]
+    # The transiently-dead section measured for real this time...
+    fast2 = [r for r in rows2 if r["metric"] == dead[0]["metric"]]
+    assert not fast2, rows2  # error row's metric was the section name;
+    # its real rows carry the measured metric names instead
+    assert not any(r.get("error") for r in rows2), rows2
+    # ...and the compat sections REPLAYED byte-identically, no re-measure.
+    live2 = [r for r in rows2 if "compat" in r.get("metric", "")]
+    assert live2 == live1, (live1, live2)
+    # Ledger grew by exactly the re-measured section.
+    recorded2 = [json.loads(ln) for ln in open(ledger) if ln.strip()]
+    assert len(recorded2) == len(recorded) + 1, recorded2
+
+
 def test_bench_watchdog_converts_hang_to_infra_record():
     """A wedged device tunnel HANGS (it does not error); the parent
     watchdog must kill the child at the deadline and still emit exactly
