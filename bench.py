@@ -175,11 +175,23 @@ def bench_fast(jax, jnp, rng) -> float:
 
     eligible, s, kp = cp.expand_plan(nu, K, MAX_LEAF_NODES)
     use_kernel = cp.expand_backend() == "pallas" and eligible and kp == K
+    # Production fused routing (models/dpf_chacha): inert at n=20 (no mid
+    # levels below nu=13) but keeps the timed graph honest if LOG_N grows.
+    from dpf_tpu.models.dpf_chacha import (
+        _eval_full_fused_cc_jit,
+        _fuse_plan_cc,
+    )
+
+    fuse_sched = _fuse_plan_cc(nu, None) if use_kernel and s > 0 else None
     if use_kernel:
-        kern_ops = cp.expand_operands(ka, s)
+        kern_ops = cp.expand_operands(ka, fuse_sched[2] if fuse_sched else s)
 
     def step(acc, seeds, ts, scw, tcw, fcw):
-        if use_kernel:
+        if fuse_sched is not None:
+            w = _eval_full_fused_cc_jit(
+                nu, fuse_sched, seeds ^ acc, ts, scw, tcw, fcw, *kern_ops
+            )
+        elif use_kernel:
             w = _eval_full_pk_jit(nu, s, seeds ^ acc, ts, scw, tcw, *kern_ops)
         else:
             w = _eval_full_cc_jit(nu, seeds ^ acc, ts, scw, tcw, fcw)
@@ -212,7 +224,13 @@ def bench_compat(jax, jnp, rng) -> float:
     differential test suite (tests/test_aes_pallas.py,
     tests/test_dpf_eval.py); the bench checksum just forces the work."""
     from dpf_tpu.core.keys import gen_batch
-    from dpf_tpu.models.dpf import DeviceKeys, _eval_full_jit, default_backend
+    from dpf_tpu.models.dpf import (
+        DeviceKeys,
+        _eval_full_fused_jit,
+        _eval_full_jit,
+        _fuse_plan,
+        default_backend,
+    )
 
     from functools import partial as _partial
 
@@ -228,12 +246,21 @@ def bench_compat(jax, jnp, rng) -> float:
         "compat-profile",
     )
     dk = DeviceKeys(ka)
+    # Mirror the production fused routing (models/dpf.eval_full_device):
+    # when DPF_TPU_FUSE engages, the timed graph is the level-fused one.
+    fuse_sched = _fuse_plan(dk.nu, backend, None)
 
     def step(acc, seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes):
-        words = _eval_full_jit(
-            dk.nu, seed_planes ^ acc, t_words, scw_planes,
-            tl_w, tr_w, fcw_planes, backend,
-        )
+        if fuse_sched is not None:
+            words = _eval_full_fused_jit(
+                dk.nu, seed_planes ^ acc, t_words, scw_planes,
+                tl_w, tr_w, fcw_planes, backend, fuse_sched,
+            )
+        else:
+            words = _eval_full_jit(
+                dk.nu, seed_planes ^ acc, t_words, scw_planes,
+                tl_w, tr_w, fcw_planes, backend,
+            )
         return acc ^ jnp.bitwise_xor.reduce(words, axis=None)
 
     def chained(r):
@@ -427,18 +454,24 @@ def _routes() -> str:
     read after the measurement so a mid-run latched degradation shows."""
     try:
         from dpf_tpu.models import dpf as mdpf
-        from dpf_tpu.ops import aes_pallas
+        from dpf_tpu.models import dpf_chacha as mdc
         from dpf_tpu.ops import chacha_pallas as cp
+        from dpf_tpu.ops import sbox_circuit
 
         parts = [
             f"fast={cp.expand_backend()}",
             f"compat={mdpf.default_backend()}",
-            f"sbox={aes_pallas._SBOX}",
+            f"sbox={sbox_circuit._SBOX}",
+            f"fuse={os.environ.get('DPF_TPU_FUSE', 'off') or 'off'}",
         ]
         if mdpf._WALK_KERNEL_BROKEN:
             parts.append("aes-walk-latched")
         if cp._SMALL_TREE_BROKEN:
             parts.append("small-tree-latched")
+        if mdpf._FUSE_BROKEN:
+            parts.append("fuse-latched")
+        if mdc._FUSE_CC_BROKEN:
+            parts.append("fuse-cc-latched")
         return ",".join(parts)
     except Exception:  # noqa: BLE001 — the record matters more
         return "unknown"

@@ -216,6 +216,123 @@ def _eval_full_jit(
     return _convert_leaves(S, T, fcw_planes, backend)
 
 
+# ---------------------------------------------------------------------------
+# Level-fused expansion (DPF_TPU_FUSE; ops/aes_pallas fused kernel family)
+# ---------------------------------------------------------------------------
+
+# Entry level of the fused tail: 2^7 nodes fill the kernel's 128-lane node
+# tile.  Levels above run the per-level pipeline (they are a vanishing
+# fraction of the work — the last two levels alone hold 3/4 of all nodes).
+_FUSE_FLOOR = 7
+
+
+def _fuse_schedule(n_levels, g, floor=_FUSE_FLOOR):
+    """(first_fused_level, group sizes) tiling levels floor..n_levels-1
+    into fused groups of <= g levels, or None when nothing can fuse.
+    ``floor`` is parameterized for tests (narrow-entry interpret runs)."""
+    mid = n_levels - floor
+    if g <= 0 or mid <= 0:
+        return None
+    groups = []
+    while mid > 0:
+        t = min(g, mid)
+        groups.append(t)
+        mid -= t
+    return floor, tuple(groups)
+
+
+def _fused_groups(S, T, scw_planes, tl_w, tr_w, first, groups):
+    """Run the fused groups from per-level bit-major state at level
+    ``first`` (S [128, W, Kp], T [W, Kp]) -> fused-layout (node-minor)
+    leaf-level state (S_f [128, Kp, W'], T_f [Kp, W'])."""
+    Sf = jnp.swapaxes(S, 1, 2)
+    Tf = jnp.swapaxes(T, 0, 1)
+    lvl = first
+    for g in groups:
+        wt = min(Tf.shape[1], aes_pallas._FWT)
+        Sf, Tf = aes_pallas.fused_levels_planes(
+            Sf, Tf, scw_planes[lvl : lvl + g], tl_w[lvl : lvl + g],
+            tr_w[lvl : lvl + g],
+        )
+        Sf = aes_pallas.fused_deinterleave(Sf, g, wt)
+        Tf = aes_pallas.fused_deinterleave(Tf, g, wt)
+        lvl += g
+    return Sf, Tf
+
+
+def _convert_leaves_fused(Sf, Tf, fcw_planes, backend):
+    """Leaf conversion + final CW from the fused layout: the MMO kernel is
+    elementwise over lanes so it runs on the node-minor flattening
+    directly; the final CW broadcast is per-key ([128, Kp, 1]); ONE
+    combined transpose restores the canonical [128, W, Kp] layout for the
+    bit-packed output contract."""
+    C = _MMO_IMPLS[backend](Sf.reshape(128, -1)).reshape(Sf.shape)
+    C = C ^ (jnp.swapaxes(fcw_planes, 1, 2) & Tf[None])
+    return unpack_planes(jnp.swapaxes(C, 1, 2))
+
+
+@partial(jax.jit, static_argnums=(0, 7, 8))
+def _eval_full_fused_jit(
+    n_levels, seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes,
+    backend, schedule,
+):
+    """Fused-backend full expansion: per-level steps to the schedule's
+    entry level, then G-level fused groups with all intermediate node
+    planes VMEM-resident, then leaf conversion from the fused layout."""
+    first, groups = schedule
+    seed_planes, scw_planes = _to_bm(seed_planes, scw_planes)
+    S, T = seed_planes, t_words
+    for i in range(first):
+        S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i], backend)
+    Sf, Tf = _fused_groups(S, T, scw_planes, tl_w, tr_w, first, groups)
+    return _convert_leaves_fused(Sf, Tf, fcw_planes, backend)
+
+
+# Sticky failure latch for the fused expansion (same pattern as the walk
+# kernel's _WALK_KERNEL_BROKEN): a Mosaic rejection on some hardware
+# degrades auto-routed callers to the per-level pipeline ONCE; an explicit
+# DPF_TPU_FUSE=<g> (or a fuse= argument) re-raises so A/Bs and tests never
+# silently measure the fallback.
+_FUSE_BROKEN = False
+
+
+def _fuse_degraded(e: Exception) -> None:
+    global _FUSE_BROKEN
+    import warnings
+
+    from ..ops import fuse_forced
+
+    if fuse_forced():
+        raise e
+    _FUSE_BROKEN = True
+    warnings.warn(
+        f"fused expansion unavailable, using the per-level path: {e}",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
+def _fuse_plan(nu: int, backend: str, fuse: int | None):
+    """Production routing decision for the fused backend: the resolved
+    schedule, or None for the per-level pipeline.  ``fuse``: None = env
+    (DPF_TPU_FUSE, honoring the sticky latch), else an explicit group
+    size (0 disables).  Fused state is bit-major — other backends keep
+    the per-level path."""
+    if backend not in _BM_BACKENDS:
+        return None
+    if fuse is None:
+        from ..ops import fuse_forced, fuse_request
+
+        if _FUSE_BROKEN and not fuse_forced():
+            return None
+        g = fuse_request(
+            aes_pallas.fuse_auto_levels() if aes_pallas.available() else 0
+        )
+    else:
+        g = fuse
+    return _fuse_schedule(nu, g) if g > 0 else None
+
+
 @partial(jax.jit, static_argnums=(0, 6))
 def _expand_prefix_jit(
     n_levels, seed_planes, t_words, scw_planes, tl_w, tr_w, backend="xla"
@@ -287,17 +404,35 @@ def eval_full_device(
     dk: DeviceKeys,
     max_plane_words: int = MAX_PLANE_WORDS,
     backend: str | None = None,
+    fuse: int | None = None,
 ):
     """Full-domain evaluation on device -> uint32[K_padded, n_leaves, 4].
 
     The returned words ARE the bit-packed output: word q of leaf w holds
     domain bits [128*w + 32*q, 128*w + 32*q + 32), LSB-first.
+
+    ``fuse``: level-fused expansion group size for the bit-major backends
+    (None = DPF_TPU_FUSE, 0 = off, g >= 1 = groups of <= g levels).  The
+    fused route covers the unchunked path; domains split into subtree
+    chunks keep the per-level pipeline.  An explicit ``fuse`` re-raises
+    kernel failures; env-auto routing degrades via the sticky latch.
     """
     backend = backend or default_backend()
     nu = dk.nu
     kp = dk.k_padded // 32
     total = (1 << nu) * kp
     if total <= max_plane_words:
+        sched = _fuse_plan(nu, backend, fuse)
+        if sched is not None:
+            try:
+                return _eval_full_fused_jit(
+                    nu, dk.seed_planes, dk.t_words, dk.scw_planes,
+                    dk.tl_words, dk.tr_words, dk.fcw_planes, backend, sched,
+                )
+            except Exception as e:  # noqa: BLE001
+                if fuse is not None:
+                    raise
+                _fuse_degraded(e)
         return _eval_full_jit(
             nu, dk.seed_planes, dk.t_words, dk.scw_planes,
             dk.tl_words, dk.tr_words, dk.fcw_planes, backend,
@@ -324,12 +459,15 @@ def eval_full(
     kb: KeyBatch,
     max_plane_words: int = MAX_PLANE_WORDS,
     backend: str | None = None,
+    fuse: int | None = None,
 ) -> np.ndarray:
     """Full-domain evaluation of a key batch -> uint8[K, out_bytes], where
     out_bytes = 2^(log_n-3) (16 when log_n < 7), byte-identical to
     ``spec.eval_full`` / the reference's EvalFull per key."""
     dk = DeviceKeys(kb)
-    words = np.asarray(eval_full_device(dk, max_plane_words, backend))  # [Kpad, W, 4]
+    words = np.asarray(
+        eval_full_device(dk, max_plane_words, backend, fuse)
+    )  # [Kpad, W, 4]
     out = np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)
     return out
 

@@ -241,6 +241,123 @@ def _finish_pk_chunks_jit(
     return jnp.moveaxis(ys, 0, 1).reshape(ys.shape[1], -1, ys.shape[3])
 
 
+# ---------------------------------------------------------------------------
+# Level-fused mid-tree expansion (DPF_TPU_FUSE; the ChaCha twin of
+# models/dpf's fused backend).  The classic kernel route already fuses the
+# LAST <= _EXP_LEVELS levels plus leaf conversion into one program; for
+# deep trees (nu > 12) the levels between the 128-node entry and that tail
+# still run one XLA level step each — ~12 full-state HBM round trips per
+# level.  The fused backend covers them with G-level VMEM-resident groups
+# (ops/chacha_pallas.fused_levels_raw), then hands ascending-order state
+# to the unchanged tail kernel.
+# ---------------------------------------------------------------------------
+
+_FUSE_CC_FLOOR = 7  # 2^7-node entry width fills the kernel's lane tile
+
+
+def _fuse_schedule_cc(nu, g, floor=_FUSE_CC_FLOOR, tail_cap=None):
+    """(first, group sizes, tail entry level) for a fused fast-profile
+    expansion, or None when no mid levels exist (the classic route already
+    covers everything).  ``floor``/``tail_cap`` are parameterized for
+    tests (small-domain interpret runs)."""
+    from ..ops import chacha_pallas as cp
+
+    if tail_cap is None:
+        tail_cap = cp._EXP_LEVELS
+    if g <= 0 or nu - floor <= 0:
+        return None
+    tail = min(tail_cap, nu - floor)
+    mid = nu - floor - tail
+    if mid <= 0:
+        return None
+    groups = []
+    while mid > 0:
+        t = min(g, mid)
+        groups.append(t)
+        mid -= t
+    return floor, tuple(groups), nu - tail
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _eval_full_fused_cc_jit(
+    nu, schedule, seeds, ts, scw, tcw, fcw, scw_t, tcw_t, fcw_t
+):
+    """Fused expansion: XLA steps to the floor, G-level fused groups over
+    the mid levels (state resident in VMEM per group, ascending node
+    order restored by the static deinterleave gather per group), then the
+    existing tail kernel (levels entry..nu-1 + leaf conversion).
+    scw_t/tcw_t/fcw_t are the tail's expand_operands."""
+    from ..ops import chacha_pallas as cp
+
+    first, groups, entry = schedule
+    S = [seeds[:, i : i + 1] for i in range(4)]
+    T = ts[:, None]
+    for i in range(first):
+        S, T = _level_step_cc(
+            S, T, [scw[:, i, w] for w in range(4)], tcw[:, i, 0], tcw[:, i, 1]
+        )
+    lvl = first
+    for g in groups:
+        wt = min(cp._EWT, T.shape[1])
+        gscw, gtcw, _ = cp.cw_operands(
+            scw[:, lvl : lvl + g], tcw[:, lvl : lvl + g], fcw, 0, g
+        )
+        outs = cp.fused_levels_raw(*S, T, gscw, gtcw, g)
+        outs = [cp.deinterleave_leaves(o, g, wt) for o in outs]
+        S, T = list(outs[:4]), outs[4]
+        lvl += g
+    return _finish_pk(nu, entry, S, T, scw_t, tcw_t, fcw_t)
+
+
+def _eval_full_pallas_fused(kb: KeyBatchFast, schedule):
+    from ..ops import chacha_pallas as cp
+    from ..parallel.sharding import _pad_fast_batch
+
+    pk = _pad_fast_batch(kb, (-kb.k) % cp._EKT)
+    words = _eval_full_fused_cc_jit(
+        pk.nu, schedule, *pk.device_args(),
+        *cp.expand_operands(pk, schedule[2]),
+    )
+    return words[: kb.k]
+
+
+# Sticky failure latch (mirror of models/dpf._FUSE_BROKEN): env-auto
+# routing degrades to the classic plan once; DPF_TPU_FUSE=<g> or an
+# explicit fuse= argument re-raises.
+_FUSE_CC_BROKEN = False
+
+
+def _fuse_cc_degraded(e: Exception) -> None:
+    global _FUSE_CC_BROKEN
+    import warnings
+
+    from ..ops import fuse_forced
+
+    if fuse_forced():
+        raise e
+    _FUSE_CC_BROKEN = True
+    warnings.warn(
+        f"fused fast-profile expansion unavailable, using the classic "
+        f"plan: {e}",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
+def _fuse_plan_cc(nu: int, fuse: int | None):
+    """Resolved fused schedule for production routing (None = classic)."""
+    from ..ops import chacha_pallas as cp
+    from ..ops import fuse_forced, fuse_request
+
+    if fuse is None:
+        if _FUSE_CC_BROKEN and not fuse_forced():
+            return None
+        g = fuse_request(cp.fuse_auto_levels() if cp._on_tpu() else 0)
+    else:
+        g = fuse
+    return _fuse_schedule_cc(nu, g) if g > 0 else None
+
+
 def _eval_full_pallas_device(kb: KeyBatchFast, entry_level: int):
     """Kernel-path full expansion: classic route (entry >= 7, 128-node-wide
     tiles) or the whole-tree entry-0 route for small domains
@@ -280,6 +397,7 @@ def eval_full_device(
     kb: KeyBatchFast,
     max_leaf_nodes: int = MAX_LEAF_NODES,
     backend: str | None = None,
+    fuse: int | None = None,
 ):
     """Full-domain evaluation on device -> uint32[K, 2^nu, 16] leaf words
     (word j of leaf w holds domain bits [512w + 32j, +32), LSB-first).
@@ -289,7 +407,12 @@ def eval_full_device(
     fallback/reference pipeline.  A 'pallas' request degrades to 'xla'
     when the kernel is ineligible (nu < 7, or the padded-key leaf
     materialization would blow the cap and the chunked XLA pipeline must
-    take over) — outputs are identical either way."""
+    take over) — outputs are identical either way.
+
+    ``fuse`` (None = DPF_TPU_FUSE, 0 = off, g >= 1): cover the mid levels
+    between the 128-node entry and the tail kernel with G-level fused
+    groups (deep trees, nu > 12).  Explicit ``fuse`` re-raises kernel
+    failures; env-auto routing degrades via the sticky latch."""
     nu = kb.nu
     total = kb.k << nu
     from ..ops import chacha_pallas as cp
@@ -308,6 +431,14 @@ def eval_full_device(
                 cp.small_tree_degraded(e)
                 return eval_full_device(kb, max_leaf_nodes, backend)
         if eligible:
+            sched = _fuse_plan_cc(nu, fuse)
+            if sched is not None:
+                try:
+                    return _eval_full_pallas_fused(kb, sched)
+                except Exception as e:  # noqa: BLE001
+                    if fuse is not None:
+                        raise
+                    _fuse_cc_degraded(e)
             return _eval_full_pallas_device(kb, entry_level)
         ok_c, s_c, _, n_chunks = cp.expand_plan_chunked(
             nu, kb.k, max_leaf_nodes
@@ -328,12 +459,13 @@ def eval_full(
     kb: KeyBatchFast,
     max_leaf_nodes: int = MAX_LEAF_NODES,
     backend: str | None = None,
+    fuse: int | None = None,
 ) -> np.ndarray:
     """Full-domain evaluation -> uint8[K, out_bytes] bit-packed
     (out_bytes = 2^(log_n-3), min 64), byte-identical to the spec
     ``chacha_np.eval_full`` per key.  Domains too large to materialize in
     one pass split into independent GGM subtree chunks."""
-    words = np.asarray(eval_full_device(kb, max_leaf_nodes, backend))
+    words = np.asarray(eval_full_device(kb, max_leaf_nodes, backend, fuse))
     return np.ascontiguousarray(words).view("<u1").reshape(kb.k, -1)
 
 

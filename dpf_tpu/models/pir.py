@@ -41,6 +41,9 @@ from .dpf import (
     _BM_BACKENDS,
     DeviceKeys,
     _convert_leaves,
+    _convert_leaves_fused,
+    _fuse_plan,
+    _fused_groups,
     _level_step,
     _to_bm,
     default_backend,
@@ -164,19 +167,39 @@ class PirServer:
             k_shards = self.mesh.shape[KEYS_AXIS]
         dk = DeviceKeys(queries, pad_to=32 * k_shards)
         backend = default_backend()
+        args = (
+            dk.seed_planes, dk.t_words, dk.scw_planes,
+            dk.tl_words, dk.tr_words, dk.fcw_planes, self.db_words,
+        )
+        words = None
         if self.mesh is None:
-            fn = _pir_single(dk.nu, self.chunk_rows, n_chunks, backend)
+            # Single-chip expansion follows the production fused routing
+            # (DPF_TPU_FUSE); the sharded path keeps per-level steps (its
+            # subtree split already changes the level schedule).
+            sched = _fuse_plan(dk.nu, backend, None)
+            if sched is not None:
+                from . import dpf as _mdpf
+
+                try:
+                    words = np.asarray(
+                        _pir_single(
+                            dk.nu, self.chunk_rows, n_chunks, backend, sched
+                        )(*args)
+                    )
+                except Exception as e:  # noqa: BLE001
+                    _mdpf._fuse_degraded(e)
+            if words is None:
+                words = np.asarray(
+                    _pir_single(dk.nu, self.chunk_rows, n_chunks, backend)(
+                        *args
+                    )
+                )
         else:
             fn = _pir_sharded(
                 self.mesh, dk.nu, self.subtree_levels, self.chunk_rows,
                 n_chunks, backend,
             )
-        words = np.asarray(
-            fn(
-                dk.seed_planes, dk.t_words, dk.scw_planes,
-                dk.tl_words, dk.tr_words, dk.fcw_planes, self.db_words,
-            )
-        )  # [Kpad, row_words]
+            words = np.asarray(fn(*args))  # [Kpad, row_words]
         return (
             np.ascontiguousarray(words[: queries.k])
             .view("<u1")
@@ -277,14 +300,35 @@ def _leaves_to_sel_words(words: jax.Array) -> jax.Array:
 
 
 @cache
-def _pir_single(nu: int, chunk_rows: int, n_chunks: int, backend: str = "xla"):
+def _pir_single(
+    nu: int, chunk_rows: int, n_chunks: int, backend: str = "xla",
+    fuse_sched=None,
+):
+    """Single-chip PIR pipeline.  ``fuse_sched`` (models/dpf._fuse_plan
+    output) routes the deep levels through the level-fused VMEM kernels —
+    the selection words then come off the fused-layout leaf convert, same
+    bytes, ~G x less HBM traffic on the expansion that feeds the parity
+    matmul."""
+
     def body(seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes, db_words):
         if backend in _BM_BACKENDS:
             seed_planes, scw_planes = _to_bm(seed_planes, scw_planes)
         S, T = seed_planes, t_words
-        for i in range(nu):
-            S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i], backend)
-        sel = _leaves_to_sel_words(_convert_leaves(S, T, fcw_planes, backend))
+        if fuse_sched is not None:
+            first, groups = fuse_sched
+            for i in range(first):
+                S, T = _level_step(
+                    S, T, scw_planes[i], tl_w[i], tr_w[i], backend
+                )
+            Sf, Tf = _fused_groups(S, T, scw_planes, tl_w, tr_w, first, groups)
+            leaves = _convert_leaves_fused(Sf, Tf, fcw_planes, backend)
+        else:
+            for i in range(nu):
+                S, T = _level_step(
+                    S, T, scw_planes[i], tl_w[i], tr_w[i], backend
+                )
+            leaves = _convert_leaves(S, T, fcw_planes, backend)
+        sel = _leaves_to_sel_words(leaves)
         return _parity_matmul(sel, db_words, chunk_rows, n_chunks)
 
     return jax.jit(body)

@@ -1,0 +1,74 @@
+"""Kernel-layer shared knobs.
+
+``DPF_TPU_FUSE`` selects the level-fused expansion backend for BOTH
+profiles (models/dpf.py and models/dpf_chacha.py):
+
+    off      per-level pipeline (current default until the on-hardware A/B
+             promotes fused — tpu_when_up.sh's fused_ab step)
+    auto     fused groups sized by the profile's VMEM-budget model on TPU,
+             off elsewhere (interpret-mode fused kernels are for tests,
+             which opt in explicitly)
+    <int g>  fused groups of exactly <= g levels, FORCED: a lowering
+             failure re-raises instead of latching the per-level fallback,
+             so A/Bs never silently measure the fallback
+
+The parse lives here (not in aes_pallas/chacha_pallas) because both
+profiles share the knob but own separate budget models.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def fuse_request(auto_g: int = 0) -> int:
+    """Requested fused-group size: 0 = off, g >= 1 = groups of <= g levels.
+    ``auto_g`` is the caller's VMEM-budget cap (pass 0 off-TPU)."""
+    env = os.environ.get("DPF_TPU_FUSE", "off")
+    if env in ("", "off"):
+        return 0
+    if env == "auto":
+        return auto_g
+    try:
+        g = int(env)
+    except ValueError:
+        raise ValueError(
+            f"DPF_TPU_FUSE={env!r} invalid; use off|auto|<levels>"
+        ) from None
+    if g < 0:
+        raise ValueError("DPF_TPU_FUSE must be >= 0")
+    return g
+
+
+def fuse_forced() -> bool:
+    """True when DPF_TPU_FUSE names an explicit group size — the fused
+    path must then re-raise on failure rather than latch the per-level
+    fallback (mirrors aes_pallas.walk_forced)."""
+    env = os.environ.get("DPF_TPU_FUSE", "")
+    return bool(env) and env not in ("off", "auto")
+
+
+def deinterleave_nodes(x, levels: int, wt: int):
+    """Restore ascending node order on the LAST axis after a block-order
+    expansion kernel (ONE implementation for both ciphers' kernels).
+
+    Inside a tile the kernels emit children in block order [all-L|all-R]
+    per level: local position = j' * wt + w with j' the level-choice bits
+    in REVERSE significance; the true local child index is
+    w * 2^levels + rev(j').  One static bit-reversal gather + axis swap
+    per array fixes it.  ``wt`` is the kernel's ENTRY node-tile width.
+    Leading dims ride along: [K, W] for the chacha word arrays
+    (chacha_pallas.deinterleave_leaves), [128, Kp, W] / [Kp, W] for the
+    compat fused layout (aes_pallas.fused_deinterleave)."""
+    if levels == 0:
+        return x
+    import jax.numpy as jnp
+    import numpy as np
+
+    n2 = 1 << levels
+    rev = np.zeros(n2, np.int32)
+    for j in range(n2):
+        rev[j] = int(format(j, f"0{levels}b")[::-1], 2)
+    lead = x.shape[:-1]
+    x = x.reshape(*lead, -1, n2, wt)[..., rev, :]
+    return jnp.swapaxes(x, -2, -1).reshape(*lead, -1)

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import aes_np
-from .sbox_circuit import sbox_bp113
+from .sbox_circuit import active_sbox
 
 # ---------------------------------------------------------------------------
 # Round-key plane masks (compile-time constants)
@@ -69,11 +69,13 @@ _XTIME_CARRY[[1, 3, 4]] = True  # position 0 gets a7 straight from the rotation
 
 
 def _sub_bytes(S: jax.Array) -> jax.Array:
-    """S-box on all 16 bytes: [128, B] -> [128, B]."""
+    """S-box on all 16 bytes: [128, B] -> [128, B].  The circuit is the
+    DPF_TPU_SBOX-selected schedule (sbox_circuit.active_sbox), read at
+    trace time — shared with every Pallas kernel variant."""
     s = S.reshape(16, 8, -1)
     # Circuit wants MSB-first planes; our bit axis is LSB-first.
     x = [s[:, 7 - i] for i in range(8)]
-    y = sbox_bp113(x)
+    y = active_sbox()(x)
     return jnp.stack(y[::-1], axis=1).reshape(128, -1)
 
 

@@ -39,7 +39,7 @@ from .aes_bitslice import (
     aes128_mmo_planes,
     prg_planes,
 )
-from .sbox_circuit import sbox_bp113, sbox_bp113_lowlive
+from .sbox_circuit import active_sbox
 
 # Lane tile.  128 lanes measured ~2x faster than 256 END-TO-END at the
 # headline config (scripts/bench_compat_ab.py on v5e: 22.9 vs 11.7
@@ -148,14 +148,10 @@ _SBOX_SPLIT = True
 # S-box circuit inside the bit-major kernels: "bp113" (113 gates, peak
 # 29 live values under emission order) or "lowlive" (the register-budgeted
 # rematerializing schedule — 156 ops, peak 24; see sbox_circuit and
-# scripts/sbox_liveness.py).  Selected by end-to-end A/B on hardware;
-# DPF_TPU_SBOX overrides for experiments.
-_SBOX_IMPLS = {"bp113": sbox_bp113, "lowlive": sbox_bp113_lowlive}
-_SBOX = os.environ.get("DPF_TPU_SBOX", "bp113")
-if _SBOX not in _SBOX_IMPLS:
-    raise ValueError(
-        f"DPF_TPU_SBOX={_SBOX!r} unknown; choose from {sorted(_SBOX_IMPLS)}"
-    )
+# scripts/sbox_liveness.py).  Selected by end-to-end A/B on hardware; the
+# registry and the DPF_TPU_SBOX selection live in sbox_circuit so ALL
+# variants (XLA, canonical, bit-major, interleaved, walk, fused) switch
+# together (sbox_circuit.set_sbox / active_sbox).
 
 
 # The bit-major circuit helpers are rank-generic: the plane axis is axis 0
@@ -170,7 +166,7 @@ def _rk_col(rk, rnd, tail_ndim):
 
 
 def _sub_bytes_bm(S):
-    sbox = _SBOX_IMPLS[_SBOX]
+    sbox = active_sbox()
     tail = S.shape[1:]
     s = S.reshape(8, 16, *tail)
     if not _SBOX_SPLIT:
@@ -468,3 +464,149 @@ def eval_points_walk_planes(
         sel,
         jnp.asarray(_RK_BOTH_BM),
     )
+
+
+# ---------------------------------------------------------------------------
+# Level-fused expansion kernels (compat profile)
+#
+# The per-level expansion (models/dpf._level_step) round-trips every node
+# plane through HBM at each of the nu levels: the PRG kernel reads the
+# parent state and writes both children, then the XLA epilogue (t-bit
+# clear, CW XOR, child interleave) reads and rewrites them.  The fused
+# kernel runs G consecutive GGM levels — PRG double-expansion, control-bit
+# extract/clear, CW XOR masked by parent t-bits — inside ONE program, so
+# all intermediate node planes stay in VMEM and HBM sees the entry tile
+# once in and the 2^G-wide child tile once out: per-leaf HBM traffic on
+# the level loop drops ~G x (model in scripts/bench_kernels.py).
+#
+# Layout: the evaluator's level state [128, W, Kp] enters the fused
+# pipeline TRANSPOSED as [128 planes, Kp key-words, W nodes] — key words
+# on sublanes (tile _FKT = 8), nodes on lanes (tile _FWT = 128; at the
+# headline config Kp = 32, so nodes are the only axis wide enough to fill
+# lanes).  Each plane value is then one (8, 128) vreg slab, exactly the
+# walk kernel's shape, and the rank-generic bit-major circuit helpers
+# apply unchanged.  Children are emitted in BLOCK order [all-L | all-R]
+# per level (a pure lane concat — the strided interleave of the canonical
+# layout is exactly what chacha_pallas's expand kernel had to avoid);
+# ascending node order is restored outside the kernel by one static
+# bit-reversal gather per group (fused_deinterleave, the trailing-axis
+# generalization of chacha_pallas.deinterleave_leaves).
+# ---------------------------------------------------------------------------
+
+_FKT = 8  # fused key-word sublane tile
+_FWT = 128  # fused node lane tile at kernel entry
+# VMEM-budget model cap: one fused program holds the entry tile plus the
+# final level's L/R child slabs (the 2^g-node output tile is one of them),
+# each node-word 128 planes x 4 B.  16 MB/core VMEM minus Mosaic's
+# double-buffered I/O windows and the S-box temporaries leaves ~8 MB for
+# the state slabs; auto group size is the largest g that fits.
+_FUSE_VMEM_BUDGET = 8 << 20
+_FUSE_MAX_G = 4
+
+
+def fuse_vmem_bytes(g: int, kt: int = _FKT, wt: int = _FWT) -> int:
+    """Modeled VMEM footprint of one fused program running ``g`` levels:
+    (entry + 2 * 2^g child-slab) node-words x 128 planes x 4 B."""
+    return 512 * kt * wt * (1 + 2 * (1 << g))
+
+
+def fuse_auto_levels() -> int:
+    """VMEM-budget group size for DPF_TPU_FUSE=auto (0 when even g=1 does
+    not fit — cannot happen at the default tile)."""
+    g = 0
+    while g < _FUSE_MAX_G and fuse_vmem_bytes(g + 1) <= _FUSE_VMEM_BUDGET:
+        g += 1
+    return g
+
+
+def _fused_levels_kernel_bm(
+    s_ref, t_ref, scw_ref, tl_ref, tr_ref, rk_ref, so_ref, to_ref, *, glevels
+):
+    """``glevels`` consecutive GGM level steps on a [128, KT, WT] bit-major
+    tile, state resident in VMEM throughout.  Children concatenate in
+    block order on the node (lane) axis each level."""
+    S = s_ref[:]  # [128, KT, WT]
+    T = t_ref[:]  # [KT, WT]
+    rk = rk_ref[:]
+    for _i in range(glevels):
+        L = _encrypt_bm(S, rk[0]) ^ S
+        R = _encrypt_bm(S, rk[1]) ^ S
+        # Plane 0 is the packed control-bit plane: extract whole, zero
+        # whole (same idiom as the walk kernel).
+        tl = L[0]
+        tr = R[0]
+        zero = jnp.zeros_like(L[0:1])
+        L = jnp.concatenate([zero, L[1:]])
+        R = jnp.concatenate([zero, R[1:]])
+        cwm = scw_ref[_i] & T[None]  # [128, KT, 1] & [1, KT, W] -> bcast
+        L = L ^ cwm
+        R = R ^ cwm
+        tl = tl ^ (tl_ref[_i] & T)  # [KT, 1] & [KT, W]
+        tr = tr ^ (tr_ref[_i] & T)
+        S = jnp.concatenate([L, R], axis=2)
+        T = jnp.concatenate([tl, tr], axis=1)
+    so_ref[:] = S
+    to_ref[:] = T
+
+
+def fused_qkt(kp: int) -> int:
+    """Largest key-word sublane tile dividing kp (cap _FKT)."""
+    kt = min(kp, _FKT)
+    while kp % kt:
+        kt -= 1
+    return kt
+
+
+def fused_levels_planes(S, T, scw_bm, tl_w, tr_w):
+    """Run ``g = scw_bm.shape[0]`` consecutive levels in one kernel.
+
+    S uint32[128, Kp, W] bit-major planes in the fused (node-minor)
+    layout, T uint32[Kp, W] packed parent control bits, scw_bm
+    uint32[g, 128, Kp] bit-major seed-CW planes, tl_w/tr_w uint32[g, Kp]
+    -> (S', T') with W << g nodes, children in BLOCK order per node tile
+    (pass through :func:`fused_deinterleave` before anything
+    order-sensitive).  W must be a power of two (it is 2^level)."""
+    g = scw_bm.shape[0]
+    kp, W = T.shape
+    kt = fused_qkt(kp)
+    wt = min(W, _FWT)
+    kern = functools.partial(_fused_levels_kernel_bm, glevels=g)
+    return pl.pallas_call(
+        kern,
+        grid=(kp // kt, W // wt),
+        in_specs=[
+            pl.BlockSpec((128, kt, wt), lambda k, w: (0, k, w)),  # S
+            pl.BlockSpec((kt, wt), lambda k, w: (k, w)),  # T
+            pl.BlockSpec((g, 128, kt, 1), lambda k, w: (0, 0, k, 0)),  # scw
+            pl.BlockSpec((g, kt, 1), lambda k, w: (0, k, 0)),  # tlcw
+            pl.BlockSpec((g, kt, 1), lambda k, w: (0, k, 0)),  # trcw
+            pl.BlockSpec((2, 11, 128), lambda k, w: (0, 0, 0)),  # rk
+        ],
+        out_specs=[
+            pl.BlockSpec((128, kt, wt << g), lambda k, w: (0, k, w)),
+            pl.BlockSpec((kt, wt << g), lambda k, w: (k, w)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((128, kp, W << g), jnp.uint32),
+            jax.ShapeDtypeStruct((kp, W << g), jnp.uint32),
+        ],
+        interpret=not _on_tpu(),
+    )(
+        S,
+        T,
+        scw_bm[:, :, :, None],
+        tl_w[:, :, None],
+        tr_w[:, :, None],
+        jnp.asarray(_RK_BOTH_BM),
+    )
+
+
+def fused_deinterleave(x, levels: int, wt: int):
+    """Restore ascending node order on the LAST axis after a fused group
+    (the fused state is [128, Kp, W], its T is [Kp, W]; ``wt`` is the
+    group's ENTRY node-tile width).  One shared implementation with the
+    chacha kernels — see ops.deinterleave_nodes for the block-order
+    math."""
+    from . import deinterleave_nodes
+
+    return deinterleave_nodes(x, levels, wt)

@@ -384,13 +384,12 @@ def expand_backend() -> str:
     return "pallas" if _on_tpu() else "xla"
 
 
-def _expand_kernel(
-    s0_ref, s1_ref, s2_ref, s3_ref, t_ref, scw_ref, tcw_ref, fcw_ref,
-    *out_refs, levels,
-):
+def _expand_levels_body(S, T, scw_ref, tcw_ref, levels):
+    """The in-kernel level loop shared by the expand+convert kernel and
+    the mid-tree fused-levels kernel: ``levels`` GGM steps on [KT, W]
+    word state, CW rows read from the lane-padded operand blocks
+    (cw_operands layout, indexed relative to the block's first level)."""
     one = np.uint32(1)
-    S = [s0_ref[:], s1_ref[:], s2_ref[:], s3_ref[:]]
-    T = t_ref[:]
 
     def bcast(col, shape):  # [KT, 1] per-key constant -> [KT, W]
         return jnp.broadcast_to(col, shape)
@@ -416,11 +415,66 @@ def _expand_kernel(
         # outside the kernel (deinterleave_leaves).
         S = [jnp.concatenate([L[w], R[w]], axis=1) for w in range(4)]
         T = jnp.concatenate([tl, tr], axis=1)
+    return S, T
+
+
+def _expand_kernel(
+    s0_ref, s1_ref, s2_ref, s3_ref, t_ref, scw_ref, tcw_ref, fcw_ref,
+    *out_refs, levels,
+):
+    S = [s0_ref[:], s1_ref[:], s2_ref[:], s3_ref[:]]
+    T = t_ref[:]
+    S, T = _expand_levels_body(S, T, scw_ref, tcw_ref, levels)
     out = _cc_core(S, _DSL, 16)
     msk = jnp.uint32(0) - T
     for j in range(16):
-        fj = bcast(fcw_ref[:, j : j + 1], T.shape)
+        fj = jnp.broadcast_to(fcw_ref[:, j : j + 1], T.shape)
         out_refs[j][:] = out[j] ^ (fj & msk)
+
+
+def _fused_levels_kernel(
+    s0_ref, s1_ref, s2_ref, s3_ref, t_ref, scw_ref, tcw_ref, *out_refs,
+    levels,
+):
+    """Mid-tree fused group: ``levels`` GGM steps in one program, NO leaf
+    conversion — the ChaCha twin of aes_pallas._fused_levels_kernel_bm.
+    Emits the four child seed-word arrays plus T, children in block order
+    (fix with deinterleave_leaves)."""
+    S = [s0_ref[:], s1_ref[:], s2_ref[:], s3_ref[:]]
+    T = t_ref[:]
+    S, T = _expand_levels_body(S, T, scw_ref, tcw_ref, levels)
+    for w in range(4):
+        out_refs[w][:] = S[w]
+    out_refs[4][:] = T
+
+
+def fused_levels_raw(s0, s1, s2, s3, T, scw_p, tcw_p, levels: int):
+    """One fused mid-tree group: state 5 x uint32[K, W] (4 seed words +
+    packed t bits), CW operands in the cw_operands lane-padded layout for
+    exactly these ``levels`` -> 5 x uint32[K, W << levels], children in
+    block order per node tile."""
+    K, W = T.shape
+    wt = min(_EWT, W)
+    sspec = pl.BlockSpec((_EKT, wt), lambda k, w: (k, w))
+    cw_spec = pl.BlockSpec((_EKT, 128), lambda k, w: (k, 0))
+    out_spec = pl.BlockSpec((_EKT, wt << levels), lambda k, w: (k, w))
+    kern = functools.partial(_fused_levels_kernel, levels=levels)
+    return pl.pallas_call(
+        kern,
+        grid=(K // _EKT, W // wt),
+        in_specs=[sspec] * 5 + [cw_spec] * 2,
+        out_specs=[out_spec] * 5,
+        out_shape=[jax.ShapeDtypeStruct((K, W << levels), jnp.uint32)] * 5,
+        interpret=not _on_tpu(),
+    )(s0, s1, s2, s3, T, scw_p, tcw_p)
+
+
+def fuse_auto_levels() -> int:
+    """VMEM-budget group size for DPF_TPU_FUSE=auto on the fast profile:
+    a mid-tree fused program carries 5 word arrays (vs the tail kernel's
+    16 output words), so the tail's measured-safe _EXP_LEVELS depth is
+    safe here a fortiori."""
+    return _EXP_LEVELS
 
 
 # Whole-tree (entry-0) kernel coverage: one program per key tile runs ALL
@@ -559,24 +613,15 @@ def _expand_raw(s0, s1, s2, s3, T, scw_p, tcw_p, fcw_p, levels):
 
 
 def deinterleave_leaves(x, levels, wt: int = _EWT):
-    """Restore ascending leaf order of one expand-kernel output word.
+    """Restore ascending leaf order of one expand-kernel output word
+    [K, W].  ``wt`` is the kernel's entry node-tile width (= _EWT for
+    the classic route, the entry node count for small trees).  XLA fuses
+    the gather into the output stack pass.  One shared implementation
+    with the compat fused kernels — see ops.deinterleave_nodes for the
+    block-order math."""
+    from . import deinterleave_nodes
 
-    Inside a tile the kernel emits children in block order, so local
-    position = j' * WT + w with j' = (b_levels .. b_1) — the level-choice
-    bits in REVERSE significance.  The true local leaf index is
-    w * 2^levels + (b_1 .. b_levels).  One static bit-reversal gather +
-    axis swap per output word fixes it; XLA fuses this into the output
-    stack pass.  ``wt`` is the kernel's entry node-tile width (= _EWT for
-    the classic route, the entry node count for small trees)."""
-    if levels == 0:
-        return x
-    k = x.shape[0]
-    n2 = 1 << levels
-    rev = np.zeros(n2, np.int32)
-    for j in range(n2):
-        rev[j] = int(format(j, f"0{levels}b")[::-1], 2)
-    x = x.reshape(k, -1, n2, wt)[:, :, rev, :]
-    return jnp.swapaxes(x, 2, 3).reshape(k, -1)
+    return deinterleave_nodes(x, levels, wt)
 
 
 def cw_operands(scw, tcw, fcw, first_level: int, nu: int):

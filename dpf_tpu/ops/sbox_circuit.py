@@ -17,9 +17,19 @@ mask with ``& 1`` per lane; fixed-width numpy/jnp dtypes need no masking.
 Convention: ``x[0]`` is the **most significant bit** of the S-box input byte,
 ``out[0]`` the MSB of the output (Boyar-Peralta's ordering).  Callers using
 LSB-first plane layouts must reverse on the way in and out.
+
+This module also owns the **circuit selection** (``DPF_TPU_SBOX`` /
+:func:`set_sbox`): every cipher path — the XLA expression
+(``aes_bitslice._sub_bytes``), the canonical Pallas kernels, the bit-major
+family (per-level, interleaved, walk, fused) — reads the active circuit
+through :func:`active_sbox`, so an A/B flip switches ALL of them at once
+and a route stamp (``bench.py``/``bench_all.py``) can name the variant
+that actually ran.
 """
 
 from __future__ import annotations
+
+import os
 
 
 def sbox_bp113(x):
@@ -404,3 +414,42 @@ def sbox_algebraic(x):
             o = ~o
         out.append(o)
     return list(reversed(out))  # back to MSB-first
+
+
+# ---------------------------------------------------------------------------
+# Circuit selection (single source of truth for every cipher path)
+# ---------------------------------------------------------------------------
+
+# "bp113": the plain Boyar-Peralta transcription (113 gates, peak 29 live
+# values under emission order / 36 with inputs pinned).  "lowlive": the
+# register-budgeted rematerializing schedule (156 ops, peak 24 / 26 pinned
+# — scripts/sbox_liveness.py; scripts/sbox_schedule_search.py's randomized
+# list scheduling cannot beat its emission order, so the hand schedule IS
+# the landed register-budgeted schedule).  The default stays bp113 until
+# the on-hardware A/B (tpu_logs/*/DECISIONS.md) flips it.
+SBOX_IMPLS = {"bp113": sbox_bp113, "lowlive": sbox_bp113_lowlive}
+
+_SBOX = os.environ.get("DPF_TPU_SBOX", "bp113")
+if _SBOX not in SBOX_IMPLS:
+    raise ValueError(
+        f"DPF_TPU_SBOX={_SBOX!r} unknown; choose from {sorted(SBOX_IMPLS)}"
+    )
+
+
+def set_sbox(name: str) -> str:
+    """Select the active circuit (A/B scripts); returns the previous name.
+    Callers must ``jax.clear_caches()`` afterwards — the selection is a
+    trace-time Python global, not a traced value."""
+    global _SBOX
+    if name not in SBOX_IMPLS:
+        raise ValueError(
+            f"unknown S-box circuit {name!r}; choose from {sorted(SBOX_IMPLS)}"
+        )
+    prev, _SBOX = _SBOX, name
+    return prev
+
+
+def active_sbox():
+    """The selected circuit function (read at trace time by every kernel
+    variant: XLA, canonical Pallas, bit-major, interleaved, walk, fused)."""
+    return SBOX_IMPLS[_SBOX]

@@ -2,8 +2,27 @@
 # Hermetic CPU test run: 8 virtual JAX CPU devices, axon TPU plugin disabled
 # (if the axon tunnel is wedged, jax.devices() hangs in any process where the
 # plugin registers — unsetting PALLAS_AXON_POOL_IPS skips registration).
+#
+#   ./runtests.sh [pytest args]          full suite (tier-1 lane: slow
+#       tests — multi-minute interpret-mode fused-kernel compiles — are
+#       excluded by the default -m; append your own -m to override, e.g.
+#       `./runtests.sh -m slow` for the fused acceptance sweep, or
+#       `./runtests.sh -m ''` for absolutely everything)
+#   ./runtests.sh --fast [pytest args]   kernel differential smoke lane:
+#       the Pallas kernel suites (fused + walk + expand routes, interpret
+#       mode) plus the S-box circuit invariants — surfaces kernel
+#       regressions in minutes instead of the full-suite half hour.
+if [ "${1:-}" = "--fast" ]; then
+  shift
+  set -- tests/test_aes_pallas.py tests/test_chacha_pallas.py \
+      tests/test_fused_expand.py tests/test_aes_bitslice.py \
+      -q -m 'not slow' "$@"
+else
+  # -m is last-wins in pytest, so a caller-supplied -m overrides ours.
+  set -- tests/ -q -m 'not slow' "$@"
+fi
 exec env -u PALLAS_AXON_POOL_IPS \
     -u PALLAS_AXON_REMOTE_COMPILE -u PALLAS_AXON_TPU_GEN \
     JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
-    python -m pytest tests/ -q "$@"
+    python -m pytest "$@"

@@ -6,10 +6,12 @@ chained eval_full graph (same method as bench.py) under different knobs:
 
     python scripts/bench_compat_ab.py pallas:256 pallas:512 xla
     python scripts/bench_compat_ab.py pallas_bm:128:bp113 pallas_bm:128:lowlive
+    python scripts/bench_compat_ab.py pallas_bm:128:bp113:0 pallas_bm:128:bp113:3
 
-Each arg is backend[:BT[:sbox]] (sbox: bp113 | lowlive).  Prints Gleaves/s
-per variant.  Variants run interleaved-in-one-process so the shared
-device's contention swings hit all of them alike.
+Each arg is backend[:BT[:sbox[:fuse]]] (sbox: bp113 | lowlive; fuse: 0 =
+per-level, g >= 1 = level-fused expansion with groups of <= g levels).
+Prints Gleaves/s per variant.  Variants run interleaved-in-one-process so
+the shared device's contention swings hit all of them alike.
 """
 
 import sys
@@ -31,7 +33,12 @@ def main():
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     from dpf_tpu.core.keys import gen_batch
-    from dpf_tpu.models.dpf import DeviceKeys, _eval_full_jit
+    from dpf_tpu.models.dpf import (
+        DeviceKeys,
+        _eval_full_fused_jit,
+        _eval_full_jit,
+        _fuse_schedule,
+    )
     from dpf_tpu.ops import aes_pallas
 
     rng = np.random.default_rng(2026)
@@ -43,15 +50,21 @@ def main():
         dk.tl_words, dk.tr_words, dk.fcw_planes,
     )
 
-    def chained(r, backend):
+    def chained(r, backend, sched=None):
         from bench import _chain_scan
 
         def step(acc, seed_planes, t_words, scw_planes, tl_w, tr_w,
                  fcw_planes):
-            words = _eval_full_jit(
-                dk.nu, seed_planes ^ acc, t_words, scw_planes,
-                tl_w, tr_w, fcw_planes, backend,
-            )
+            if sched is not None:
+                words = _eval_full_fused_jit(
+                    dk.nu, seed_planes ^ acc, t_words, scw_planes,
+                    tl_w, tr_w, fcw_planes, backend, sched,
+                )
+            else:
+                words = _eval_full_jit(
+                    dk.nu, seed_planes ^ acc, t_words, scw_planes,
+                    tl_w, tr_w, fcw_planes, backend,
+                )
             return acc ^ jnp.bitwise_xor.reduce(words, axis=None)
 
         return _chain_scan(jax, jnp, step, r)
@@ -62,9 +75,19 @@ def main():
         if len(parts) > 1:
             aes_pallas._BT = int(parts[1])
         if len(parts) > 2:
-            aes_pallas._SBOX = parts[2]
+            from dpf_tpu.ops import sbox_circuit
+
+            sbox_circuit.set_sbox(parts[2])
+        sched = None
+        if len(parts) > 3 and parts[3] not in ("", "0", "off"):
+            sched = _fuse_schedule(dk.nu, int(parts[3]))
+            if sched is None:  # forced-fuse contract: never measure the
+                raise SystemExit(  # per-level path under a fused label
+                    f"{spec_str}: no fused schedule at nu={dk.nu} "
+                    f"(tree too shallow) — refusing to mislabel per-level"
+                )
         jax.clear_caches()
-        f1, f3 = chained(1, backend), chained(3, backend)
+        f1, f3 = chained(1, backend, sched), chained(3, backend, sched)
         np.asarray(f1(*args))
         np.asarray(f3(*args))
         best = float("inf")

@@ -9,6 +9,15 @@ Compares, on uint32[128, B] plane state:
 
 Usage: python scripts/bench_kernels.py [B_log2=17]
 Prints AES-MMO blocks/sec per variant (1 PRG = 2 MMO over 32*B blocks).
+
+Fused-expansion route (the level-fused kernel family, ops/aes_pallas):
+
+    python scripts/bench_kernels.py --fused [nu=13] [kp=32] [g=3]
+
+Prints the modeled per-leaf HBM bytes of the level loop for the per-level
+vs the G-level-fused pipeline (the model runs anywhere — "modeled on
+CPU"), and on a live TPU also times one fused group against the same G
+per-level steps at the mid-tree shape (measured when a window opens).
 """
 
 from __future__ import annotations
@@ -116,7 +125,88 @@ def timeit(fn, S, reps=10):
     return best
 
 
+def fused_hbm_model(nu: int, kp: int, g: int, floor: int = 7):
+    """Modeled HBM bytes/leaf of the level loop (levels floor..nu-1) for
+    the per-level vs the fused pipeline.  Units: one level-state "node
+    word" is 128 planes x 4 B = 512 B per (node, key-word).
+
+    Per-level, level i (parent width W = 2^i): the PRG kernel reads the
+    parent state and writes both children (3 state passes), then the XLA
+    epilogue (t-bit clear + CW XOR + child interleave) reads and rewrites
+    the children (4 more child-sized passes) -> 7 W-units.  Fused group of
+    ``gl`` levels at entry width W: entry read + 2^gl-wide write + the
+    deinterleave gather's read+write -> (1 + 3 * 2^gl) W-units; the CW
+    application and child plumbing happen in VMEM."""
+    unit = 512 * kp  # bytes per node of per-key-word level state
+    per_level = sum(7 * (1 << i) * unit for i in range(floor, nu))
+    fused = 0
+    lvl = floor
+    while lvl < nu:
+        gl = min(g, nu - lvl)
+        fused += (1 + 3 * (1 << gl)) * (1 << lvl) * unit
+        lvl += gl
+    leaves = (1 << nu) * kp * 32  # 32 keys per lane word
+    return per_level / leaves, fused / leaves
+
+
+def bench_fused(nu: int, kp: int, g: int):
+    from dpf_tpu.models.dpf import _fuse_schedule, _level_step
+    from dpf_tpu.ops import aes_pallas as ap
+
+    pl_leaf, fu_leaf = fused_hbm_model(nu, kp, g)
+    sched = _fuse_schedule(nu, g)
+    print(
+        f"HBM model, level loop (levels 7..{nu - 1}, kp={kp}): "
+        f"per-level {pl_leaf:.1f} B/leaf, fused-{g} {fu_leaf:.1f} B/leaf "
+        f"({pl_leaf / fu_leaf:.2f}x less), schedule={sched}"
+    )
+    if not jax.default_backend() == "tpu":
+        print("no TPU: modeled only (timing needs the Mosaic kernels)")
+        return
+    # Time ONE mid-tree fused group vs the same g per-level steps at the
+    # group's entry shape (W = 2^(nu-g) nodes, so the timed work is the
+    # most expensive group of the schedule).
+    W = 1 << max(nu - g, 7)
+    rng = np.random.default_rng(0)
+    Sf = jnp.asarray(
+        rng.integers(0, 1 << 32, size=(128, kp, W), dtype=np.uint32)
+    )
+    Tf = jnp.asarray(rng.integers(0, 1 << 32, size=(kp, W), dtype=np.uint32))
+    scw = rng.integers(0, 1 << 32, size=(g, 128, kp), dtype=np.uint32)
+    scw[:, 0] = 0
+    scw = jnp.asarray(scw)
+    tl = jnp.asarray(rng.integers(0, 1 << 32, size=(g, kp), dtype=np.uint32))
+    tr = jnp.asarray(rng.integers(0, 1 << 32, size=(g, kp), dtype=np.uint32))
+
+    @jax.jit
+    def fused(Sf):
+        So, To = ap.fused_levels_planes(Sf, Tf, scw, tl, tr)
+        So = ap.fused_deinterleave(So, g, min(W, ap._FWT))
+        To = ap.fused_deinterleave(To, g, min(W, ap._FWT))
+        return So, To
+
+    @jax.jit
+    def per_level(Sf):
+        S = jnp.swapaxes(Sf, 1, 2)
+        T = jnp.swapaxes(Tf, 0, 1)
+        for i in range(g):
+            S, T = _level_step(S, T, scw[i], tl[i], tr[i], "pallas_bm")
+        return S, T
+    leaves = (W << g) * kp * 32
+    t = timeit(fused, Sf)
+    print(f"fused-{g}    {leaves / t / 1e9:8.2f} Gleaves/s  ({t * 1e3:.2f} ms)")
+    t = timeit(per_level, Sf)
+    print(f"per-level  {leaves / t / 1e9:8.2f} Gleaves/s  ({t * 1e3:.2f} ms)")
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--fused":
+        nums = [int(a) for a in sys.argv[2:]]
+        nu = nums[0] if nums else 13
+        kp = nums[1] if len(nums) > 1 else 32
+        g = nums[2] if len(nums) > 2 else 3
+        bench_fused(nu, kp, g)
+        return
     blog = int(sys.argv[1]) if len(sys.argv) > 1 else 17
     B = 1 << blog
     rng = np.random.default_rng(0)

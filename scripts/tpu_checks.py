@@ -9,7 +9,9 @@ the real Mosaic lowering of:
     path is CPU-only),
   * the whole-tree entry-0 expand route (TPU-only, cannot be interpreted
     — see chacha_pallas.small_tree_entry),
-  * the lowlive S-box inside the bit-major PRG kernel.
+  * the lowlive S-box inside the bit-major PRG kernel,
+  * the level-fused expansion kernels, both profiles (DPF_TPU_FUSE) —
+    the fused_ab bench step may only be trusted if these lower.
 
 Each check runs in a containment wrapper: a failure (Mosaic rejection,
 mismatch) is recorded and the REMAINING checks still run — the
@@ -164,19 +166,49 @@ def main():
         Sj = jnp.asarray(S)
         to_bm = np.array(ap._TO_BM)
         L0, R0 = prg_planes(Sj)
-        orig_sbox = ap._SBOX
+        from dpf_tpu.ops import sbox_circuit
+
+        orig_sbox = sbox_circuit.set_sbox("lowlive")
         try:
-            ap._SBOX = "lowlive"
             jax.clear_caches()
             L1, R1 = ap.prg_planes_pallas_bm(Sj[to_bm])
         finally:
-            ap._SBOX = orig_sbox
+            sbox_circuit.set_sbox(orig_sbox)
             jax.clear_caches()
         inv = np.argsort(to_bm)
         assert (np.asarray(L0) == np.asarray(L1)[inv]).all(), "lowlive L"
         assert (np.asarray(R0) == np.asarray(R1)[inv]).all(), "lowlive R"
 
     _check("lowlive S-box kernel", lowlive_sbox, t0)
+
+    def fused_compat():
+        # Level-fused compat expansion (Mosaic lowering + byte identity)
+        from dpf_tpu.models.dpf import DeviceKeys, eval_full_device
+
+        rng = np.random.default_rng(6)
+        alphas = rng.integers(0, 1 << 16, size=64, dtype=np.uint64)
+        ka, _ = gen_batch(alphas, 16, rng=rng)
+        dk = DeviceKeys(ka)
+        want = np.asarray(eval_full_device(dk, backend="pallas_bm", fuse=0))
+        got = np.asarray(eval_full_device(dk, backend="pallas_bm", fuse=2))
+        assert (got == want).all(), "fused-compat mismatch"
+
+    _check("fused expansion (compat)", fused_compat, t0)
+
+    def fused_fast():
+        # Level-fused mid-tree groups, fast profile (nu = 13: one 2-level
+        # group via tail_cap, exercising fused_levels_raw on hardware)
+        from dpf_tpu.models import dpf_chacha as dc
+
+        rng = np.random.default_rng(7)
+        alphas = rng.integers(0, 1 << 22, size=8, dtype=np.uint64)
+        ka, _ = kc.gen_batch(alphas, 22, rng=rng)
+        want = np.asarray(dc.eval_full_device(ka, backend="pallas", fuse=0))
+        sched = dc._fuse_schedule_cc(ka.nu, 2, tail_cap=3)
+        got = np.asarray(dc._eval_full_pallas_fused(ka, sched))
+        assert (got == want).all(), "fused-fast mismatch"
+
+    _check("fused expansion (fast)", fused_fast, t0)
 
     if _FAILURES:
         print(f"TPU CHECKS FAILED: {', '.join(_FAILURES)}")

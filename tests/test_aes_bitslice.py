@@ -23,6 +23,72 @@ def test_sbox_circuits_exhaustive():
         assert np.array_equal(got, aes_np.SBOX), fn.__name__
 
 
+def test_registered_sbox_impls_exhaustive():
+    """Every DPF_TPU_SBOX-selectable circuit must compute the exact S-box
+    over all 256 inputs — the registry is the one gate every kernel
+    variant (XLA, canonical, bit-major, interleaved, walk, fused) goes
+    through, so a bad entry corrupts keys everywhere at once."""
+    from dpf_tpu.ops.sbox_circuit import SBOX_IMPLS
+
+    xs = np.arange(256, dtype=np.uint8)
+    planes = [((xs >> (7 - b)) & 1).astype(np.uint32) for b in range(8)]
+    for name, fn in SBOX_IMPLS.items():
+        out = fn(planes)
+        got = np.zeros(256, dtype=np.uint8)
+        for b in range(8):
+            got |= ((out[b] & 1) << (7 - b)).astype(np.uint8)
+        assert np.array_equal(got, aes_np.SBOX), name
+
+
+def _load_liveness_tool():
+    import importlib.util
+    import os
+
+    p = os.path.join(
+        os.path.dirname(__file__), "..", "scripts", "sbox_liveness.py"
+    )
+    spec_ = importlib.util.spec_from_file_location("sbox_liveness", p)
+    mod = importlib.util.module_from_spec(spec_)
+    spec_.loader.exec_module(mod)
+    return mod
+
+
+def test_lowlive_register_budget_invariant():
+    """Frozen gate/liveness invariant of the register-budgeted schedule,
+    measured by the same offline tool that designed it
+    (scripts/sbox_liveness.py): peak live cut <= 24 (<= 26 with the 8
+    inputs pinned) at exactly 156 ops.  A refactor that silently
+    reorders the emission back above the budget — the whole point of the
+    schedule — fails here, not on hardware."""
+    lv = _load_liveness_tool()
+    peak, _ = lv.analyze(sbox_bp113_lowlive, "lowlive")
+    assert peak <= 24, peak
+    peak_pinned, _ = lv.analyze(
+        sbox_bp113_lowlive, "lowlive-pinned", keep_inputs_live=True
+    )
+    assert peak_pinned <= 26, peak_pinned
+    tr, _outs = lv.trace(sbox_bp113_lowlive)
+    ops = [op for op, _ in tr if op is not None]
+    assert len(ops) == 156
+    assert ops.count("and") == 32 and ops.count("not") == 4
+    # And the baseline it buys against: plain BP113 transcription.
+    bp_peak, _ = lv.analyze(sbox_bp113, "bp113")
+    assert bp_peak == 29, bp_peak
+
+
+def test_sbox_selection_registry():
+    from dpf_tpu.ops import sbox_circuit as sc
+
+    prev = sc.set_sbox("lowlive")
+    try:
+        assert sc.active_sbox() is sbox_bp113_lowlive
+        with pytest.raises(ValueError, match="unknown S-box"):
+            sc.set_sbox("nope")
+        assert sc.active_sbox() is sbox_bp113_lowlive  # unchanged on error
+    finally:
+        sc.set_sbox(prev)
+
+
 def test_pack_unpack_roundtrip_np():
     rng = np.random.default_rng(0)
     blocks = rng.integers(0, 256, size=(100, 16), dtype=np.uint8)

@@ -155,7 +155,9 @@ def test_bm_kernels_lowlive_sbox_match_xla(monkeypatch):
     is selected by module global, not a traced value)."""
     import jax
 
-    monkeypatch.setattr(aes_pallas, "_SBOX", "lowlive")
+    from dpf_tpu.ops import sbox_circuit
+
+    monkeypatch.setattr(sbox_circuit, "_SBOX", "lowlive")
     jax.clear_caches()
     to_bm = np.array(aes_pallas._TO_BM)
     S = _rand_planes(256, seed=9)

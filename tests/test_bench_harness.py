@@ -142,6 +142,84 @@ def test_bench_all_ledger_resumes_without_remeasuring(tmp_path):
     assert len(recorded2) == len(recorded) + 1, recorded2
 
 
+def test_ledger_retry_errors_knob(tmp_path, monkeypatch):
+    """DPF_TPU_BENCH_LEDGER_RETRY_ERRORS: recorded sections whose rows
+    contain an error row are dropped on load (they re-measure) and fresh
+    non-transient error rows are not recorded — the escape hatch for
+    environment-dependent failures without a transport signature, which
+    would otherwise replay verbatim until the code or a knob changes.
+    Unit-level (module internals): the subprocess ledger flow is covered
+    by test_bench_all_ledger_resumes_without_remeasuring."""
+    sys.path.insert(0, REPO)
+    import bench_all as ba
+
+    ledger = str(tmp_path / "ledger.jsonl")
+    key = {"head": "k", "scale": "small", "knobs": {}}
+    err_rows = [{"metric": "s1", "value": 0, "unit": "", "error": "boom"}]
+    ok_rows = [{"metric": "s2", "value": 1.0, "unit": "x"}]
+    with open(ledger, "w") as f:
+        for rec in (
+            key,
+            {"section": "s1", "rows": err_rows},
+            {"section": "s2", "rows": ok_rows},
+        ):
+            f.write(json.dumps(rec) + "\n")
+    monkeypatch.setattr(ba, "_LEDGER_PATH", ledger)
+    monkeypatch.setattr(ba, "_ledger_key", lambda scale: key)
+    # Default: both sections replay (error rows pinned).
+    monkeypatch.setattr(ba, "_LEDGER", {})
+    ba._ledger_load("small")
+    assert set(ba._LEDGER) == {"s1", "s2"}
+    # With the knob: the error section re-measures, the good one replays.
+    monkeypatch.setattr(ba, "_RETRY_ERRORS", True)
+    monkeypatch.setattr(ba, "_LEDGER", {})
+    ba._ledger_load("small")
+    assert set(ba._LEDGER) == {"s2"}
+    # A fresh non-transient failure is not recorded under the knob...
+    monkeypatch.setattr(ba, "_ONLY", [])
+    monkeypatch.setattr(ba, "_FORCE_FAIL", ["s3"])
+    ba._section("s3", lambda: None)
+    assert "s3" not in ba._LEDGER
+    # ...but IS recorded (pinned) without it, preserving default behavior.
+    monkeypatch.setattr(ba, "_RETRY_ERRORS", False)
+    ba._section("s3", lambda: None)
+    assert "s3" in ba._LEDGER
+
+
+def test_transient_classified_before_truncation(monkeypatch):
+    """A transport signature past the 300-char display cut must still
+    classify the section as transient (not recorded in the ledger), and
+    the emitted row must carry the explicit "transient": true marker —
+    the watcher's rc=0 wedge verdict reads THAT, since the signature
+    text itself may be truncated out of the log."""
+    sys.path.insert(0, REPO)
+    import bench_all as ba
+
+    recorded = {}
+    monkeypatch.setattr(ba, "_LEDGER_PATH", "unused")
+    monkeypatch.setattr(
+        ba, "_ledger_record", lambda s, rows: recorded.setdefault(s, rows)
+    )
+    monkeypatch.setattr(ba, "_ONLY", [])
+    monkeypatch.setattr(ba, "_FORCE_FAIL", [])
+    monkeypatch.setattr(ba, "_LEDGER", {})
+
+    def die():
+        raise RuntimeError("x" * 400 + " UNAVAILABLE: tunnel died")
+
+    ba._section("s-long", die)
+    assert "s-long" not in recorded  # transient: must re-measure next run
+    row = ba._CUR_ROWS[-1]
+    assert row["transient"] is True and "UNAVAILABLE" not in row["error"]
+
+    def die_short():
+        raise RuntimeError("a real verdict")
+
+    ba._section("s-real", die_short)
+    assert "s-real" in recorded  # non-transient: pinned (default knobs)
+    assert "transient" not in ba._CUR_ROWS[-1]
+
+
 def test_bench_watchdog_converts_hang_to_infra_record():
     """A wedged device tunnel HANGS (it does not error); the parent
     watchdog must kill the child at the deadline and still emit exactly

@@ -1,0 +1,403 @@
+"""Level-fused expansion differentials (interpreter mode on CPU CI).
+
+The fused backend (DPF_TPU_FUSE; ops/aes_pallas + ops/chacha_pallas fused
+kernel families) runs G consecutive GGM levels per kernel program.  Any
+drift from the per-level pipeline — CW indexing, the block-order child
+emission, the deinterleave gather, the fused-layout leaf convert — is a
+silent key-corruption bug, so the fused routes are pinned byte-for-byte
+against the per-level path and the NumPy spec for G in {2, 3, 4} on both
+profiles.
+
+Interpret-mode bitsliced-AES kernels carry multi-minute XLA:CPU compiles,
+and the tier-1 lane is a fixed time budget: everything that compiles an
+AES fused kernel (the G sweeps, end-to-end runs, PIR threading, the
+compat latch) runs under ``-m slow`` (``pytest -m slow`` — the
+acceptance sweep), while the cheap ChaCha-twin kernel differential and
+latch contract plus all pure-logic gates stay in tier-1.  Latch tests
+deliberately use schedules/shapes no other test compiles: a jit-cache
+hit would skip retracing and the synthetic kernel failure would never
+fire (found the hard way).
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from dpf_tpu.core import spec
+from dpf_tpu.core.keys import gen_batch
+from dpf_tpu.models import dpf as mdpf
+from dpf_tpu.models import dpf_chacha as dc
+from dpf_tpu.models.dpf import _fuse_schedule, _level_step, eval_full
+from dpf_tpu.models.keys_chacha import gen_batch as gen_batch_cc
+from dpf_tpu.ops import aes_pallas as ap
+from dpf_tpu.ops import chacha_pallas as cp
+from dpf_tpu.ops import fuse_forced, fuse_request
+
+
+# ---------------------------------------------------------------------------
+# Pure-logic gates: schedule, env parse, VMEM budget, deinterleave math
+# ---------------------------------------------------------------------------
+
+
+def test_fuse_schedule_tiling():
+    assert _fuse_schedule(9, 2) == (7, (2,))
+    assert _fuse_schedule(13, 3) == (7, (3, 3))
+    assert _fuse_schedule(13, 4) == (7, (4, 2))
+    assert _fuse_schedule(7, 2) is None  # nothing below the floor
+    assert _fuse_schedule(13, 0) is None
+    assert _fuse_schedule(6, 4, floor=2) == (2, (4,))
+
+
+def test_fuse_schedule_cc_tiling():
+    # nu=13: tail takes _EXP_LEVELS, one mid level remains
+    assert dc._fuse_schedule_cc(13, 2) == (7, (1,), 8)
+    assert dc._fuse_schedule_cc(18, 3) == (7, (3, 3), 13)
+    assert dc._fuse_schedule_cc(12, 2) is None  # classic route covers all
+    assert dc._fuse_schedule_cc(13, 2, tail_cap=2) == (7, (2, 2), 11)
+
+
+def test_fuse_env_parse(monkeypatch):
+    monkeypatch.delenv("DPF_TPU_FUSE", raising=False)
+    assert fuse_request(3) == 0 and not fuse_forced()
+    monkeypatch.setenv("DPF_TPU_FUSE", "off")
+    assert fuse_request(3) == 0 and not fuse_forced()
+    monkeypatch.setenv("DPF_TPU_FUSE", "auto")
+    assert fuse_request(3) == 3 and not fuse_forced()
+    monkeypatch.setenv("DPF_TPU_FUSE", "2")
+    assert fuse_request(3) == 2 and fuse_forced()
+    monkeypatch.setenv("DPF_TPU_FUSE", "bogus")
+    with pytest.raises(ValueError, match="DPF_TPU_FUSE"):
+        fuse_request(3)
+
+
+def test_fuse_vmem_budget_model():
+    # The model must cap auto at a group size whose footprint fits the
+    # budget, and the footprint must be monotone in g.
+    g = ap.fuse_auto_levels()
+    assert 1 <= g <= ap._FUSE_MAX_G
+    assert ap.fuse_vmem_bytes(g) <= ap._FUSE_VMEM_BUDGET
+    if g < ap._FUSE_MAX_G:
+        assert ap.fuse_vmem_bytes(g + 1) > ap._FUSE_VMEM_BUDGET
+    assert ap.fuse_vmem_bytes(3) > ap.fuse_vmem_bytes(2)
+    assert cp.fuse_auto_levels() == cp._EXP_LEVELS
+
+
+def test_fuse_plan_gating(monkeypatch):
+    # Canonical backends keep the per-level path; bm backends fuse only
+    # when a schedule exists and the latch is clear.
+    monkeypatch.setattr(mdpf, "_FUSE_BROKEN", False)
+    assert mdpf._fuse_plan(13, "xla", 3) is None
+    assert mdpf._fuse_plan(13, "pallas", 3) is None
+    assert mdpf._fuse_plan(13, "pallas_bm", 3) == (7, (3, 3))
+    assert mdpf._fuse_plan(13, "pallas_bm", 0) is None
+    assert mdpf._fuse_plan(7, "pallas_bm", 3) is None
+    # Latch blocks env-auto routing but not explicit requests.
+    monkeypatch.setattr(mdpf, "_FUSE_BROKEN", True)
+    monkeypatch.delenv("DPF_TPU_FUSE", raising=False)
+    assert mdpf._fuse_plan(13, "pallas_bm", None) is None
+    assert mdpf._fuse_plan(13, "pallas_bm", 3) == (7, (3, 3))
+
+
+def test_fused_deinterleave_restores_order():
+    """Host-side simulation of the kernel's block-order child emission on
+    the TRAILING axis (the fused [128, Kp, W] layout), mirroring
+    test_deinterleave_wt_restores_order for the chacha kernel."""
+    rng = np.random.default_rng(5)
+    for lead, wt, ntiles, levels in [
+        ((3,), 2, 1, 3), ((2, 2), 4, 2, 2), ((1,), 128, 1, 2)
+    ]:
+        W = wt * ntiles
+        n2 = 1 << levels
+        vals = rng.integers(0, 1 << 32, size=lead + (W, n2), dtype=np.uint64)
+        true_order = np.zeros(lead + (W * n2,), np.uint32)
+        emitted = np.zeros(lead + (W * n2,), np.uint32)
+        for t in range(ntiles):
+            for w in range(wt):
+                for j in range(n2):
+                    jrev = int(format(j, f"0{levels}b")[::-1], 2)
+                    node = t * wt + w
+                    true_order[..., node * n2 + j] = vals[..., node, j]
+                    emitted[..., (t * n2 + jrev) * wt + w] = vals[..., node, j]
+        got = np.asarray(
+            ap.fused_deinterleave(jnp.asarray(emitted), levels, wt)
+        )
+        np.testing.assert_array_equal(got, true_order)
+
+
+# ---------------------------------------------------------------------------
+# Kernel-level differentials: one fused program vs per-level steps
+# ---------------------------------------------------------------------------
+
+
+def _check_fused_kernel(g, W, kp, seed):
+    rng = np.random.default_rng(seed)
+    S = jnp.asarray(
+        rng.integers(0, 1 << 32, size=(128, W, kp), dtype=np.uint32)
+    )
+    T = jnp.asarray(rng.integers(0, 1 << 32, size=(W, kp), dtype=np.uint32))
+    scw = rng.integers(0, 1 << 32, size=(g, 128, kp), dtype=np.uint32)
+    scw[:, 0] = 0  # plane 0 (the t bit) of every sCW is 0 by Gen
+    scw = jnp.asarray(scw)
+    tl = jnp.asarray(rng.integers(0, 1 << 32, size=(g, kp), dtype=np.uint32))
+    tr = jnp.asarray(rng.integers(0, 1 << 32, size=(g, kp), dtype=np.uint32))
+
+    S1, T1 = S, T
+    for i in range(g):
+        S1, T1 = _level_step(S1, T1, scw[i], tl[i], tr[i], "pallas_bm")
+
+    wt = min(W, ap._FWT)
+    So, To = ap.fused_levels_planes(
+        jnp.swapaxes(S, 1, 2), jnp.swapaxes(T, 0, 1), scw, tl, tr
+    )
+    So = ap.fused_deinterleave(So, g, wt)
+    To = ap.fused_deinterleave(To, g, wt)
+    np.testing.assert_array_equal(
+        np.asarray(jnp.swapaxes(So, 1, 2)), np.asarray(S1)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(jnp.swapaxes(To, 0, 1)), np.asarray(T1)
+    )
+
+
+@pytest.mark.slow
+def test_fused_kernel_matches_per_level():
+    """fused_levels_planes + deinterleave must reproduce g per-level
+    steps bit-for-bit on random bit-major state (interpret mode).  The
+    bitsliced-AES interpret compile is minutes, so the whole sweep lives
+    in the slow lane; tier-1 keeps the (cheap) ChaCha-twin kernel
+    differential below."""
+    _check_fused_kernel(2, 8, 2, seed=20)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("g,W,kp", [(3, 4, 1), (4, 2, 1)])
+def test_fused_kernel_matches_per_level_deep(g, W, kp):
+    _check_fused_kernel(g, W, kp, seed=10 * g)
+
+
+def test_fused_cc_kernel_matches_level_steps():
+    """The ChaCha twin at kernel level: fused_levels_raw + deinterleave
+    vs per-level _level_step_cc (cheap — no bitsliced cipher)."""
+    g, K, W = 2, 8, 4
+    rng = np.random.default_rng(60)
+    S = [
+        jnp.asarray(rng.integers(0, 1 << 32, size=(K, W), dtype=np.uint32))
+        for _ in range(4)
+    ]
+    T = jnp.asarray(rng.integers(0, 2, size=(K, W), dtype=np.uint32))
+    scw = rng.integers(0, 1 << 32, size=(K, g, 4), dtype=np.uint32)
+    scw[:, :, 0] &= ~np.uint32(1)  # word-0 LSB (the t bit) is 0 by Gen
+    tcw = rng.integers(0, 2, size=(K, g, 2), dtype=np.uint32)
+    fcw = rng.integers(0, 1 << 32, size=(K, 16), dtype=np.uint32)
+
+    S1, T1 = list(S), T
+    for i in range(g):
+        S1, T1 = dc._level_step_cc(
+            S1, T1,
+            [jnp.asarray(scw[:, i, w]) for w in range(4)],
+            jnp.asarray(tcw[:, i, 0]), jnp.asarray(tcw[:, i, 1]),
+        )
+
+    scw_p, tcw_p, _ = cp.cw_operands(scw, tcw, fcw, 0, g)
+    outs = cp.fused_levels_raw(*S, T, scw_p, tcw_p, g)
+    wt = min(cp._EWT, W)
+    outs = [np.asarray(cp.deinterleave_leaves(o, g, wt)) for o in outs]
+    for w in range(4):
+        np.testing.assert_array_equal(outs[w], np.asarray(S1[w]))
+    np.testing.assert_array_equal(outs[4], np.asarray(T1))
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: fused eval_full vs per-level vs the NumPy spec (-m slow)
+# ---------------------------------------------------------------------------
+
+
+def _check_compat_fused(log_n, K, g, seed):
+    rng = np.random.default_rng(seed)
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, kb = gen_batch(alphas, log_n, rng=rng)
+    got = eval_full(ka, backend="pallas_bm", fuse=g)
+    want = eval_full(ka, backend="pallas_bm", fuse=0)
+    np.testing.assert_array_equal(got, want)
+    w0 = np.frombuffer(spec.eval_full(ka.to_bytes()[0], log_n), np.uint8)
+    np.testing.assert_array_equal(got[0], w0)
+    rec = got ^ eval_full(kb, backend="pallas_bm", fuse=g)
+    bits = np.unpackbits(rec, axis=1, bitorder="little")
+    assert (bits.sum(axis=1) == 1).all()
+    assert (bits[np.arange(K), alphas.astype(np.int64)] == 1).all()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "log_n,g", [(16, 2), (17, 3), (18, 4)]
+)  # nu = 9/10/11 -> schedules (7,(2,)) / (7,(3,)) / (7,(4,))
+def test_eval_full_fused_matches_per_level_and_spec(log_n, g):
+    _check_compat_fused(log_n, 32, g, seed=20 + g)
+
+
+def _check_cc_fused(log_n, k, sched, seed):
+    rng = np.random.default_rng(seed)
+    alphas = rng.integers(0, 1 << log_n, size=k, dtype=np.uint64)
+    ka, kb = gen_batch_cc(alphas, log_n, rng=rng)
+    want = dc.eval_full(ka, backend="xla")
+
+    def fused(kx):
+        w = np.asarray(dc._eval_full_pallas_fused(kx, sched))
+        return np.ascontiguousarray(w).view("<u1").reshape(kx.k, -1)
+
+    got = fused(ka)
+    np.testing.assert_array_equal(got, want)
+    rec = got ^ fused(kb)
+    bits = np.unpackbits(rec, axis=1, bitorder="little")
+    assert (bits.sum(axis=1) == 1).all()
+    assert (bits[np.arange(k), alphas.astype(np.int64)] == 1).all()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "g,tail_cap,want_sched",
+    [
+        (2, 2, (7, (2, 2), 11)),
+        (3, 3, (7, (3,), 10)),
+        (4, 2, (7, (4,), 11)),
+    ],
+)
+def test_eval_full_fused_cc_matches_xla(g, tail_cap, want_sched):
+    # nu = 13 (log_n 22); tail_cap leaves mid levels for the fused groups
+    # ahead of the unchanged tail kernel.
+    sched = dc._fuse_schedule_cc(13, g, tail_cap=tail_cap)
+    assert sched == want_sched
+    _check_cc_fused(22, 2, sched, seed=30 + g)
+
+
+@pytest.mark.slow
+def test_eval_full_fused_cc_env_route(monkeypatch):
+    """The public env-routed chacha fused path (production defaults: floor
+    7, _EXP_LEVELS tail) through eval_full_device."""
+    monkeypatch.setattr(dc, "_FUSE_CC_BROKEN", False)
+    rng = np.random.default_rng(35)
+    log_n, k = 22, 2  # nu = 13 -> schedule (7, (1,), 8)
+    alphas = rng.integers(0, 1 << log_n, size=k, dtype=np.uint64)
+    ka, _ = gen_batch_cc(alphas, log_n, rng=rng)
+    want = dc.eval_full(ka, backend="xla")
+    got = dc.eval_full(ka, backend="pallas", fuse=2)
+    np.testing.assert_array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# Sticky-latch fallback semantics (mirrors the walk/small-tree latch tests)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fused_failure_latches_to_per_level(monkeypatch):
+    """An env-auto-routed fused failure must latch _FUSE_BROKEN and
+    degrade eval_full to the per-level pipeline with a warning; explicit
+    requests (fuse= / DPF_TPU_FUSE=<g>) re-raise.  The schedule is
+    monkeypatched to a shape no other test compiles, so the fused jit
+    must retrace and the synthetic failure actually fires.  Slow lane:
+    the per-level fallback compile is the cost; the same latch contract
+    is pinned in-lane by the (cheap) ChaCha twin below."""
+    import dpf_tpu.ops as ops
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic lowering failure")
+
+    monkeypatch.setattr(ap, "fused_levels_planes", boom)
+    monkeypatch.setattr(mdpf, "_FUSE_BROKEN", False)
+    monkeypatch.delenv("DPF_TPU_FUSE", raising=False)
+    monkeypatch.setattr(ops, "fuse_request", lambda auto_g=0: 2)
+    monkeypatch.setattr(
+        mdpf, "_fuse_schedule",
+        lambda n_levels, g, floor=7: (2, (2, 2)) if g > 0 else None,
+    )
+    rng = np.random.default_rng(40)
+    log_n, K = 13, 64  # nu = 6; same shapes as the test_aes_pallas suite
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, _ = gen_batch(alphas, log_n, rng=rng)
+    want = eval_full(ka, backend="pallas_bm", fuse=0)
+    with pytest.warns(RuntimeWarning, match="fused expansion unavailable"):
+        got = eval_full(ka, backend="pallas_bm")  # env-auto routing
+    np.testing.assert_array_equal(got, want)
+    assert mdpf._FUSE_BROKEN
+    # Latched: subsequent env-routed calls skip fused without re-attempting
+    # (boom would raise again if the route were re-tried).
+    np.testing.assert_array_equal(eval_full(ka, backend="pallas_bm"), want)
+    # Explicit fuse= request must see the raw failure, latch or no latch.
+    with pytest.raises(RuntimeError, match="synthetic lowering failure"):
+        eval_full(ka, backend="pallas_bm", fuse=2)
+
+
+def test_fused_cc_failure_latches_to_classic(monkeypatch):
+    import dpf_tpu.ops as ops
+
+    def boom(*a, **k):
+        raise RuntimeError("synthetic lowering failure")
+
+    monkeypatch.setattr(dc, "_eval_full_pallas_fused", boom)
+    monkeypatch.setattr(dc, "_FUSE_CC_BROKEN", False)
+    monkeypatch.delenv("DPF_TPU_FUSE", raising=False)
+    monkeypatch.setattr(ops, "fuse_request", lambda auto_g=0: 2)
+    # A schedule for a tree the real planner would leave to the classic
+    # route (nu = 7), so the fallback compile is the cheap convert-only
+    # tail at shapes test_chacha_pallas already exercises.
+    monkeypatch.setattr(
+        dc, "_fuse_schedule_cc",
+        lambda nu, g, floor=7, tail_cap=None: (2, (2,), 4) if g > 0 else None,
+    )
+    rng = np.random.default_rng(41)
+    log_n, k = 16, 3  # nu = 7: classic entry 7, zero fused tail levels
+    alphas = rng.integers(0, 1 << log_n, size=k, dtype=np.uint64)
+    ka, _ = gen_batch_cc(alphas, log_n, rng=rng)
+    want = dc.eval_full(ka, backend="pallas", fuse=0)
+    with pytest.warns(RuntimeWarning, match="fused fast-profile expansion"):
+        got = dc.eval_full(ka, backend="pallas")
+    np.testing.assert_array_equal(got, want)
+    assert dc._FUSE_CC_BROKEN
+    np.testing.assert_array_equal(dc.eval_full(ka, backend="pallas"), want)
+    with pytest.raises(RuntimeError, match="synthetic lowering failure"):
+        dc.eval_full(ka, backend="pallas", fuse=2)
+
+
+# ---------------------------------------------------------------------------
+# PIR threading: the fused schedule through the selection-vector pipeline
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_pir_single_fused_matches_per_level():
+    from dpf_tpu.models.dpf import DeviceKeys
+    from dpf_tpu.models.pir import (
+        PirServer,
+        _pir_single,
+        pir_query,
+        pir_reconstruct,
+    )
+
+    rng = np.random.default_rng(50)
+    n_rows, row_bytes = 1 << 16, 16  # log_n = 16 -> nu = 9
+    db = rng.integers(0, 256, size=(n_rows, row_bytes), dtype=np.uint8)
+    srv = PirServer(db, chunk_rows=1 << 12)
+    idx = np.array([5, 47777], np.uint64)
+    ka, kb = pir_query(idx, n_rows, rng=rng)
+    sched = mdpf._fuse_schedule(srv.nu, 2)
+    n_chunks = srv.dom // srv.chunk_rows
+    dk = DeviceKeys(ka)
+    args = (
+        dk.seed_planes, dk.t_words, dk.scw_planes,
+        dk.tl_words, dk.tr_words, dk.fcw_planes, srv.db_words,
+    )
+    plain = np.asarray(
+        _pir_single(dk.nu, srv.chunk_rows, n_chunks, "pallas_bm")(*args)
+    )
+    fused = np.asarray(
+        _pir_single(dk.nu, srv.chunk_rows, n_chunks, "pallas_bm", sched)(
+            *args
+        )
+    )
+    np.testing.assert_array_equal(fused, plain)
+    # And the protocol still reconstructs through the public answer() path.
+    ans_a, ans_b = srv.answer(ka), srv.answer(kb)
+    rows = pir_reconstruct(ans_a, ans_b)
+    np.testing.assert_array_equal(rows, db[idx.astype(np.int64)])
