@@ -443,6 +443,9 @@ def main() -> None:
                 "vs_baseline": round(fast / baseline, 2),
                 "aes_compat_gleaves": round(compat / 1e9, 3),
                 "aes_compat_vs_baseline": round(compat / baseline, 2),
+                # Result payload per expansion call (already bit-packed —
+                # EvalFull output is 1 bit/leaf by construction).
+                "bytes_out": K * (1 << LOG_N) // 8,
                 "route": _routes(),
             }
         )

@@ -23,6 +23,15 @@ here).  Timing methods:
 the real TPU matrix (config 4 holds a 512 MB database plus ~2 GB of leaf
 selection words in HBM).
 
+Row anchoring: every pointwise/PIR/FSS row carries a live ``vs_baseline``
+measured against the native single-core batch entries
+(native/dpf_native.cc dpfn_[cc_|dcf_]eval_points_batch, or EvalFull + host
+XOR for PIR) in the row's own units, and a ``bytes_out`` stamp (the result
+payload a client receives).  The serving-shaped configs 3/5 additionally
+measure the PACKED output route (``packed`` in the metric name and route
+stamp): same computation, bit-packed D2H/wire — ``bytes_out`` drops 8x,
+which on a link-bound dispatch path is the throughput headline.
+
 Failure containment: each config section runs inside ``_section`` — an
 exception (the likely first-hardware-run mode: Mosaic rejecting a
 never-compiled kernel) emits an ``"error"`` row and the matrix CONTINUES;
@@ -77,6 +86,9 @@ _TRANSIENT_SIGS = (
 _ROUTE_KNOBS = (
     "DPF_TPU_SBOX", "DPF_TPU_PRG", "DPF_TPU_POINTS_AES", "DPF_TPU_POINTS",
     "DPF_TPU_EXPAND_ENTRY", "DPF_TPU_FAST", "DPF_TPU_FUSE", "JAX_PLATFORMS",
+    # Output-format knob: packed vs byte-per-bit rows must never collide
+    # on a ledger resume.
+    "DPF_TPU_WIRE_FORMAT",
 )
 # DPF_TPU_BENCH_LEDGER_RETRY_ERRORS=1: sections whose recorded rows
 # contain an error row are NOT replayed (and not re-recorded) — the
@@ -252,13 +264,90 @@ def _skipped(name: str, why: str) -> None:
     )
 
 
-def _emit(name, value, unit, baseline=None, route=None):
+def _emit(name, value, unit, baseline=None, route=None, scale=1e9,
+          bytes_out=None):
+    """One scoreboard row.  ``baseline`` is in base units/sec and ``scale``
+    converts ``value``'s unit to base units (1e9 for Gleaves rows, 1e6 for
+    Mqueries/Mgate rows, 1 for queries/sec) so every row's ``vs_baseline``
+    is a live like-for-like ratio.  ``bytes_out`` stamps the row's result
+    payload (D2H / wire bytes a client of this call receives) — the packed
+    rows' whole point is this number dropping 8x at equal correctness."""
     row = {"metric": name, "value": round(value, 3), "unit": unit}
     if route:
         row["route"] = route
+    if bytes_out is not None:
+        row["bytes_out"] = int(bytes_out)
     if baseline:
-        row["vs_baseline"] = round(value * 1e9 / baseline, 2)
+        row["vs_baseline"] = round(value * scale / baseline, 2)
     _out(row)
+
+
+def _native_points_rate(kind: str, log_n: int, q: int, keys_n: int = 8):
+    """Single-core native pointwise walk rate (queries/sec) — the live
+    vs_baseline anchor for the serving-shaped configs 3/5, measured from
+    the SAME batch entries the packed/unpacked A-B compares like-for-like
+    bytes against (native/dpf_native.cc dpfn_[cc_|dcf_]eval_points_batch).
+    Sub-sampled (keys_n x q) with best-of timing, same discipline as
+    measure_baseline; None when the native backend is unavailable (rows
+    then omit vs_baseline rather than fake it)."""
+    try:
+        from dpf_tpu.backends import cpu_native as cn
+
+        if not cn.available():
+            return None
+        rngb = np.random.default_rng(12)
+        gen, ev = {
+            "compat": (cn.gen, cn.eval_points_batch),
+            "fast": (cn.cc_gen, cn.cc_eval_points_batch),
+            "dcf": (cn.dcf_gen, cn.dcf_eval_points_batch),
+        }[kind]
+        keys = [
+            gen(int(a), log_n, rng=rngb)[0]
+            for a in rngb.integers(0, 1 << log_n, size=keys_n, dtype=np.uint64)
+        ]
+        xsb = rngb.integers(0, 1 << log_n, size=(keys_n, q), dtype=np.uint64)
+        ev(keys[:2], xsb[:2], log_n)  # warm
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            ev(keys, xsb, log_n)
+            best = min(best, time.perf_counter() - t0)
+        return keys_n * q / best
+    except Exception:  # noqa: BLE001 — baseline is best-effort
+        return None
+
+
+def _native_pir_rate(db: np.ndarray, log_n: int, nq: int = 2):
+    """Single-core 2-server-PIR baseline (queries/sec): native fast-profile
+    EvalFull per query + XOR of the selected rows on the host — what one
+    CPU core does with the identical keys and database.  Sub-sampled to
+    ``nq`` queries (each query scans the full DB)."""
+    try:
+        from dpf_tpu.backends import cpu_native as cn
+
+        if not cn.available():
+            return None
+        rngb = np.random.default_rng(13)
+        nrows = db.shape[0]
+        dbw = np.ascontiguousarray(db).view("<u8")  # XOR in 8-byte lanes
+        alphas = rngb.integers(0, nrows, size=nq, dtype=np.uint64)
+        keys = [cn.cc_gen(int(a), log_n, rng=rngb)[0] for a in alphas]
+
+        def one(key):
+            sel = np.frombuffer(cn.cc_eval_full(key, log_n), np.uint8)
+            bits = np.unpackbits(sel, bitorder="little")[:nrows]
+            return np.bitwise_xor.reduce(dbw[bits.astype(bool)], axis=0)
+
+        one(keys[0])  # warm
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for kx in keys:
+                one(kx)
+            best = min(best, time.perf_counter() - t0)
+        return nq / best
+    except Exception:  # noqa: BLE001
+        return None
 
 
 _ONLY = [s for s in os.environ.get("DPF_TPU_BENCH_ONLY", "").split(",") if s]
@@ -400,7 +489,8 @@ def main():
                             stat="median")
         _emit(f"1-key eval_full n={n1} (fast)", (1 << n1) / dt / 1e9,
               "Gleaves/sec", baseline,
-              route=_route("pallas-expand" if use_kernel1 else "xla-levels"))
+              route=_route("pallas-expand" if use_kernel1 else "xla-levels"),
+              bytes_out=(1 << n1) // 8)
 
     _section("cfg1-fast-n16", cfg1_fast)
 
@@ -440,7 +530,8 @@ def main():
                             stat="median")
         _emit(f"1-key eval_full n={n1b} (fast)", (1 << n1b) / dt / 1e9,
               "Gleaves/sec", baseline,
-              route=_route("pallas-expand" if use_k28 else "xla-levels"))
+              route=_route("pallas-expand" if use_k28 else "xla-levels"),
+              bytes_out=(1 << n1b) // 8)
 
     _section("cfg1b-fast-n28", cfg1b_fast)
 
@@ -519,7 +610,8 @@ def main():
                   f"{bk28}{'-chunked' if c28 else ''}",
                   sbox=bk28.startswith("pallas"),
                   fuse=not c28,  # chunked path keeps per-level steps
-              ))
+              ),
+              bytes_out=(1 << n1b) // 8)
 
     _section("cfg1b-compat-n28", cfg1b_compat)
 
@@ -567,7 +659,8 @@ def main():
                             repeats=5, stat="median")
         _emit(f"{k28f}-key eval_full n={n1b} (fast, chunked kernel)",
               k28f * (1 << n1b) / dt / 1e9, "Gleaves/sec", baseline,
-              route=_route("pallas-expand-chunked"))
+              route=_route("pallas-expand-chunked"),
+              bytes_out=k28f * (1 << n1b) // 8)
 
     _section("cfg1b-fast-chunked", cfg1b_fast_chunked)
 
@@ -592,19 +685,21 @@ def main():
             dt = _marginal_time(chained2(1), chained2(3), a2, 3)
             _emit(f"{k2}-key eval_full n={n2} (fast)",
                   k2 * (1 << n2) / dt / 1e9, "Gleaves/sec", baseline,
-                  route=_route("xla-levels"))
+                  route=_route("xla-levels"), bytes_out=k2 * (1 << n2) // 8)
         else:
             # Same code as bench.py so scoreboard and matrix can't diverge.
             fast2 = bench_fast(jax, jnp, np.random.default_rng(2026))
             _emit("1024-key eval_full n=20 (fast)", fast2 / 1e9,
                   "Gleaves/sec", baseline,
-                  route=_route(f"bench.py:{cp.expand_backend()}"))
+                  route=_route(f"bench.py:{cp.expand_backend()}"),
+                  bytes_out=1024 * (1 << 20) // 8)
             compat2 = bench_compat(jax, jnp, np.random.default_rng(2026))
             bk2 = compat_backend()
             _emit("1024-key eval_full n=20 (compat)", compat2 / 1e9,
                   "Gleaves/sec", baseline,
                   route=_route(f"bench.py:{bk2}",
-                               sbox=bk2.startswith("pallas"), fuse=True))
+                               sbox=bk2.startswith("pallas"), fuse=True),
+                  bytes_out=1024 * (1 << 20) // 8)
 
     _section("cfg2-headline", cfg2)
 
@@ -613,11 +708,25 @@ def main():
         kap, _ = kc.gen_batch(
             rng.integers(0, 1 << n3, size=k3, dtype=np.uint64), n3, rng=rng
         )
+        base3f = _native_points_rate("fast", n3, min(q3, 1024))
         dt = _timed_host_call(lambda: fast_points(kap, xs))
         use_wk = _use_walk_kernel(k3)
         _emit(f"pointwise eval n={n3} {k3}x{q3} (fast, incl. dispatch)",
               k3 * q3 / dt / 1e6, "Mqueries/sec",
+              baseline=base3f, scale=1e6, bytes_out=k3 * q3,
               route=_route("pallas-walk" if use_wk else "xla-walk"))
+
+        # Packed-route row: the same call returning bit-packed words —
+        # 8x fewer wire bytes (32x less D2H than uint8), measured
+        # dispatch-inclusive so the link-bound win is visible.
+        dtp = _timed_host_call(lambda: fast_points(kap, xs, packed=True))
+        _emit(f"pointwise eval n={n3} {k3}x{q3} (fast, packed, incl. dispatch)",
+              k3 * q3 / dtp / 1e6, "Mqueries/sec",
+              baseline=base3f, scale=1e6,
+              bytes_out=k3 * ((q3 + 7) // 8),
+              route=_route(
+                  ("pallas-walk" if use_wk else "xla-walk") + ",packed"
+              ))
 
         # Device row: chain R walks in one compiled function, the output bits
         # feeding the next round's query (bit-0 flip keeps the index in
@@ -668,6 +777,7 @@ def main():
                             stat="median")
         _emit(f"pointwise eval n={n3} {k3}x{q3} (fast, device)",
               k3 * q3 / dt / 1e6, "Mqueries/sec",
+              baseline=base3f, scale=1e6,
               route=_route("pallas-walk" if use_wk else "xla-walk"))
 
     _section("cfg3-fast", cfg3_fast)
@@ -676,14 +786,31 @@ def main():
         kac3, _ = gen_compat(
             rng.integers(0, 1 << n3, size=k3, dtype=np.uint64), n3, rng=rng
         )
+        base3c = _native_points_rate("compat", n3, min(q3, 1024))
         dt = _timed_host_call(lambda: compat_points(kac3, xs))
         # Read AFTER the host call: a Mosaic failure in it latches the
         # kernel off, and both the label and the device row must follow.
         use_aes_walk = _compat_walk_eligible(k3)
         _emit(f"pointwise eval n={n3} {k3}x{q3} (compat, incl. dispatch)",
               k3 * q3 / dt / 1e6, "Mqueries/sec",
+              baseline=base3c, scale=1e6, bytes_out=k3 * q3,
               route=_route(
                   "aes-walk-kernel" if use_aes_walk else "xla-aes-walk",
+                  sbox=use_aes_walk,
+              ))
+
+        # Packed-route row (the walk kernel's packed words are its native
+        # output — the unpacked row above pays an extra unpack + 8x bytes).
+        dtp = _timed_host_call(lambda: compat_points(kac3, xs, packed=True))
+        use_aes_walk = _compat_walk_eligible(k3)
+        _emit(f"pointwise eval n={n3} {k3}x{q3} "
+              "(compat, packed, incl. dispatch)",
+              k3 * q3 / dtp / 1e6, "Mqueries/sec",
+              baseline=base3c, scale=1e6,
+              bytes_out=k3 * ((q3 + 7) // 8),
+              route=_route(
+                  ("aes-walk-kernel" if use_aes_walk else "xla-aes-walk")
+                  + ",packed",
                   sbox=use_aes_walk,
               ))
 
@@ -722,6 +849,7 @@ def main():
                             stat="median")
         _emit(f"pointwise eval n={n3} {k3}x{q3} (compat, device)",
               k3 * q3 / dt / 1e6, "Mqueries/sec",
+              baseline=base3c, scale=1e6,
               route=_route(
                   "aes-walk-kernel" if use_aes_walk else f"xla-{bk3}",
                   sbox=use_aes_walk,
@@ -736,6 +864,7 @@ def main():
         idx = rng.integers(0, nrows, size=nq, dtype=np.uint64)
         qa, qb = pir_query(idx, nrows, rng=rng, profile="fast")
         srv = PirServer(db, profile="fast")
+        base4 = _native_pir_rate(db, srv.log_n)
         ans_a = []  # capture the last timed answer — a full 512 MB-DB pass
         dt = _timed_host_call(lambda: ans_a.append(srv.answer(qa)))
         rows = pir_reconstruct(ans_a[-1], srv.answer(qb))
@@ -743,6 +872,7 @@ def main():
         _emit(
             f"2-server PIR {nrows}x{rb}B, {nq} queries (fast, incl. dispatch)",
             nq / dt, "queries/sec",
+            baseline=base4, scale=1, bytes_out=nq * rb,
             route=_route("expand+parity-matmul"),
         )
 
@@ -772,6 +902,7 @@ def main():
                             stat="median")
         _emit(f"2-server PIR {nrows}x{rb}B, {nq} queries (fast, device)",
               nq / dt, "queries/sec",
+              baseline=base4, scale=1, bytes_out=nq * rb,
               route=_route("expand+parity-matmul"))
 
     _section("cfg4-pir", cfg4)
@@ -782,13 +913,31 @@ def main():
             rng.integers(0, 1 << n5, size=g5, dtype=np.uint64), n5, rng=rng,
             profile="fast",
         )
+        # Native per-level gate baseline: one CPU gate-eval = n5 DPF walks.
+        b5f = _native_points_rate("fast", n5, q5)
+        base5f = b5f / n5 if b5f else None
         dt = _timed_host_call(lambda: eval_lt_points(ca, xs5))
         k5 = ca.levels.k
         use_wk5 = _use_walk_kernel(k5)
         _emit(
             f"FSS lt-gate n={n5} {g5} gates x {q5} pts (fast, incl. dispatch)",
             g5 * q5 / dt / 1e6, "Mgate-evals/sec",
+            baseline=base5f, scale=1e6, bytes_out=g5 * q5,
             route=_route("pallas-walk" if use_wk5 else "xla-walk"),
+        )
+
+        # Packed-route row: gate shares leave the device (and would cross
+        # the wire) bit-packed — q5=32 pts/gate collapse to 4 bytes.
+        dtp = _timed_host_call(lambda: eval_lt_points(ca, xs5, packed=True))
+        _emit(
+            f"FSS lt-gate n={n5} {g5} gates x {q5} pts "
+            "(fast, packed, incl. dispatch)",
+            g5 * q5 / dtp / 1e6, "Mgate-evals/sec",
+            baseline=base5f, scale=1e6,
+            bytes_out=g5 * ((q5 + 7) // 8),
+            route=_route(
+                ("pallas-walk" if use_wk5 else "xla-walk") + ",packed"
+            ),
         )
 
         # Device row: the level-grouped walk + on-device gate XOR-fold.
@@ -849,6 +998,7 @@ def main():
                             stat="median")
         _emit(f"FSS lt-gate n={n5} {g5} gates x {q5} pts (fast, device)",
               g5 * q5 / dt / 1e6, "Mgate-evals/sec",
+              baseline=base5f, scale=1e6,
               route=_route("pallas-walk" if use_wk5 else "xla-walk"))
 
     _section("cfg5-fast", cfg5_fast)
@@ -866,6 +1016,8 @@ def main():
         )
         xs5c = xs5[:g5c]
         kc5 = cac.levels.k
+        b5c = _native_points_rate("compat", n5, q5)
+        base5c = b5c / n5 if b5c else None
         dt = _timed_host_call(lambda: grouped_compat(
             cac.levels, xs5c, groups=1, reduce=True
         ))
@@ -875,8 +1027,28 @@ def main():
             f"FSS lt-gate n={n5} {g5c} gates x {q5} pts "
             "(compat, incl. dispatch)",
             g5c * q5 / dt / 1e6, "Mgate-evals/sec",
+            baseline=base5c, scale=1e6, bytes_out=g5c * q5,
             route=_route(
                 "aes-walk-kernel" if use_aes_walk5 else "xla-aes-walk",
+                sbox=use_aes_walk5,
+            ),
+        )
+
+        # Packed-route row (device pack on the grouped walk; the gate
+        # shares cross the link at ceil(q5/8) bytes per gate).
+        dtp = _timed_host_call(lambda: grouped_compat(
+            cac.levels, xs5c, groups=1, reduce=True, packed=True
+        ))
+        use_aes_walk5 = _compat_walk_eligible(kc5)
+        _emit(
+            f"FSS lt-gate n={n5} {g5c} gates x {q5} pts "
+            "(compat, packed, incl. dispatch)",
+            g5c * q5 / dtp / 1e6, "Mgate-evals/sec",
+            baseline=base5c, scale=1e6,
+            bytes_out=g5c * ((q5 + 7) // 8),
+            route=_route(
+                ("aes-walk-kernel" if use_aes_walk5 else "xla-aes-walk")
+                + ",packed",
                 sbox=use_aes_walk5,
             ),
         )
@@ -914,6 +1086,7 @@ def main():
             _emit(f"FSS lt-gate n={n5} {g5c} gates x {q5} pts "
                   "(compat, device)",
                   g5c * q5 / dt / 1e6, "Mgate-evals/sec",
+                  baseline=base5c, scale=1e6,
                   route=_route("aes-walk-kernel", sbox=True))
 
     if not small:
@@ -928,13 +1101,31 @@ def main():
         da, _db = dcf_mod.gen_lt_batch(
             rng.integers(0, 1 << n5, size=g5, dtype=np.uint64), n5, rng=rng
         )
+        base5d = _native_points_rate("dcf", n5, q5)
         use_dcf_kernel = dcf_mod.points_kernel_eligible(da.k)
         dt = _timed_host_call(lambda: dcf_mod.eval_lt_points(da, xs5))
         _emit(
             f"FSS lt-gate n={n5} {g5} gates x {q5} pts (DCF, incl. dispatch)",
             g5 * q5 / dt / 1e6, "Mgate-evals/sec",
+            baseline=base5d, scale=1e6, bytes_out=g5 * q5,
             route=_route(
                 "pallas-dcf-walk" if use_dcf_kernel else "xla-dcf-walk"
+            ),
+        )
+
+        # Packed-route row (DCF shares leave the device bit-packed).
+        dtp = _timed_host_call(
+            lambda: dcf_mod.eval_lt_points(da, xs5, packed=True)
+        )
+        _emit(
+            f"FSS lt-gate n={n5} {g5} gates x {q5} pts "
+            "(DCF, packed, incl. dispatch)",
+            g5 * q5 / dtp / 1e6, "Mgate-evals/sec",
+            baseline=base5d, scale=1e6,
+            bytes_out=g5 * ((q5 + 7) // 8),
+            route=_route(
+                ("pallas-dcf-walk" if use_dcf_kernel else "xla-dcf-walk")
+                + ",packed"
             ),
         )
 
@@ -988,6 +1179,7 @@ def main():
                             stat="median")
         _emit(f"FSS lt-gate n={n5} {g5} gates x {q5} pts (DCF, device)",
               g5 * q5 / dt / 1e6, "Mgate-evals/sec",
+              baseline=base5d, scale=1e6,
               route=_route(
                   "pallas-dcf-walk" if use_dcf_kernel else "xla-dcf-walk"
               ))
@@ -1004,6 +1196,9 @@ def main():
         width = rng.integers(0, 1 << 30, size=g5, dtype=np.uint64)
         hi5 = np.minimum(lo5 + width, np.uint64((1 << n5) - 1))
         ia, _ib = dcf_mod.gen_interval_batch(lo5, hi5, n5, rng=rng)
+        # Native anchor: one interval gate-eval = two DCF walks.
+        b5i = _native_points_rate("dcf", n5, q5)
+        base5i = b5i / 2 if b5i else None
         # The fused interval batch holds 2K keys (upper+lower halves).
         use_dcf_kernel = dcf_mod.points_kernel_eligible(2 * g5)
         dt = _timed_host_call(
@@ -1013,6 +1208,7 @@ def main():
             f"FSS interval-gate n={n5} {g5} gates x {q5} pts "
             "(DCF, incl. dispatch)",
             g5 * q5 / dt / 1e6, "Mgate-evals/sec",
+            baseline=base5i, scale=1e6, bytes_out=g5 * q5,
             route=_route(
                 "pallas-dcf-walk" if use_dcf_kernel else "xla-dcf-walk"
             ),

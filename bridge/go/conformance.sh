@@ -1,0 +1,35 @@
+#!/bin/sh
+# One-command sidecar conformance run: start the sidecar, run the Go
+# conformance suite (dpftpu/client_test.go — Gen/Eval/EvalFull XOR
+# reconstruction, frozen golden vectors, packed + unpacked wire formats),
+# tear the sidecar down.  Needs Go >= 1.21 and a Python env with dpf_tpu
+# importable (run from anywhere; paths are script-relative).
+#
+#   ./conformance.sh            # ephemeral sidecar on port 8993
+#   PORT=9000 ./conformance.sh  # pick the port
+#   DPFTPU_URL=http://host:8990 go test ./dpftpu -run Conformance -v
+#                               # against an already-running sidecar
+set -e
+cd "$(dirname "$0")"
+PORT="${PORT:-8993}"
+
+PYTHONPATH="$(cd ../.. && pwd)" python -m dpf_tpu.server --port "$PORT" &
+SIDECAR=$!
+trap 'kill "$SIDECAR" 2>/dev/null || true' EXIT INT TERM
+
+# Wait for /healthz (the first import of jax takes a few seconds).  A
+# sidecar that never comes up must FAIL the run — the Go tests skip
+# without a reachable sidecar, which would otherwise turn a dead server
+# into a green "conformance" result.
+for _ in $(seq 1 60); do
+  if curl -sf "http://127.0.0.1:$PORT/healthz" >/dev/null 2>&1; then
+    break
+  fi
+  sleep 1
+done
+curl -sf "http://127.0.0.1:$PORT/healthz" >/dev/null 2>&1 || {
+  echo "conformance.sh: sidecar never became healthy on :$PORT" >&2
+  exit 1
+}
+
+DPFTPU_URL="http://127.0.0.1:$PORT" go test ./dpftpu -run Conformance -v

@@ -107,6 +107,32 @@ func (c *Client) EvalFull(k DPFkey, logN uint) ([]byte, error) {
 	return c.post(fmt.Sprintf("/v1/evalfull?log_n=%d", logN), k)
 }
 
+// pointsBody serializes K keys plus their K*Q little-endian query indices
+// (the shared request body of the points endpoints).
+func pointsBody(keys []DPFkey, xs [][]uint64) ([]byte, int, error) {
+	if len(xs) != len(keys) {
+		return nil, 0, fmt.Errorf("dpftpu: xs rows != key count")
+	}
+	kl := len(keys[0])
+	nq := len(xs[0])
+	body := make([]byte, 0, kl*len(keys)+8*nq*len(keys))
+	for _, k := range keys {
+		if len(k) != kl {
+			return nil, 0, fmt.Errorf("dpftpu: inconsistent key lengths")
+		}
+		body = append(body, k...)
+	}
+	for _, row := range xs {
+		if len(row) != nq {
+			return nil, 0, fmt.Errorf("dpftpu: inconsistent query row lengths")
+		}
+		for _, x := range row {
+			body = binary.LittleEndian.AppendUint64(body, x)
+		}
+	}
+	return body, nq, nil
+}
+
 // EvalPointsBatch evaluates K shares at Q points each in one round trip:
 // xs[i] holds key i's Q query indices; the reply bit [i][j] is
 // Eval(keys[i], xs[i][j]).  All keys must have the same logN and every
@@ -115,25 +141,9 @@ func (c *Client) EvalPointsBatch(keys []DPFkey, xs [][]uint64, logN uint) ([][]b
 	if len(keys) == 0 {
 		return nil, nil
 	}
-	if len(xs) != len(keys) {
-		return nil, fmt.Errorf("dpftpu: xs rows != key count")
-	}
-	kl := len(keys[0])
-	nq := len(xs[0])
-	body := make([]byte, 0, kl*len(keys)+8*nq*len(keys))
-	for _, k := range keys {
-		if len(k) != kl {
-			return nil, fmt.Errorf("dpftpu: inconsistent key lengths")
-		}
-		body = append(body, k...)
-	}
-	for _, row := range xs {
-		if len(row) != nq {
-			return nil, fmt.Errorf("dpftpu: inconsistent query row lengths")
-		}
-		for _, x := range row {
-			body = binary.LittleEndian.AppendUint64(body, x)
-		}
+	body, nq, err := pointsBody(keys, xs)
+	if err != nil {
+		return nil, err
 	}
 	out, err := c.post(fmt.Sprintf(
 		"/v1/eval_points_batch?log_n=%d&k=%d&q=%d", logN, len(keys), nq), body)
@@ -148,6 +158,49 @@ func (c *Client) EvalPointsBatch(keys []DPFkey, xs [][]uint64, logN uint) ([][]b
 		res[i] = out[i*nq : (i+1)*nq]
 	}
 	return res, nil
+}
+
+// EvalPointsBatchPacked is EvalPointsBatch over the bit-packed wire format
+// (format=packed): each reply row is ceil(Q/8) bytes with query j at byte
+// j/8, bit j%8 — LSB-first, the same convention as EvalFull's output and
+// the reference's (dpf/dpf.go:207-209); bits beyond Q are zero.  The
+// response is 8x smaller than the byte-per-bit format — on a link-bound
+// serving path that is an 8x throughput difference.  Unpack rows with
+// UnpackBits, or XOR two parties' packed rows directly (reconstruction
+// commutes with the packing).
+func (c *Client) EvalPointsBatchPacked(keys []DPFkey, xs [][]uint64, logN uint) ([][]byte, error) {
+	if len(keys) == 0 {
+		return nil, nil
+	}
+	body, nq, err := pointsBody(keys, xs)
+	if err != nil {
+		return nil, err
+	}
+	out, err := c.post(fmt.Sprintf(
+		"/v1/eval_points_batch?log_n=%d&k=%d&q=%d&format=packed",
+		logN, len(keys), nq), body)
+	if err != nil {
+		return nil, err
+	}
+	row := (nq + 7) / 8
+	if len(out) != len(keys)*row {
+		return nil, fmt.Errorf("dpftpu: bad packed reply length %d", len(out))
+	}
+	res := make([][]byte, len(keys))
+	for i := range keys {
+		res[i] = out[i*row : (i+1)*row]
+	}
+	return res, nil
+}
+
+// UnpackBits expands a packed row (LSB-first, the packed wire format) to
+// q bytes of 0/1 bits — the inverse of the server-side packing.
+func UnpackBits(row []byte, q int) []byte {
+	bits := make([]byte, q)
+	for j := 0; j < q; j++ {
+		bits[j] = (row[j>>3] >> (j & 7)) & 1
+	}
+	return bits
 }
 
 // DcfGen generates K one-key-per-gate comparison key pairs: evaluating a

@@ -1,0 +1,207 @@
+// Sidecar conformance test, shaped like the reference's own test file
+// (dpf/dpf_test.go: Gen, then Eval/EvalFull XOR reconstruction over the
+// domain) but run THROUGH the bridge: every byte crosses the sidecar's
+// wire, so a pass pins the whole client -> HTTP -> evaluator -> wire-format
+// stack, in both the byte-per-bit and the bit-packed response formats.
+//
+// The sidecar must be reachable (default http://127.0.0.1:8990, override
+// with DPFTPU_URL); otherwise the test skips — this repo's build image has
+// no Go toolchain, so the one-command run lives in ../conformance.sh and
+// is documented in ../README.md.
+package dpftpu
+
+import (
+	"crypto/sha256"
+	"encoding/hex"
+	"net/http"
+	"os"
+	"testing"
+	"time"
+)
+
+// Frozen golden vector (generated once from the line-verified NumPy spec,
+// seed 2026; second-sourced by the C++ native backend — the same pinning
+// discipline as tests/test_golden_vectors.py).  The key bytes are the
+// reference's serialization layout (dpf/dpf.go:89-92,111-112,165); the
+// EvalFull digest pins the bit-packed output bytes (LSB-first,
+// dpf/dpf.go:207-209).
+const (
+	goldenLogN     = 10
+	goldenAlpha    = 619
+	goldenKeyAHex  = "aaf912da04acce2dbf4cc3066759d1a300328e3198ef5a8188201531c5adb3726000018a70fc6937aed86c13f12d248b1bf44f000102487fd25ee2250614dc530ded5d957c0100dee5170000d98dcf94089551f5b90ddc"
+	goldenKeyBHex  = "eaf18f5de5e69e77739c6f145f1fd95e01328e3198ef5a8188201531c5adb3726000018a70fc6937aed86c13f12d248b1bf44f000102487fd25ee2250614dc530ded5d957c0100dee5170000d98dcf94089551f5b90ddc"
+	goldenOutASha  = "09bfd0344ab07ea01e1451c79cd643621dc33a9a5b8f16da73627623608270b2"
+	goldenOutBSha  = "d752a3df0b7207f2bc609a47256db655db2d6be0c97443e29f729c99b2b53652"
+)
+
+func conformanceClient(t *testing.T) *Client {
+	t.Helper()
+	base := os.Getenv("DPFTPU_URL")
+	if base == "" {
+		base = "http://127.0.0.1:8990"
+	}
+	hc := &http.Client{Timeout: 2 * time.Second}
+	resp, err := hc.Get(base + "/healthz")
+	if err != nil {
+		t.Skipf("sidecar not reachable at %s (start it or set DPFTPU_URL): %v",
+			base, err)
+	}
+	resp.Body.Close()
+	return New(base)
+}
+
+// TestConformanceGenEval mirrors the reference's Gen/Eval usage: a fresh
+// key pair's point evaluations must XOR to the indicator of alpha.
+func TestConformanceGenEval(t *testing.T) {
+	c := conformanceClient(t)
+	const logN, alpha = 10, 123
+	ka, kb, err := c.Gen(alpha, logN)
+	if err != nil {
+		t.Fatal(err)
+	}
+	for _, x := range []uint64{alpha, alpha - 1, alpha + 1, 0, (1 << logN) - 1} {
+		ba, err := c.Eval(ka, x, logN)
+		if err != nil {
+			t.Fatal(err)
+		}
+		bb, err := c.Eval(kb, x, logN)
+		if err != nil {
+			t.Fatal(err)
+		}
+		want := byte(0)
+		if x == alpha {
+			want = 1
+		}
+		if ba^bb != want {
+			t.Fatalf("Eval reconstruction at x=%d: %d ^ %d != %d", x, ba, bb, want)
+		}
+	}
+}
+
+// TestConformanceEvalFull mirrors the reference's EvalFull test: the two
+// shares' full expansions XOR to exactly one set bit, at alpha, in the
+// LSB-first packed layout.
+func TestConformanceEvalFull(t *testing.T) {
+	c := conformanceClient(t)
+	const logN, alpha = 10, 777
+	ka, kb, err := c.Gen(alpha, logN)
+	if err != nil {
+		t.Fatal(err)
+	}
+	oa, err := c.EvalFull(ka, logN)
+	if err != nil {
+		t.Fatal(err)
+	}
+	ob, err := c.EvalFull(kb, logN)
+	if err != nil {
+		t.Fatal(err)
+	}
+	if len(oa) != (1<<logN)/8 {
+		t.Fatalf("EvalFull length %d != %d", len(oa), (1<<logN)/8)
+	}
+	ones := 0
+	for i := range oa {
+		rec := oa[i] ^ ob[i]
+		for b := 0; b < 8; b++ {
+			if rec>>b&1 == 1 {
+				ones++
+				if uint64(i*8+b) != alpha {
+					t.Fatalf("set bit at %d, want %d", i*8+b, alpha)
+				}
+			}
+		}
+	}
+	if ones != 1 {
+		t.Fatalf("reconstruction has %d set bits, want 1", ones)
+	}
+}
+
+// TestConformanceGoldenVectors pushes the frozen key bytes through the
+// sidecar and pins the returned output bytes — serialization AND
+// evaluation cannot drift without failing here.
+func TestConformanceGoldenVectors(t *testing.T) {
+	c := conformanceClient(t)
+	for _, v := range []struct{ keyHex, outSha string }{
+		{goldenKeyAHex, goldenOutASha},
+		{goldenKeyBHex, goldenOutBSha},
+	} {
+		key, err := hex.DecodeString(v.keyHex)
+		if err != nil {
+			t.Fatal(err)
+		}
+		out, err := c.EvalFull(DPFkey(key), goldenLogN)
+		if err != nil {
+			t.Fatal(err)
+		}
+		got := sha256.Sum256(out)
+		if hex.EncodeToString(got[:]) != v.outSha {
+			t.Fatalf("golden EvalFull digest drifted: %x", got)
+		}
+		bit, err := c.Eval(DPFkey(key), goldenAlpha, goldenLogN)
+		if err != nil {
+			t.Fatal(err)
+		}
+		if bit != out[goldenAlpha/8]>>(goldenAlpha%8)&1 {
+			t.Fatalf("Eval disagrees with EvalFull bit at alpha")
+		}
+	}
+}
+
+// TestConformancePointsPackedAndUnpacked pins the two response formats of
+// /v1/eval_points_batch against each other and against the wire contract:
+// the packed reply is exactly ceil(Q/8) bytes per key (8x smaller), and
+// unpacking it reproduces the byte-per-bit reply bit-for-bit.
+func TestConformancePointsPackedAndUnpacked(t *testing.T) {
+	c := conformanceClient(t)
+	const logN, alpha = 10, 321
+	const q = 37 // deliberately not a multiple of 8: tail bits must be zero
+	ka, kb, err := c.Gen(alpha, logN)
+	if err != nil {
+		t.Fatal(err)
+	}
+	keys := []DPFkey{ka, kb}
+	xs := make([][]uint64, len(keys))
+	for i := range xs {
+		xs[i] = make([]uint64, q)
+		for j := range xs[i] {
+			xs[i][j] = uint64((j * 53) % (1 << logN))
+		}
+		xs[i][0] = alpha
+	}
+	bits, err := c.EvalPointsBatch(keys, xs, logN)
+	if err != nil {
+		t.Fatal(err)
+	}
+	packed, err := c.EvalPointsBatchPacked(keys, xs, logN)
+	if err != nil {
+		t.Fatal(err)
+	}
+	wantRow := (q + 7) / 8
+	for i := range keys {
+		if len(packed[i]) != wantRow {
+			t.Fatalf("packed row %d is %d bytes, want %d", i, len(packed[i]), wantRow)
+		}
+		got := UnpackBits(packed[i], q)
+		for j := 0; j < q; j++ {
+			if got[j] != bits[i][j] {
+				t.Fatalf("packed/unpacked mismatch at [%d][%d]", i, j)
+			}
+		}
+		// tail bits beyond q are zero by contract
+		if tail := packed[i][wantRow-1] >> (q % 8); q%8 != 0 && tail != 0 {
+			t.Fatalf("nonzero tail bits in packed row %d", i)
+		}
+	}
+	// XOR reconstruction works directly on the packed rows.
+	for j := 0; j < q; j++ {
+		want := byte(0)
+		if xs[0][j] == alpha {
+			want = 1
+		}
+		ra := packed[0][j/8] >> (j % 8) & 1
+		rb := packed[1][j/8] >> (j % 8) & 1
+		if ra^rb != want {
+			t.Fatalf("packed reconstruction at query %d", j)
+		}
+	}
+}

@@ -102,8 +102,16 @@ def eval_full_batch(kb: KeyBatch, **kwargs) -> np.ndarray:
     return _dpf.eval_full(kb, **kwargs)
 
 
-def eval_points_batch(kb: KeyBatch, xs: np.ndarray) -> np.ndarray:
-    """Pointwise evaluation of a key batch at xs uint64[K, Q] -> uint8[K, Q]."""
+def eval_points_batch(
+    kb: KeyBatch, xs: np.ndarray, packed: bool = False
+) -> np.ndarray:
+    """Pointwise evaluation of a key batch at xs uint64[K, Q] -> uint8[K, Q].
+
+    ``packed=True`` returns the evaluation's native bit-packed form
+    instead — uint32[K, ceil(Q/32)] words, query q at word q//32 bit q%32
+    (LSB-first, the reference's EvalFull bit order; bits >= Q zero) — with
+    no device-side unpack, so the device->host transfer shrinks 32x.
+    ``core.bitpack.unpack_bits(words, Q)`` recovers the byte-per-bit form."""
     from .models import dpf as _dpf
 
-    return _dpf.eval_points(kb, xs)
+    return _dpf.eval_points(kb, xs, packed=packed)

@@ -81,6 +81,10 @@ def _load():
         lib.dpfn_eval_points_batch.argtypes = [
             u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, u64p, ctypes.c_uint64, u8p,
         ]
+        lib.dpfn_eval_points_batch_packed.restype = ctypes.c_int
+        lib.dpfn_eval_points_batch_packed.argtypes = [
+            u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, u64p, ctypes.c_uint64, u8p,
+        ]
         # Fast profile (ChaCha12, core/chacha_np.py layout).
         lib.dpfn_cc_key_len.restype = ctypes.c_uint64
         lib.dpfn_cc_key_len.argtypes = [ctypes.c_uint64]
@@ -100,6 +104,10 @@ def _load():
         lib.dpfn_cc_eval_points_batch.argtypes = [
             u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, u64p, ctypes.c_uint64, u8p,
         ]
+        lib.dpfn_cc_eval_points_batch_packed.restype = ctypes.c_int
+        lib.dpfn_cc_eval_points_batch_packed.argtypes = [
+            u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, u64p, ctypes.c_uint64, u8p,
+        ]
         # DCF (one-key-per-gate comparison, models/dcf.py layout).
         lib.dpfn_dcf_key_len.restype = ctypes.c_uint64
         lib.dpfn_dcf_key_len.argtypes = [ctypes.c_uint64]
@@ -107,6 +115,10 @@ def _load():
         lib.dpfn_dcf_gen.argtypes = [ctypes.c_uint64, ctypes.c_uint64, u8p, u8p, u8p, u8p]
         lib.dpfn_dcf_eval_points_batch.restype = ctypes.c_int
         lib.dpfn_dcf_eval_points_batch.argtypes = [
+            u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, u64p, ctypes.c_uint64, u8p,
+        ]
+        lib.dpfn_dcf_eval_points_batch_packed.restype = ctypes.c_int
+        lib.dpfn_dcf_eval_points_batch_packed.argtypes = [
             u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, u64p, ctypes.c_uint64, u8p,
         ]
         _lib = lib
@@ -275,6 +287,45 @@ def eval_points_batch(keys: list[bytes], xs: np.ndarray, log_n: int) -> np.ndarr
     return out
 
 
+def _points_batch_packed(
+    keys: list[bytes], xs: np.ndarray, log_n: int,
+    key_len_fn: str, entry: str, what: str,
+) -> np.ndarray:
+    """Shared driver for the three packed batch entries -> uint8 rows
+    [K, ceil(Q/8)], LSB-first (the core/bitpack wire contract; the bytes
+    are the like-for-like baseline of the accelerated packed routes)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    klen = int(getattr(lib, key_len_fn)(log_n))
+    arr = np.frombuffer(b"".join(keys), dtype=np.uint8)
+    if arr.size != klen * len(keys):
+        raise ValueError(f"{what}: bad key length in batch")
+    xs = np.ascontiguousarray(xs, dtype=np.uint64)
+    k, q = xs.shape
+    if k != len(keys):
+        raise ValueError("xs first axis must match number of keys")
+    out = np.empty((k, -(-q // 8)), np.uint8)
+    rc = getattr(lib, entry)(
+        _u8ptr(arr), k, klen, log_n,
+        xs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), q, _u8ptr(out),
+    )
+    if rc:
+        raise ValueError(f"{what}: native packed points batch failed (rc={rc})")
+    return out
+
+
+def eval_points_batch_packed(
+    keys: list[bytes], xs: np.ndarray, log_n: int
+) -> np.ndarray:
+    """Packed-output twin of ``eval_points_batch``: uint8[K, ceil(Q/8)]
+    rows, bit j of row i = Eval(keys[i], xs[i, j]) at byte j//8, bit j%8."""
+    return _points_batch_packed(
+        keys, xs, log_n, "dpfn_key_len", "dpfn_eval_points_batch_packed",
+        "dpf",
+    )
+
+
 def cc_eval_points_batch(keys: list[bytes], xs: np.ndarray, log_n: int) -> np.ndarray:
     """Fast-profile batched pointwise evaluation (mirror of
     ``eval_points_batch`` over the ChaCha key layout)."""
@@ -297,6 +348,16 @@ def cc_eval_points_batch(keys: list[bytes], xs: np.ndarray, log_n: int) -> np.nd
     if rc:
         raise ValueError(f"dpf-fast: native eval_points_batch failed (rc={rc})")
     return out
+
+
+def cc_eval_points_batch_packed(
+    keys: list[bytes], xs: np.ndarray, log_n: int
+) -> np.ndarray:
+    """Packed-output twin of ``cc_eval_points_batch`` (uint8 wire rows)."""
+    return _points_batch_packed(
+        keys, xs, log_n, "dpfn_cc_key_len",
+        "dpfn_cc_eval_points_batch_packed", "dpf-fast",
+    )
 
 
 # --------------------------------------------------------------------------
@@ -348,3 +409,13 @@ def dcf_eval_points_batch(keys: list[bytes], xs: np.ndarray, log_n: int) -> np.n
     if rc:
         raise ValueError(f"dcf: native eval_points_batch failed (rc={rc})")
     return out
+
+
+def dcf_eval_points_batch_packed(
+    keys: list[bytes], xs: np.ndarray, log_n: int
+) -> np.ndarray:
+    """Packed-output twin of ``dcf_eval_points_batch`` (uint8 wire rows)."""
+    return _points_batch_packed(
+        keys, xs, log_n, "dpfn_dcf_key_len",
+        "dpfn_dcf_eval_points_batch_packed", "dcf",
+    )

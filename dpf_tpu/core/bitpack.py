@@ -1,0 +1,126 @@
+"""Bit-packed output contract — THE single source of the packed layout.
+
+The kernels compute packed uint32 words natively (the compat walk kernel's
+output IS ``uint32[K, qp]``; the expansion kernels emit packed leaf words),
+and the reference's own output convention is bit-packed LSB-first
+(dpf/dpf.go:207-209: bit x at byte x//8, bit x%8).  The packed pipeline
+keeps that form end-to-end:
+
+    word layout   uint32[..., ceil(Q/32)]: query q -> word q // 32,
+                  bit q % 32 (LSB-first within the word)
+    byte layout   the little-endian view of those words: query q ->
+                  byte q // 8, bit q % 8 — exactly the reference's
+                  EvalFull convention and the sidecar's /v1/evalfull bytes
+    wire rows     ceil(Q/8) bytes per row (the trailing word's spare
+                  bytes are dropped on the wire)
+    tail bits     bits >= Q in the last word are ZERO (padded queries
+                  evaluate garbage; the producers mask them so packed
+                  outputs are deterministic and wire rows are comparable
+                  byte-for-byte)
+
+Every producer (device evaluators, native backend, sidecar) and consumer
+(unpack wrappers, Go client, tests) goes through these helpers so the
+contract has one definition.  NumPy helpers are host-side; the ``_jnp``
+twins run inside jitted graphs so packing happens ON DEVICE — the whole
+point is that the host link sees 8x (bytes) / 32x (uint8-word) less data.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def packed_words(q: int) -> int:
+    """Words per row of a packed [.., Q] output: ceil(Q / 32)."""
+    return -(-int(q) // 32)
+
+
+def packed_bytes(q: int) -> int:
+    """Wire bytes per row of a packed [.., Q] output: ceil(Q / 8)."""
+    return -(-int(q) // 8)
+
+
+def mask_tail(words: np.ndarray, q: int) -> np.ndarray:
+    """Zero bits >= q in the last word (copy only when masking applies)."""
+    q = int(q)
+    if q % 32 and words.shape[-1]:
+        words = words.copy()
+        words[..., -1] &= np.uint32((1 << (q % 32)) - 1)
+    return words
+
+
+def pack_bits(bits: np.ndarray) -> np.ndarray:
+    """Host pack: uint8[..., Q] 0/1 -> uint32[..., ceil(Q/32)], LSB-first,
+    tail bits zero."""
+    bits = np.asarray(bits)
+    q = bits.shape[-1]
+    pad = (-q) % 32
+    if pad:
+        bits = np.concatenate(
+            [bits, np.zeros(bits.shape[:-1] + (pad,), bits.dtype)], axis=-1
+        )
+    b = bits.reshape(bits.shape[:-1] + (-1, 32)).astype(np.uint32)
+    return (b << np.arange(32, dtype=np.uint32)).sum(-1, dtype=np.uint32)
+
+
+def unpack_bits(words: np.ndarray, q: int) -> np.ndarray:
+    """Host unpack: uint32[..., W] -> uint8[..., q] 0/1 bits (the thin
+    wrapper the byte-per-bit APIs are now built on)."""
+    w = np.asarray(words)
+    bits = ((w[..., :, None] >> np.arange(32, dtype=np.uint32)) & 1).astype(
+        np.uint8
+    )
+    return bits.reshape(w.shape[:-1] + (-1,))[..., : int(q)]
+
+
+def pack_bits_jnp(bits):
+    """Device pack inside a jitted graph: [..., Q] 0/1 (Q % 32 == 0) ->
+    uint32[..., Q // 32]."""
+    import jax.numpy as jnp
+
+    shape = bits.shape[:-1] + (bits.shape[-1] // 32, 32)
+    b = bits.reshape(shape).astype(jnp.uint32)
+    return (b << jnp.arange(32, dtype=jnp.uint32)).sum(-1, dtype=jnp.uint32)
+
+
+def pack_bits_qmajor_jnp(bits):
+    """Device pack of a QUERY-MAJOR bit tensor (the fast-profile walk
+    layout): [Q, K] 0/1 (Q % 32 == 0) -> uint32[K, Q // 32]."""
+    import jax.numpy as jnp
+
+    q, k = bits.shape
+    b = bits.reshape(q // 32, 32, k).astype(jnp.uint32)
+    w = (b << jnp.arange(32, dtype=jnp.uint32)[None, :, None]).sum(
+        1, dtype=jnp.uint32
+    )
+    return w.T
+
+
+def words_to_wire(words: np.ndarray, q: int) -> bytes:
+    """uint32[K, W] packed words -> the wire blob: K rows of ceil(q/8)
+    bytes, concatenated (the /v1/eval_points_batch?format=packed body)."""
+    w = np.ascontiguousarray(mask_tail(np.asarray(words, dtype=np.uint32), q))
+    rows = w.view("<u1").reshape(w.shape[0], -1)[:, : packed_bytes(q)]
+    return np.ascontiguousarray(rows).tobytes()
+
+
+def wire_to_words(data: bytes, k: int, q: int) -> np.ndarray:
+    """Wire blob (k rows x ceil(q/8) bytes) -> uint32[k, ceil(q/32)]."""
+    rb = packed_bytes(q)
+    rows = np.frombuffer(bytes(data), np.uint8).reshape(k, rb)
+    pad = packed_words(q) * 4 - rb
+    if pad:
+        rows = np.concatenate([rows, np.zeros((k, pad), np.uint8)], axis=1)
+    return np.ascontiguousarray(rows).view("<u4")
+
+
+def byte_rows_to_words(rows: np.ndarray, q: int) -> np.ndarray:
+    """uint8[K, ceil(q/8)] packed byte rows (the native backend's output)
+    -> uint32[K, ceil(q/32)] words."""
+    rows = np.asarray(rows, dtype=np.uint8)
+    pad = packed_words(q) * 4 - rows.shape[1]
+    if pad:
+        rows = np.concatenate(
+            [rows, np.zeros((rows.shape[0], pad), np.uint8)], axis=1
+        )
+    return np.ascontiguousarray(rows).view("<u4")

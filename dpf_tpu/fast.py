@@ -99,14 +99,20 @@ def eval_full_batch(kb: KeyBatchFast) -> np.ndarray:
 
 
 def eval_points_batch(
-    kb: KeyBatchFast, xs: np.ndarray, backend: str = "auto"
+    kb: KeyBatchFast, xs: np.ndarray, backend: str = "auto",
+    packed: bool = False,
 ) -> np.ndarray:
     """Batched pointwise evaluation: xs uint64[K, Q] -> uint8[K, Q].
 
     ``backend="auto"`` runs on the accelerator; ``backend="cpu"`` runs the
     host path (native C++ batch entry when built, NumPy spec otherwise) —
     useful for small batches that don't amortize a dispatch, and as the
-    differential-test counterpart of the device path."""
+    differential-test counterpart of the device path.
+
+    ``packed=True`` returns bit-packed words uint32[K, ceil(Q/32)] (query
+    q at word q//32, bit q%32, LSB-first, tail zero — core/bitpack.py)
+    with the pack done where the bits are produced (on device, or in the
+    native packed batch entry), so the transfer/wire cost drops 8-32x."""
     if backend == "cpu":
         xs = np.asarray(xs, dtype=np.uint64)
         if xs.ndim != 2 or xs.shape[0] != kb.k:
@@ -116,10 +122,20 @@ def eval_points_batch(
         keys = kb.to_bytes()
         nat = _native()
         if nat is not None:
+            if packed:
+                from .core import bitpack
+
+                rows = nat.cc_eval_points_batch_packed(keys, xs, kb.log_n)
+                return bitpack.byte_rows_to_words(rows, xs.shape[1])
             return nat.cc_eval_points_batch(keys, xs, kb.log_n)
-        return np.array(
+        bits = np.array(
             [[_cc.eval_point(k, int(x), kb.log_n) for x in row]
              for k, row in zip(keys, xs)],
             dtype=np.uint8,
         )
-    return _eval_points_dev(kb, xs)
+        if packed:
+            from .core import bitpack
+
+            return bitpack.pack_bits(bits)
+        return bits
+    return _eval_points_dev(kb, xs, packed=packed)

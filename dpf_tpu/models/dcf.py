@@ -54,6 +54,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..core import bitpack
 from ..core import chacha_np as cc
 from .dpf_chacha import _split_queries
 
@@ -283,12 +284,17 @@ def points_kernel_eligible(k: int) -> bool:
     return cp.points_backend() == "pallas" and cp.usable(k)
 
 
-def eval_lt_points(kb: DcfKeyBatch, xs: np.ndarray) -> np.ndarray:
+def eval_lt_points(
+    kb: DcfKeyBatch, xs: np.ndarray, packed: bool = False
+) -> np.ndarray:
     """Batched comparison-share evaluation: xs uint64[K, Q] -> uint8[K, Q]
     with  eval(ka) ^ eval(kb) == 1{x < alpha}  per gate.
 
     Routes through the Pallas whole-walk kernel on TPU (DCF mode) when the
-    key count tiles the kernel's lane quantum; else the XLA body."""
+    key count tiles the kernel's lane quantum; else the XLA body.
+    ``packed`` returns the shares as uint32[K, ceil(Q/32)] packed words
+    (device-side pack, core/bitpack contract — 32x less D2H; XOR
+    reconstruction works directly on the words)."""
     xs = np.asarray(xs, dtype=np.uint64)
     if xs.ndim != 2 or xs.shape[0] != kb.k:
         raise ValueError("dcf: xs must be [K, Q]")
@@ -297,14 +303,28 @@ def eval_lt_points(kb: DcfKeyBatch, xs: np.ndarray) -> np.ndarray:
     from ..ops import chacha_pallas as cp
 
     if points_kernel_eligible(kb.k):
-        return cp.eval_points_walk_dcf(kb, xs)
-    return _eval_points_xla(kb, xs)
+        return cp.eval_points_walk_dcf(kb, xs, packed=packed)
+    return _eval_points_xla(kb, xs, packed)
 
 
-def _eval_points_xla(kb: DcfKeyBatch, xs: np.ndarray) -> np.ndarray:
-    from .dpf_chacha import _eval_points_cc_jit
+def _eval_points_xla(
+    kb: DcfKeyBatch, xs: np.ndarray, packed: bool = False
+) -> np.ndarray:
+    from .dpf_chacha import _eval_points_cc_jit, _eval_points_cc_packed_jit
 
     seeds, ts, scw, tcw, vcw, fvcw = kb.device_args()
+    if packed:
+        Q = xs.shape[1]
+        pad_q = (-Q) % 32
+        if pad_q:
+            xs = np.concatenate(
+                [xs, np.zeros((xs.shape[0], pad_q), np.uint64)], axis=1
+            )
+        xs_hi, xs_lo = _split_queries(xs, kb.log_n)
+        words = _eval_points_cc_packed_jit(
+            kb.nu, kb.log_n, seeds, ts, scw, tcw, fvcw, xs_hi, xs_lo, 0, vcw
+        )
+        return bitpack.mask_tail(np.asarray(words), Q)
     xs_hi, xs_lo = _split_queries(xs, kb.log_n)
     bits = _eval_points_cc_jit(
         kb.nu, kb.log_n, seeds, ts, scw, tcw, fvcw, xs_hi, xs_lo, 0, vcw
@@ -353,12 +373,15 @@ def _concat_batches(a: DcfKeyBatch, b: DcfKeyBatch) -> DcfKeyBatch:
     )
 
 
-def eval_interval_points(ik, xs: np.ndarray) -> np.ndarray:
+def eval_interval_points(ik, xs: np.ndarray, packed: bool = False) -> np.ndarray:
     """Evaluate interval shares at xs uint64[K, Q] -> uint8[K, Q]; ``ik``
     is one party's (upper, lower, const) triple from
     :func:`gen_interval_batch`.  Both gate sets evaluate in ONE device
     launch (a fused 2K-key batch, built lazily and reused — its
-    device-resident operands amortize across calls)."""
+    device-resident operands amortize across calls).  ``packed`` returns
+    uint32[K, ceil(Q/32)] packed words (core/bitpack contract); the
+    upper^lower fold and the public wrap constant apply directly on the
+    words."""
     upper, lower, const = ik[0], ik[1], ik[2]
     xs = np.asarray(xs, dtype=np.uint64)
     if xs.ndim != 2 or xs.shape[0] != upper.k:
@@ -374,6 +397,12 @@ def eval_interval_points(ik, xs: np.ndarray) -> np.ndarray:
             upper._interval_both = (lower, both)
         except AttributeError:
             pass
-    bits = eval_lt_points(both, np.concatenate([xs, xs]))
     k = upper.k
+    if packed:
+        words = eval_lt_points(both, np.concatenate([xs, xs]), packed=True)
+        # const in {0, 1} complements a gate's whole row; re-mask the tail
+        # the complement just set.
+        cmask = (np.uint32(0) - const.astype(np.uint32))[:, None]
+        return bitpack.mask_tail(words[:k] ^ words[k:] ^ cmask, xs.shape[1])
+    bits = eval_lt_points(both, np.concatenate([xs, xs]))
     return bits[:k] ^ bits[k:] ^ const[:, None]

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import bitpack
 from ..core.keys import KeyBatch
 from ..ops import aes_pallas
 from ..ops.aes_bitslice import (
@@ -507,9 +508,17 @@ def _point_masks(kb: KeyBatch):
 
 
 def eval_points(
-    kb: KeyBatch, xs: np.ndarray, backend: str | None = None
+    kb: KeyBatch, xs: np.ndarray, backend: str | None = None,
+    packed: bool = False,
 ) -> np.ndarray:
     """Batched pointwise evaluation: xs uint64[K, Q] -> bits uint8[K, Q].
+
+    ``packed=True`` returns the evaluation's NATIVE bit-packed form
+    instead: uint32[K, ceil(Q/32)] words, query q at word q//32 bit q%32
+    (LSB-first; bits >= Q zero — core/bitpack.py).  The whole-walk kernel
+    already computes exactly these words, so the packed route skips the
+    unpack entirely and the D2H transfer shrinks 32x (8x on the wire);
+    the byte-per-bit return is a thin unpack of the same words.
 
     One root-to-leaf path walk per (key, query) lane, all lanes in lockstep:
     per level both PRG children are computed bitsliced and the path bit
@@ -546,7 +555,7 @@ def eval_points(
         and (backend in _BM_BACKENDS or aes_pallas.walk_forced())
     ):
         try:
-            return _eval_points_walk_compat(kb, xs)
+            return _eval_points_walk_compat(kb, xs, packed=packed)
         except Exception as e:  # noqa: BLE001
             _walk_kernel_degraded(e)
     pad_q = (-Q) % 32
@@ -560,6 +569,11 @@ def eval_points(
     else:
         xs_hi = jnp.zeros((1, 1), jnp.uint32)  # never read when log_n <= 32
 
+    if packed:
+        words = _eval_points_packed_jit(
+            kb.nu, kb.log_n, *_point_masks(kb), xs_hi, xs_lo, qp, backend
+        )
+        return bitpack.mask_tail(np.asarray(words), Q)
     bits = _eval_points_jit(
         kb.nu, kb.log_n, *_point_masks(kb), xs_hi, xs_lo, qp, backend
     )
@@ -590,9 +604,14 @@ def _walk_kernel_degraded(e: Exception) -> None:
     )
 
 
-def _eval_points_walk_compat(kb: KeyBatch, xs: np.ndarray) -> np.ndarray:
+def _eval_points_walk_compat(
+    kb: KeyBatch, xs: np.ndarray, packed: bool = False
+) -> np.ndarray:
     """Whole-walk kernel route: pads keys to the kernel's 8-key sublane
-    tile and queries to whole packed words, returns uint8[K, Q]."""
+    tile and queries to whole packed words, returns uint8[K, Q] — or, with
+    ``packed``, the kernel's packed words uint32[K, ceil(Q/32)] DIRECTLY
+    (the kernel's native output; the unpacked return below is the thin
+    host-side unpack of the same words)."""
     K, Q = xs.shape
     kpad = (-kb.k) % aes_pallas._PKT
     if kpad:
@@ -614,14 +633,12 @@ def _eval_points_walk_compat(kb: KeyBatch, xs: np.ndarray) -> np.ndarray:
         xs_hi = jnp.asarray((xs >> np.uint64(32)).astype(np.uint32))
     else:
         xs_hi = jnp.zeros((1, 1), jnp.uint32)
-    packed = _eval_points_walk_jit(
+    words = np.asarray(_eval_points_walk_jit(
         kb.nu, kb.log_n, *_point_masks(kb), xs_hi, xs_lo, qp
-    )
-    packed = np.asarray(packed)  # [Kpad, qp]
-    bits = (
-        (packed[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
-    ).astype(np.uint8).reshape(packed.shape[0], -1)
-    return bits[:K, :Q]
+    ))  # [Kpad, qp]
+    if packed:
+        return bitpack.mask_tail(words[:K], Q)
+    return bitpack.unpack_bits(words[:K], Q)
 
 
 def _eval_points_walk_body(
@@ -666,7 +683,7 @@ _eval_points_walk_jit = partial(jax.jit, static_argnums=(0, 1, 10))(
 
 def eval_points_level_grouped(
     kb: KeyBatch, xs: np.ndarray, groups: int, reduce: bool = False,
-    backend: str | None = None,
+    backend: str | None = None, packed: bool = False,
 ) -> np.ndarray:
     """FSS-support pointwise evaluation over level-major key groups
     (compat profile; mirror of dpf_chacha.eval_points_level_grouped).
@@ -680,7 +697,9 @@ def eval_points_level_grouped(
     the wire sees the level-replicated query tensor; otherwise the masked
     queries are expanded host-side and walked by the XLA body.
     -> uint8[groups * log_n * G, Q], or uint8[G, Q] with ``reduce`` (the
-    level/group XOR-fold happens on device on the kernel route)."""
+    level/group XOR-fold happens on device on the kernel route).
+    ``packed`` returns the same rows as uint32[., ceil(Q/32)] packed words
+    (the kernel's native form — no unpack, 32x less D2H; bitpack.py)."""
     xs = np.asarray(xs, dtype=np.uint64)
     if xs.ndim != 2:
         raise ValueError("dpf: xs must be [G, Q]")
@@ -706,11 +725,11 @@ def eval_points_level_grouped(
         if groups > 1:
             qexp = np.concatenate([qexp] * groups)
         bits = eval_points(kb, qexp, backend=backend)
-        if not reduce:
-            return bits
-        return np.bitwise_xor.reduce(
-            bits.reshape(groups * n, G, Q), axis=0
-        )
+        if reduce:
+            bits = np.bitwise_xor.reduce(
+                bits.reshape(groups * n, G, Q), axis=0
+            )
+        return bitpack.pack_bits(bits) if packed else bits
     pad_q = (-Q) % 32
     if pad_q:
         xs = np.concatenate([xs, np.zeros((G, pad_q), np.uint64)], axis=1)
@@ -721,18 +740,17 @@ def eval_points_level_grouped(
     else:
         xs_hi = jnp.zeros((1, 1), jnp.uint32)
     try:
-        packed = np.asarray(_grouped_walk_jit(
+        words = np.asarray(_grouped_walk_jit(
             kb.nu, n, groups, G, *_point_masks(kb), xs_hi, xs_lo, qp, reduce
         ))
     except Exception as e:  # noqa: BLE001
         _walk_kernel_degraded(e)
         return eval_points_level_grouped(
-            kb, xs[:, :Q], groups, reduce, backend
+            kb, xs[:, :Q], groups, reduce, backend, packed
         )
-    bits = (
-        (packed[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
-    ).astype(np.uint8).reshape(packed.shape[0], -1)
-    return bits[:, :Q]
+    if packed:
+        return bitpack.mask_tail(words, Q)
+    return bitpack.unpack_bits(words, Q)
 
 
 def _grouped_walk_body(
@@ -850,4 +868,23 @@ def _eval_points_body(
 
 _eval_points_jit = partial(jax.jit, static_argnums=(0, 1, 10, 11))(
     _eval_points_body
+)
+
+
+def _eval_points_packed_body(
+    nu, log_n, seed_masks, t_masks, scw_masks, tl_masks, tr_masks,
+    fcw_masks, xs_hi, xs_lo, qp, backend="xla",
+):
+    """Packed twin of the XLA walk body: the per-query bits pack into
+    uint32 words ON DEVICE (core/bitpack), so the D2H transfer is the
+    packed words — same 32x cut the walk kernel's native output gets."""
+    bits = _eval_points_body(
+        nu, log_n, seed_masks, t_masks, scw_masks, tl_masks, tr_masks,
+        fcw_masks, xs_hi, xs_lo, qp, backend,
+    )
+    return bitpack.pack_bits_jnp(bits)
+
+
+_eval_points_packed_jit = partial(jax.jit, static_argnums=(0, 1, 10, 11))(
+    _eval_points_packed_body
 )

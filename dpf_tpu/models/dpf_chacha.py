@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import bitpack
 from ..core import chacha_np as cc
 from .keys_chacha import KeyBatchFast
 
@@ -567,6 +568,26 @@ _eval_points_cc_jit = partial(jax.jit, static_argnums=(0, 1, 9))(
 )
 
 
+def _eval_points_cc_packed_body(
+    nu, log_n, seeds, ts, scw, tcw, fcw, xs_hi, xs_lo, level_groups=0,
+    vcw=None,
+):
+    """Packed twin of the XLA walk body (also the DCF XLA route via
+    ``vcw``): the query-major [Q, K] bits pack into uint32[K, Q/32] words
+    ON DEVICE (core/bitpack; the caller pads Q to 32), so the D2H
+    transfer is the packed words — the same 32x cut the walk kernel's
+    packed route gets."""
+    bits = _eval_points_cc_body(
+        nu, log_n, seeds, ts, scw, tcw, fcw, xs_hi, xs_lo, level_groups, vcw
+    )
+    return bitpack.pack_bits_qmajor_jnp(bits)
+
+
+_eval_points_cc_packed_jit = partial(jax.jit, static_argnums=(0, 1, 9))(
+    _eval_points_cc_packed_body
+)
+
+
 def _split_queries(xs: np.ndarray, log_n: int):
     """uint64[A, B] -> (xs_hi, xs_lo) device operands of the transposed
     queries (xs_hi is a never-read [1,1] dummy when log_n <= 32)."""
@@ -585,13 +606,19 @@ def _use_walk_kernel(k: int) -> bool:
     return cp.points_backend() == "pallas" and cp.usable(k)
 
 
-def eval_points(kb: KeyBatchFast, xs: np.ndarray) -> np.ndarray:
+def eval_points(
+    kb: KeyBatchFast, xs: np.ndarray, packed: bool = False
+) -> np.ndarray:
     """Batched pointwise evaluation: xs uint64[K, Q] -> uint8[K, Q].
 
     On TPU (key counts divisible by 128) the whole walk runs as one Pallas
     kernel (ops/chacha_pallas.py) — state in VMEM instead of an HBM round
     trip per fused op; the XLA body is the fallback and A/B reference
-    (DPF_TPU_POINTS=xla)."""
+    (DPF_TPU_POINTS=xla).  ``packed=True`` returns bit-packed words
+    uint32[K, ceil(Q/32)] instead (query q at word q//32, bit q%32,
+    LSB-first, tail bits zero — core/bitpack.py), packed ON DEVICE so the
+    D2H transfer shrinks 32x; the byte-per-bit return is a thin unpack of
+    the same bits."""
     xs = np.asarray(xs, dtype=np.uint64)
     if xs.ndim != 2 or xs.shape[0] != kb.k:
         raise ValueError("dpf-fast: xs must be [K, Q]")
@@ -600,7 +627,9 @@ def eval_points(kb: KeyBatchFast, xs: np.ndarray) -> np.ndarray:
     if _use_walk_kernel(kb.k):
         from ..ops import chacha_pallas as cp
 
-        return cp.eval_points_walk(kb, xs)
+        return cp.eval_points_walk(kb, xs, packed=packed)
+    if packed:
+        return _eval_points_cc_packed(kb, xs)
     xs_hi, xs_lo = _split_queries(xs, kb.log_n)
     bits = _eval_points_cc_jit(
         kb.nu, kb.log_n, *kb.device_args(), xs_hi, xs_lo
@@ -608,8 +637,27 @@ def eval_points(kb: KeyBatchFast, xs: np.ndarray) -> np.ndarray:
     return np.asarray(bits).T
 
 
+def _eval_points_cc_packed(
+    kb, xs: np.ndarray, level_groups: int = 0, vcw=None
+) -> np.ndarray:
+    """XLA-body packed route shared by the DPF and DCF (``vcw``) walks:
+    pad Q to whole words, pack on device, mask the tail bits."""
+    Q = xs.shape[1]
+    pad_q = (-Q) % 32
+    if pad_q:
+        xs = np.concatenate(
+            [xs, np.zeros((xs.shape[0], pad_q), np.uint64)], axis=1
+        )
+    xs_hi, xs_lo = _split_queries(xs, kb.log_n)
+    words = _eval_points_cc_packed_jit(
+        kb.nu, kb.log_n, *kb.device_args(), xs_hi, xs_lo, level_groups, vcw
+    )
+    return bitpack.mask_tail(np.asarray(words), Q)
+
+
 def eval_points_level_grouped(
-    kb: KeyBatchFast, xs: np.ndarray, groups: int, reduce: bool = False
+    kb: KeyBatchFast, xs: np.ndarray, groups: int, reduce: bool = False,
+    packed: bool = False,
 ) -> np.ndarray:
     """FSS-support pointwise evaluation over level-major key groups.
 
@@ -622,7 +670,9 @@ def eval_points_level_grouped(
     level-replicated query tensor.  -> uint8[groups * log_n * G, Q]; with
     ``reduce`` the level/group blocks are XOR-folded into gate shares
     -> uint8[G, Q] (on device when the Pallas walk kernel is in use — the
-    D2H transfer shrinks by groups * log_n)."""
+    D2H transfer shrinks by groups * log_n).  ``packed`` returns the same
+    rows as uint32[., ceil(Q/32)] packed words (device-side pack,
+    core/bitpack contract)."""
     xs = np.asarray(xs, dtype=np.uint64)
     if xs.ndim != 2:
         raise ValueError("dpf-fast: xs must be [G, Q]")
@@ -630,10 +680,20 @@ def eval_points_level_grouped(
         raise ValueError("dpf-fast: key count != groups * log_n * G")
     if (xs >> np.uint64(kb.log_n)).any():
         raise ValueError("dpf-fast: query index out of domain")
+    G = xs.shape[0]
     if _use_walk_kernel(kb.k):
         from ..ops import chacha_pallas as cp
 
-        return cp.eval_points_walk(kb, xs, groups=groups, reduce=reduce)
+        return cp.eval_points_walk(
+            kb, xs, groups=groups, reduce=reduce, packed=packed
+        )
+    if packed:
+        words = _eval_points_cc_packed(kb, xs, level_groups=groups)
+        if reduce:  # XOR-fold commutes with the packing — fold the words
+            words = np.bitwise_xor.reduce(
+                words.reshape(groups * kb.log_n, G, -1), axis=0
+            )
+        return words
     xs_hi, xs_lo = _split_queries(xs, kb.log_n)
     bits = _eval_points_cc_jit(
         kb.nu, kb.log_n, *kb.device_args(), xs_hi, xs_lo,
@@ -641,8 +701,7 @@ def eval_points_level_grouped(
     )
     out = np.asarray(bits).T
     if reduce:
-        g = xs.shape[0]
         return np.bitwise_xor.reduce(
-            out.reshape(groups * kb.log_n, g, -1), axis=0
+            out.reshape(groups * kb.log_n, G, -1), axis=0
         )
     return out

@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import bitpack
 from ..core.keys import KeyBatch, gen_batch
 from .dpf import DeviceKeys, eval_full_device, eval_points
 
@@ -187,14 +188,19 @@ def _masked_prefix_queries(xs: np.ndarray, log_n: int) -> np.ndarray:
     return ((xs[None, :, :] >> shifts) << shifts).reshape(n * xs.shape[0], -1)
 
 
-def eval_lt_points(ck: CmpKeyBatch, xs: np.ndarray) -> np.ndarray:
+def eval_lt_points(
+    ck: CmpKeyBatch, xs: np.ndarray, packed: bool = False
+) -> np.ndarray:
     """Evaluate comparison shares at xs uint64[G, Q] -> uint8[G, Q].
 
     One device launch over all ``n * G`` level-DPFs; the level
     XOR-reduction collapses the unique matching level into the predicate.
     Both profiles mask the dyadic-prefix queries on device
     (eval_points_level_grouped) — the raw [G, Q] queries are all that
-    crosses the wire; off-TPU the compat profile expands them host-side."""
+    crosses the wire; off-TPU the compat profile expands them host-side.
+    ``packed`` returns the gate shares as uint32[G, ceil(Q/32)] packed
+    words (core/bitpack contract): the level fold happens on packed words,
+    so the selection vector never round-trips through uint8."""
     xs = np.asarray(xs, dtype=np.uint64)
     if xs.ndim != 2 or xs.shape[0] != ck.g:
         raise ValueError("fss: xs must be [G, Q]")
@@ -202,9 +208,10 @@ def eval_lt_points(ck: CmpKeyBatch, xs: np.ndarray) -> np.ndarray:
     if grouped is not None:
         # Level XOR-fold happens on device (ops/chacha_pallas.py): only the
         # [G, Q] gate shares cross the host link, not [n*G, Q] level bits.
-        return grouped(ck.levels, xs, groups=1, reduce=True)
+        return grouped(ck.levels, xs, groups=1, reduce=True, packed=packed)
     bits = ep(ck.levels, _masked_prefix_queries(xs, ck.log_n))
-    return np.bitwise_xor.reduce(bits.reshape(ck.log_n, ck.g, -1), axis=0)
+    out = np.bitwise_xor.reduce(bits.reshape(ck.log_n, ck.g, -1), axis=0)
+    return bitpack.pack_bits(out) if packed else out
 
 
 def gen_interval_batch(
@@ -238,11 +245,15 @@ def gen_interval_batch(
     return IntervalKeyBatch(ua, la, const_a), IntervalKeyBatch(ub, lb, const_b)
 
 
-def eval_interval_points(ik: IntervalKeyBatch, xs: np.ndarray) -> np.ndarray:
+def eval_interval_points(
+    ik: IntervalKeyBatch, xs: np.ndarray, packed: bool = False
+) -> np.ndarray:
     """Evaluate interval shares at xs uint64[G, Q] -> uint8[G, Q].
 
     Both comparison gate sets fuse into a single device launch (one
-    ``KeyBatch`` of ``2 * n * G`` keys)."""
+    ``KeyBatch`` of ``2 * n * G`` keys).  ``packed`` returns
+    uint32[G, ceil(Q/32)] packed words (core/bitpack contract); the
+    public wrap constant complements rows directly on the words."""
     _, ep, batch_cls, _, grouped = _profile_funcs(ik.upper.profile)
     xs = np.asarray(xs, dtype=np.uint64)
     G, n = ik.upper.g, ik.upper.log_n
@@ -261,11 +272,17 @@ def eval_interval_points(ik: IntervalKeyBatch, xs: np.ndarray) -> np.ndarray:
         )
         ik._both = both  # fused batch reused (and device-cached) across calls
     if grouped is not None:
-        out = grouped(both, xs, groups=2, reduce=True)  # device XOR-fold
+        # device XOR-fold (packed words stay packed end-to-end)
+        out = grouped(both, xs, groups=2, reduce=True, packed=packed)
     else:
         q = _masked_prefix_queries(xs, n)  # [n*G, Q]
         bits = ep(both, np.concatenate([q, q]))
         out = np.bitwise_xor.reduce(bits.reshape(2, n, G, -1), axis=(0, 1))
+        if packed:
+            out = bitpack.pack_bits(out)
+    if packed:
+        cmask = (np.uint32(0) - ik.const.astype(np.uint32))[:, None]
+        return bitpack.mask_tail(out ^ cmask, xs.shape[1])
     return out ^ ik.const[:, None]
 
 

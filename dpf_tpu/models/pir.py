@@ -35,6 +35,7 @@ from ..parallel.sharding import (
     LEAF_AXIS,
     expand_subtree_local,
     leaf_axis_levels,
+    shard_map_compat,
     xor_allreduce,
 )
 from .dpf import (
@@ -256,17 +257,22 @@ class PirServer:
 
 def _unpack_bits_i8(words: jax.Array) -> jax.Array:
     """uint32[M, W] -> int8[M, 32*W] bits, LSB-first per word.  Used for
-    both the selection rows and the db rows of the parity matmul."""
+    both the selection rows and the db rows of the parity matmul — the
+    ONLY place the packed pipeline widens to bytes, and only chunk-local
+    inside the MXU kernel (int8 is the matmul's input type); everywhere
+    else selection vectors stay packed uint32 words
+    (core/bitpack contract)."""
     m = words.shape[0]
     b = (words[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)) & 1
     return b.reshape(m, -1).astype(jnp.int8)
 
 
 def _pack_bits_u32(bits: jax.Array) -> jax.Array:
-    """int32[..., 32*R] 0/1 -> uint32[..., R]."""
-    shape = bits.shape[:-1] + (bits.shape[-1] // 32, 32)
-    b = bits.reshape(shape).astype(jnp.uint32)
-    return (b << jnp.arange(32, dtype=jnp.uint32)).sum(-1, dtype=jnp.uint32)
+    """int32[..., 32*R] 0/1 -> uint32[..., R] (core/bitpack.pack_bits_jnp
+    — the shared packed-word contract)."""
+    from ..core import bitpack
+
+    return bitpack.pack_bits_jnp(bits)
 
 
 def _parity_matmul(sel_words, db_words, chunk_rows, n_chunks):
@@ -405,7 +411,7 @@ def _pir_sharded_fast(
         return xor_allreduce(part, LEAF_AXIS)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             body,
             mesh=mesh,
             in_specs=(
@@ -434,7 +440,7 @@ def _pir_sharded(
 
     keyed = P(None, None, KEYS_AXIS)
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             body,
             mesh=mesh,
             in_specs=(

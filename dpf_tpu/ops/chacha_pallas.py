@@ -53,6 +53,7 @@ import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 
+from ..core import bitpack
 from ..core import chacha_np as cc
 
 _C = [int(v) for v in cc._CONSTANTS]
@@ -228,32 +229,45 @@ def _walk_raw(
     )(meta, seeds_t, scw_t, tcw_t, vcw_t, fcw_t, xs_lo, xs_hi)
 
 
-@functools.partial(jax.jit, static_argnums=(7, 8, 9))
-def _walk_call(meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi, log_n, nu, qt):
-    # uint8 on device: the result crosses the host link (4x smaller D2H).
-    return _walk_raw(
-        meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi, log_n, nu, qt
-    ).astype(jnp.uint8)
-
-
 @functools.partial(jax.jit, static_argnums=(7, 8, 9, 10))
+def _walk_call(
+    meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi, log_n, nu, qt,
+    packed=False,
+):
+    # uint8 on device: the result crosses the host link (4x smaller D2H).
+    # ``packed`` packs the [Q, K] bits into uint32[K, Q/32] words on
+    # device instead (core/bitpack; Q padded to 32 by the caller) — 32x
+    # smaller D2H than the uint8 bits, and already in the wire layout.
+    bits = _walk_raw(
+        meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi, log_n, nu, qt
+    )
+    if packed:
+        return bitpack.pack_bits_qmajor_jnp(bits)
+    return bits.astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnums=(7, 8, 9, 10, 11))
 def _walk_call_reduced(
-    meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi, log_n, nu, qt, g
+    meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi, log_n, nu, qt, g,
+    packed=False,
 ):
     """Walk + on-device XOR-reduction over the level (and group) blocks of
     an FSS gate batch: [Q, K] bits -> uint8[Q, g].  The reduction is why
     this exists — an FSS answer is the XOR over a gate's level-DPFs
     (models/fss.py), and reducing before D2H shrinks the transfer by
-    K/g (= groups * log_n, 64x at BASELINE config 5)."""
+    K/g (= groups * log_n, 64x at BASELINE config 5).  ``packed`` packs
+    the reduced gate bits into uint32[g, Q/32] words on device — the two
+    cuts compound (K/g * 32 less D2H than raw uint8 level bits)."""
     bits = _walk_raw(
         meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi, log_n, nu, qt
     )
     q, k = bits.shape
-    return (
-        jax.lax.reduce(
-            bits.reshape(q, k // g, g), np.uint32(0), jax.lax.bitwise_xor, (1,)
-        )
-    ).astype(jnp.uint8)
+    gates = jax.lax.reduce(
+        bits.reshape(q, k // g, g), np.uint32(0), jax.lax.bitwise_xor, (1,)
+    )
+    if packed:
+        return bitpack.pack_bits_qmajor_jnp(gates)
+    return gates.astype(jnp.uint8)
 
 
 # ---------------------------------------------------------------------------
@@ -318,7 +332,8 @@ def _qtile(q: int) -> int:
 
 
 def eval_points_walk(
-    kb, xs: np.ndarray, groups: int = 0, reduce: bool = False
+    kb, xs: np.ndarray, groups: int = 0, reduce: bool = False,
+    packed: bool = False,
 ) -> np.ndarray:
     """Pointwise walk via the Pallas kernel.
 
@@ -326,12 +341,14 @@ def eval_points_walk(
     queries uint64[G, Q] for level-grouped FSS batches — same contracts as
     models/dpf_chacha.eval_points / eval_points_level_grouped, which route
     here on TPU.  -> uint8[K, Q]; with ``reduce`` (grouped only) the level/
-    group blocks are XOR-folded on device -> uint8[G, Q]."""
+    group blocks are XOR-folded on device -> uint8[G, Q].  ``packed``
+    returns the rows as uint32[., ceil(Q/32)] packed words instead, the
+    pack done on device (core/bitpack contract; 32x less D2H)."""
     k = kb.k
     meta, seeds_t, scw_t, tcw_t, fcw_t = walk_operands(kb, groups)
     xs_t = np.ascontiguousarray(xs.T)  # [Q, G or K]
     q = xs_t.shape[0]
-    pad_q = (-q) % 8
+    pad_q = (-q) % 32 if packed else (-q) % 8
     if pad_q:
         xs_t = np.concatenate(
             [xs_t, np.zeros((pad_q,) + xs_t.shape[1:], xs_t.dtype)]
@@ -351,16 +368,18 @@ def eval_points_walk(
         if not groups:
             raise ValueError("reduce requires a level-grouped batch")
         g = k // (groups * kb.log_n)
-        bits = _walk_call_reduced(
+        out = _walk_call_reduced(
             meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi,
-            kb.log_n, kb.nu, qt, g,
+            kb.log_n, kb.nu, qt, g, packed,
         )
     else:
-        bits = _walk_call(
+        out = _walk_call(
             meta, seeds_t, scw_t, tcw_t, fcw_t, xs_lo, xs_hi,
-            kb.log_n, kb.nu, qt,
+            kb.log_n, kb.nu, qt, packed,
         )
-    return np.asarray(bits)[:q].T
+    if packed:
+        return bitpack.mask_tail(np.asarray(out), q)
+    return np.asarray(out)[:q].T
 
 
 # ---------------------------------------------------------------------------
@@ -672,14 +691,18 @@ def expand_operands(kb, first_level: int):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnums=(8, 9, 10))
+@functools.partial(jax.jit, static_argnums=(8, 9, 10, 11))
 def _walk_call_dcf(
-    meta, seeds_t, scw_t, tcw_t, vcw_t, fvcw_t, xs_lo, xs_hi, log_n, nu, qt
+    meta, seeds_t, scw_t, tcw_t, vcw_t, fvcw_t, xs_lo, xs_hi, log_n, nu, qt,
+    packed=False,
 ):
-    return _walk_raw(
+    bits = _walk_raw(
         meta, seeds_t, scw_t, tcw_t, fvcw_t, xs_lo, xs_hi, log_n, nu, qt,
         vcw_t=vcw_t, dcf=True,
-    ).astype(jnp.uint8)
+    )
+    if packed:
+        return bitpack.pack_bits_qmajor_jnp(bits)
+    return bits.astype(jnp.uint8)
 
 
 def dcf_walk_operands(kb):
@@ -708,15 +731,18 @@ def dcf_walk_operands(kb):
     return ops
 
 
-def eval_points_walk_dcf(kb, xs: np.ndarray) -> np.ndarray:
+def eval_points_walk_dcf(
+    kb, xs: np.ndarray, packed: bool = False
+) -> np.ndarray:
     """DCF comparison-share walk via the Pallas kernel: xs uint64[K, Q] ->
     uint8[K, Q] (same contract as models/dcf.eval_lt_points, which routes
-    here on TPU)."""
+    here on TPU).  ``packed`` packs the shares on device ->
+    uint32[K, ceil(Q/32)] (core/bitpack contract)."""
     k = kb.k
     ops = dcf_walk_operands(kb)
     xs_t = np.ascontiguousarray(xs.T)
     q = xs_t.shape[0]
-    pad_q = (-q) % 8
+    pad_q = (-q) % 32 if packed else (-q) % 8
     if pad_q:
         xs_t = np.concatenate(
             [xs_t, np.zeros((pad_q,) + xs_t.shape[1:], xs_t.dtype)]
@@ -726,7 +752,9 @@ def eval_points_walk_dcf(kb, xs: np.ndarray) -> np.ndarray:
         xs_hi = jnp.asarray((xs_t >> np.uint64(32)).astype(np.uint32))
     else:
         xs_hi = jnp.zeros((1, k), jnp.uint32)  # never read
-    bits = _walk_call_dcf(
-        *ops, xs_lo, xs_hi, kb.log_n, kb.nu, _qtile(xs_lo.shape[0])
+    out = _walk_call_dcf(
+        *ops, xs_lo, xs_hi, kb.log_n, kb.nu, _qtile(xs_lo.shape[0]), packed
     )
-    return np.asarray(bits)[:q].T
+    if packed:
+        return bitpack.mask_tail(np.asarray(out), q)
+    return np.asarray(out)[:q].T

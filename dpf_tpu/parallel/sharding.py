@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..core import bitpack
 from ..core.keys import KeyBatch
 from ..models.dpf import (
     _BM_BACKENDS,
@@ -42,6 +43,27 @@ from ..models.dpf import (
 
 KEYS_AXIS = "keys"
 LEAF_AXIS = "leaf"
+
+
+def shard_map_compat(body, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` across JAX versions — the single entry every
+    shard_map in the framework goes through.  Newer JAX exposes it as
+    ``jax.shard_map`` (replication checking via ``check_vma``); 0.4.x has
+    only ``jax.experimental.shard_map.shard_map`` with the same knob
+    named ``check_rep``."""
+    kwargs = {}
+    if check_vma is not None:
+        kwargs["check_vma" if hasattr(jax, "shard_map") else "check_rep"] = (
+            check_vma
+        )
+    fn = (
+        jax.shard_map
+        if hasattr(jax, "shard_map")
+        else __import__(
+            "jax.experimental.shard_map", fromlist=["shard_map"]
+        ).shard_map
+    )
+    return fn(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
 
 
 def make_mesh(
@@ -133,7 +155,7 @@ def _sharded_eval_full(mesh: Mesh, nu: int, subtree_levels: int, backend: str):
         return _convert_leaves(S, T, fcw_planes, backend)
 
     keyed = P(None, None, KEYS_AXIS)  # plane tensors: lane-word axis last
-    sharded = jax.shard_map(
+    sharded = shard_map_compat(
         body,
         mesh=mesh,
         in_specs=(
@@ -238,7 +260,7 @@ def _sharded_eval_full_fast(
             nu, entry, S, T, *cw_operands(scw, tcw, fcw, entry, nu)
         )
 
-    sharded = jax.shard_map(
+    sharded = shard_map_compat(
         body,
         mesh=mesh,
         in_specs=(
@@ -351,7 +373,7 @@ def _pad_compat_batch(kb: KeyBatch, pad: int) -> KeyBatch:
 @cache
 def _sharded_eval_points(
     mesh: Mesh, nu: int, log_n: int, qp: int, backend: str,
-    use_walk_kernel: bool = False,
+    use_walk_kernel: bool = False, packed: bool = False,
 ):
     """Compat pointwise walk sharded over the ``keys`` axis.  Queries travel
     with their keys (each shard walks its own (key, query) lanes); meshes
@@ -360,28 +382,36 @@ def _sharded_eval_points(
     that it is the replicated [1, 1] dummy.  ``use_walk_kernel`` routes
     each shard through the VMEM whole-walk kernel (the single-chip TPU
     default; caller guarantees per-shard key counts tile it), returning
-    the same unpacked uint8 bits."""
+    the same unpacked uint8 bits.  ``packed`` keeps each shard's output
+    bit-packed (the walk kernel's words pass through untouched; the XLA
+    body packs shard-locally) so the cross-shard gather and the D2H move
+    32x less data."""
     from ..models.dpf import _eval_points_body, _eval_points_walk_body
 
     def body(seed_m, t_m, scw_m, tl_m, tr_m, fcw_m, xs_hi, xs_lo):
         if use_walk_kernel:
-            packed = _eval_points_walk_body(
+            words = _eval_points_walk_body(
                 nu, log_n, seed_m, t_m, scw_m, tl_m, tr_m, fcw_m,
                 xs_hi, xs_lo, qp,
             )
-            k = packed.shape[0]
+            if packed:
+                return words  # the kernel's native packed output
+            k = words.shape[0]
             lane = jnp.arange(32, dtype=jnp.uint32)
-            bits = (packed[:, :, None] >> lane) & jnp.uint32(1)
+            bits = (words[:, :, None] >> lane) & jnp.uint32(1)
             return bits.reshape(k, qp * 32).astype(jnp.uint8)
-        return _eval_points_body(
+        bits = _eval_points_body(
             nu, log_n, seed_m, t_m, scw_m, tl_m, tr_m, fcw_m,
             xs_hi, xs_lo, qp, backend,
         )
+        if packed:
+            return bitpack.pack_bits_jnp(bits)  # shard-local pack
+        return bits
 
     keyed = P(None, KEYS_AXIS)
     hi_spec = P(KEYS_AXIS, None) if log_n > 32 else P(None, None)
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             body,
             mesh=mesh,
             in_specs=(
@@ -395,13 +425,16 @@ def _sharded_eval_points(
 
 
 def eval_points_sharded(
-    kb: KeyBatch, xs: np.ndarray, mesh: Mesh, backend: str | None = None
+    kb: KeyBatch, xs: np.ndarray, mesh: Mesh, backend: str | None = None,
+    packed: bool = False,
 ) -> np.ndarray:
     """Sharded batched pointwise evaluation (compat profile):
     xs uint64[K, Q] -> uint8[K, Q], key batch sharded over the ``keys``
     axis — pure data parallelism, zero cross-chip communication (the
     reference Eval is one key / one point at a time, dpf/dpf.go:171).
-    ``backend`` selects the PRG kernel set per shard (models/dpf)."""
+    ``backend`` selects the PRG kernel set per shard (models/dpf).
+    ``packed`` returns uint32[K, ceil(Q/32)] packed words, packed
+    SHARD-LOCALLY before the output gather (core/bitpack contract)."""
     from ..models.dpf import _point_masks
 
     backend = backend or default_backend()
@@ -440,20 +473,24 @@ def eval_points_sharded(
         xs_hi = jnp.asarray((xsp >> np.uint64(32)).astype(np.uint32))
     else:
         xs_hi = jnp.zeros((1, 1), jnp.uint32)
-    fn = _sharded_eval_points(mesh, kbp.nu, kbp.log_n, qp, backend, use_walk)
+    fn = _sharded_eval_points(
+        mesh, kbp.nu, kbp.log_n, qp, backend, use_walk, packed
+    )
     try:
-        bits = np.asarray(fn(*_point_masks(kbp), xs_hi, xs_lo))
+        out = np.asarray(fn(*_point_masks(kbp), xs_hi, xs_lo))
     except Exception as e:  # noqa: BLE001
         if not use_walk:
             raise
         mdpf._walk_kernel_degraded(e)
-        return eval_points_sharded(kb, xs, mesh, backend)
-    return bits[:K, :Q]
+        return eval_points_sharded(kb, xs, mesh, backend, packed)
+    if packed:
+        return bitpack.mask_tail(out[:K], Q)
+    return out[:K, :Q]
 
 
 @cache
 def _sharded_eval_points_fast(
-    mesh: Mesh, nu: int, log_n: int, qt: int = 0
+    mesh: Mesh, nu: int, log_n: int, qt: int = 0, packed: bool = False
 ):
     """Fast-profile pointwise walk sharded over the ``keys`` axis.  State is
     query-major [Q, K] (models/dpf_chacha.py), so the key axis is LAST.
@@ -462,15 +499,21 @@ def _sharded_eval_points_fast(
     kernel (ops/chacha_pallas._walk_raw) with that query tile — the same
     kernel the single-chip path runs; the per-shard key-minor operands
     (rows x K) are built in-graph from the sharded key material (tiny
-    transposes against the walk itself)."""
+    transposes against the walk itself).  ``packed`` packs each shard's
+    bits into uint32[K_shard, Q/32] words before the output gather
+    (core/bitpack; caller pads Q to 32), so the output's key axis moves
+    FIRST."""
     from ..core import chacha_np as cc
     from ..models.dpf_chacha import _eval_points_cc_body
 
     def body(seeds, ts, scw, tcw, fcw, xs_hi, xs_lo):
         if not qt:
-            return _eval_points_cc_body(
+            bits = _eval_points_cc_body(
                 nu, log_n, seeds, ts, scw, tcw, fcw, xs_hi, xs_lo
             )
+            if packed:
+                return bitpack.pack_bits_qmajor_jnp(bits)
+            return bits
         from ..ops import chacha_pallas as cp
 
         k = seeds.shape[0]
@@ -492,13 +535,15 @@ def _sharded_eval_points_fast(
             meta, seeds_t, scw_t, tcw_t, fcw.T, xs_lo, xs_hi,
             log_n, nu, qt,
         )
+        if packed:
+            return bitpack.pack_bits_qmajor_jnp(bits)  # shard-local pack
         return bits.astype(jnp.uint8)
 
     # Kernel routes shard the hi operand with the keys even when it is the
     # never-read [1, K] dummy (the kernel's block spec is key-minor).
     hi_spec = P(None, None) if (log_n <= 32 and not qt) else P(None, KEYS_AXIS)
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             body,
             mesh=mesh,
             in_specs=(
@@ -506,18 +551,21 @@ def _sharded_eval_points_fast(
                 P(KEYS_AXIS, None, None), P(KEYS_AXIS, None),
                 hi_spec, P(None, KEYS_AXIS),
             ),
-            out_specs=P(None, KEYS_AXIS),
+            out_specs=P(KEYS_AXIS, None) if packed else P(None, KEYS_AXIS),
             check_vma=False,
         )
     )
 
 
-def eval_points_sharded_fast(kb, xs: np.ndarray, mesh: Mesh) -> np.ndarray:
+def eval_points_sharded_fast(
+    kb, xs: np.ndarray, mesh: Mesh, packed: bool = False
+) -> np.ndarray:
     """Sharded batched pointwise evaluation (fast profile):
     xs uint64[K, Q] -> uint8[K, Q], key batch sharded over ``keys``.
     Each shard walks via the Pallas whole-walk kernel when its key count
     tiles the kernel's 128-key lane quantum (pad target), else the XLA
-    body."""
+    body.  ``packed`` returns uint32[K, ceil(Q/32)] packed words, packed
+    SHARD-LOCALLY before the output gather (core/bitpack contract)."""
     from ..models.dpf_chacha import _split_queries
     from ..ops import chacha_pallas as cp
 
@@ -534,7 +582,7 @@ def eval_points_sharded_fast(kb, xs: np.ndarray, mesh: Mesh) -> np.ndarray:
     padded = _pad_fast_batch(kb, pad)
     if pad:
         xs = np.concatenate([xs, np.zeros((pad, Q), np.uint64)])
-    pad_q = (-Q) % 8 if use_kernel else 0
+    pad_q = (-Q) % 32 if packed else ((-Q) % 8 if use_kernel else 0)
     if pad_q:
         xs = np.concatenate(
             [xs, np.zeros((xs.shape[0], pad_q), np.uint64)], axis=1
@@ -543,9 +591,11 @@ def eval_points_sharded_fast(kb, xs: np.ndarray, mesh: Mesh) -> np.ndarray:
     qt = cp._qtile(xs_lo.shape[0]) if use_kernel else 0
     if use_kernel and kb.log_n <= 32:
         xs_hi = jnp.zeros((1, padded.k), jnp.uint32)  # never read
-    fn = _sharded_eval_points_fast(mesh, kb.nu, kb.log_n, qt)
-    bits = np.asarray(fn(*padded.device_args(), xs_hi, xs_lo))
-    return bits.T[:K, :Q]
+    fn = _sharded_eval_points_fast(mesh, kb.nu, kb.log_n, qt, packed)
+    out = np.asarray(fn(*padded.device_args(), xs_hi, xs_lo))
+    if packed:
+        return bitpack.mask_tail(out[:K], Q)
+    return out.T[:K, :Q]
 
 
 @cache
@@ -587,7 +637,7 @@ def _sharded_dcf_points(mesh: Mesh, nu: int, log_n: int, qt: int):
 
     hi_spec = P(None, None) if (log_n <= 32 and not qt) else P(None, KEYS_AXIS)
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             body,
             mesh=mesh,
             in_specs=(

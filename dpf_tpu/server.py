@@ -15,19 +15,33 @@ Endpoints (all POST, binary bodies, profile/params in the query string):
   /v1/evalfull?log_n=N[&profile=fast]        body: one key  -> bit-packed bytes
   /v1/evalfull_batch?log_n=N&k=K[&profile=fast]
         body: K concatenated keys -> K concatenated expansions
-  /v1/eval_points_batch?log_n=N&k=K&q=Q[&profile=fast]
+  /v1/eval_points_batch?log_n=N&k=K&q=Q[&profile=fast][&format=packed]
         body: K concatenated keys || K*Q little-endian uint64 indices
-        -> K*Q bytes of 0/1 bits (row-major [K, Q])
+        -> K*Q bytes of 0/1 bits (row-major [K, Q]); with format=packed,
+           K rows of ceil(Q/8) bit-packed bytes instead (bit j of row i at
+           byte j//8, bit j%8 LSB-first — the /v1/evalfull convention and
+           the reference's, dpf/dpf.go:207-209; tail bits zero) — an 8x
+           cut of the dominant serving-traffic response
   /v1/dcf_gen?log_n=N&k=K                     body: K uint64 alphas
         -> K DCF keys (party A) || K DCF keys (party B)  (fast profile)
-  /v1/dcf_eval_points?log_n=N&k=K&q=Q         body: keys || uint64 indices
-        -> K*Q comparison-share bits (models/dcf.py; one key per gate)
+  /v1/dcf_eval_points?log_n=N&k=K&q=Q[&format=packed]
+        body: keys || uint64 indices
+        -> K*Q comparison-share bits (models/dcf.py; one key per gate),
+           or K * ceil(Q/8) packed bytes with format=packed
   /v1/dcf_interval_gen?log_n=N&k=K            body: K uint64 lo || K uint64 hi
         -> party A blob || party B blob, each 2K DCF keys (upper, lower)
            || K public const bytes
-  /v1/dcf_interval_eval?log_n=N&k=K&q=Q       body: one party blob || indices
-        -> K*Q interval-share bits (1{lo <= x <= hi} after XOR)
+  /v1/dcf_interval_eval?log_n=N&k=K&q=Q[&format=packed]
+        body: one party blob || indices
+        -> K*Q interval-share bits (1{lo <= x <= hi} after XOR), or
+           K * ceil(Q/8) packed bytes with format=packed
   /healthz                                    -> "ok"
+
+Format negotiation: ``format=bits`` (the byte-per-bit default, for
+back-compat) or ``format=packed``; anything else is a 400.  The server-side
+default for requests that omit the param is the ``DPF_TPU_WIRE_FORMAT``
+env knob (bits).  Packed responses follow the core/bitpack contract —
+clients unpack with ``bitpack.unpack_bits`` / ``dpftpu.UnpackBits``.
 
 Batched endpoints amortize the device dispatch exactly like the in-process
 batch API; errors surface as HTTP 400 with a text reason (clean error
@@ -39,11 +53,24 @@ Run: ``python -m dpf_tpu.server --port 8990``.
 from __future__ import annotations
 
 import argparse
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 import numpy as np
+
+from .core import bitpack
+
+
+def _wire_format(q: dict) -> bool:
+    """Resolve the response format for a points endpoint -> packed? bool.
+    Per-request ``format`` param wins; ``DPF_TPU_WIRE_FORMAT`` sets the
+    server default; unknown values are a 400 (ValueError)."""
+    fmt = q.get("format", os.environ.get("DPF_TPU_WIRE_FORMAT") or "bits")
+    if fmt not in ("bits", "packed"):
+        raise ValueError(f"unknown format {fmt!r} (use bits|packed)")
+    return fmt == "packed"
 
 
 def _profile_api(profile: str):
@@ -119,10 +146,14 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                 keys = [bytes(body[i * kl : (i + 1) * kl]) for i in range(k)]
                 xs = np.frombuffer(body[k * kl :], dtype="<u8").reshape(k, nq)
+                packed = _wire_format(q)
                 out = api.eval_points_batch(
-                    batch_cls.from_bytes(keys, log_n), xs
+                    batch_cls.from_bytes(keys, log_n), xs, packed=packed
                 )
-                self._reply(200, np.ascontiguousarray(out).tobytes())
+                if packed:
+                    self._reply(200, bitpack.words_to_wire(out, nq))
+                else:
+                    self._reply(200, np.ascontiguousarray(out).tobytes())
             elif route == "/v1/dcf_gen":
                 from .models import dcf
 
@@ -145,10 +176,14 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                 keys = [bytes(body[i * kl : (i + 1) * kl]) for i in range(k)]
                 xs = np.frombuffer(body[k * kl :], dtype="<u8").reshape(k, nq)
+                packed = _wire_format(q)
                 out = dcf.eval_lt_points(
-                    dcf.DcfKeyBatch.from_bytes(keys, log_n), xs
+                    dcf.DcfKeyBatch.from_bytes(keys, log_n), xs, packed=packed
                 )
-                self._reply(200, np.ascontiguousarray(out).tobytes())
+                if packed:
+                    self._reply(200, bitpack.words_to_wire(out, nq))
+                else:
+                    self._reply(200, np.ascontiguousarray(out).tobytes())
             elif route == "/v1/dcf_interval_gen":
                 from .models import dcf
 
@@ -192,8 +227,14 @@ class _Handler(BaseHTTPRequestHandler):
                     body[2 * k * kl : blob_len], dtype="<u1"
                 )
                 xs = np.frombuffer(body[blob_len:], dtype="<u8").reshape(k, nq)
-                out = dcf.eval_interval_points((upper, lower, const), xs)
-                self._reply(200, np.ascontiguousarray(out).tobytes())
+                packed = _wire_format(q)
+                out = dcf.eval_interval_points(
+                    (upper, lower, const), xs, packed=packed
+                )
+                if packed:
+                    self._reply(200, bitpack.words_to_wire(out, nq))
+                else:
+                    self._reply(200, np.ascontiguousarray(out).tobytes())
             else:
                 self._reply(404, b"not found", "text/plain")
         except Exception as e:  # noqa: BLE001 — bridge must not crash

@@ -60,7 +60,14 @@ def fast_profile():
     pa = fast.eval_points_batch(ka, xs)
     pb = fast.eval_points_batch(kb, xs)
     assert ((pa ^ pb) == [[1, 0, 0], [1, 0, 0], [1, 0, 0]]).all()
-    print("fast     : batched EvalFull + pointwise ok")
+    # Packed output: the same bits as uint32 words (8x less wire, 32x
+    # less D2H); XOR reconstruction works directly on the words.
+    from dpf_tpu.core import bitpack
+
+    wa = fast.eval_points_batch(ka, xs, packed=True)
+    wb = fast.eval_points_batch(kb, xs, packed=True)
+    assert (bitpack.unpack_bits(wa ^ wb, xs.shape[1]) == (pa ^ pb)).all()
+    print("fast     : batched EvalFull + pointwise (packed + unpacked) ok")
 
 
 def comparison_gates():

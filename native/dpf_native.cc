@@ -464,6 +464,33 @@ int dpfn_eval_points_batch(const uint8_t* keys, uint64_t n_keys,
   return 0;
 }
 
+// Packed-output variant: out is n_keys rows of ceil(n_points/8) bytes,
+// query j of row i at byte j/8, bit j%8 (LSB-first — the same convention
+// as the EvalFull output, dpf/dpf.go:207-209, and the framework's packed
+// wire format; core/bitpack.py is the contract's single source).  This is
+// the like-for-like baseline entry for the accelerated packed route: the
+// bytes produced here must equal the device path's packed rows exactly.
+int dpfn_eval_points_batch_packed(const uint8_t* keys, uint64_t n_keys,
+                                  uint64_t key_len, uint64_t log_n,
+                                  const uint64_t* xs, uint64_t n_points,
+                                  uint8_t* out_packed) {
+  if (log_n > 63 || key_len != serialized_key_len(log_n)) return -1;
+  const uint64_t row = (n_points + 7) / 8;
+  for (uint64_t i = 0; i < n_keys; i++) {
+    const uint8_t* key = keys + i * key_len;
+    if (!key_canonical(key, log_n)) return -4;
+    uint8_t* out_row = out_packed + i * row;
+    std::memset(out_row, 0, row);
+    for (uint64_t j = 0; j < n_points; j++) {
+      const uint64_t x = xs[i * n_points + j];
+      if (x >> log_n) return -3;
+      out_row[j >> 3] |= static_cast<uint8_t>(
+          eval_walk(key, key_len, x, log_n) << (j & 7));
+    }
+  }
+  return 0;
+}
+
 }  // extern "C"
 
 // ===========================================================================
@@ -736,6 +763,32 @@ int dpfn_cc_eval_full_batch(const uint8_t* keys, uint64_t n_keys,
   return 0;
 }
 
+namespace cc {
+// One fast-profile point evaluation (the walk shared by the unpacked and
+// packed batch entries); the key is already validated.
+inline uint8_t point_bit(const uint8_t* key, uint64_t key_len,
+                         uint64_t log_n, uint64_t x) {
+  const uint64_t lv = levels(log_n);
+  const uint8_t* fcw = key + key_len - 64;
+  St st;
+  load4(key, st.s);
+  st.t = key[16];
+  for (uint64_t d = 0; d < lv; d++)
+    descend(st, key + 17 + 18 * d, (x >> (log_n - 1 - d)) & 1);
+  uint32_t leaf[16];
+  convert(st.s, leaf);
+  if (st.t) {
+    for (int w = 0; w < 16; w++) {
+      uint32_t v;
+      std::memcpy(&v, fcw + 4 * w, 4);
+      leaf[w] ^= v;
+    }
+  }
+  const uint64_t low = log_n >= kLeafLog ? (x & 511) : x;
+  return static_cast<uint8_t>((leaf[low >> 5] >> (low & 31)) & 1);
+}
+}  // namespace cc
+
 // Fast-profile mirror of dpfn_eval_points_batch: contiguous keys, xs
 // uint64[n_keys * n_points], out bits uint8 (0/1) in the same layout.
 // Key canonical-form validation runs once per key, not per point.
@@ -744,31 +797,36 @@ int dpfn_cc_eval_points_batch(const uint8_t* keys, uint64_t n_keys,
                               const uint64_t* xs, uint64_t n_points,
                               uint8_t* out_bits) {
   if (log_n > 63 || key_len != cc::klen(log_n)) return -1;
-  const uint64_t lv = cc::levels(log_n);
   for (uint64_t i = 0; i < n_keys; i++) {
     const uint8_t* key = keys + i * key_len;
     if (!cc::canonical(key, log_n)) return -4;
-    const uint8_t* fcw = key + key_len - 64;
     for (uint64_t j = 0; j < n_points; j++) {
       const uint64_t x = xs[i * n_points + j];
       if (x >> log_n) return -3;
-      cc::St st;
-      cc::load4(key, st.s);
-      st.t = key[16];
-      for (uint64_t d = 0; d < lv; d++)
-        cc::descend(st, key + 17 + 18 * d, (x >> (log_n - 1 - d)) & 1);
-      uint32_t leaf[16];
-      cc::convert(st.s, leaf);
-      if (st.t) {
-        for (int w = 0; w < 16; w++) {
-          uint32_t v;
-          std::memcpy(&v, fcw + 4 * w, 4);
-          leaf[w] ^= v;
-        }
-      }
-      const uint64_t low = log_n >= cc::kLeafLog ? (x & 511) : x;
-      out_bits[i * n_points + j] =
-          static_cast<uint8_t>((leaf[low >> 5] >> (low & 31)) & 1);
+      out_bits[i * n_points + j] = cc::point_bit(key, key_len, log_n, x);
+    }
+  }
+  return 0;
+}
+
+// Packed-output variant (fast profile): rows of ceil(n_points/8) bytes,
+// LSB-first — see dpfn_eval_points_batch_packed.
+int dpfn_cc_eval_points_batch_packed(const uint8_t* keys, uint64_t n_keys,
+                                     uint64_t key_len, uint64_t log_n,
+                                     const uint64_t* xs, uint64_t n_points,
+                                     uint8_t* out_packed) {
+  if (log_n > 63 || key_len != cc::klen(log_n)) return -1;
+  const uint64_t row = (n_points + 7) / 8;
+  for (uint64_t i = 0; i < n_keys; i++) {
+    const uint8_t* key = keys + i * key_len;
+    if (!cc::canonical(key, log_n)) return -4;
+    uint8_t* out_row = out_packed + i * row;
+    std::memset(out_row, 0, row);
+    for (uint64_t j = 0; j < n_points; j++) {
+      const uint64_t x = xs[i * n_points + j];
+      if (x >> log_n) return -3;
+      out_row[j >> 3] |= static_cast<uint8_t>(
+          cc::point_bit(key, key_len, log_n, x) << (j & 7));
     }
   }
   return 0;
@@ -879,6 +937,52 @@ int dpfn_dcf_gen(uint64_t alpha, uint64_t log_n, const uint8_t* seed0,
   return 0;
 }
 
+namespace dcf {
+// One comparison-share walk (shared by the unpacked and packed batch
+// entries); the key is already validated.
+inline uint8_t point_share(const uint8_t* key, uint64_t key_len,
+                           uint64_t log_n, uint64_t x) {
+  const uint64_t lv = cc::levels(log_n);
+  const uint8_t* fvcw = key + key_len - 64;
+  uint32_t s[4];
+  cc::load4(key, s);
+  int t = key[16];
+  uint32_t acc = 0;
+  for (uint64_t d = 0; d < lv; d++) {
+    const uint8_t* cw = key + 17 + 19 * d;
+    uint32_t l[4], r[4], v;
+    expand_v(s, l, r, &v);
+    int tl = l[0] & 1, tr = r[0] & 1;
+    l[0] &= ~1u;
+    r[0] &= ~1u;
+    const uint32_t xbit = (x >> (log_n - 1 - d)) & 1;
+    if (!xbit) acc ^= (v ^ (t ? cw[18] : 0)) & 1;
+    if (t) {
+      uint32_t scw[4];
+      cc::load4(cw, scw);
+      cc::xor4(l, scw);
+      cc::xor4(r, scw);
+      tl ^= cw[16];
+      tr ^= cw[17];
+    }
+    std::memcpy(s, xbit ? r : l, 16);
+    t = xbit ? tr : tl;
+  }
+  uint32_t leaf[16];
+  cc::convert(s, leaf);
+  if (t) {
+    for (int w = 0; w < 16; w++) {
+      uint32_t v;
+      std::memcpy(&v, fvcw + 4 * w, 4);
+      leaf[w] ^= v;
+    }
+  }
+  const uint64_t low = log_n >= cc::kLeafLog ? (x & 511) : x;
+  acc ^= (leaf[low >> 5] >> (low & 31)) & 1;
+  return static_cast<uint8_t>(acc & 1);
+}
+}  // namespace dcf
+
 // Comparison-share walk: out bits uint8[n_keys * n_points], one key per
 // gate (same layout as dpfn_cc_eval_points_batch).
 int dpfn_dcf_eval_points_batch(const uint8_t* keys, uint64_t n_keys,
@@ -886,50 +990,36 @@ int dpfn_dcf_eval_points_batch(const uint8_t* keys, uint64_t n_keys,
                                const uint64_t* xs, uint64_t n_points,
                                uint8_t* out_bits) {
   if (log_n > 63 || log_n < 1 || key_len != dcf::klen(log_n)) return -1;
-  const uint64_t lv = cc::levels(log_n);
   for (uint64_t i = 0; i < n_keys; i++) {
     const uint8_t* key = keys + i * key_len;
     if (!dcf::canonical(key, log_n)) return -4;
-    const uint8_t* fvcw = key + key_len - 64;
     for (uint64_t j = 0; j < n_points; j++) {
       const uint64_t x = xs[i * n_points + j];
       if (x >> log_n) return -3;
-      uint32_t s[4];
-      cc::load4(key, s);
-      int t = key[16];
-      uint32_t acc = 0;
-      for (uint64_t d = 0; d < lv; d++) {
-        const uint8_t* cw = key + 17 + 19 * d;
-        uint32_t l[4], r[4], v;
-        dcf::expand_v(s, l, r, &v);
-        int tl = l[0] & 1, tr = r[0] & 1;
-        l[0] &= ~1u;
-        r[0] &= ~1u;
-        const uint32_t xbit = (x >> (log_n - 1 - d)) & 1;
-        if (!xbit) acc ^= (v ^ (t ? cw[18] : 0)) & 1;
-        if (t) {
-          uint32_t scw[4];
-          cc::load4(cw, scw);
-          cc::xor4(l, scw);
-          cc::xor4(r, scw);
-          tl ^= cw[16];
-          tr ^= cw[17];
-        }
-        std::memcpy(s, xbit ? r : l, 16);
-        t = xbit ? tr : tl;
-      }
-      uint32_t leaf[16];
-      cc::convert(s, leaf);
-      if (t) {
-        for (int w = 0; w < 16; w++) {
-          uint32_t v;
-          std::memcpy(&v, fvcw + 4 * w, 4);
-          leaf[w] ^= v;
-        }
-      }
-      const uint64_t low = log_n >= cc::kLeafLog ? (x & 511) : x;
-      acc ^= (leaf[low >> 5] >> (low & 31)) & 1;
-      out_bits[i * n_points + j] = static_cast<uint8_t>(acc & 1);
+      out_bits[i * n_points + j] = dcf::point_share(key, key_len, log_n, x);
+    }
+  }
+  return 0;
+}
+
+// Packed-output variant (DCF): rows of ceil(n_points/8) bytes, LSB-first
+// — see dpfn_eval_points_batch_packed.
+int dpfn_dcf_eval_points_batch_packed(const uint8_t* keys, uint64_t n_keys,
+                                      uint64_t key_len, uint64_t log_n,
+                                      const uint64_t* xs, uint64_t n_points,
+                                      uint8_t* out_packed) {
+  if (log_n > 63 || log_n < 1 || key_len != dcf::klen(log_n)) return -1;
+  const uint64_t row = (n_points + 7) / 8;
+  for (uint64_t i = 0; i < n_keys; i++) {
+    const uint8_t* key = keys + i * key_len;
+    if (!dcf::canonical(key, log_n)) return -4;
+    uint8_t* out_row = out_packed + i * row;
+    std::memset(out_row, 0, row);
+    for (uint64_t j = 0; j < n_points; j++) {
+      const uint64_t x = xs[i * n_points + j];
+      if (x >> log_n) return -3;
+      out_row[j >> 3] |= static_cast<uint8_t>(
+          dcf::point_share(key, key_len, log_n, x) << (j & 7));
     }
   }
   return 0;

@@ -10,12 +10,15 @@
 #       `./runtests.sh -m ''` for absolutely everything)
 #   ./runtests.sh --fast [pytest args]   kernel differential smoke lane:
 #       the Pallas kernel suites (fused + walk + expand routes, interpret
-#       mode) plus the S-box circuit invariants — surfaces kernel
-#       regressions in minutes instead of the full-suite half hour.
+#       mode), the S-box circuit invariants, and the packed<->unpacked
+#       output differentials (every packed route vs its byte-per-bit twin
+#       plus the sidecar wire contract) — surfaces kernel regressions in
+#       minutes instead of the full-suite half hour.
 if [ "${1:-}" = "--fast" ]; then
   shift
   set -- tests/test_aes_pallas.py tests/test_chacha_pallas.py \
       tests/test_fused_expand.py tests/test_aes_bitslice.py \
+      tests/test_packed.py \
       -q -m 'not slow' "$@"
 else
   # -m is last-wins in pytest, so a caller-supplied -m overrides ours.

@@ -11,7 +11,13 @@ the real Mosaic lowering of:
     — see chacha_pallas.small_tree_entry),
   * the lowlive S-box inside the bit-major PRG kernel,
   * the level-fused expansion kernels, both profiles (DPF_TPU_FUSE) —
-    the fused_ab bench step may only be trusted if these lower.
+    the fused_ab bench step may only be trusted if these lower,
+  * the DCF mode of the whole-walk kernel (models/dcf.py's TPU route),
+  * the chunked-scan finish pipelines, both profiles (lax.scan over the
+    subtree chunks wrapping the expand kernels),
+  * the packed-output routes (eval_points/grouped/DCF with packed=True:
+    the device-side pack composed with every walk kernel) — no packed
+    route's first real-Mosaic contact may happen in production.
 
 Each check runs in a containment wrapper: a failure (Mosaic rejection,
 mismatch) is recorded and the REMAINING checks still run — the
@@ -121,7 +127,7 @@ def main():
         rng = np.random.default_rng(406)
         try:
             os.environ["DPF_TPU_EXPAND_ENTRY"] = "small"
-            for log_n3 in (11, 14, 16):
+            for log_n3 in (11, 12, 14, 16):
                 ok, entry, _ = cp.expand_plan(log_n3 - 9, 3, 1 << 23)
                 assert ok and entry == 0, (log_n3, ok, entry)
                 a3 = rng.integers(0, 1 << log_n3, size=3, dtype=np.uint64)
@@ -209,6 +215,97 @@ def main():
         assert (got == want).all(), "fused-fast mismatch"
 
     _check("fused expansion (fast)", fused_fast, t0)
+
+    def dcf_walk():
+        # DCF mode of the whole-walk kernel (128 gates tile the lane
+        # quantum -> the production kernel route) vs the NumPy spec walk.
+        from dpf_tpu.models import dcf as dcf_mod
+
+        rng = np.random.default_rng(8)
+        log_n, K, Q = 20, 128, 16
+        alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+        da, db = dcf_mod.gen_lt_batch(alphas, log_n, rng=rng)
+        xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+        assert dcf_mod.points_kernel_eligible(K), "dcf kernel not eligible"
+        got = dcf_mod.eval_lt_points(da, xs)
+        want = dcf_mod.eval_points_np(da, xs)
+        assert (got == want).all(), "dcf walk kernel != spec"
+        rec = got ^ dcf_mod.eval_lt_points(db, xs)
+        assert (rec == (xs < alphas[:, None])).all(), "dcf reconstruction"
+
+    _check("dcf walk kernel", dcf_walk, t0)
+
+    def chunked_finish():
+        # Chunked-scan finish pipelines: tiny caps force the split, the
+        # scan-wrapped kernels must lower and match the one-shot routes.
+        from dpf_tpu.models.dpf import DeviceKeys, eval_full_device
+
+        rng = np.random.default_rng(9)
+        # compat: 8 keys n=16 -> 2^9 * (8/32 -> 1) words/plane; cap at 2^7
+        ka, _ = gen_batch(
+            rng.integers(0, 1 << 16, size=8, dtype=np.uint64), 16, rng=rng
+        )
+        dk = DeviceKeys(ka)
+        want = np.asarray(eval_full_device(dk))
+        got = np.asarray(eval_full_device(dk, max_plane_words=1 << 7))
+        assert (got == want).all(), "compat chunked finish mismatch"
+        # fast: 8 keys n=22 -> 2^25 padded leaf nodes; cap at 2^22 chunks
+        kaf, _ = kc.gen_batch(
+            rng.integers(0, 1 << 22, size=8, dtype=np.uint64), 22, rng=rng
+        )
+        wantf = dc.eval_full(kaf)
+        gotf = dc.eval_full(kaf, max_leaf_nodes=1 << 22)
+        assert (gotf == wantf).all(), "fast chunked finish mismatch"
+
+    _check("chunked-scan finish (both profiles)", chunked_finish, t0)
+
+    def packed_routes():
+        # Packed-output routes through every walk kernel: the device-side
+        # pack composed with the Mosaic kernels must lower, and the words
+        # must unpack to the byte-per-bit outputs exactly.
+        from dpf_tpu.core import bitpack
+        from dpf_tpu.models import dcf as dcf_mod
+        from dpf_tpu.models.fss import gen_lt_batch as gen_fss
+
+        rng = np.random.default_rng(10)
+        # compat whole-walk kernel: packed IS the kernel's native output
+        log_n, K, Q = 20, 16, 40
+        ka, _ = gen_batch(
+            rng.integers(0, 1 << log_n, size=K, dtype=np.uint64), log_n,
+            rng=rng,
+        )
+        xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+        bits = mdpf._eval_points_walk_compat(ka, xs)
+        words = mdpf._eval_points_walk_compat(ka, xs, packed=True)
+        assert (bitpack.unpack_bits(words, Q) == bits).all(), "compat packed"
+        # compat grouped + on-device reduce, packed
+        ca, _ = gen_fss(
+            rng.integers(0, 1 << 16, size=4, dtype=np.uint64), 16, rng=rng,
+            profile="compat",
+        )
+        xg = rng.integers(0, 1 << 16, size=(4, 16), dtype=np.uint64)
+        gb = mdpf.eval_points_level_grouped(ca.levels, xg, 1, reduce=True)
+        gw = mdpf.eval_points_level_grouped(
+            ca.levels, xg, 1, reduce=True, packed=True
+        )
+        assert (bitpack.unpack_bits(gw, 16) == gb).all(), "grouped packed"
+        # fast walk kernel packed (device-side qmajor pack)
+        kaf, _ = kc.gen_batch(
+            rng.integers(0, 1 << 20, size=128, dtype=np.uint64), 20, rng=rng
+        )
+        xf = rng.integers(0, 1 << 20, size=(128, Q), dtype=np.uint64)
+        bf = dc.eval_points(kaf, xf)
+        wf = dc.eval_points(kaf, xf, packed=True)
+        assert (bitpack.unpack_bits(wf, Q) == bf).all(), "fast packed"
+        # dcf walk kernel packed
+        da, _ = dcf_mod.gen_lt_batch(
+            rng.integers(0, 1 << 20, size=128, dtype=np.uint64), 20, rng=rng
+        )
+        bd = dcf_mod.eval_lt_points(da, xf)
+        wd = dcf_mod.eval_lt_points(da, xf, packed=True)
+        assert (bitpack.unpack_bits(wd, Q) == bd).all(), "dcf packed"
+
+    _check("packed-output routes", packed_routes, t0)
 
     if _FAILURES:
         print(f"TPU CHECKS FAILED: {', '.join(_FAILURES)}")

@@ -86,10 +86,10 @@ def test_bench_all_completes_past_a_dead_row():
     dead = [r for r in rows if r.get("error")]
     assert len(dead) == 1 and dead[0]["metric"] == "cfg3-fast", rows
     assert "forced failure" in dead[0]["error"]
-    # The matrix continued: the LATER compat section produced value rows,
-    # each carrying a route field.
+    # The matrix continued: the LATER compat section produced value rows
+    # (incl.-dispatch, packed, device), each carrying a route field.
     live = [r for r in rows if "compat" in r.get("metric", "")]
-    assert len(live) == 2, rows
+    assert len(live) == 3, rows
     assert all(r["value"] > 0 and r.get("route") for r in live), rows
 
 
@@ -118,8 +118,8 @@ def test_bench_all_ledger_resumes_without_remeasuring(tmp_path):
     dead = [r for r in rows1 if r.get("error")]
     assert len(dead) == 1 and "UNAVAILABLE" in dead[0]["error"], rows1
     live1 = [r for r in rows1 if "compat" in r.get("metric", "")]
-    assert len(live1) == 2 and all(r["value"] > 0 for r in live1), rows1
-    # Transient error NOT recorded; the compat section (both rows) is.
+    assert len(live1) == 3 and all(r["value"] > 0 for r in live1), rows1
+    # Transient error NOT recorded; the compat section (all rows) is.
     recorded = [json.loads(ln) for ln in open(ledger) if ln.strip()]
     assert [r.get("section") for r in recorded] == [None, "cfg3-compat"], (
         recorded

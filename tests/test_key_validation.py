@@ -1,0 +1,159 @@
+"""Fuzzed non-canonical-key rejection — NO backend may diverge on
+malformed keys.
+
+The reference validates nothing (dpf.go:72-74 trusts its caller); this
+framework's contract is stricter: Gen only ever emits canonical keys
+(control bytes in {0,1}, seed/sCW LSBs clear), and every ingestion point —
+the NumPy spec parser, the device batch codecs, and the native C++
+backend — must REJECT anything else, identically.  A backend that accepted
+a non-canonical key would evaluate it to backend-dependent bytes (the
+bitsliced evaluator reads the t-byte as a lane mask, the native one as an
+int), silently breaking the all-backends-bit-identical invariant.
+
+The fuzzer targets the canonical-form constraint surface directly (random
+corruptions of the constrained bytes, random values that violate them)
+plus wrong-length keys; each mutated key must raise everywhere."""
+
+import numpy as np
+import pytest
+
+from dpf_tpu.backends import cpu_native
+from dpf_tpu.core import chacha_np as cc
+from dpf_tpu.core import spec
+from dpf_tpu.core.keys import KeyBatch
+from dpf_tpu.models import dcf as dcf_mod
+from dpf_tpu.models.keys_chacha import KeyBatchFast
+
+N_FUZZ = 60  # mutations per profile (deterministic rng)
+
+
+def _corruptions(rng, key: bytes, cw_off: int, cw_stride: int, nu: int,
+                 ctrl_in_cw: tuple[int, ...]):
+    """Yield non-canonical mutations of ``key``: every canonical
+    constraint violated at fuzzed positions with fuzzed values.
+
+    ``cw_off``/``cw_stride`` locate the per-level CWs; ``ctrl_in_cw`` are
+    the control-byte offsets within one CW (bytes constrained to {0,1});
+    byte 0 of the key and of each CW must have a clear LSB."""
+    for _ in range(N_FUZZ):
+        k = bytearray(key)
+        kind = rng.integers(0, 4 if nu else 2)
+        if kind == 0:  # root control byte out of {0, 1}
+            k[16] = int(rng.integers(2, 256))
+        elif kind == 1:  # root seed LSB set
+            k[0] |= 1
+        elif kind == 2:  # a level CW's control byte out of {0, 1}
+            i = int(rng.integers(0, nu))
+            off = cw_off + cw_stride * i + int(
+                ctrl_in_cw[rng.integers(0, len(ctrl_in_cw))]
+            )
+            k[off] = int(rng.integers(2, 256))
+        else:  # a level sCW's LSB set
+            i = int(rng.integers(0, nu))
+            k[cw_off + cw_stride * i] |= 1
+        yield bytes(k)
+    # wrong lengths are malformed too
+    yield key[:-1]
+    yield key + b"\x00"
+
+
+def _native(fn_name):
+    if not cpu_native.available():
+        return None
+    return getattr(cpu_native, fn_name)
+
+
+def test_compat_backends_agree_on_rejection():
+    rng = np.random.default_rng(11)
+    log_n = 12
+    nu = log_n - 7
+    ka, _ = spec.gen(123, log_n, rng)
+    nat_eval = _native("eval_point")
+    nat_full = _native("eval_full")
+    # the valid key is accepted everywhere
+    spec.parse_key(ka, log_n)
+    KeyBatch.from_bytes([ka], log_n)
+    if nat_eval:
+        nat_eval(ka, 123, log_n)
+        nat_full(ka, log_n)
+    for bad in _corruptions(rng, ka, 17, 18, nu, (16, 17)):
+        with pytest.raises(ValueError):
+            spec.eval_point(bad, 0, log_n)
+        with pytest.raises(ValueError):
+            spec.eval_full(bad, log_n)
+        with pytest.raises(ValueError):
+            KeyBatch.from_bytes([bad], log_n)
+        if nat_eval:
+            with pytest.raises(ValueError):
+                nat_eval(bad, 0, log_n)
+            with pytest.raises(ValueError):
+                nat_full(bad, log_n)
+            with pytest.raises(ValueError):
+                cpu_native.eval_points_batch(
+                    [bad], np.zeros((1, 2), np.uint64), log_n
+                )
+
+
+def test_fast_backends_agree_on_rejection():
+    rng = np.random.default_rng(12)
+    log_n = 13
+    nu = cc.nu_of(log_n)
+    ka, _ = cc.gen(77, log_n, rng)
+    nat_eval = _native("cc_eval_point")
+    cc.eval_point(ka, 77, log_n)
+    KeyBatchFast.from_bytes([ka], log_n)
+    if nat_eval:
+        nat_eval(ka, 77, log_n)
+    for bad in _corruptions(rng, ka, 17, 18, nu, (16, 17)):
+        with pytest.raises(ValueError):
+            cc.eval_point(bad, 0, log_n)
+        with pytest.raises(ValueError):
+            cc.eval_full(bad, log_n)
+        with pytest.raises(ValueError):
+            KeyBatchFast.from_bytes([bad], log_n)
+        if nat_eval:
+            with pytest.raises(ValueError):
+                nat_eval(bad, 0, log_n)
+            with pytest.raises(ValueError):
+                cpu_native.cc_eval_points_batch(
+                    [bad], np.zeros((1, 2), np.uint64), log_n
+                )
+            with pytest.raises(ValueError):
+                cpu_native.cc_eval_points_batch_packed(
+                    [bad], np.zeros((1, 2), np.uint64), log_n
+                )
+
+
+def test_dcf_backends_agree_on_rejection():
+    rng = np.random.default_rng(13)
+    log_n = 13
+    nu = cc.nu_of(log_n)
+    da, _ = dcf_mod.gen_lt_batch(
+        np.array([99], dtype=np.uint64), log_n, rng=rng
+    )
+    ka = da.to_bytes()[0]
+    xs1 = np.zeros((1, 2), np.uint64)
+    nat = _native("dcf_eval_points_batch")
+    dcf_mod.DcfKeyBatch.from_bytes([ka], log_n)
+    if nat:
+        nat([ka], xs1, log_n)
+    # DCF CWs are 19 bytes: sCW(16) | tL | tR | VCW — three {0,1} bytes
+    for bad in _corruptions(rng, ka, 17, 19, nu, (16, 17, 18)):
+        with pytest.raises(ValueError):
+            dcf_mod.DcfKeyBatch.from_bytes([bad], log_n)
+        if nat:
+            with pytest.raises(ValueError):
+                nat([bad], xs1, log_n)
+            with pytest.raises(ValueError):
+                cpu_native.dcf_eval_points_batch_packed([bad], xs1, log_n)
+
+
+def test_small_domain_keys_fuzzed_too():
+    """nu = 0 keys (no CW levels) still have constrained root bytes."""
+    rng = np.random.default_rng(14)
+    ka, _ = spec.gen(3, 5, rng)
+    for bad in _corruptions(rng, ka, 17, 18, 0, (16, 17)):
+        with pytest.raises(ValueError):
+            spec.eval_point(bad, 0, 5)
+        with pytest.raises(ValueError):
+            KeyBatch.from_bytes([bad], 5)

@@ -1,0 +1,396 @@
+"""Packed-output pipeline differentials: packed must equal unpacked after
+unpack — across spec, device (interpret kernels), and native backends,
+both profiles, including DCF — and the sidecar's packed wire format must
+be exactly K * ceil(Q/8) LSB-first bytes (core/bitpack is the contract's
+single source).  Query counts are deliberately NOT multiples of 32/8 so
+the tail-masking contract (bits >= Q zero) is always under test."""
+
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpf_tpu.backends import cpu_native
+from dpf_tpu.core import bitpack
+from dpf_tpu.core.keys import gen_batch
+from dpf_tpu.models import dcf as dcf_mod
+from dpf_tpu.models import dpf as mdpf
+from dpf_tpu.models import dpf_chacha as mdc
+from dpf_tpu.models import fss
+from dpf_tpu.models import keys_chacha as kc
+
+
+def test_bitpack_roundtrip_and_tail():
+    rng = np.random.default_rng(0)
+    for q in (1, 7, 8, 31, 32, 33, 95):
+        bits = rng.integers(0, 2, size=(3, q), dtype=np.uint8)
+        words = bitpack.pack_bits(bits)
+        assert words.shape == (3, bitpack.packed_words(q))
+        assert (bitpack.unpack_bits(words, q) == bits).all()
+        # tail bits are zero by construction
+        assert (bitpack.mask_tail(words, q) == words).all()
+        # wire roundtrip
+        wire = bitpack.words_to_wire(words, q)
+        assert len(wire) == 3 * bitpack.packed_bytes(q)
+        assert (bitpack.wire_to_words(wire, 3, q) == words).all()
+        # the wire bytes ARE numpy's LSB-first packbits
+        assert wire == np.packbits(bits, axis=1, bitorder="little").tobytes()
+
+
+def test_compat_packed_matches_unpacked_and_spec():
+    from dpf_tpu.core import spec
+
+    rng = np.random.default_rng(1)
+    log_n, K, Q = 10, 3, 37
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, kb = gen_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    xs[:, 0] = alphas
+    bits = mdpf.eval_points(ka, xs, backend="xla")
+    words = mdpf.eval_points(ka, xs, backend="xla", packed=True)
+    assert words.dtype == np.uint32
+    assert (bitpack.unpack_bits(words, Q) == bits).all()
+    assert (bitpack.pack_bits(bits) == words).all()
+    # spec cross-check of a few (key, query) cells
+    keys = ka.to_bytes()
+    for i in range(K):
+        for j in (0, 1, Q - 1):
+            assert bits[i, j] == spec.eval_point(keys[i], int(xs[i, j]), log_n)
+    # XOR reconstruction commutes with the packing
+    wb = mdpf.eval_points(kb, xs, backend="xla", packed=True)
+    rec = bitpack.unpack_bits(words ^ wb, Q)
+    np.testing.assert_array_equal(rec, (xs == alphas[:, None]).astype(np.uint8))
+
+
+def test_compat_walk_kernel_packed_is_native_output():
+    """The interpret-mode walk kernel route: packed output must be the
+    kernel's own words (no repack), identical to the unpacked route's
+    bits after unpack."""
+    rng = np.random.default_rng(2)
+    log_n, K, Q = 13, 8, 40
+    ka, _ = gen_batch(
+        rng.integers(0, 1 << log_n, size=K, dtype=np.uint64), log_n, rng=rng
+    )
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    bits = mdpf._eval_points_walk_compat(ka, xs)
+    words = mdpf._eval_points_walk_compat(ka, xs, packed=True)
+    assert (bitpack.pack_bits(bits) == words).all()
+
+
+def test_compat_grouped_packed_both_routes():
+    rng = np.random.default_rng(3)
+    n, G, Q = 10, 3, 11
+    ca, _ = fss.gen_lt_batch(
+        rng.integers(0, 1 << n, size=G, dtype=np.uint64), n, rng=rng,
+        profile="compat",
+    )
+    xs = rng.integers(0, 1 << n, size=(G, Q), dtype=np.uint64)
+    for reduce in (False, True):
+        bits = mdpf.eval_points_level_grouped(
+            ca.levels, xs, groups=1, reduce=reduce
+        )
+        words = mdpf.eval_points_level_grouped(
+            ca.levels, xs, groups=1, reduce=reduce, packed=True
+        )
+        assert (bitpack.pack_bits(bits) == words).all()
+
+
+def test_fast_packed_matches_unpacked_and_spec():
+    from dpf_tpu.core import chacha_np as cc
+
+    rng = np.random.default_rng(4)
+    log_n, K, Q = 12, 4, 33
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    ka, _ = kc.gen_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    xs[:, 0] = alphas
+    bits = mdc.eval_points(ka, xs)
+    words = mdc.eval_points(ka, xs, packed=True)
+    assert (bitpack.pack_bits(bits) == words).all()
+    keys = ka.to_bytes()
+    for i in range(K):
+        assert bits[i, 0] == cc.eval_point(keys[i], int(xs[i, 0]), log_n)
+
+
+def test_fast_walk_kernel_packed_matches():
+    """Interpret-mode fast-profile walk kernel: packed (device-side pack)
+    vs unpacked, plain and level-grouped-reduced."""
+    from dpf_tpu.ops import chacha_pallas as cp
+
+    rng = np.random.default_rng(5)
+    log_n, K, Q = 12, 128, 24
+    ka, _ = kc.gen_batch(
+        rng.integers(0, 1 << log_n, size=K, dtype=np.uint64), log_n, rng=rng
+    )
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    bits = cp.eval_points_walk(ka, xs)
+    words = cp.eval_points_walk(ka, xs, packed=True)
+    assert (bitpack.pack_bits(bits) == words).all()
+
+
+def test_fast_grouped_packed_xla_route():
+    rng = np.random.default_rng(6)
+    n, G, Q = 12, 2, 9
+    ca, _ = fss.gen_lt_batch(
+        rng.integers(0, 1 << n, size=G, dtype=np.uint64), n, rng=rng,
+        profile="fast",
+    )
+    xs = rng.integers(0, 1 << n, size=(G, Q), dtype=np.uint64)
+    for reduce in (False, True):
+        bits = mdc.eval_points_level_grouped(
+            ca.levels, xs, groups=1, reduce=reduce
+        )
+        words = mdc.eval_points_level_grouped(
+            ca.levels, xs, groups=1, reduce=reduce, packed=True
+        )
+        assert (bitpack.pack_bits(bits) == words).all()
+
+
+def test_fss_gates_packed_both_profiles():
+    rng = np.random.default_rng(7)
+    n, G, Q = 10, 3, 13
+    for prof in ("compat", "fast"):
+        alphas = rng.integers(0, 1 << n, size=G, dtype=np.uint64)
+        ca, cb = fss.gen_lt_batch(alphas, n, rng=rng, profile=prof)
+        xs = rng.integers(0, 1 << n, size=(G, Q), dtype=np.uint64)
+        wa = fss.eval_lt_points(ca, xs, packed=True)
+        wb = fss.eval_lt_points(cb, xs, packed=True)
+        assert (bitpack.pack_bits(fss.eval_lt_points(ca, xs)) == wa).all()
+        rec = bitpack.unpack_bits(wa ^ wb, Q)
+        np.testing.assert_array_equal(
+            rec, (xs < alphas[:, None]).astype(np.uint8)
+        )
+        # interval gates, including the hi = 2^n - 1 wrap edge (public
+        # constant complements the packed row)
+        lo = np.array([0, 5, 100], dtype=np.uint64)
+        hi = np.array([(1 << n) - 1, 9, 100], dtype=np.uint64)
+        ia, ib = fss.gen_interval_batch(lo, hi, n, rng=rng, profile=prof)
+        wia = fss.eval_interval_points(ia, xs, packed=True)
+        wib = fss.eval_interval_points(ib, xs, packed=True)
+        assert (
+            bitpack.pack_bits(fss.eval_interval_points(ia, xs)) == wia
+        ).all()
+        rec = bitpack.unpack_bits(wia ^ wib, Q)
+        np.testing.assert_array_equal(
+            rec,
+            ((xs >= lo[:, None]) & (xs <= hi[:, None])).astype(np.uint8),
+        )
+
+
+def test_dcf_packed_matches_unpacked_and_spec():
+    rng = np.random.default_rng(8)
+    log_n, K, Q = 12, 4, 21
+    alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+    da, db = dcf_mod.gen_lt_batch(alphas, log_n, rng=rng)
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    bits = dcf_mod.eval_lt_points(da, xs)
+    words = dcf_mod.eval_lt_points(da, xs, packed=True)
+    assert (bitpack.pack_bits(bits) == words).all()
+    np.testing.assert_array_equal(bits, dcf_mod.eval_points_np(da, xs))
+    # packed reconstruction
+    wb = dcf_mod.eval_lt_points(db, xs, packed=True)
+    rec = bitpack.unpack_bits(words ^ wb, Q)
+    np.testing.assert_array_equal(rec, (xs < alphas[:, None]).astype(np.uint8))
+    # interval gates on packed words, wrap edge included
+    lo = np.array([0, 5, 9, 100], dtype=np.uint64)
+    hi = np.array([(1 << log_n) - 1, 9, 9, 4000], dtype=np.uint64)
+    ia, ib = dcf_mod.gen_interval_batch(lo, hi, log_n, rng=rng)
+    wia = dcf_mod.eval_interval_points(ia, xs, packed=True)
+    wib = dcf_mod.eval_interval_points(ib, xs, packed=True)
+    assert (
+        bitpack.pack_bits(dcf_mod.eval_interval_points(ia, xs)) == wia
+    ).all()
+    rec = bitpack.unpack_bits(wia ^ wib, Q)
+    np.testing.assert_array_equal(
+        rec, ((xs >= lo[:, None]) & (xs <= hi[:, None])).astype(np.uint8)
+    )
+
+
+def test_native_packed_matches_device_bytes():
+    """Baseline parity: the native packed batch entries must produce the
+    SAME bytes as the accelerated packed routes — the A/B compares
+    like-for-like."""
+    if not cpu_native.available():
+        pytest.skip(f"native backend unavailable: {cpu_native.load_error()}")
+    rng = np.random.default_rng(9)
+    log_n, K, Q = 12, 3, 21
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+
+    # compat
+    ka, _ = gen_batch(
+        rng.integers(0, 1 << log_n, size=K, dtype=np.uint64), log_n, rng=rng
+    )
+    dev = mdpf.eval_points(ka, xs, backend="xla", packed=True)
+    nat = cpu_native.eval_points_batch_packed(ka.to_bytes(), xs, log_n)
+    assert (bitpack.byte_rows_to_words(nat, Q) == dev).all()
+
+    # fast
+    kaf, _ = kc.gen_batch(
+        rng.integers(0, 1 << log_n, size=K, dtype=np.uint64), log_n, rng=rng
+    )
+    dev = mdc.eval_points(kaf, xs, packed=True)
+    nat = cpu_native.cc_eval_points_batch_packed(kaf.to_bytes(), xs, log_n)
+    assert (bitpack.byte_rows_to_words(nat, Q) == dev).all()
+
+    # dcf
+    da, _ = dcf_mod.gen_lt_batch(
+        rng.integers(0, 1 << log_n, size=K, dtype=np.uint64), log_n, rng=rng
+    )
+    dev = dcf_mod.eval_lt_points(da, xs, packed=True)
+    nat = cpu_native.dcf_eval_points_batch_packed(da.to_bytes(), xs, log_n)
+    assert (bitpack.byte_rows_to_words(nat, Q) == dev).all()
+
+
+def test_sharded_packed_matches(tmp_path):
+    from dpf_tpu.parallel.sharding import (
+        eval_points_sharded,
+        eval_points_sharded_fast,
+        make_mesh,
+    )
+
+    rng = np.random.default_rng(10)
+    mesh = make_mesh(4)
+    log_n, K, Q = 10, 8, 21
+    ka, _ = gen_batch(
+        rng.integers(0, 1 << log_n, size=K, dtype=np.uint64), log_n, rng=rng
+    )
+    xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+    bits = eval_points_sharded(ka, xs, mesh)
+    words = eval_points_sharded(ka, xs, mesh, packed=True)
+    assert (bitpack.pack_bits(bits) == words).all()
+
+    kaf, _ = kc.gen_batch(
+        rng.integers(0, 1 << 12, size=K, dtype=np.uint64), 12, rng=rng
+    )
+    xf = rng.integers(0, 1 << 12, size=(K, Q), dtype=np.uint64)
+    bits = eval_points_sharded_fast(kaf, xf, mesh)
+    words = eval_points_sharded_fast(kaf, xf, mesh, packed=True)
+    assert (bitpack.pack_bits(bits) == words).all()
+
+
+# ---------------------------------------------------------------------------
+# Wire level: /v1/eval_points_batch format negotiation
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def srv():
+    from dpf_tpu import server as srv_mod
+
+    s = srv_mod.serve(port=0)
+    yield f"http://127.0.0.1:{s.server_address[1]}"
+    s.shutdown()
+
+
+def _post(url, body=b""):
+    req = urllib.request.Request(url, data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return r.read()
+
+
+def test_server_packed_wire_exact_bytes(srv):
+    """Acceptance: the packed response is EXACTLY K * ceil(Q/8) bytes
+    (LSB-first), the unpacked format still serves under the back-compat
+    param/default, and the two agree bit-for-bit."""
+    from dpf_tpu.core import chacha_np as cc
+
+    log_n, k, q = 12, 2, 37
+    kl = cc.key_len(log_n)
+    blobs = [
+        _post(f"{srv}/v1/gen?log_n={log_n}&alpha={a}&profile=fast")
+        for a in (5, 900)
+    ]
+    xs = np.random.default_rng(0).integers(
+        0, 1 << log_n, size=(k, q), dtype="<u8"
+    )
+    body = b"".join(b[:kl] for b in blobs) + xs.tobytes()
+    url = f"{srv}/v1/eval_points_batch?log_n={log_n}&k={k}&q={q}&profile=fast"
+    default = _post(url, body)  # no format param: byte-per-bit back-compat
+    unpacked = _post(url + "&format=bits", body)
+    assert default == unpacked
+    packed = _post(url + "&format=packed", body)
+    assert len(unpacked) == k * q
+    assert len(packed) == k * bitpack.packed_bytes(q)
+    bits = np.frombuffer(unpacked, np.uint8).reshape(k, q)
+    assert packed == np.packbits(bits, axis=1, bitorder="little").tobytes()
+    # unknown format -> 400, never a crash
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(url + "&format=zstd", body)
+    assert ei.value.code == 400
+
+
+def test_server_packed_wire_reduction_config_shapes(srv):
+    """The config-3/5-shaped wire cut: Q=4096 points (config 3) and Q=32
+    gate points (config 5, DCF) both shrink exactly 8x on the wire."""
+    from dpf_tpu.core import chacha_np as cc
+
+    # config-3 shape (Q=4096; domain shrunk so the CPU walk stays fast)
+    log_n, k, q = 12, 2, 4096
+    kl = cc.key_len(log_n)
+    blobs = [
+        _post(f"{srv}/v1/gen?log_n={log_n}&alpha={a}&profile=fast")
+        for a in (1, 2)
+    ]
+    xs = np.random.default_rng(1).integers(
+        0, 1 << log_n, size=(k, q), dtype="<u8"
+    )
+    body = b"".join(b[:kl] for b in blobs) + xs.tobytes()
+    url = f"{srv}/v1/eval_points_batch?log_n={log_n}&k={k}&q={q}&profile=fast"
+    unpacked = _post(url, body)
+    packed = _post(url + "&format=packed", body)
+    assert len(unpacked) == 8 * len(packed)  # >= 8x wire reduction
+    bits = np.frombuffer(unpacked, np.uint8).reshape(k, q)
+    assert packed == np.packbits(bits, axis=1, bitorder="little").tobytes()
+
+    # config-5 shape through the DCF endpoint (32 pts/gate -> 4 bytes/gate)
+    log_n5, g, q5 = 12, 3, 32
+    alphas = np.array([17, 900, 2047], dtype="<u8")
+    blob = _post(f"{srv}/v1/dcf_gen?log_n={log_n5}&k={g}", alphas.tobytes())
+    kl5 = dcf_mod.key_len(log_n5)
+    xs5 = np.random.default_rng(2).integers(
+        0, 1 << log_n5, size=(g, q5), dtype="<u8"
+    )
+    body5 = blob[: g * kl5] + xs5.tobytes()
+    url5 = f"{srv}/v1/dcf_eval_points?log_n={log_n5}&k={g}&q={q5}"
+    unpacked5 = _post(url5, body5)
+    packed5 = _post(url5 + "&format=packed", body5)
+    assert len(unpacked5) == 8 * len(packed5)
+    bits5 = np.frombuffer(unpacked5, np.uint8).reshape(g, q5)
+    assert packed5 == np.packbits(bits5, axis=1, bitorder="little").tobytes()
+
+
+def test_server_interval_packed_wire(srv):
+    """/v1/dcf_interval_eval with format=packed — the one packed endpoint
+    whose response is post-processed AFTER packing (the public wrap
+    constant complements rows, then the tail re-masks), so the wire path
+    needs its own pin.  Includes the hi = 2^n - 1 wrap gate and an odd Q
+    (tail bits must stay zero through the complement)."""
+    log_n, k, q = 10, 3, 11
+    lo = np.array([0, 100, 512], dtype="<u8")
+    hi = np.array([0, 400, (1 << log_n) - 1], dtype="<u8")
+    blob = _post(
+        f"{srv}/v1/dcf_interval_gen?log_n={log_n}&k={k}",
+        lo.tobytes() + hi.tobytes(),
+    )
+    kl = dcf_mod.key_len(log_n)
+    half = 2 * k * kl + k
+    xs = np.random.default_rng(3).integers(
+        0, 1 << log_n, size=(k, q), dtype="<u8"
+    )
+    url = f"{srv}/v1/dcf_interval_eval?log_n={log_n}&k={k}&q={q}"
+    rec_u = rec_p = None
+    for h in (0, 1):
+        body = blob[h * half : (h + 1) * half] + xs.tobytes()
+        u = _post(url, body)
+        p = _post(url + "&format=packed", body)
+        assert len(u) == k * q
+        assert len(p) == k * bitpack.packed_bytes(q)
+        bits = np.frombuffer(u, np.uint8).reshape(k, q)
+        assert p == np.packbits(bits, axis=1, bitorder="little").tobytes()
+        rec_u = bits if rec_u is None else rec_u ^ bits
+        pw = bitpack.wire_to_words(p, k, q)
+        rec_p = pw if rec_p is None else rec_p ^ pw
+    want = ((xs >= lo[:, None]) & (xs <= hi[:, None])).astype(np.uint8)
+    np.testing.assert_array_equal(rec_u, want)
+    np.testing.assert_array_equal(bitpack.unpack_bits(rec_p, q), want)

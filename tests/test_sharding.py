@@ -12,6 +12,7 @@ import pytest
 import dpf_tpu
 from dpf_tpu.core import spec
 from dpf_tpu.parallel import eval_full_sharded, make_mesh, xor_allreduce
+from dpf_tpu.parallel.sharding import shard_map_compat
 
 pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
@@ -70,7 +71,7 @@ def test_xor_allreduce():
     )
 
     f = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             lambda x: xor_allreduce(x, "x"),
             mesh=mesh,
             in_specs=P("x", None),
