@@ -89,6 +89,11 @@ _ROUTE_KNOBS = (
     # Output-format knob: packed vs byte-per-bit rows must never collide
     # on a ledger resume.
     "DPF_TPU_WIRE_FORMAT",
+    # Serving fast-path knobs: batching/donation/streaming change what the
+    # serving-latency sections measure.
+    "DPF_TPU_BATCH", "DPF_TPU_BATCH_WINDOW_US", "DPF_TPU_BATCH_MAX_KEYS",
+    "DPF_TPU_DONATE", "DPF_TPU_STREAM", "DPF_TPU_STREAM_MIN_BYTES",
+    "DPF_TPU_PLAN_KFLOOR", "DPF_TPU_KEY_CACHE_ENTRIES",
 )
 # DPF_TPU_BENCH_LEDGER_RETRY_ERRORS=1: sections whose recorded rows
 # contain an error row are NOT replayed (and not re-recorded) — the
@@ -265,13 +270,15 @@ def _skipped(name: str, why: str) -> None:
 
 
 def _emit(name, value, unit, baseline=None, route=None, scale=1e9,
-          bytes_out=None):
+          bytes_out=None, extra=None):
     """One scoreboard row.  ``baseline`` is in base units/sec and ``scale``
     converts ``value``'s unit to base units (1e9 for Gleaves rows, 1e6 for
     Mqueries/Mgate rows, 1 for queries/sec) so every row's ``vs_baseline``
     is a live like-for-like ratio.  ``bytes_out`` stamps the row's result
     payload (D2H / wire bytes a client of this call receives) — the packed
-    rows' whole point is this number dropping 8x at equal correctness."""
+    rows' whole point is this number dropping 8x at equal correctness.
+    ``extra`` merges additional committed fields into the row (the serving
+    rows' latency percentiles and ``batch_coalesced``)."""
     row = {"metric": name, "value": round(value, 3), "unit": unit}
     if route:
         row["route"] = route
@@ -279,7 +286,25 @@ def _emit(name, value, unit, baseline=None, route=None, scale=1e9,
         row["bytes_out"] = int(bytes_out)
     if baseline:
         row["vs_baseline"] = round(value * scale / baseline, 2)
+    if extra:
+        row.update(extra)
     _out(row)
+
+
+def _percentiles_ms(lat: list[float]) -> dict:
+    """p50/p95/p99 row fields from per-request wall latencies (seconds).
+    Queue-wait is included by construction — the client-side clock starts
+    before the request enters the sidecar's batcher."""
+    if not lat:
+        raise RuntimeError("no completed requests to take percentiles of")
+    a = np.sort(np.asarray(lat, dtype=np.float64)) * 1e3
+    pick = lambda p: float(a[min(len(a) - 1, int(len(a) * p))])  # noqa: E731
+    return {
+        "p50_ms": round(pick(0.50), 3),
+        "p95_ms": round(pick(0.95), 3),
+        "p99_ms": round(pick(0.99), 3),
+        "n_requests": len(a),
+    }
 
 
 def _native_points_rate(kind: str, log_n: int, q: int, keys_n: int = 8):
@@ -856,6 +881,158 @@ def main():
               ))
 
     _section("cfg3-compat", cfg3_compat)
+
+    # ---- serving fast path: latency percentiles through the sidecar --------
+    # Queue-wait-inclusive per-request wall latencies (the number a client
+    # actually observes) plus ``batch_coalesced`` — keys per dispatch the
+    # micro-batcher ACHIEVED, read back from /v1/stats — so the batcher's
+    # effect is a committed number, not a claim.  The config-1-shaped row
+    # (single-key EvalFull, dispatch-inclusive) is the direct measure of
+    # VERDICT Weak #4: PR 2's cfg1 rows were device-only chained slope.
+    def cfg_serving():
+        import urllib.request
+
+        from dpf_tpu import server as srv_mod
+
+        srv_mod.reset_serving_state()
+        s = srv_mod.serve(port=0)
+        try:
+            base = f"http://127.0.0.1:{s.server_address[1]}"
+
+            def post(path, body=b""):
+                req = urllib.request.Request(
+                    base + path, data=body, method="POST"
+                )
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    return r.read()
+
+            n1 = 16 if not small else 12
+            np1, qp1, nthread, per_t = (
+                (20, 512, 16, 8) if not small else (12, 64, 4, 2)
+            )
+            # Plan warmup BEFORE the timed requests — first-request
+            # compile must never pollute a latency percentile.
+            kbuckets = sorted(
+                {1 << i for i in range(nthread.bit_length() + 1)}
+            )
+            post(
+                "/v1/warmup",
+                json.dumps(
+                    {
+                        "shapes": (
+                            [{"route": "evalfull", "profile": "fast",
+                              "log_n": n1, "k": 1}]
+                            + [{"route": "points", "profile": "fast",
+                                "log_n": np1, "k": kb, "q": qp1}
+                               for kb in kbuckets]
+                        )
+                    }
+                ).encode(),
+            )
+
+            from dpf_tpu.models import keys_chacha as kc_mod
+
+            rngs = np.random.default_rng(77)
+            ka1, _ = kc_mod.gen_batch(
+                np.array([123 % (1 << n1)], np.uint64), n1, rng=rngs
+            )
+            key1 = ka1.to_bytes()[0]
+            reps1 = 48 if not small else 8
+            lat1 = []
+            for _ in range(reps1):
+                t0 = time.perf_counter()
+                post(f"/v1/evalfull?log_n={n1}&profile=fast", key1)
+                lat1.append(time.perf_counter() - t0)
+            pct1 = _percentiles_ms(lat1)
+            _emit(
+                f"serving 1-key evalfull n={n1} (fast, http incl. dispatch)",
+                (1 << n1) / (pct1["p50_ms"] / 1e3) / 1e9,
+                "Gleaves/sec", baseline,
+                route=_route("sidecar,plan-cache"),
+                bytes_out=(1 << n1) // 8, extra=pct1,
+            )
+
+            # Concurrent single-key pointwise: nthread clients x per_t
+            # requests each, packed wire — the micro-batcher's shape.
+            alphas = rngs.integers(
+                0, 1 << np1, size=nthread, dtype=np.uint64
+            )
+            kbs = [
+                kc_mod.gen_batch(
+                    np.array([a], np.uint64), np1, rng=rngs
+                )[0].to_bytes()[0]
+                for a in alphas
+            ]
+            xs_rows = [
+                rngs.integers(0, 1 << np1, size=(1, qp1), dtype=np.uint64)
+                for _ in range(nthread)
+            ]
+            import threading as _th
+
+            lats: list[float] = []
+            lat_lock = _th.Lock()
+            errs: list = []
+
+            def client(i):
+                body = kbs[i] + xs_rows[i].tobytes()
+                path = (
+                    f"/v1/eval_points_batch?log_n={np1}&k=1&q={qp1}"
+                    "&profile=fast&format=packed"
+                )
+                try:
+                    for _ in range(per_t):
+                        t0 = time.perf_counter()
+                        post(path, body)
+                        dt = time.perf_counter() - t0
+                        with lat_lock:
+                            lats.append(dt)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            stats0 = json.loads(
+                urllib.request.urlopen(base + "/v1/stats", timeout=30).read()
+            )["batcher"]
+            threads = [
+                _th.Thread(target=client, args=(i,)) for i in range(nthread)
+            ]
+            t_all = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(300)
+            wall = time.perf_counter() - t_all
+            if errs:
+                raise errs[0]
+            if any(t.is_alive() for t in threads):
+                # A wedged dispatch must become an honest error row, not
+                # a silently-partial percentile row read mid-flight.
+                raise RuntimeError(
+                    "serving bench wedged: client threads still running "
+                    "after 300s"
+                )
+            stats1 = json.loads(
+                urllib.request.urlopen(base + "/v1/stats", timeout=30).read()
+            )["batcher"]
+            d_req = stats1["requests"] - stats0["requests"]
+            d_disp = max(stats1["dispatches"] - stats0["dispatches"], 1)
+            d_keys = stats1["keys_dispatched"] - stats0["keys_dispatched"]
+            pct = _percentiles_ms(lats)
+            pct["batch_coalesced"] = round(d_keys / d_disp, 3)
+            pct["dispatches"] = d_disp
+            pct["concurrency"] = nthread
+            _emit(
+                f"serving pointwise n={np1} {nthread}x1x{qp1} "
+                "(fast, packed, http concurrent)",
+                d_req * qp1 / wall / 1e6,
+                "Mqueries/sec",
+                route=_route("sidecar,micro-batcher,packed"),
+                bytes_out=(qp1 + 7) // 8, extra=pct,
+            )
+        finally:
+            s.shutdown()
+            srv_mod.reset_serving_state()
+
+    _section("cfg-serving-latency", cfg_serving)
 
     # ---- config 4: 2-server PIR, 2^24 x 32 B, 1k queries --------------------
     def cfg4():

@@ -41,12 +41,28 @@ type Client struct {
 
 // New returns a client for the sidecar at baseURL (e.g.
 // "http://127.0.0.1:8990") using the compat profile.
+//
+// The client owns a pooled Transport with HTTP keep-alive: on the
+// link-bound serving path a fresh TCP + HTTP handshake per request costs
+// more than many evaluations, and the sidecar's micro-batcher can only
+// coalesce requests that actually arrive concurrently — connection churn
+// serializes them.  The pool keeps enough idle connections per host for
+// a busy client's worker fan-out (http.DefaultTransport caps idle
+// connections per host at 2, which churns under any real concurrency).
 func New(baseURL string) *Client {
+	// Clone the default transport so proxy handling and dial/TLS
+	// timeouts keep their stdlib behavior; widen only the idle pool.
+	tr := http.DefaultTransport.(*http.Transport).Clone()
+	tr.MaxIdleConns = 64
+	tr.MaxIdleConnsPerHost = 64
 	return &Client{
 		BaseURL: baseURL,
 		Profile: "compat",
 		// Full-domain expansions at large n take seconds on first compile.
-		HTTP: &http.Client{Timeout: 120 * time.Second},
+		HTTP: &http.Client{
+			Timeout:   120 * time.Second,
+			Transport: tr,
+		},
 	}
 }
 

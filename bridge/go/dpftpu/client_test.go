@@ -13,8 +13,12 @@ package dpftpu
 import (
 	"crypto/sha256"
 	"encoding/hex"
+	"io"
+	"net"
 	"net/http"
+	"net/http/httptest"
 	"os"
+	"sync"
 	"testing"
 	"time"
 )
@@ -75,6 +79,43 @@ func TestConformanceGenEval(t *testing.T) {
 		if ba^bb != want {
 			t.Fatalf("Eval reconstruction at x=%d: %d ^ %d != %d", x, ba, bb, want)
 		}
+	}
+}
+
+// TestConnectionReuse pins the client's keep-alive behavior without a
+// sidecar: sequential requests through one Client must ride ONE TCP
+// connection (the pooled Transport; each request fully drains and closes
+// the response body, which is what makes the connection reusable).  A
+// regression here re-introduces a TCP+HTTP handshake per request on the
+// link-bound serving path.
+func TestConnectionReuse(t *testing.T) {
+	var mu sync.Mutex
+	conns := 0
+	srv := httptest.NewUnstartedServer(http.HandlerFunc(
+		func(w http.ResponseWriter, r *http.Request) {
+			io.Copy(io.Discard, r.Body)
+			w.Write([]byte{0})
+		}))
+	srv.Config.ConnState = func(c net.Conn, s http.ConnState) {
+		if s == http.StateNew {
+			mu.Lock()
+			conns++
+			mu.Unlock()
+		}
+	}
+	srv.Start()
+	defer srv.Close()
+	c := New(srv.URL)
+	for i := 0; i < 16; i++ {
+		if _, err := c.Eval(DPFkey{1}, uint64(i), 10); err != nil {
+			t.Fatal(err)
+		}
+	}
+	mu.Lock()
+	got := conns
+	mu.Unlock()
+	if got != 1 {
+		t.Fatalf("16 sequential requests opened %d connections; want 1 (keep-alive reuse)", got)
 	}
 }
 

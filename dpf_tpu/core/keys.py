@@ -44,6 +44,10 @@ class KeyBatch:
     # Zero-padded copies keyed by pad amount (parallel/sharding), so padding
     # to a mesh doesn't defeat the per-batch device caches.
     _padded: object = field(default=None, repr=False, compare=False)
+    # Memoized default-padding DeviceKeys (models/dpf._cached_device_keys):
+    # a key-cached serving batch re-used across requests must not repack
+    # + re-upload its bit-planes per call.
+    _device_keys: object = field(default=None, repr=False, compare=False)
 
     @property
     def k(self) -> int:

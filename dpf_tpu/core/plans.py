@@ -1,0 +1,457 @@
+"""Persistent dispatch plans — the serving fast path's trace discipline.
+
+Every sidecar request used to pay the full host-side toll alone: repack
+key bytes, re-enter ``jax.jit`` dispatch with whatever (K, Q) shape the
+client happened to send — and a NEW shape means a NEW trace + XLA
+compile, seconds of latency landing on user traffic.  This module pins
+the shape space down to a small closed set of **plans** so steady-state
+serving never traces:
+
+  * a plan is keyed on ``(route, profile, log_n, K-bucket, Q-bucket,
+    packed, fuse, sbox)`` — everything that selects a distinct compiled
+    executable.  K is bucketed to powers of two (requests pad up with
+    zero keys and slice the padding back off — "pad + mask"), Q to
+    power-of-two multiples of 32 (the packed-word quantum), so the
+    number of live traces is logarithmic in the request-shape space.
+  * ``warmup(shapes)`` compiles the plans for a deployment's expected
+    shapes BEFORE traffic arrives (the sidecar exposes it as
+    ``POST /v1/warmup``); after warmup the hit path performs zero
+    retraces — asserted by ``trace_count()`` in tests.
+  * per-plan hit/miss/compile counters feed ``/v1/stats`` and the bench
+    matrix's serving rows.
+
+The plan layer owns only shape discipline and bookkeeping; the actual
+evaluators are the production routes in ``models/`` (so a plan-cached
+call measures exactly what a direct call runs, on the same kernels).
+
+Buffer donation (``DPF_TPU_DONATE``, the other half of "steady-state
+serving allocates nothing") is resolved here too: ``donation_enabled()``
+gates the ``donate_argnums`` twins of the chunk-finish executables in
+``models/dpf.py`` / ``models/dpf_chacha.py`` — the level-state carries
+handed from the prefix expansion to the finish are dead afterwards, so
+XLA may reuse their buffers in place.  ``off`` / ``auto`` / ``on``;
+``auto`` donates on TPU and stays off elsewhere (CPU XLA may decline
+the aliasing hint with a warning).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import NamedTuple
+
+import numpy as np
+
+from . import bitpack
+
+# ---------------------------------------------------------------------------
+# Knobs
+# ---------------------------------------------------------------------------
+
+
+def donation_enabled() -> bool:
+    """Resolve DPF_TPU_DONATE (off|auto|on; default auto = TPU only)."""
+    v = os.environ.get("DPF_TPU_DONATE", "auto").lower()
+    if v in ("on", "1", "true"):
+        return True
+    if v in ("off", "0", "false", ""):
+        return False
+    if v != "auto":
+        raise ValueError(f"DPF_TPU_DONATE={v!r} unknown (off|auto|on)")
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def k_floor() -> int:
+    """Minimum K bucket (DPF_TPU_PLAN_KFLOOR).  Serving deployments on
+    TPU may pin this to a kernel lane quantum (e.g. 128 for the fast
+    walk kernel) so even single-key requests take the kernel route; the
+    default 1 keeps CPU smoke runs cheap."""
+    return int(os.environ.get("DPF_TPU_PLAN_KFLOOR", "1") or 1)
+
+
+def _pow2_bucket(n: int, floor: int = 1) -> int:
+    n = max(int(n), int(floor), 1)
+    return 1 << (n - 1).bit_length()
+
+
+def k_bucket(k: int) -> int:
+    return _pow2_bucket(k, k_floor())
+
+
+def q_bucket(q: int) -> int:
+    """Query-count bucket: power-of-two multiples of the 32-bit packed
+    word (so the packed word count is itself stable per bucket)."""
+    return _pow2_bucket(q, 32)
+
+
+# ---------------------------------------------------------------------------
+# Plan identity
+# ---------------------------------------------------------------------------
+
+
+class PlanKey(NamedTuple):
+    route: str  # "points" | "dcf_points" | "dcf_interval" | "evalfull"
+    profile: str  # "compat" | "fast"
+    log_n: int
+    k_bucket: int
+    q_bucket: int  # 0 for full-domain routes
+    packed: bool
+    fuse: str  # DPF_TPU_FUSE in force (expansion routes)
+    sbox: str  # active S-box schedule (compat cipher routes)
+
+
+def plan_key(
+    route: str, profile: str, log_n: int, k: int, q: int = 0,
+    packed: bool = True,
+) -> PlanKey:
+    from ..ops import sbox_circuit
+
+    return PlanKey(
+        route, profile, int(log_n), k_bucket(k),
+        q_bucket(q) if q else 0, bool(packed),
+        os.environ.get("DPF_TPU_FUSE", "off") or "off",
+        sbox_circuit.active_sbox(),
+    )
+
+
+class Plan:
+    """One cached dispatch plan: shape bucket + counters.  The executable
+    itself lives in the models' jit caches; the plan guarantees every
+    call lands on the same (static, shape) entry."""
+
+    __slots__ = ("key", "hits", "misses", "compile_s", "last_used")
+
+    def __init__(self, key: PlanKey):
+        self.key = key
+        self.hits = 0
+        self.misses = 0
+        self.compile_s = 0.0
+        self.last_used = 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "key": "/".join(str(f) for f in self.key),
+            "hits": self.hits,
+            "misses": self.misses,
+            "compile_s": round(self.compile_s, 3),
+        }
+
+
+class PlanCache:
+    def __init__(self):
+        self._plans: dict[PlanKey, Plan] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: PlanKey) -> tuple[Plan, bool]:
+        """-> (plan, first_use).  ``first_use`` marks the warmup/compile
+        visit (the caller stamps compile_s on it)."""
+        with self._lock:
+            plan = self._plans.get(key)
+            if plan is None:
+                plan = self._plans[key] = Plan(key)
+                plan.misses += 1
+                return plan, True
+            plan.hits += 1
+            return plan, False
+
+    def stats(self) -> dict:
+        with self._lock:
+            plans = [p.as_dict() for p in self._plans.values()]
+        return {
+            "plans": plans,
+            "hits": sum(p["hits"] for p in plans),
+            "misses": sum(p["misses"] for p in plans),
+            "trace_cache_entries": trace_count(),
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._plans.clear()
+
+
+_CACHE = PlanCache()
+
+
+def cache() -> PlanCache:
+    return _CACHE
+
+
+def trace_count() -> int:
+    """Total cached (traced + compiled) entries across every module-level
+    jitted function in the dpf_tpu package — the retrace detector: after
+    ``warmup`` of a deployment's shapes, serving traffic must not grow
+    this number (asserted in tests/test_serving.py)."""
+    import sys
+
+    total = 0
+    for name, mod in list(sys.modules.items()):
+        if not name.startswith("dpf_tpu") or mod is None:
+            continue
+        for v in list(vars(mod).values()):
+            cs = getattr(v, "_cache_size", None)
+            if callable(cs):
+                try:
+                    total += int(cs())
+                except Exception:  # noqa: BLE001 — counting is best-effort
+                    pass
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Pad + mask execution helpers
+# ---------------------------------------------------------------------------
+
+
+def _pad_keys(kb, pad: int):
+    """Zero-pad any struct-of-arrays key batch on the key axis, memoized
+    on the batch (zero keys are canonical in every profile; the memo
+    keeps repeated single-request dispatches on the SAME padded object so
+    its device-resident operand caches survive across calls)."""
+    if not pad:
+        return kb
+    from ..core.keys import KeyBatch
+    from ..models.keys_chacha import KeyBatchFast
+
+    if isinstance(kb, KeyBatch):
+        from ..parallel.sharding import _pad_compat_batch
+
+        return _pad_compat_batch(kb, pad)
+    if isinstance(kb, KeyBatchFast):
+        from ..parallel.sharding import _pad_fast_batch
+
+        return _pad_fast_batch(kb, pad)
+    # DcfKeyBatch (and any future SoA batch whose array fields follow
+    # log_n in declaration order).
+    import dataclasses
+
+    cache_attr = getattr(kb, "_plan_padded", None)
+    if cache_attr and pad in cache_attr:
+        return cache_attr[pad]
+    arrays = [
+        getattr(kb, f.name)
+        for f in dataclasses.fields(kb)
+        if isinstance(getattr(kb, f.name), np.ndarray)
+    ]
+    padded = type(kb)(
+        kb.log_n,
+        *(
+            np.concatenate([a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+            for a in arrays
+        ),
+    )
+    try:
+        cache_attr = cache_attr or {}
+        cache_attr[pad] = padded
+        kb._plan_padded = cache_attr
+    except AttributeError:
+        pass
+    return padded
+
+
+def _pad_queries(xs: np.ndarray, kb_: int, qb: int) -> np.ndarray:
+    """Pad the query tensor to its plan bucket on BOTH axes (padded keys
+    evaluate at index 0; padded queries are masked off the output)."""
+    k, q = xs.shape
+    if k == kb_ and q == qb:
+        return xs
+    out = np.zeros((kb_, qb), np.uint64)
+    out[:k, :q] = xs
+    return out
+
+
+def _points_eval(route: str, profile: str, kb, xs: np.ndarray):
+    if route == "dcf_points":
+        from ..models import dcf
+
+        return dcf.eval_lt_points(kb, xs, packed=True)
+    if profile == "fast":
+        from ..models import dpf_chacha
+
+        return dpf_chacha.eval_points(kb, xs, packed=True)
+    from ..models import dpf
+
+    return dpf.eval_points(kb, xs, packed=True)
+
+
+def run_points(route: str, profile: str, kb, xs: np.ndarray) -> np.ndarray:
+    """Plan-cached pointwise evaluation -> packed words
+    uint32[K, ceil(Q/32)] (core/bitpack contract).  ``route`` is
+    "points" (profile selects compat/fast) or "dcf_points"."""
+    xs = np.asarray(xs, dtype=np.uint64)
+    K, Q = xs.shape
+    key = plan_key(route, profile, kb.log_n, K, Q, packed=True)
+    plan, first = _CACHE.get(key)
+    t0 = time.perf_counter()
+    kbp = _pad_keys(kb, key.k_bucket - K)
+    words = np.asarray(
+        _points_eval(
+            route, profile, kbp,
+            _pad_queries(xs, key.k_bucket, key.q_bucket),
+        )
+    )
+    if first:
+        plan.compile_s = time.perf_counter() - t0
+    plan.last_used = time.time()
+    return bitpack.mask_tail(
+        np.ascontiguousarray(words[:K, : bitpack.packed_words(Q)]), Q
+    )
+
+
+def run_interval(ik, xs: np.ndarray) -> np.ndarray:
+    """Plan-cached DCF interval evaluation (``ik`` = one party's
+    (upper, lower, const) triple) -> packed words uint32[K, ceil(Q/32)]."""
+    from ..models import dcf
+
+    upper, lower, const = ik[0], ik[1], ik[2]
+    xs = np.asarray(xs, dtype=np.uint64)
+    K, Q = xs.shape
+    key = plan_key("dcf_interval", "fast", upper.log_n, K, Q, packed=True)
+    plan, first = _CACHE.get(key)
+    t0 = time.perf_counter()
+    pad = key.k_bucket - K
+    if pad:
+        # The padded triple memoizes on the upper batch so a re-queried
+        # gate set reuses its fused 2K-key device operands.
+        cached = getattr(upper, "_plan_interval_padded", None)
+        if cached is not None and cached[0] is lower and cached[1] == pad:
+            up, lp, cp_ = cached[2]
+        else:
+            up = _pad_keys(upper, pad)
+            lp = _pad_keys(lower, pad)
+            cp_ = np.concatenate(
+                [np.asarray(const, np.uint8), np.zeros(pad, np.uint8)]
+            )
+            try:
+                upper._plan_interval_padded = (lower, pad, (up, lp, cp_))
+            except AttributeError:
+                pass
+    else:
+        up, lp, cp_ = upper, lower, const
+    words = np.asarray(
+        dcf.eval_interval_points(
+            (up, lp, cp_),
+            _pad_queries(xs, key.k_bucket, key.q_bucket),
+            packed=True,
+        )
+    )
+    if first:
+        plan.compile_s = time.perf_counter() - t0
+    plan.last_used = time.time()
+    return bitpack.mask_tail(
+        np.ascontiguousarray(words[:K, : bitpack.packed_words(Q)]), Q
+    )
+
+
+def run_evalfull(profile: str, kb) -> np.ndarray:
+    """Plan-cached full-domain expansion -> uint8[K, out_bytes]."""
+    K = kb.k
+    key = plan_key("evalfull", profile, kb.log_n, K, 0, packed=True)
+    plan, first = _CACHE.get(key)
+    t0 = time.perf_counter()
+    kbp = _pad_keys(kb, key.k_bucket - K)
+    if profile == "fast":
+        from ..models import dpf_chacha
+
+        out = dpf_chacha.eval_full(kbp)
+    else:
+        from ..models import dpf
+
+        out = dpf.eval_full(kbp)
+    if first:
+        plan.compile_s = time.perf_counter() - t0
+    plan.last_used = time.time()
+    return out[:K]
+
+
+# ---------------------------------------------------------------------------
+# Warmup
+# ---------------------------------------------------------------------------
+
+
+def warmup(shapes: list[dict]) -> list[dict]:
+    """Compile the plans for a deployment's expected request shapes so
+    first-request compile never lands on user traffic.
+
+    Each spec: ``{"route": "points"|"dcf_points"|"dcf_interval"|
+    "evalfull", "profile": "compat"|"fast", "log_n": N, "k": K,
+    "q": Q}`` (``q`` ignored for evalfull; ``profile`` ignored for the
+    DCF routes, which are fast-profile by construction).  An evalfull
+    spec with ``"stream": true`` ALSO drives the streaming pipeline once
+    (its per-chunk finish executables are distinct compiles from the
+    blocking plan's — a deployment serving streamed /v1/evalfull must
+    warm them too or the first large streamed request pays the compile).
+    Returns one summary dict per spec (the bucketed key, wall seconds)."""
+    out = []
+    rng = np.random.default_rng(0)
+    for spec in shapes:
+        route = spec.get("route", "points")
+        profile = spec.get("profile", "compat")
+        log_n = int(spec["log_n"])
+        k = int(spec.get("k", 1))
+        q = int(spec.get("q", 32))
+        t0 = time.perf_counter()
+        kb_count = k_bucket(k)
+        alphas = np.zeros(kb_count, np.uint64)
+        if route == "evalfull":
+            if profile == "fast":
+                from ..models.keys_chacha import gen_batch
+
+                kb, _ = gen_batch(alphas, log_n, rng=rng)
+            else:
+                from ..core.keys import gen_batch
+
+                kb, _ = gen_batch(alphas, log_n, rng=rng)
+            run_evalfull(profile, kb)
+            if spec.get("stream"):
+                # The streaming path is NOT K-bucketed (the sidecar
+                # streams the parsed batch directly), so warm at the
+                # spec's exact K.
+                if profile == "fast":
+                    from ..models.dpf_chacha import eval_full_stream
+                else:
+                    from ..models.dpf import eval_full_stream
+                kb_s = kb
+                if kb.k != k:
+                    kb_s, _ = gen_batch(
+                        np.zeros(k, np.uint64), log_n, rng=rng
+                    )
+                for _ in eval_full_stream(kb_s):
+                    pass
+        elif route == "dcf_interval":
+            from ..models import dcf
+
+            ia, _ = dcf.gen_interval_batch(
+                alphas, alphas, log_n, rng=rng
+            )
+            run_interval(ia, np.zeros((kb_count, q), np.uint64))
+        elif route == "dcf_points":
+            from ..models import dcf
+
+            da, _ = dcf.gen_lt_batch(alphas, log_n, rng=rng)
+            run_points(route, "fast", da, np.zeros((kb_count, q), np.uint64))
+        elif route == "points":
+            if profile == "fast":
+                from ..models.keys_chacha import gen_batch
+
+                kb, _ = gen_batch(alphas, log_n, rng=rng)
+            else:
+                from ..core.keys import gen_batch
+
+                kb, _ = gen_batch(alphas, log_n, rng=rng)
+            run_points(route, profile, kb, np.zeros((kb_count, q), np.uint64))
+        else:
+            raise ValueError(f"warmup: unknown route {route!r}")
+        out.append(
+            {
+                "route": route,
+                "profile": profile,
+                "log_n": log_n,
+                "k_bucket": kb_count,
+                "q_bucket": q_bucket(q) if route != "evalfull" else 0,
+                "seconds": round(time.perf_counter() - t0, 3),
+            }
+        )
+    return out

@@ -1,0 +1,67 @@
+"""Double-buffered chunk streaming — the ONE copy of the overlap driver.
+
+Both profiles' ``eval_full_stream`` (models/dpf.py, models/dpf_chacha.py)
+drive the same pipeline: dispatch subtree chunk j+1's compute BEFORE
+chunk j's device->host copy completes, so on hardware the D2H of
+finished chunks hides under the next chunk's compute and a streaming
+consumer gets its first bytes after ~one chunk.  The scheduling contract
+(chunk-level selection, the event protocol the overlap tests pin, the
+dispatch/finalize ordering) lives here so the profiles cannot silently
+diverge; the callers supply only the profile-specific pieces (the
+per-chunk dispatch and the words->bytes view).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+
+def chunk_levels(total: int, cap: int, min_chunks: int, nu: int) -> int:
+    """Levels ``c`` to split at: enough that each of the 2^c chunks fits
+    ``cap``, at least ``min_chunks`` chunks (streaming a single block
+    would be the blocking path with extra steps), never more than nu."""
+    n_chunks = -(-total // cap)
+    c = max(
+        (n_chunks - 1).bit_length(),
+        (max(min_chunks, 1) - 1).bit_length(),
+    )
+    return min(c, nu)
+
+
+def stream_chunks(c: int, dispatch, to_rows, events=None, timer=None):
+    """Yield 2^c chunk-row blocks from the double-buffered pipeline.
+
+    ``dispatch(j)`` issues chunk j's device computation (async — it must
+    return the un-fetched device array); ``to_rows(np_words)`` converts a
+    fetched chunk to the rows to yield.  ``events``, when a list, records
+    ("dispatch"|"d2h_start"|"d2h_done", j) in order — the modeled-overlap
+    check off hardware: dispatch of chunk j+1 precedes d2h_done of chunk
+    j.  ``timer`` (utils.profiling.PhaseTimer) accumulates the
+    "dispatch" and "d2h" phases."""
+
+    def ph(name):
+        return timer.phase(name) if timer else contextlib.nullcontext()
+
+    def rec(ev, j):
+        if events is not None:
+            events.append((ev, j))
+
+    def finalize(words, j):
+        words.copy_to_host_async()
+        rec("d2h_start", j)
+        with ph("d2h"):
+            out = np.asarray(words)
+        rec("d2h_done", j)
+        return to_rows(out)
+
+    prev = None
+    for j in range(1 << c):
+        with ph("dispatch"):
+            cur = dispatch(j)
+        rec("dispatch", j)
+        if prev is not None:
+            yield finalize(prev, j - 1)
+        prev = cur
+    yield finalize(prev, (1 << c) - 1)

@@ -362,8 +362,7 @@ def _finish_chunk_body(
     return _convert_leaves(S, T, fcw_planes, backend)
 
 
-@partial(jax.jit, static_argnums=(0, 1, 8))
-def _finish_chunks_scan_jit(
+def _finish_chunks_scan_body(
     n_levels, first, S, T, scw_planes, tl_w, tr_w, fcw_planes, backend="xla"
 ):
     """Finish ALL 2^c subtree chunks in ONE compiled function.
@@ -389,6 +388,28 @@ def _finish_chunks_scan_jit(
 
     _, ys = jax.lax.scan(body, None, (Sx, Tx))  # [C, Kpad, Wc, 4]
     return jnp.moveaxis(ys, 0, 1).reshape(ys.shape[1], -1, ys.shape[3])
+
+
+_finish_chunks_scan_jit = partial(jax.jit, static_argnums=(0, 1, 8))(
+    _finish_chunks_scan_body
+)
+# Donated twin (the serving fast path, core/plans.donation_enabled): the
+# prefix level-state carries (S, T) are dead once the finish consumes
+# them, so XLA may reuse their buffers in place — steady-state chunked
+# expansion allocates no fresh level-state HBM per call.
+_finish_chunks_scan_donated_jit = partial(
+    jax.jit, static_argnums=(0, 1, 8), donate_argnums=(2, 3)
+)(_finish_chunks_scan_body)
+
+# Single-chunk finish: the streaming pipeline's unit of dispatch (one
+# subtree chunk per call, so finished chunks can start their D2H while
+# the next chunk computes).
+_finish_chunk_jit = partial(jax.jit, static_argnums=(0, 1, 8))(
+    _finish_chunk_body
+)
+_finish_chunk_donated_jit = partial(
+    jax.jit, static_argnums=(0, 1, 8), donate_argnums=(2, 3)
+)(_finish_chunk_body)
 
 
 # ---------------------------------------------------------------------------
@@ -451,7 +472,14 @@ def eval_full_device(
     if backend in _BM_BACKENDS:
         # One permute for all chunks; S from the prefix is already bit-major.
         scw = _scw_to_bm(scw)
-    return _finish_chunks_scan_jit(
+    from ..core import plans
+
+    fin = (
+        _finish_chunks_scan_donated_jit
+        if plans.donation_enabled()
+        else _finish_chunks_scan_jit
+    )
+    return fin(
         nu - c, c, S, T, scw, dk.tl_words, dk.tr_words, dk.fcw_planes, backend
     )
 
@@ -465,12 +493,95 @@ def eval_full(
     """Full-domain evaluation of a key batch -> uint8[K, out_bytes], where
     out_bytes = 2^(log_n-3) (16 when log_n < 7), byte-identical to
     ``spec.eval_full`` / the reference's EvalFull per key."""
-    dk = DeviceKeys(kb)
+    dk = _cached_device_keys(kb)
     words = np.asarray(
         eval_full_device(dk, max_plane_words, backend, fuse)
     )  # [Kpad, W, 4]
     out = np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)
     return out
+
+
+def _words_to_rows(words: np.ndarray, k: int) -> np.ndarray:
+    """[Kpad, W, 4] chunk words -> uint8[k, W*16] output-byte rows."""
+    return np.ascontiguousarray(words[:k]).view("<u1").reshape(k, -1)
+
+
+def _cached_device_keys(kb: KeyBatch) -> DeviceKeys:
+    """Memoized default-padding DeviceKeys: key material is immutable once
+    evaluated, and a serving batch re-sent across requests (the keycache
+    hit path) must not repack + re-upload its bit-planes per call."""
+    dk = kb._device_keys
+    if dk is None:
+        dk = DeviceKeys(kb)
+        kb._device_keys = dk
+    return dk
+
+
+def eval_full_stream(
+    kb: KeyBatch,
+    max_plane_words: int = MAX_PLANE_WORDS,
+    backend: str | None = None,
+    min_chunks: int = 2,
+    events: list | None = None,
+    timer=None,
+):
+    """Double-buffered streaming full-domain evaluation.
+
+    Yields uint8[K, chunk_bytes] blocks whose axis-1 concatenation is
+    byte-identical to :func:`eval_full`.  The chunked-scan finish is
+    split into one dispatch per subtree chunk: chunk ``j+1``'s compute
+    is dispatched BEFORE chunk ``j``'s device->host copy completes
+    (``copy_to_host_async``), so on hardware the D2H of finished chunks
+    overlaps the next chunk's compute and a streaming consumer (the
+    sidecar's /v1/evalfull) gets its first bytes after ~one chunk
+    instead of the whole tree.  Domains that fit one compiled expansion
+    still split into ``min_chunks`` chunks (nu permitting) — streaming
+    with a single chunk would be the blocking path with extra steps.
+
+    ``events`` / ``timer`` follow the shared driver's protocol
+    (core/stream.stream_chunks — the modeled-overlap check and the
+    "dispatch"/"d2h" phases).  Donation follows
+    core/plans.donation_enabled (each chunk's level-state slice is dead
+    after its finish)."""
+    from ..core import plans
+    from ..core.stream import chunk_levels, stream_chunks
+
+    backend = backend or default_backend()
+    dk = _cached_device_keys(kb)
+    nu = dk.nu
+    kp = dk.k_padded // 32
+    c = chunk_levels((1 << nu) * kp, max_plane_words, min_chunks, nu)
+
+    def to_rows(out):
+        return _words_to_rows(out, kb.k)
+
+    if c == 0:
+        yield from stream_chunks(
+            0, lambda j: eval_full_device(dk, max_plane_words, backend),
+            to_rows, events, timer,
+        )
+        return
+
+    S, T = _expand_prefix_jit(
+        c, dk.seed_planes, dk.t_words, dk.scw_planes, dk.tl_words,
+        dk.tr_words, backend,
+    )
+    scw = dk.scw_planes
+    if backend in _BM_BACKENDS:
+        scw = _scw_to_bm(scw)
+    fin = (
+        _finish_chunk_donated_jit
+        if plans.donation_enabled()
+        else _finish_chunk_jit
+    )
+
+    def dispatch(j):
+        return fin(
+            nu - c, c, S[:, j : j + 1, :], T[j : j + 1], scw,
+            dk.tl_words, dk.tr_words, dk.fcw_planes, backend,
+        )
+
+    yield from stream_chunks(c, dispatch, to_rows, events, timer)
 
 
 def _point_masks(kb: KeyBatch):

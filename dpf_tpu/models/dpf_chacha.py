@@ -160,8 +160,9 @@ def _finish_chunk_cc_body(n_levels, first, S, T, scw, tcw, fcw):
     return _convert_leaves_cc(S, T, [fcw[:, j] for j in range(16)])
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _finish_chunks_cc_scan_jit(n_levels, first, s0, s1, s2, s3, T, scw, tcw, fcw):
+def _finish_chunks_cc_scan_body(
+    n_levels, first, s0, s1, s2, s3, T, scw, tcw, fcw
+):
     """All subtree chunks in ONE compiled function (lax.scan over the node
     axis) — one dispatch instead of 2 per chunk; per-iteration working set
     unchanged (see models/dpf._finish_chunks_scan_jit for the rationale).
@@ -177,6 +178,25 @@ def _finish_chunks_cc_scan_jit(n_levels, first, s0, s1, s2, s3, T, scw, tcw, fcw
 
     _, ys = jax.lax.scan(body, None, xs)  # [C, K, Wc, 16]
     return jnp.moveaxis(ys, 0, 1).reshape(ys.shape[1], -1, ys.shape[3])
+
+
+_finish_chunks_cc_scan_jit = partial(jax.jit, static_argnums=(0, 1))(
+    _finish_chunks_cc_scan_body
+)
+# Donated twin (core/plans.donation_enabled): the prefix level-state
+# carries are dead once the finish consumes them — see the compat
+# mirror models/dpf._finish_chunks_scan_donated_jit.
+_finish_chunks_cc_scan_donated_jit = partial(
+    jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3, 4, 5, 6)
+)(_finish_chunks_cc_scan_body)
+
+# Single-chunk finish — the streaming pipeline's unit of dispatch.
+_finish_chunk_cc_jit = partial(jax.jit, static_argnums=(0, 1))(
+    _finish_chunk_cc_body
+)
+_finish_chunk_cc_donated_jit = partial(
+    jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3)
+)(_finish_chunk_cc_body)
 
 
 # Soft cap on K * 2^nu leaf nodes per compiled expansion (each leaf is 64 B
@@ -222,8 +242,7 @@ def _finish_pk_jit(nu, first, s0, s1, s2, s3, T, scw_p, tcw_p, fcw_p):
     return _finish_pk(nu, first, [s0, s1, s2, s3], T, scw_p, tcw_p, fcw_p)
 
 
-@partial(jax.jit, static_argnums=(0, 1, 2, 3))
-def _finish_pk_chunks_jit(
+def _finish_pk_chunks_body(
     nu, first, n_chunks, wc, s0, s1, s2, s3, T, scw_p, tcw_p, fcw_p
 ):
     """Kernel tail over ALL node-range chunks in ONE compiled function
@@ -240,6 +259,14 @@ def _finish_pk_chunks_jit(
 
     _, ys = jax.lax.scan(body, None, xs)  # [C, K, Wc, 16]
     return jnp.moveaxis(ys, 0, 1).reshape(ys.shape[1], -1, ys.shape[3])
+
+
+_finish_pk_chunks_jit = partial(jax.jit, static_argnums=(0, 1, 2, 3))(
+    _finish_pk_chunks_body
+)
+_finish_pk_chunks_donated_jit = partial(
+    jax.jit, static_argnums=(0, 1, 2, 3), donate_argnums=(4, 5, 6, 7, 8)
+)(_finish_pk_chunks_body)
 
 
 # ---------------------------------------------------------------------------
@@ -390,7 +417,14 @@ def _eval_full_pallas_chunked(kb: KeyBatchFast, entry_level: int, n_chunks: int)
     S, T = _expand_prefix_cc_jit(s, seeds, ts, scw, tcw)
     ops = cp.expand_operands(pk, s)
     wc = (1 << s) // n_chunks
-    words = _finish_pk_chunks_jit(nu, s, n_chunks, wc, *S, T, *ops)
+    from ..core import plans
+
+    fin = (
+        _finish_pk_chunks_donated_jit
+        if plans.donation_enabled()
+        else _finish_pk_chunks_jit
+    )
+    words = fin(nu, s, n_chunks, wc, *S, T, *ops)
     return words[: kb.k]
 
 
@@ -453,7 +487,14 @@ def eval_full_device(
     n_chunks = -(-total // max_leaf_nodes)
     c = min((n_chunks - 1).bit_length(), nu)
     S, T = _expand_prefix_cc_jit(c, seeds, ts, scw, tcw)
-    return _finish_chunks_cc_scan_jit(nu - c, c, *S, T, scw, tcw, fcw)
+    from ..core import plans
+
+    fin = (
+        _finish_chunks_cc_scan_donated_jit
+        if plans.donation_enabled()
+        else _finish_chunks_cc_scan_jit
+    )
+    return fin(nu - c, c, *S, T, scw, tcw, fcw)
 
 
 def eval_full(
@@ -468,6 +509,56 @@ def eval_full(
     one pass split into independent GGM subtree chunks."""
     words = np.asarray(eval_full_device(kb, max_leaf_nodes, backend, fuse))
     return np.ascontiguousarray(words).view("<u1").reshape(kb.k, -1)
+
+
+def eval_full_stream(
+    kb: KeyBatchFast,
+    max_leaf_nodes: int = MAX_LEAF_NODES,
+    min_chunks: int = 2,
+    events: list | None = None,
+    timer=None,
+):
+    """Fast-profile twin of models/dpf.eval_full_stream: double-buffered
+    per-subtree-chunk finish with the D2H of finished chunks overlapping
+    the next chunk's compute.  Yields uint8[K, chunk_bytes] blocks whose
+    axis-1 concatenation is byte-identical to :func:`eval_full`.  The
+    per-chunk finish runs the XLA level body (a W=1 chunk entry cannot
+    grow inside the expand kernel off the TPU-only small-tree route —
+    docs/DESIGN.md compile trap (b)); streaming trades peak device rate
+    for time-to-first-byte, which on the 40 MB/s serving link is the
+    binding constraint.  ``events`` / ``timer`` follow the shared
+    driver's protocol (core/stream.stream_chunks)."""
+    from ..core import plans
+    from ..core.stream import chunk_levels, stream_chunks
+
+    nu = kb.nu
+    c = chunk_levels(kb.k << nu, max_leaf_nodes, min_chunks, nu)
+
+    def to_rows(words):
+        return np.ascontiguousarray(words).view("<u1").reshape(kb.k, -1)
+
+    if c == 0:
+        yield from stream_chunks(
+            0, lambda j: eval_full_device(kb, max_leaf_nodes), to_rows,
+            events, timer,
+        )
+        return
+
+    seeds, ts, scw, tcw, fcw = kb.device_args()
+    S, T = _expand_prefix_cc_jit(c, seeds, ts, scw, tcw)
+    fin = (
+        _finish_chunk_cc_donated_jit
+        if plans.donation_enabled()
+        else _finish_chunk_cc_jit
+    )
+
+    def dispatch(j):
+        return fin(
+            nu - c, c, [s[:, j : j + 1] for s in S], T[:, j : j + 1],
+            scw, tcw, fcw,
+        )
+
+    yield from stream_chunks(c, dispatch, to_rows, events, timer)
 
 
 def _eval_points_cc_body(

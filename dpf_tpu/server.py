@@ -12,7 +12,15 @@ Endpoints (all POST, binary bodies, profile/params in the query string):
 
   /v1/gen?log_n=N[&alpha=A][&profile=fast]   -> key_a || key_b
   /v1/eval?log_n=N&x=X[&profile=fast]        body: one key  -> 1 byte (0/1)
-  /v1/evalfull?log_n=N[&profile=fast]        body: one key  -> bit-packed bytes
+  /v1/evalfull?log_n=N[&profile=fast][&stream=0|1]
+        body: one key  -> bit-packed bytes.  ``stream=1`` (or the
+        DPF_TPU_STREAM=on|auto default, auto streaming responses >=
+        DPF_TPU_STREAM_MIN_BYTES) writes the response progressively from
+        the double-buffered chunked expansion: each subtree chunk's
+        bytes go onto the socket while the next chunk computes, so
+        time-to-first-byte is ~one chunk instead of the whole tree.
+        Content-Length is always exact; the byte stream is identical to
+        the blocking reply, so clients need no changes.
   /v1/evalfull_batch?log_n=N&k=K[&profile=fast]
         body: K concatenated keys -> K concatenated expansions
   /v1/eval_points_batch?log_n=N&k=K&q=Q[&profile=fast][&format=packed]
@@ -35,7 +43,34 @@ Endpoints (all POST, binary bodies, profile/params in the query string):
         body: one party blob || indices
         -> K*Q interval-share bits (1{lo <= x <= hi} after XOR), or
            K * ceil(Q/8) packed bytes with format=packed
+  /v1/warmup                                  body: JSON
+        {"shapes": [{"route": "points"|"dcf_points"|"dcf_interval"|
+        "evalfull", "profile": "compat"|"fast", "log_n": N, "k": K,
+        "q": Q}, ...]} — compile the dispatch plans for those shapes NOW
+        (core/plans.py) so first-request compile never lands on user
+        traffic.  An evalfull spec with "stream": true also warms the
+        streaming pipeline's per-chunk executables (distinct compiles).
+        Replies JSON with per-shape compile seconds.
   /healthz                                    -> "ok"
+  /v1/stats (GET)                             -> JSON observability:
+        plan-cache hit/miss + live trace count, micro-batcher
+        coalescing (requests, dispatches, batch_coalesced mean/max,
+        queue-wait), key-repack LRU hits, and per-phase timers
+        (queue_wait, pack, dispatch, compute, d2h, reply —
+        utils/profiling.PhaseTimer).
+
+Serving fast path (the request pipeline for the pointwise/DCF/interval
+endpoints):
+
+  parse/LRU repack (serving/keycache.py — repeated key bytes skip
+  validation + packing + the key-material upload entirely)
+    -> dynamic micro-batcher (serving/batcher.py — concurrent requests
+       on the same (route, profile, log_n) lane coalesce into ONE device
+       dispatch; DPF_TPU_BATCH_WINDOW_US / DPF_TPU_BATCH_MAX_KEYS;
+       DPF_TPU_BATCH=off degrades to direct dispatch)
+    -> plan cache (core/plans.py — K/Q bucketed to powers of two, padded
+       + masked, so the steady state replays pre-traced executables)
+    -> per-request slicing from the packed output words.
 
 Format negotiation: ``format=bits`` (the byte-per-bit default, for
 back-compat) or ``format=packed``; anything else is a 400.  The server-side
@@ -53,14 +88,20 @@ Run: ``python -m dpf_tpu.server --port 8990``.
 from __future__ import annotations
 
 import argparse
+import contextlib
+import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
-from .core import bitpack
+from .core import bitpack, plans
+from .serving import Batcher, IntervalWork, KeyCache, PointsWork
+from .serving.batcher import dispatch_interval, dispatch_points
+from .utils.profiling import PhaseTimer
 
 
 def _wire_format(q: dict) -> bool:
@@ -88,8 +129,128 @@ def _profile_api(profile: str):
     return dpf_tpu, key_len, KeyBatch
 
 
+class _ServingState:
+    """Per-process serving machinery: micro-batcher, host-repack LRU and
+    the thread-merged phase timers.  Built lazily on first request so env
+    knobs set by tests/deployments before traffic take effect."""
+
+    def __init__(self):
+        self.batcher = Batcher()
+        self.keys = KeyCache()
+        self.phases = PhaseTimer()
+        self.batch_enabled = (
+            os.environ.get("DPF_TPU_BATCH", "on").lower()
+            not in ("off", "0", "false")
+        )
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.phases.add(name, dt)
+
+    def merge_timer(self, tm: PhaseTimer) -> None:
+        with self._lock:
+            for name, dt in tm.phases.items():
+                self.phases.add(name, dt, tm.counts[name])
+
+    def run(self, work, dispatch):
+        """One request through the fast path: micro-batcher (when
+        enabled) -> plan cache -> per-request result rows."""
+        if self.batch_enabled:
+            res = self.batcher.submit(work, dispatch)
+        else:
+            t0 = time.perf_counter()
+            res = dispatch([work])[0]
+            work.dispatch_s = time.perf_counter() - t0
+            work.coalesced = work.n_keys
+        with self._lock:
+            self.phases.add("queue_wait", work.queue_wait)
+            # A coalesced dispatch is shared: attribute each request its
+            # key-row share so phases.compute sums to real device time
+            # (the batcher's dispatch_seconds holds the per-dispatch
+            # truth).
+            self.phases.add(
+                "compute",
+                work.dispatch_s * work.n_keys / max(work.coalesced, 1),
+            )
+        return res
+
+    def stats_snapshot(self) -> dict:
+        """Consistent /v1/stats payload: the phase dict is copied under
+        the state lock (request threads mutate it concurrently)."""
+        with self._lock:
+            phases = self.phases.as_dict()
+        return {
+            "plans": plans.cache().stats(),
+            "batcher": self.batcher.stats_dict(),
+            "key_cache": self.keys.stats(),
+            "phases": phases,
+            "batch_enabled": self.batch_enabled,
+        }
+
+
+_STATE: _ServingState | None = None
+_STATE_LOCK = threading.Lock()
+
+
+def _serving_state() -> _ServingState:
+    global _STATE
+    with _STATE_LOCK:
+        if _STATE is None:
+            _STATE = _ServingState()
+        return _STATE
+
+
+def reset_serving_state() -> None:
+    """Drop the lazy serving singleton (tests/benches re-read the batching
+    and cache env knobs on the next request)."""
+    global _STATE
+    with _STATE_LOCK:
+        _STATE = None
+
+
+def _evalfull_out_bytes(profile: str, log_n: int) -> int:
+    """The models' output-row contract, in one place: 2^(log_n-3) bytes
+    with the profile's leaf-width floor (compat 16, fast 64)."""
+    return max((1 << log_n) >> 3, 64 if profile == "fast" else 16)
+
+
+def _stream_mode(q: dict, out_bytes: int) -> bool:
+    """Resolve streaming for /v1/evalfull: per-request ``stream`` param
+    wins; DPF_TPU_STREAM=off|auto|on sets the default (auto streams
+    responses >= DPF_TPU_STREAM_MIN_BYTES, default 1 MiB)."""
+    v = q.get("stream")
+    if v is not None:
+        if v not in ("0", "1"):
+            raise ValueError(f"unknown stream {v!r} (use 0|1)")
+        return v == "1"
+    env = os.environ.get("DPF_TPU_STREAM", "auto").lower()
+    if env in ("on", "1", "true"):
+        return True
+    if env in ("off", "0", "false", ""):
+        return False
+    if env != "auto":
+        raise ValueError(f"DPF_TPU_STREAM={env!r} unknown (off|auto|on)")
+    return out_bytes >= int(
+        os.environ.get("DPF_TPU_STREAM_MIN_BYTES", str(1 << 20)) or (1 << 20)
+    )
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "dpf-tpu-sidecar/1"
+    # HTTP/1.1 so connections persist (BaseHTTPRequestHandler defaults to
+    # 1.0, which closes after every response — that would defeat both the
+    # Go client's pooled keep-alive Transport and the micro-batcher, whose
+    # coalescing needs requests to ARRIVE concurrently, not serialized
+    # behind per-request TCP handshakes).  Safe here: every response path
+    # sends an exact Content-Length, including the streaming one.
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, *a):  # quiet by default
         pass
@@ -105,20 +266,117 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply(400, msg.encode(), "text/plain")
 
     def do_GET(self):
-        if urlparse(self.path).path == "/healthz":
+        path = urlparse(self.path).path
+        if path == "/healthz":
             self._reply(200, b"ok", "text/plain")
+        elif path == "/v1/stats":
+            payload = _serving_state().stats_snapshot()
+            self._reply(
+                200, json.dumps(payload).encode(), "application/json"
+            )
         else:
             self._reply(404, b"not found", "text/plain")
+
+    def _points_reply(self, words: np.ndarray, nq: int, packed: bool, st):
+        with st.phase("reply"):
+            if packed:
+                self._reply(200, bitpack.words_to_wire(words, nq))
+            else:
+                self._reply(
+                    200,
+                    np.ascontiguousarray(
+                        bitpack.unpack_bits(words, nq)
+                    ).tobytes(),
+                )
+
+    def _evalfull_stream(self, profile: str, kb, log_n: int, st):
+        """Write one key's expansion progressively from the streaming
+        pipeline.  The first chunk is pulled BEFORE the status line so
+        evaluation errors still surface as a clean 400."""
+        tm = PhaseTimer()
+        if profile == "fast":
+            from .models.dpf_chacha import eval_full_stream
+
+            gen = eval_full_stream(kb, timer=tm)
+        else:
+            from .models.dpf import eval_full_stream
+
+            gen = eval_full_stream(kb, timer=tm)
+        first = next(gen)
+        declared = _evalfull_out_bytes(profile, log_n)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(declared))
+        self.end_headers()
+        written = 0
+        try:
+            # Only the socket writes belong to the "reply" phase — the
+            # generator's resumption does device dispatch + D2H, which
+            # the stream's own timer already records as dispatch/d2h.
+            chunk = first
+            while chunk is not None:
+                row = chunk[0].tobytes()
+                with st.phase("reply"):
+                    self.wfile.write(row)
+                written += len(row)
+                chunk = next(gen, None)
+        except Exception:  # noqa: BLE001
+            # The 200 status line is already on the wire: a second
+            # response here would corrupt the client's payload.  The only
+            # honest signal for a mid-stream failure is an aborted
+            # connection (short read vs the declared Content-Length).
+            self.close_connection = True
+        finally:
+            if written != declared:
+                # Declared-length drift (or a mid-stream abort): never
+                # let a keep-alive client read the next response out of
+                # frame.
+                self.close_connection = True
+            st.merge_timer(tm)
 
     def do_POST(self):
         try:
             url = urlparse(self.path)
             q = {k: v[0] for k, v in parse_qs(url.query).items()}
             body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            route = url.path
+            st = _serving_state()
+
+            if route == "/v1/warmup":
+                spec = json.loads(body or b"[]")
+                shapes = spec.get("shapes", []) if isinstance(spec, dict) \
+                    else spec
+                warmed = plans.warmup(shapes)
+                self._reply(
+                    200,
+                    json.dumps(
+                        {
+                            "warmed": warmed,
+                            "trace_cache_entries": plans.trace_count(),
+                        }
+                    ).encode(),
+                    "application/json",
+                )
+                return
+
             profile = q.get("profile", "compat")
             api, key_len, batch_cls = _profile_api(profile)
             log_n = int(q["log_n"])
-            route = url.path
+
+            def cached_keys(kind, blob, k, kl, cls=None):
+                """Parse ``k`` concatenated keys through the repack LRU."""
+                cls = cls or batch_cls
+                with st.phase("pack"):
+                    return st.keys.get(
+                        kind, log_n, blob,
+                        lambda: cls.from_bytes(
+                            [
+                                bytes(blob[i * kl : (i + 1) * kl])
+                                for i in range(k)
+                            ],
+                            log_n,
+                        ),
+                    )
 
             if route == "/v1/gen":
                 alpha = int(q.get("alpha", 0))
@@ -128,15 +386,27 @@ class _Handler(BaseHTTPRequestHandler):
                 bit = api.Eval(bytes(body), int(q["x"]), log_n)
                 self._reply(200, bytes([bit]))
             elif route == "/v1/evalfull":
-                self._reply(200, api.EvalFull(bytes(body), log_n))
+                kl = key_len(log_n)
+                if len(body) != kl:
+                    raise ValueError(f"body must be one {kl}-byte key")
+                kb = cached_keys(profile, bytes(body), 1, kl)
+                if _stream_mode(q, _evalfull_out_bytes(profile, log_n)):
+                    self._evalfull_stream(profile, kb, log_n, st)
+                else:
+                    with st.phase("dispatch"):
+                        out = plans.run_evalfull(profile, kb)
+                    with st.phase("reply"):
+                        self._reply(200, out[0].tobytes())
             elif route == "/v1/evalfull_batch":
                 k = int(q["k"])
                 kl = key_len(log_n)
                 if len(body) != k * kl:
                     raise ValueError(f"body must be {k}*{kl} bytes")
-                keys = [bytes(body[i * kl : (i + 1) * kl]) for i in range(k)]
-                out = api.eval_full_batch(batch_cls.from_bytes(keys, log_n))
-                self._reply(200, np.ascontiguousarray(out).tobytes())
+                kb = cached_keys(profile, bytes(body), k, kl)
+                with st.phase("dispatch"):
+                    out = plans.run_evalfull(profile, kb)
+                with st.phase("reply"):
+                    self._reply(200, np.ascontiguousarray(out).tobytes())
             elif route == "/v1/eval_points_batch":
                 k, nq = int(q["k"]), int(q["q"])
                 kl = key_len(log_n)
@@ -144,16 +414,13 @@ class _Handler(BaseHTTPRequestHandler):
                     raise ValueError(
                         f"body must be {k}*{kl} key bytes + {k}*{nq}*8 index bytes"
                     )
-                keys = [bytes(body[i * kl : (i + 1) * kl]) for i in range(k)]
-                xs = np.frombuffer(body[k * kl :], dtype="<u8").reshape(k, nq)
                 packed = _wire_format(q)
-                out = api.eval_points_batch(
-                    batch_cls.from_bytes(keys, log_n), xs, packed=packed
+                kb = cached_keys(profile, bytes(body[: k * kl]), k, kl)
+                xs = np.frombuffer(body[k * kl :], dtype="<u8").reshape(k, nq)
+                words = st.run(
+                    PointsWork("points", profile, kb, xs), dispatch_points
                 )
-                if packed:
-                    self._reply(200, bitpack.words_to_wire(out, nq))
-                else:
-                    self._reply(200, np.ascontiguousarray(out).tobytes())
+                self._points_reply(words, nq, packed, st)
             elif route == "/v1/dcf_gen":
                 from .models import dcf
 
@@ -174,16 +441,15 @@ class _Handler(BaseHTTPRequestHandler):
                     raise ValueError(
                         f"body must be {k}*{kl} key bytes + {k}*{nq}*8 index bytes"
                     )
-                keys = [bytes(body[i * kl : (i + 1) * kl]) for i in range(k)]
-                xs = np.frombuffer(body[k * kl :], dtype="<u8").reshape(k, nq)
                 packed = _wire_format(q)
-                out = dcf.eval_lt_points(
-                    dcf.DcfKeyBatch.from_bytes(keys, log_n), xs, packed=packed
+                kb = cached_keys(
+                    "dcf", bytes(body[: k * kl]), k, kl, cls=dcf.DcfKeyBatch
                 )
-                if packed:
-                    self._reply(200, bitpack.words_to_wire(out, nq))
-                else:
-                    self._reply(200, np.ascontiguousarray(out).tobytes())
+                xs = np.frombuffer(body[k * kl :], dtype="<u8").reshape(k, nq)
+                words = st.run(
+                    PointsWork("dcf_points", "fast", kb, xs), dispatch_points
+                )
+                self._points_reply(words, nq, packed, st)
             elif route == "/v1/dcf_interval_gen":
                 from .models import dcf
 
@@ -213,28 +479,34 @@ class _Handler(BaseHTTPRequestHandler):
                         f"(2*{k}*{kl} keys + {k} consts) + {k}*{nq}*8 "
                         "index bytes"
                     )
+                packed = _wire_format(q)
 
-                def keys_at(off):
-                    return dcf.DcfKeyBatch.from_bytes(
-                        [bytes(body[off + i * kl : off + (i + 1) * kl])
-                         for i in range(k)],
-                        log_n,
+                def build_triple(blob=bytes(body[:blob_len])):
+                    def keys_at(off):
+                        return dcf.DcfKeyBatch.from_bytes(
+                            [
+                                bytes(blob[off + i * kl : off + (i + 1) * kl])
+                                for i in range(k)
+                            ],
+                            log_n,
+                        )
+
+                    return (
+                        keys_at(0),
+                        keys_at(k * kl),
+                        np.frombuffer(
+                            blob[2 * k * kl :], dtype="<u1"
+                        ).copy(),
                     )
 
-                upper = keys_at(0)
-                lower = keys_at(k * kl)
-                const = np.frombuffer(
-                    body[2 * k * kl : blob_len], dtype="<u1"
-                )
+                with st.phase("pack"):
+                    triple = st.keys.get(
+                        "dcf_interval", log_n, bytes(body[:blob_len]),
+                        build_triple,
+                    )
                 xs = np.frombuffer(body[blob_len:], dtype="<u8").reshape(k, nq)
-                packed = _wire_format(q)
-                out = dcf.eval_interval_points(
-                    (upper, lower, const), xs, packed=packed
-                )
-                if packed:
-                    self._reply(200, bitpack.words_to_wire(out, nq))
-                else:
-                    self._reply(200, np.ascontiguousarray(out).tobytes())
+                words = st.run(IntervalWork(triple, xs), dispatch_interval)
+                self._points_reply(words, nq, packed, st)
             else:
                 self._reply(404, b"not found", "text/plain")
         except Exception as e:  # noqa: BLE001 — bridge must not crash

@@ -1,0 +1,11 @@
+"""Serving-layer machinery for the sidecar fast path: the dynamic
+micro-batcher (batcher.py) that coalesces concurrent requests into one
+device dispatch, and the host-repack LRU (keycache.py) that lets
+repeated keys skip canonical-form validation + SoA packing entirely.
+Both sit BETWEEN dpf_tpu/server.py and the plan cache
+(core/plans.py); the evaluators themselves are untouched."""
+
+from .batcher import Batcher, IntervalWork, PointsWork
+from .keycache import KeyCache
+
+__all__ = ["Batcher", "PointsWork", "IntervalWork", "KeyCache"]

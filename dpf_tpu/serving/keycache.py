@@ -1,0 +1,67 @@
+"""Host-repack LRU: repeated keys skip validation + SoA packing.
+
+The sidecar's ``blob`` / ``keys_at`` closures used to rebuild the full
+key arrays on EVERY request — canonical-form validation, byte slicing,
+struct-of-arrays views — even when a client (a PIR server re-querying
+the same DB keys, a retrying proxy) sends byte-identical key material
+each time.  This cache keys the parsed batch on a digest of the raw key
+bytes, so a repeat hit returns the SAME batch object — which also
+carries the device-resident operand memos (``_point_masks`` /
+``_device_args``), so the repack, the canonical checks, AND the
+key-material H2D upload are all skipped.
+
+Capacity is ``DPF_TPU_KEY_CACHE_ENTRIES`` batches (default 32; 0
+disables).  Entries are whole request key-sets, not individual keys —
+the serving hot case is the same batch re-sent verbatim.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+
+
+class KeyCache:
+    def __init__(self, entries: int | None = None):
+        if entries is None:
+            entries = int(
+                os.environ.get("DPF_TPU_KEY_CACHE_ENTRIES", "32") or 32
+            )
+        self.entries = max(int(entries), 0)
+        self._lru: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, kind: str, log_n: int, blob: bytes, build):
+        """Return the parsed batch for ``blob`` (the request's raw key
+        bytes), building it via ``build()`` on a miss.  Parse failures
+        propagate and are never cached."""
+        if not self.entries:
+            return build()
+        key = (kind, int(log_n), hashlib.sha256(blob).digest())
+        with self._lock:
+            hit = self._lru.get(key)
+            if hit is not None:
+                self._lru.move_to_end(key)
+                self.hits += 1
+                return hit
+            self.misses += 1
+        val = build()
+        with self._lock:
+            self._lru[key] = val
+            self._lru.move_to_end(key)
+            while len(self._lru) > self.entries:
+                self._lru.popitem(last=False)
+        return val
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._lru),
+                "capacity": self.entries,
+                "hits": self.hits,
+                "misses": self.misses,
+            }

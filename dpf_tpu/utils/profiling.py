@@ -32,9 +32,21 @@ class PhaseTimer:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            self.phases[name] = self.phases.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
+            self.add(name, time.perf_counter() - t0)
+
+    def add(self, name: str, dt: float, n: int = 1) -> None:
+        """Record a duration measured elsewhere (the serving path times
+        phases across threads and merges under its own lock)."""
+        self.phases[name] = self.phases.get(name, 0.0) + dt
+        self.counts[name] = self.counts.get(name, 0) + n
+
+    def as_dict(self) -> dict:
+        """JSON-able snapshot (the sidecar's /v1/stats payload): per-phase
+        total seconds and event counts."""
+        return {
+            name: {"seconds": round(dt, 6), "count": self.counts[name]}
+            for name, dt in self.phases.items()
+        }
 
     def total(self) -> float:
         return sum(self.phases.values())

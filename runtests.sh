@@ -10,15 +10,17 @@
 #       `./runtests.sh -m ''` for absolutely everything)
 #   ./runtests.sh --fast [pytest args]   kernel differential smoke lane:
 #       the Pallas kernel suites (fused + walk + expand routes, interpret
-#       mode), the S-box circuit invariants, and the packed<->unpacked
+#       mode), the S-box circuit invariants, the packed<->unpacked
 #       output differentials (every packed route vs its byte-per-bit twin
-#       plus the sidecar wire contract) — surfaces kernel regressions in
-#       minutes instead of the full-suite half hour.
+#       plus the sidecar wire contract), and the serving fast path
+#       (plan cache / micro-batcher / streaming EvalFull differentials,
+#       tests/test_serving.py) — surfaces kernel + serving regressions
+#       in minutes instead of the full-suite half hour.
 if [ "${1:-}" = "--fast" ]; then
   shift
   set -- tests/test_aes_pallas.py tests/test_chacha_pallas.py \
       tests/test_fused_expand.py tests/test_aes_bitslice.py \
-      tests/test_packed.py \
+      tests/test_packed.py tests/test_serving.py \
       -q -m 'not slow' "$@"
 else
   # -m is last-wins in pytest, so a caller-supplied -m overrides ours.

@@ -17,7 +17,12 @@ the real Mosaic lowering of:
     subtree chunks wrapping the expand kernels),
   * the packed-output routes (eval_points/grouped/DCF with packed=True:
     the device-side pack composed with every walk kernel) — no packed
-    route's first real-Mosaic contact may happen in production.
+    route's first real-Mosaic contact may happen in production,
+  * the donated-buffer chunk finishes (DPF_TPU_DONATE=on twins of the
+    scan finish, both profiles) and the double-buffered streaming
+    EvalFull pipeline at several (nu, K-bucket) points — the serving
+    fast path's executables, same first-contact rule,
+  * the plan-cache bucketed dispatch (pad + mask) at several K-buckets.
 
 Each check runs in a containment wrapper: a failure (Mosaic rejection,
 mismatch) is recorded and the REMAINING checks still run — the
@@ -306,6 +311,124 @@ def main():
         assert (bitpack.unpack_bits(wd, Q) == bd).all(), "dcf packed"
 
     _check("packed-output routes", packed_routes, t0)
+
+    def donated_routes():
+        # DPF_TPU_DONATE=on twins of the chunk finishes, both profiles,
+        # at two (nu, K) points each: the donated executables are
+        # DISTINCT compiles from the plain ones (input-output aliasing
+        # changes the program) and must match them byte-for-byte.
+        from dpf_tpu.models.dpf import DeviceKeys, eval_full_device
+
+        rng = np.random.default_rng(11)
+        for log_n, k, cap in ((16, 8, 1 << 7), (20, 32, 1 << 11)):
+            ka, _ = gen_batch(
+                rng.integers(0, 1 << log_n, size=k, dtype=np.uint64),
+                log_n, rng=rng,
+            )
+            dk = DeviceKeys(ka)
+            # Reference FORCED non-donated (auto means ON here, on TPU) —
+            # the whole point is donated vs non-donated, not vs itself.
+            try:
+                os.environ["DPF_TPU_DONATE"] = "off"
+                want = np.asarray(eval_full_device(dk))
+                os.environ["DPF_TPU_DONATE"] = "on"
+                got = np.asarray(eval_full_device(dk, max_plane_words=cap))
+            finally:
+                os.environ.pop("DPF_TPU_DONATE", None)
+            assert (got == want).all(), f"compat donated n={log_n}"
+        for log_n, k, cap in ((22, 8, 1 << 22), (24, 4, 1 << 23)):
+            kaf, _ = kc.gen_batch(
+                rng.integers(0, 1 << log_n, size=k, dtype=np.uint64),
+                log_n, rng=rng,
+            )
+            try:
+                os.environ["DPF_TPU_DONATE"] = "off"
+                want = dc.eval_full(kaf)
+                os.environ["DPF_TPU_DONATE"] = "on"
+                got = dc.eval_full(kaf, max_leaf_nodes=cap)
+            finally:
+                os.environ.pop("DPF_TPU_DONATE", None)
+            assert (got == want).all(), f"fast donated n={log_n}"
+
+    _check("donated chunk finish (both profiles)", donated_routes, t0)
+
+    def streaming_evalfull():
+        # Double-buffered streaming pipeline (per-chunk finish +
+        # copy_to_host_async overlap) at several (nu, K-bucket) points,
+        # donated and not; chunk concatenation must equal the blocking
+        # output and the event trace must show dispatch(j+1) before
+        # d2h_done(j).
+        from dpf_tpu.models.dpf import eval_full as compat_full
+        from dpf_tpu.models.dpf import eval_full_stream as compat_stream
+
+        rng = np.random.default_rng(12)
+        for donate in ("off", "on"):
+            try:
+                os.environ["DPF_TPU_DONATE"] = donate
+                for log_n, k in ((16, 1), (20, 8)):
+                    ka, _ = gen_batch(
+                        rng.integers(0, 1 << log_n, size=k, dtype=np.uint64),
+                        log_n, rng=rng,
+                    )
+                    ev = []
+                    chunks = list(
+                        compat_stream(ka, min_chunks=4, events=ev)
+                    )
+                    got = np.concatenate(chunks, axis=1)
+                    assert (got == compat_full(ka)).all(), (
+                        f"compat stream n={log_n} donate={donate}"
+                    )
+                    order = {(e, j): i for i, (e, j) in enumerate(ev)}
+                    for j in range(len(chunks) - 1):
+                        assert (
+                            order[("dispatch", j + 1)]
+                            < order[("d2h_done", j)]
+                        ), f"no overlap at chunk {j}"
+                kaf, _ = kc.gen_batch(
+                    rng.integers(0, 1 << 22, size=2, dtype=np.uint64), 22,
+                    rng=rng,
+                )
+                gotf = np.concatenate(
+                    list(dc.eval_full_stream(kaf, min_chunks=4)), axis=1
+                )
+                assert (gotf == dc.eval_full(kaf)).all(), (
+                    f"fast stream donate={donate}"
+                )
+            finally:
+                os.environ.pop("DPF_TPU_DONATE", None)
+
+    _check("streaming eval_full (double-buffered)", streaming_evalfull, t0)
+
+    def plan_buckets():
+        # Plan-cache pad + mask dispatch at several K-buckets through the
+        # REAL kernel routes (the padded shapes are what production
+        # serves after warmup; their first Mosaic contact is here).
+        from dpf_tpu.core import bitpack, plans
+
+        rng = np.random.default_rng(13)
+        log_n, Q = 20, 40
+        for k in (3, 8, 100):  # buckets 4, 8, 128
+            ka, _ = gen_batch(
+                rng.integers(0, 1 << log_n, size=k, dtype=np.uint64),
+                log_n, rng=rng,
+            )
+            xs = rng.integers(0, 1 << log_n, size=(k, Q), dtype=np.uint64)
+            words = plans.run_points("points", "compat", ka, xs)
+            want = mdpf.eval_points(ka, xs)
+            assert (bitpack.unpack_bits(words, Q) == want).all(), (
+                f"compat plan bucket k={k}"
+            )
+            kaf, _ = kc.gen_batch(
+                rng.integers(0, 1 << log_n, size=k, dtype=np.uint64),
+                log_n, rng=rng,
+            )
+            wf = plans.run_points("points", "fast", kaf, xs)
+            wantf = dc.eval_points(kaf, xs)
+            assert (bitpack.unpack_bits(wf, Q) == wantf).all(), (
+                f"fast plan bucket k={k}"
+            )
+
+    _check("plan-cache bucketed dispatch", plan_buckets, t0)
 
     if _FAILURES:
         print(f"TPU CHECKS FAILED: {', '.join(_FAILURES)}")

@@ -1,0 +1,523 @@
+"""Serving fast path: dispatch plans, micro-batcher, streaming EvalFull.
+
+Covers the PR's acceptance contracts on the CPU mesh:
+
+  * plan-cache hit path performs ZERO retraces after warmup (asserted
+    via the jit trace counter, core/plans.trace_count);
+  * the micro-batcher coalesces >= 4 concurrent single-key requests into
+    one dispatch (threaded, deterministically gated) and every coalesced
+    answer is byte-identical to the serial single-request answer — both
+    wire formats, both profiles, through the real HTTP sidecar;
+  * the donated-buffer chunk-finish routes match the spec backend
+    byte-for-byte (donation-aliasing differential);
+  * streaming EvalFull's chunks concatenate to the blocking output and
+    its event trace shows chunk j+1's dispatch preceding chunk j's D2H
+    completion (the modeled-overlap check off hardware).
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpf_tpu.core import bitpack, plans
+
+
+def _post(url, body=b""):
+    req = urllib.request.Request(url, data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.read()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=60) as r:
+        return r.read()
+
+
+@pytest.fixture()
+def srv(monkeypatch):
+    """A sidecar with a visible batching window (so concurrent-test
+    bursts coalesce deterministically) and a fresh serving state."""
+    monkeypatch.setenv("DPF_TPU_BATCH_WINDOW_US", "20000")
+    from dpf_tpu import server as srv_mod
+
+    srv_mod.reset_serving_state()
+    s = srv_mod.serve(port=0)
+    yield f"http://127.0.0.1:{s.server_address[1]}"
+    s.shutdown()
+    srv_mod.reset_serving_state()
+
+
+# ---------------------------------------------------------------------------
+# Plan cache
+# ---------------------------------------------------------------------------
+
+
+def test_plan_buckets():
+    assert [plans.k_bucket(k) for k in (1, 2, 3, 4, 5, 9)] == [
+        1, 2, 4, 4, 8, 16,
+    ]
+    assert [plans.q_bucket(q) for q in (1, 31, 32, 33, 64, 100)] == [
+        32, 32, 32, 64, 64, 128,
+    ]
+    key = plans.plan_key("points", "compat", 9, 3, 17)
+    assert (key.k_bucket, key.q_bucket, key.packed) == (4, 32, True)
+
+
+def test_plan_cache_zero_retrace_after_warmup():
+    from dpf_tpu.core.keys import gen_batch
+
+    log_n = 9
+    rng = np.random.default_rng(21)
+    reqs = []
+    for k, q in [(1, 5), (2, 17), (3, 32), (4, 8), (1, 31)]:
+        alphas = rng.integers(0, 1 << log_n, size=k, dtype=np.uint64)
+        kb, _ = gen_batch(alphas, log_n, rng=rng)
+        xs = rng.integers(0, 1 << log_n, size=(k, q), dtype=np.uint64)
+        reqs.append((kb, xs))
+    # Expected values from the direct byte-per-bit API, computed BEFORE
+    # the snapshot (the unpacked twin has its own traces).
+    import dpf_tpu
+
+    expected = [dpf_tpu.eval_points_batch(kb, xs) for kb, xs in reqs]
+    plans.warmup(
+        [
+            {"route": "points", "profile": "compat", "log_n": log_n,
+             "k": k, "q": 32}
+            for k in (1, 2, 4)
+        ]
+    )
+    before = plans.trace_count()
+    hits0 = plans.cache().stats()["hits"]
+    for (kb, xs), want in zip(reqs, expected):
+        words = plans.run_points("points", "compat", kb, xs)
+        assert words.shape == (xs.shape[0], bitpack.packed_words(xs.shape[1]))
+        np.testing.assert_array_equal(
+            bitpack.unpack_bits(words, xs.shape[1]), want
+        )
+    assert plans.trace_count() == before, "plan hit path retraced"
+    assert plans.cache().stats()["hits"] >= hits0 + len(reqs)
+
+
+def test_plan_repeat_key_batch_reuses_padding():
+    """The pad memo keeps a re-used batch on the same padded object so
+    device-side operand caches survive across requests."""
+    from dpf_tpu.core.keys import gen_batch
+
+    kb, _ = gen_batch(
+        np.array([7], np.uint64), 9, rng=np.random.default_rng(3)
+    )
+    p1 = plans._pad_keys(kb, 3)
+    p2 = plans._pad_keys(kb, 3)
+    assert p1 is p2
+    assert p1.k == 4
+
+
+# ---------------------------------------------------------------------------
+# Micro-batcher
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_coalesces_and_matches_serial():
+    """>= 4 concurrent single-key requests, one dispatch, byte-identical
+    answers.  The first dispatch is gated so the burst piles up behind it
+    deterministically (coalescing-by-backpressure, no timing luck)."""
+    from dpf_tpu import fast as fapi
+    from dpf_tpu.models.keys_chacha import gen_batch as genf
+    from dpf_tpu.serving.batcher import Batcher, PointsWork, dispatch_points
+
+    log_n = 10
+    rng = np.random.default_rng(31)
+    alphas = rng.integers(0, 1 << log_n, size=6, dtype=np.uint64)
+    kbs = [genf(np.array([a], np.uint64), log_n, rng=rng)[0] for a in alphas]
+    # Deliberately mixed Q per request: the merge must pad to the widest
+    # and re-cut each answer to its own Q.
+    xss = [
+        rng.integers(0, 1 << log_n, size=(1, 3 + 7 * i), dtype=np.uint64)
+        for i in range(6)
+    ]
+    b = Batcher(window_us=0)
+    gate, entered = threading.Event(), threading.Event()
+    sizes = []
+
+    def gated(items):
+        if not entered.is_set():
+            entered.set()
+            assert gate.wait(30)
+        sizes.append(len(items))
+        return dispatch_points(items)
+
+    res = [None] * 6
+
+    def worker(i):
+        res[i] = b.submit(PointsWork("points", "fast", kbs[i], xss[i]), gated)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(6)
+    ]
+    threads[0].start()
+    assert entered.wait(30)
+    for t in threads[1:]:
+        t.start()
+    # Wait until the burst is queued behind the gated leader.
+    for _ in range(500):
+        with b._lock:
+            depth = sum(len(q) for q in b._pending.values())
+        if depth >= 5:
+            break
+        threading.Event().wait(0.01)
+    gate.set()
+    for t in threads:
+        t.join(60)
+    assert max(sizes) >= 4, f"burst did not coalesce: {sizes}"
+    st = b.stats.as_dict()
+    assert st["requests"] == 6
+    assert st["dispatches"] == len(sizes) < 6
+    assert st["batch_coalesced_max"] >= 4
+    for i in range(6):
+        want = fapi.eval_points_batch(kbs[i], xss[i], packed=True)
+        np.testing.assert_array_equal(res[i], want)
+
+
+def test_batcher_dispatch_error_fans_out():
+    from dpf_tpu.serving.batcher import Batcher, PointsWork
+
+    class _KB:
+        log_n = 9
+
+    b = Batcher(window_us=0)
+
+    def boom(items):
+        raise ValueError("kaboom")
+
+    with pytest.raises(ValueError, match="kaboom"):
+        b.submit(
+            PointsWork("points", "compat", _KB(), np.zeros((1, 4), np.uint64)),
+            boom,
+        )
+    # The lane must be released for the next request.
+    assert not b._busy
+
+
+def test_threaded_http_clients_byte_identical(srv):
+    """N concurrent single-key clients through the real sidecar — both
+    profiles, both wire formats — must each get the bytes a serial
+    request would."""
+    from dpf_tpu.core import chacha_np as cc
+    from dpf_tpu.core import spec
+
+    log_n, q = 9, 6
+    rng = np.random.default_rng(41)
+    jobs = []
+    for i in range(8):
+        profile = ("compat", "fast")[i % 2]
+        fmt = ("bits", "packed")[(i // 2) % 2]
+        kl = spec.key_len(log_n) if profile == "compat" else cc.key_len(log_n)
+        alpha = int(rng.integers(0, 1 << log_n))
+        keys = _post(
+            f"{srv}/v1/gen?log_n={log_n}&alpha={alpha}&profile={profile}"
+        )
+        key = keys[:kl]
+        xs = rng.integers(0, 1 << log_n, size=(1, q), dtype=np.uint64)
+        xs[0, 0] = alpha
+        jobs.append((profile, fmt, key, xs))
+
+    # Serial ground truth first (its own connections, its own dispatches).
+    def run_one(profile, fmt, key, xs):
+        return _post(
+            f"{srv}/v1/eval_points_batch?log_n={log_n}&k=1&q={q}"
+            f"&profile={profile}&format={fmt}",
+            key + xs.tobytes(),
+        )
+
+    serial = [run_one(*j) for j in jobs]
+    results = [None] * len(jobs)
+    errs = []
+
+    def worker(i):
+        try:
+            results[i] = run_one(*jobs[i])
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(len(jobs))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errs, errs
+    assert results == serial
+    stats = json.loads(_get(f"{srv}/v1/stats"))
+    assert stats["batcher"]["requests"] >= 16
+    assert stats["batcher"]["dispatches"] <= stats["batcher"]["requests"]
+    assert stats["key_cache"]["hits"] > 0  # serial vs threaded reuse
+
+
+def test_dcf_and_interval_through_batcher(srv):
+    """The DCF routes ride the same fast path; reconstruction invariants
+    must hold through the batcher + plan cache."""
+    from dpf_tpu.models import dcf as dcf_mod
+
+    log_n, k, q = 10, 3, 5
+    alphas = np.array([17, 600, 1023], dtype="<u8")
+    blob = _post(f"{srv}/v1/dcf_gen?log_n={log_n}&k={k}", alphas.tobytes())
+    kl = dcf_mod.key_len(log_n)
+    xs = np.array(
+        [[a, max(int(a) - 1, 0), 0, (1 << log_n) - 1, int(a)] for a in alphas],
+        dtype="<u8",
+    )
+    halves = [
+        _post(
+            f"{srv}/v1/dcf_eval_points?log_n={log_n}&k={k}&q={q}"
+            "&format=packed",
+            blob[h * k * kl : (h + 1) * k * kl] + xs.tobytes(),
+        )
+        for h in (0, 1)
+    ]
+    rec = bitpack.unpack_bits(
+        bitpack.wire_to_words(halves[0], k, q)
+        ^ bitpack.wire_to_words(halves[1], k, q),
+        q,
+    )
+    np.testing.assert_array_equal(rec, (xs < alphas[:, None]).astype(np.uint8))
+
+    lo = np.array([0, 100, 512], dtype="<u8")
+    hi = np.array([0, 400, (1 << log_n) - 1], dtype="<u8")
+    iblob = _post(
+        f"{srv}/v1/dcf_interval_gen?log_n={log_n}&k={k}",
+        lo.tobytes() + hi.tobytes(),
+    )
+    half = 2 * k * kl + k
+    ihalves = [
+        _post(
+            f"{srv}/v1/dcf_interval_eval?log_n={log_n}&k={k}&q={q}",
+            iblob[h * half : (h + 1) * half] + xs.tobytes(),
+        )
+        for h in (0, 1)
+    ]
+    rec = (
+        np.frombuffer(ihalves[0], np.uint8)
+        ^ np.frombuffer(ihalves[1], np.uint8)
+    ).reshape(k, q)
+    want = ((xs >= lo[:, None]) & (xs <= hi[:, None])).astype(np.uint8)
+    np.testing.assert_array_equal(rec, want)
+
+
+# ---------------------------------------------------------------------------
+# Donation differentials
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_donated_chunk_finish_matches_spec(monkeypatch):
+    """DPF_TPU_DONATE=on through the chunked finishes of both profiles:
+    the donated-buffer executables must stay byte-identical to the spec
+    backend (the donation-aliasing differential)."""
+    monkeypatch.setenv("DPF_TPU_DONATE", "on")
+    from dpf_tpu.core import chacha_np as cc
+    from dpf_tpu.core import spec
+    from dpf_tpu.core.keys import gen_batch
+    from dpf_tpu.models import dpf as mdpf
+    from dpf_tpu.models import dpf_chacha as dc
+    from dpf_tpu.models.keys_chacha import gen_batch as genf
+
+    rng = np.random.default_rng(51)
+    ka, _ = gen_batch(np.array([123, 4000], np.uint64), 12, rng=rng)
+    got = mdpf.eval_full(ka, max_plane_words=1 << 4)
+    for i, key in enumerate(ka.to_bytes()):
+        assert bytes(got[i]) == spec.eval_full(key, 12)
+
+    kf, _ = genf(np.array([55, 9000], np.uint64), 14, rng=rng)
+    gotf = dc.eval_full(kf, max_leaf_nodes=1 << 7)
+    for i, key in enumerate(kf.to_bytes()):
+        assert bytes(gotf[i]) == cc.eval_full(key, 14)
+
+
+def test_donation_knob_resolution(monkeypatch):
+    monkeypatch.setenv("DPF_TPU_DONATE", "on")
+    assert plans.donation_enabled()
+    monkeypatch.setenv("DPF_TPU_DONATE", "off")
+    assert not plans.donation_enabled()
+    monkeypatch.setenv("DPF_TPU_DONATE", "bogus")
+    with pytest.raises(ValueError):
+        plans.donation_enabled()
+
+
+# ---------------------------------------------------------------------------
+# Streaming EvalFull
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_eval_full_stream_matches_and_overlaps(monkeypatch):
+    # Donation ON: this also pins the donated per-chunk executables (the
+    # default-off path is exercised by the server streaming test).
+    monkeypatch.setenv("DPF_TPU_DONATE", "on")
+    from dpf_tpu.models import dpf as mdpf
+    from dpf_tpu.models import dpf_chacha as dc
+    from dpf_tpu.core.keys import gen_batch
+    from dpf_tpu.models.keys_chacha import gen_batch as genf
+    from dpf_tpu.utils.profiling import PhaseTimer
+
+    rng = np.random.default_rng(61)
+    ka, _ = gen_batch(np.array([123, 4000], np.uint64), 12, rng=rng)
+    want = mdpf.eval_full(ka)
+    ev, tm = [], PhaseTimer()
+    chunks = list(
+        mdpf.eval_full_stream(
+            ka, max_plane_words=1 << 4, min_chunks=4, events=ev, timer=tm
+        )
+    )
+    assert len(chunks) >= 4
+    np.testing.assert_array_equal(np.concatenate(chunks, axis=1), want)
+    # Modeled-overlap check: chunk j+1 is dispatched BEFORE chunk j's
+    # D2H completes — the double-buffered pipeline's defining property.
+    order = {(e, j): i for i, (e, j) in enumerate(ev)}
+    for j in range(len(chunks) - 1):
+        assert order[("dispatch", j + 1)] < order[("d2h_done", j)], ev
+    assert tm.counts["dispatch"] == len(chunks)
+    assert tm.counts["d2h"] == len(chunks)
+
+    kf, _ = genf(np.array([55, 9000], np.uint64), 14, rng=rng)
+    wantf = dc.eval_full(kf)
+    evf = []
+    chf = list(
+        dc.eval_full_stream(
+            kf, max_leaf_nodes=1 << 7, min_chunks=4, events=evf
+        )
+    )
+    assert len(chf) >= 4
+    np.testing.assert_array_equal(np.concatenate(chf, axis=1), wantf)
+    order = {(e, j): i for i, (e, j) in enumerate(evf)}
+    for j in range(len(chf) - 1):
+        assert order[("dispatch", j + 1)] < order[("d2h_done", j)], evf
+
+
+def test_eval_full_stream_single_chunk_domain():
+    """nu = 0 domains can't chunk: the stream degenerates to one block,
+    still byte-identical."""
+    from dpf_tpu.models import dpf as mdpf
+    from dpf_tpu.core.keys import gen_batch
+
+    ka, _ = gen_batch(
+        np.array([3], np.uint64), 6, rng=np.random.default_rng(8)
+    )
+    chunks = list(mdpf.eval_full_stream(ka))
+    assert len(chunks) == 1
+    np.testing.assert_array_equal(chunks[0], mdpf.eval_full(ka))
+
+
+def test_server_streaming_evalfull(srv):
+    from dpf_tpu.core import spec
+
+    log_n = 10
+    kl = spec.key_len(log_n)
+    keys = _post(f"{srv}/v1/gen?log_n={log_n}&alpha=700")
+    ka = keys[:kl]
+    blocking = _post(f"{srv}/v1/evalfull?log_n={log_n}&stream=0", ka)
+    req = urllib.request.Request(
+        f"{srv}/v1/evalfull?log_n={log_n}&stream=1", data=ka, method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=60) as r:
+        assert int(r.headers["Content-Length"]) == (1 << log_n) // 8
+        streamed = r.read()
+    assert streamed == blocking == spec.eval_full(ka, log_n)
+    # Fast profile too.
+    from dpf_tpu.core import chacha_np as cc
+
+    klf = cc.key_len(log_n)
+    keysf = _post(f"{srv}/v1/gen?log_n={log_n}&alpha=700&profile=fast")
+    kaf = keysf[:klf]
+    b = _post(f"{srv}/v1/evalfull?log_n={log_n}&profile=fast&stream=0", kaf)
+    s = _post(f"{srv}/v1/evalfull?log_n={log_n}&profile=fast&stream=1", kaf)
+    assert b == s == cc.eval_full(kaf, log_n)
+    # Unknown stream value -> clean 400.
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{srv}/v1/evalfull?log_n={log_n}&stream=2", ka)
+    assert ei.value.code == 400
+
+
+# ---------------------------------------------------------------------------
+# Warmup endpoint + observability
+# ---------------------------------------------------------------------------
+
+
+def test_warmup_endpoint_and_stats(srv):
+    reply = json.loads(
+        _post(
+            f"{srv}/v1/warmup",
+            json.dumps(
+                {
+                    "shapes": [
+                        {"route": "points", "profile": "fast",
+                         "log_n": 10, "k": 1, "q": 8},
+                        {"route": "evalfull", "profile": "compat",
+                         "log_n": 9, "k": 1},
+                    ]
+                }
+            ).encode(),
+        )
+    )
+    assert len(reply["warmed"]) == 2
+    assert reply["warmed"][0]["k_bucket"] == 1
+    assert reply["trace_cache_entries"] > 0
+    # stream:true also warms the streaming per-chunk executables — a
+    # subsequent streamed request must not add traces.
+    _post(
+        f"{srv}/v1/warmup",
+        json.dumps(
+            {"shapes": [{"route": "evalfull", "profile": "compat",
+                         "log_n": 10, "k": 1, "stream": True}]}
+        ).encode(),
+    )
+    tc0 = plans.trace_count()
+    from dpf_tpu.core import spec as spec_mod
+
+    key = _post(f"{srv}/v1/gen?log_n=10&alpha=5")[: spec_mod.key_len(10)]
+    streamed = _post(f"{srv}/v1/evalfull?log_n=10&stream=1", key)
+    assert streamed == spec_mod.eval_full(key, 10)
+    assert plans.trace_count() == tc0, "streamed request retraced after warmup"
+    stats = json.loads(_get(f"{srv}/v1/stats"))
+    for section in ("plans", "batcher", "key_cache", "phases"):
+        assert section in stats, stats
+    assert stats["plans"]["misses"] >= 1
+    # Malformed warmup body -> clean 400, server stays up.
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{srv}/v1/warmup", b"not json")
+    assert ei.value.code == 400
+    assert _get(f"{srv}/healthz") == b"ok"
+
+
+def test_key_cache_lru_hits_and_eviction():
+    from dpf_tpu.serving.keycache import KeyCache
+
+    kc = KeyCache(entries=2)
+    built = []
+
+    def mk(tag):
+        def build():
+            built.append(tag)
+            return tag
+
+        return build
+
+    assert kc.get("compat", 9, b"A", mk("a")) == "a"
+    assert kc.get("compat", 9, b"A", mk("a2")) == "a"  # hit: no rebuild
+    assert kc.get("compat", 9, b"B", mk("b")) == "b"
+    assert kc.get("compat", 9, b"C", mk("c")) == "c"  # evicts A
+    assert kc.get("compat", 9, b"A", mk("a3")) == "a3"
+    assert built == ["a", "b", "c", "a3"]
+    st = kc.stats()
+    assert st["hits"] == 1 and st["misses"] == 4
+    # Same bytes under a different kind/domain must not collide.
+    assert kc.get("fast", 9, b"A", mk("fa")) == "fa"
+    # Capacity 0 disables caching entirely.
+    kc0 = KeyCache(entries=0)
+    assert kc0.get("compat", 9, b"A", mk("z")) == "z"
+    assert kc0.stats()["entries"] == 0
