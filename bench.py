@@ -36,6 +36,8 @@ import time
 
 import numpy as np
 
+from dpf_tpu.core import knobs
+
 LOG_N = 20
 K = 1024
 # Single-core AES-NI EvalFull, n=20, 1024 keys, measured on this machine's
@@ -310,11 +312,14 @@ def _infra_record(detail: str) -> str:
     )
 
 
-def _env_float(name: str, default: float) -> float:
+def _env_float(name: str) -> float:
+    """Registry-declared float knob, with the bench harness's forgiving
+    parse: garbage degrades to the declared default (a bad env var must
+    not break the one-JSON-line contract)."""
     try:
-        return float(os.environ.get(name, default))
+        return knobs.get_float(name)
     except ValueError:
-        return default
+        return float(knobs.knob(name).default)
 
 
 def _watchdog_main() -> None:
@@ -335,8 +340,8 @@ def _watchdog_main() -> None:
          2700 s cap can exceed the caller's own budget, producing an empty
          record where the caller's kill wins the race).
     """
-    timeout = _env_float("DPF_TPU_BENCH_TIMEOUT", 900.0)
-    probe_timeout = _env_float("DPF_TPU_BENCH_PROBE_TIMEOUT", 120.0)
+    timeout = _env_float("DPF_TPU_BENCH_TIMEOUT")
+    probe_timeout = _env_float("DPF_TPU_BENCH_PROBE_TIMEOUT")
     import subprocess
 
     env = dict(os.environ)
@@ -406,10 +411,7 @@ def main() -> None:
     (AssertionError from the reconstruction spot-checks) are NOT retried and
     exit nonzero — a wrong answer is a bug, not weather.
     """
-    try:
-        backoff = float(os.environ.get("DPF_TPU_BENCH_BACKOFF", "10"))
-    except ValueError:
-        backoff = 10.0
+    backoff = _env_float("DPF_TPU_BENCH_BACKOFF")
     fast = compat = None
     err: Exception | None = None
     attempts = 3
@@ -465,7 +467,7 @@ def _routes() -> str:
             f"fast={cp.expand_backend()}",
             f"compat={mdpf.default_backend()}",
             f"sbox={sbox_circuit._SBOX}",
-            f"fuse={os.environ.get('DPF_TPU_FUSE', 'off') or 'off'}",
+            f"fuse={knobs.get_str('DPF_TPU_FUSE')}",
         ]
         if mdpf._WALK_KERNEL_BROKEN:
             parts.append("aes-walk-latched")
@@ -481,7 +483,7 @@ def _routes() -> str:
 
 
 if __name__ == "__main__":
-    if os.environ.get("DPF_TPU_BENCH_CHILD"):
+    if knobs.is_set("DPF_TPU_BENCH_CHILD"):
         main()
     else:
         _watchdog_main()

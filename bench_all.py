@@ -55,6 +55,9 @@ import time
 
 import numpy as np
 
+from dpf_tpu.analysis import LINT_SUITE_VERSION
+from dpf_tpu.core import knobs
+
 from bench import (
     _chain_scan,
     _marginal_time,
@@ -76,7 +79,7 @@ from bench import (
 # re-measure on the next attempt.
 # ---------------------------------------------------------------------------
 
-_LEDGER_PATH = os.environ.get("DPF_TPU_BENCH_LEDGER", "")
+_LEDGER_PATH = knobs.get_str("DPF_TPU_BENCH_LEDGER")
 _LEDGER: dict[str, list] = {}  # completed section -> its rows
 _CUR_ROWS: list = []  # rows emitted by the section currently running
 _TRANSIENT_SIGS = (
@@ -101,9 +104,7 @@ _ROUTE_KNOBS = (
 # signature (OOM, one-off kernel fault) that would otherwise be pinned
 # into the ledger until the code or a route knob changes.  "0"/"false"/
 # "off" mean off, like every other knob here.
-_RETRY_ERRORS = os.environ.get(
-    "DPF_TPU_BENCH_LEDGER_RETRY_ERRORS", ""
-).lower() not in ("", "0", "false", "off")
+_RETRY_ERRORS = knobs.get_bool("DPF_TPU_BENCH_LEDGER_RETRY_ERRORS")
 
 
 def _has_error_row(rows: list) -> bool:
@@ -116,12 +117,13 @@ def _ledger_key(scale: str) -> dict:
     rows), marked never-matching while any of it has uncommitted edits."""
     repo = os.path.dirname(os.path.abspath(__file__))
     paths = ["dpf_tpu", "native", "bench.py", "bench_all.py"]
-    override = os.environ.get("DPF_TPU_BENCH_LEDGER_KEY")
+    override = knobs.get_raw("DPF_TPU_BENCH_LEDGER_KEY")
     if override:  # tests: pin the key regardless of tree state
         return {
             "head": override,
             "scale": scale,
-            "knobs": {k: os.environ.get(k, "") for k in _ROUTE_KNOBS},
+            "knobs": knobs.snapshot(_ROUTE_KNOBS),
+            "lint": LINT_SUITE_VERSION,
         }
     try:
         rp = subprocess.run(
@@ -142,7 +144,11 @@ def _ledger_key(scale: str) -> dict:
     return {
         "head": head,
         "scale": scale,
-        "knobs": {k: os.environ.get(k, "") for k in _ROUTE_KNOBS},
+        "knobs": knobs.snapshot(_ROUTE_KNOBS),
+        # Which static-discipline suite vetted the measured tree: a lint
+        # suite bump re-measures (the discipline itself changed what the
+        # benches are allowed to run).
+        "lint": LINT_SUITE_VERSION,
     }
 
 
@@ -224,7 +230,7 @@ def _route(base: str, sbox: bool = False, fuse: bool = False) -> str:
 
         base = f"{base},sbox={sbox_circuit._SBOX}"
     if fuse:  # expansion rows: which fused-group request was in force
-        base = f"{base},fuse={os.environ.get('DPF_TPU_FUSE', 'off') or 'off'}"
+        base = f"{base},fuse={knobs.get_str('DPF_TPU_FUSE')}"
     return ",".join([base] + _latch_flags())
 
 
@@ -375,9 +381,9 @@ def _native_pir_rate(db: np.ndarray, log_n: int, nq: int = 2):
         return None
 
 
-_ONLY = [s for s in os.environ.get("DPF_TPU_BENCH_ONLY", "").split(",") if s]
+_ONLY = [s for s in knobs.get_str("DPF_TPU_BENCH_ONLY").split(",") if s]
 _FORCE_FAIL = [
-    s for s in os.environ.get("DPF_TPU_BENCH_FORCE_FAIL", "").split(",") if s
+    s for s in knobs.get_str("DPF_TPU_BENCH_FORCE_FAIL").split(",") if s
 ]
 
 

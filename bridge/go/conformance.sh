@@ -1,7 +1,8 @@
 #!/bin/sh
-# One-command sidecar conformance run: start the sidecar, run the Go
-# conformance suite (dpftpu/client_test.go — Gen/Eval/EvalFull XOR
-# reconstruction, frozen golden vectors, packed + unpacked wire formats),
+# One-command sidecar conformance run: gofmt -l + go vet, start the
+# sidecar, run the Go suite under the RACE DETECTOR (dpftpu/client_test.go
+# — Gen/Eval/EvalFull XOR reconstruction, frozen golden vectors, packed +
+# unpacked wire formats, and the 16-goroutine pooled-Transport stress),
 # tear the sidecar down.  Needs Go >= 1.21 and a Python env with dpf_tpu
 # importable (run from anywhere; paths are script-relative).
 #
@@ -12,6 +13,17 @@
 set -e
 cd "$(dirname "$0")"
 PORT="${PORT:-8993}"
+
+# Static hygiene first (no sidecar needed): formatting and vet are part
+# of the repo's lint discipline (scripts/lint_all.sh runs them too when
+# a toolchain exists); a diff here fails the conformance run.
+unformatted="$(gofmt -l .)"
+if [ -n "$unformatted" ]; then
+  echo "conformance.sh: gofmt needs to run on:" >&2
+  echo "$unformatted" >&2
+  exit 1
+fi
+go vet ./...
 
 PYTHONPATH="$(cd ../.. && pwd)" python -m dpf_tpu.server --port "$PORT" &
 SIDECAR=$!
@@ -32,4 +44,7 @@ curl -sf "http://127.0.0.1:$PORT/healthz" >/dev/null 2>&1 || {
   exit 1
 }
 
-DPFTPU_URL="http://127.0.0.1:$PORT" go test ./dpftpu -run Conformance -v
+# The whole suite under the race detector: the conformance tests against
+# the live sidecar AND the sidecar-free concurrency tests (pooled
+# Transport shared across 16 goroutines — TestConcurrentClientRace).
+DPFTPU_URL="http://127.0.0.1:$PORT" go test -race ./dpftpu -v

@@ -11,8 +11,10 @@
 package dpftpu
 
 import (
+	"bytes"
 	"crypto/sha256"
 	"encoding/hex"
+	"fmt"
 	"io"
 	"net"
 	"net/http"
@@ -244,5 +246,53 @@ func TestConformancePointsPackedAndUnpacked(t *testing.T) {
 		if ra^rb != want {
 			t.Fatalf("packed reconstruction at query %d", j)
 		}
+	}
+}
+
+// TestConcurrentClientRace drives one shared Client from 16 goroutines
+// through the pooled Transport against a local double — no sidecar
+// needed, so `go test -race ./dpftpu` exercises the connection pool and
+// response handling under the race detector in every environment
+// (conformance.sh runs the whole suite under -race).  Each goroutine
+// checks it got ITS OWN reply byte back: a pooled-transport race that
+// crossed response bodies between requests would surface here as a
+// wrong byte, not just a detector report.
+func TestConcurrentClientRace(t *testing.T) {
+	srv := httptest.NewServer(http.HandlerFunc(
+		func(w http.ResponseWriter, r *http.Request) {
+			body, _ := io.ReadAll(r.Body)
+			w.Write(body)
+		}))
+	defer srv.Close()
+	c := New(srv.URL)
+	const goroutines, perG = 16, 25
+	var wg sync.WaitGroup
+	errs := make(chan error, goroutines)
+	for g := 0; g < goroutines; g++ {
+		wg.Add(1)
+		go func(g int) {
+			defer wg.Done()
+			for i := 0; i < perG; i++ {
+				// Unique per request, so ANY crossed reply — even
+				// between two in-flight requests — is a wrong byte.
+				mark := []byte{byte(g), byte(i)}
+				out, err := c.post("/v1/eval?log_n=10&x=0", mark)
+				if err != nil {
+					errs <- err
+					return
+				}
+				if !bytes.Equal(out, mark) {
+					errs <- fmt.Errorf(
+						"goroutine %d got %v, want %v — crossed replies",
+						g, out, mark)
+					return
+				}
+			}
+		}(g)
+	}
+	wg.Wait()
+	close(errs)
+	for err := range errs {
+		t.Fatal(err)
 	}
 }

@@ -1,0 +1,59 @@
+"""Repo-native static analysis: the discipline the ROADMAP's production
+north star needs, checked on every commit for free.
+
+Four AST-based passes over the whole tree (one entrypoint:
+``python -m dpf_tpu.analysis`` / ``scripts/lint_all.sh``; exits nonzero
+on any finding):
+
+  knob-registry   every DPF_TPU_* env knob is declared once in
+                  dpf_tpu/core/knobs.py and read only through it —
+                  direct env reads and undeclared (typo'd) knob names
+                  are findings.
+  secret-hygiene  key bytes / PRG seeds / correction words must never
+                  flow into logging, f-strings in raised exceptions,
+                  /v1/stats payloads, or bench ledgers (name-based
+                  intra-function taint; the sha256 digest in
+                  serving/keycache.py is the sanctioned sanitizer).
+  host-sync       no silent device->host synchronization in the kernel
+                  and serving hot paths (.block_until_ready(), .item(),
+                  jax.device_get, bare np.asarray materialization)
+                  except at ``# host-sync:``-annotated sync points.
+  pallas-jit      every pl.pallas_call site carries a statically
+                  evaluated ``# vmem:`` footprint model within the
+                  module's declared VMEM budget, and every jax.jit's
+                  static/donate argnum specs are hashable literals
+                  (no list/dict retrace hazards).
+
+Each pass ships fixture files with seeded violations
+(``dpf_tpu/analysis/fixtures/``, excluded from real scans) and a test
+asserting the pass catches them AND that the real tree is clean
+(tests/test_analysis.py) — the suite is a tier-1 lane
+(``runtests.sh --lint``).
+
+``LINT_SUITE_VERSION`` names the discipline in force; bench_all.py
+stamps it into the ledger key so benches record which suite vetted the
+tree they measured.
+"""
+
+from __future__ import annotations
+
+# Bump when a pass is added or materially tightened (bench ledgers keyed
+# on it re-measure).
+LINT_SUITE_VERSION = "1"
+
+# name -> (module, callable); imported lazily so `import dpf_tpu.analysis`
+# stays cheap for the bench harness's version stamp.
+PASSES = {
+    "knob-registry": ("dpf_tpu.analysis.knob_registry_pass", "run"),
+    "secret-hygiene": ("dpf_tpu.analysis.secret_hygiene_pass", "run"),
+    "host-sync": ("dpf_tpu.analysis.host_sync_pass", "run"),
+    "pallas-jit": ("dpf_tpu.analysis.pallas_discipline_pass", "run"),
+}
+
+
+def get_pass(name: str):
+    """The pass callable for ``name`` (import on demand)."""
+    import importlib
+
+    mod_name, fn_name = PASSES[name]
+    return getattr(importlib.import_module(mod_name), fn_name)

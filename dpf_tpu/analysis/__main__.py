@@ -1,0 +1,97 @@
+"""``python -m dpf_tpu.analysis`` — run the static-analysis suite.
+
+    python -m dpf_tpu.analysis                 # all passes, whole tree
+    python -m dpf_tpu.analysis --pass host-sync
+    python -m dpf_tpu.analysis --root /path/to/checkout
+    python -m dpf_tpu.analysis --write-knobs-doc   # regenerate docs/KNOBS.md
+    python -m dpf_tpu.analysis --check-knobs-doc   # fail when it is stale
+
+Exits 0 on a clean tree, 1 on any finding (CI contract:
+``scripts/lint_all.sh`` / ``runtests.sh --lint``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from ..core import knobs
+from . import LINT_SUITE_VERSION, PASSES, get_pass
+from .common import repo_root
+
+_KNOBS_DOC = os.path.join("docs", "KNOBS.md")
+
+
+def _knobs_doc_path(root: str) -> str:
+    return os.path.join(root, _KNOBS_DOC)
+
+
+def _check_knobs_doc(root: str) -> int:
+    want = knobs.render_markdown()
+    try:
+        with open(_knobs_doc_path(root), encoding="utf-8") as f:
+            have = f.read()
+    except OSError:
+        have = ""
+    if have != want:
+        print(
+            f"{_KNOBS_DOC} is stale — regenerate with "
+            "'python -m dpf_tpu.analysis --write-knobs-doc'",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dpf_tpu.analysis", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "--pass", dest="passes", action="append", choices=sorted(PASSES),
+        help="run only this pass (repeatable; default: all)",
+    )
+    ap.add_argument(
+        "--root", default=None,
+        help="tree to scan (default: the checkout containing dpf_tpu/)",
+    )
+    ap.add_argument(
+        "--write-knobs-doc", action="store_true",
+        help="regenerate docs/KNOBS.md from the registry and exit",
+    )
+    ap.add_argument(
+        "--check-knobs-doc", action="store_true",
+        help="exit 1 when docs/KNOBS.md is stale vs the registry",
+    )
+    args = ap.parse_args(argv)
+    root = os.path.abspath(args.root) if args.root else repo_root()
+
+    if args.write_knobs_doc:
+        path = _knobs_doc_path(root)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(knobs.render_markdown())
+        print(f"wrote {os.path.relpath(path, root)}")
+        return 0
+    if args.check_knobs_doc:
+        return _check_knobs_doc(root)
+
+    names = args.passes or sorted(PASSES)
+    findings = []
+    for name in names:
+        findings.extend(get_pass(name)(root))
+    findings.sort(key=lambda f: (f.path, f.line))
+    for f in findings:
+        print(f)
+    print(
+        f"dpf_tpu.analysis v{LINT_SUITE_VERSION}: "
+        f"{len(names)} pass(es), {len(findings)} finding(s)",
+        file=sys.stderr,
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

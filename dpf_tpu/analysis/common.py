@@ -1,0 +1,144 @@
+"""Shared plumbing for the analysis passes: findings, tree walking,
+pragma comments.
+
+Pragmas are how the passes express ALLOWLISTED exceptions in-place, next
+to the code they cover (reviewable, greppable, and they travel with the
+line in refactors — unlike a path/line table in the linter):
+
+  ``# host-sync: <why>``  on (or immediately above) a host-sync call —
+      an allowlisted synchronization point.
+  ``# vmem: <expr>``      on (or immediately above) a pl.pallas_call —
+      the statically-evaluated VMEM footprint model for that kernel.
+  ``# knob-ok``           on a line mentioning a DPF_TPU_* name the
+      knob-registry pass should skip (used by the lint suite's own
+      tests, which must spell typo'd knob names on purpose).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str  # repo-relative
+    line: int
+    pass_name: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_name}] {self.message}"
+
+
+def repo_root() -> str:
+    """The tree the passes scan by default: the directory containing the
+    ``dpf_tpu`` package (repo root in a checkout)."""
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+_SKIP_DIRS = {"__pycache__", ".git", ".claude", "tpu_logs", "node_modules"}
+_FIXTURES = os.path.join("dpf_tpu", "analysis", "fixtures")
+
+
+def iter_py_files(root: str, include_fixtures: bool = False):
+    """Yield repo-relative paths of every .py file under ``root``,
+    skipping caches and (by default) the seeded-violation fixtures."""
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+        rel_dir = os.path.relpath(dirpath, root)
+        if not include_fixtures and rel_dir.startswith(_FIXTURES):
+            continue
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.normpath(os.path.join(rel_dir, fn))
+
+
+def parse_file(root: str, rel: str):
+    """-> (ast.Module, source lines).  Syntax errors become a one-line
+    finding upstream; here they just raise."""
+    path = os.path.join(root, rel)
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    return ast.parse(src, filename=rel), src.splitlines()
+
+
+def pragma(lines: list[str], lineno: int, tag: str) -> str | None:
+    """The pragma payload for AST line ``lineno`` (1-based): looks on the
+    node's own line then the line above, returns the text after the tag
+    (may be empty) or None when absent.  The line above only counts when
+    it is a comment-only line — a trailing pragma on the previous CODE
+    line sanctions that line, not this one."""
+    for ln in (lineno, lineno - 1):
+        if not 1 <= ln <= len(lines):
+            continue
+        text = lines[ln - 1]
+        if ln != lineno and not text.lstrip().startswith("#"):
+            continue
+        idx = text.find("# " + tag)
+        if idx >= 0:
+            return text[idx + len(tag) + 2 :].strip()
+    return None
+
+
+def import_aliases(tree: ast.Module) -> dict[str, str]:
+    """Local name -> fully dotted origin for every import binding:
+    ``import os`` (os -> os), ``import numpy as np`` (np -> numpy),
+    ``from os import getenv as ge`` (ge -> os.getenv).  The passes
+    resolve call targets through this so aliased forms (``from os import
+    getenv``; ``from jax import device_get``) cannot slip past matching
+    that only knew the fully-qualified spelling."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    out[a.asname] = a.name
+                else:
+                    head = a.name.split(".")[0]
+                    out[head] = head
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                if a.name != "*":
+                    out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def resolve_dotted(node: ast.AST, aliases: dict[str, str]) -> str | None:
+    """The import-resolved dotted origin of a Name/Attribute chain
+    (``ge`` -> 'os.getenv', ``pl.pallas_call`` ->
+    'jax.experimental.pallas.pallas_call'), or None when the base name
+    is not an import binding."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    base = aliases.get(node.id)
+    if base is None:
+        return None
+    parts.append(base)
+    return ".".join(reversed(parts))
+
+
+def in_scope(rel: str, prefixes: tuple[str, ...]) -> bool:
+    rel = rel.replace(os.sep, "/")
+    return any(
+        rel == p or rel.startswith(p if p.endswith("/") else p + "/")
+        for p in prefixes
+    )
+
+
+def dotted_module(rel: str) -> str | None:
+    """Repo-relative path -> importable dotted name, for files inside the
+    dpf_tpu package; None for everything else (scripts, tests,
+    fixtures)."""
+    rel = rel.replace(os.sep, "/")
+    if not rel.startswith("dpf_tpu/") or "fixtures/" in rel:
+        return None
+    mod = rel[: -len(".py")].replace("/", ".")
+    return mod[: -len(".__init__")] if mod.endswith(".__init__") else mod

@@ -1,0 +1,30 @@
+"""Seeded violations for the host-sync pass (NEVER imported by
+production code; excluded from real-tree scans)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def hot_loop(xs, out):
+    total = 0
+    for x in xs:
+        y = jnp.sum(x)
+        y.block_until_ready()  # seeded: blocking sync in a loop
+        total += int(jnp.max(x))  # seeded: device scalar pulled to host
+    host = np.asarray(out)  # seeded: bare materialization, no annotation
+    probe = jax.device_get(out)  # seeded: blocking D2H
+    return total, host, probe
+
+
+def aliased_probe(out):
+    from jax import device_get
+
+    return device_get(out)  # seeded: aliased-import D2H bypass
+
+
+def sanctioned(words, xs):
+    coerced = np.asarray(xs, dtype=np.uint64)  # CLEAN: host-side coercion
+    # host-sync: fixture's sanctioned chunk D2H
+    final = np.asarray(words)
+    return coerced, final

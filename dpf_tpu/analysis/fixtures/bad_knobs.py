@@ -1,0 +1,23 @@
+"""Seeded violations for the knob-registry pass (NEVER imported by
+production code; excluded from real-tree scans)."""
+
+import os
+
+# R1/R2: a direct env read of a declared knob, bypassing the registry.
+FUSE = os.environ.get("DPF_TPU_FUSE", "off")
+
+# R2: subscript read.
+SBOX = os.environ["DPF_TPU_SBOX"]
+
+# R3: a typo'd knob name — the silent-failure mode the registry kills
+# (the real knob is DPF_TPU_BATCH_WINDOW_US).
+WINDOW = os.environ.get("DPF_TPU_BATCH_WINDOW_MS", "200")
+
+# Legal: a WRITE of a declared knob (A/B scripts set knobs for children).
+os.environ["DPF_TPU_POINTS"] = "xla"
+
+from os import getenv  # noqa: E402
+
+# R2 through the ALIASED import — the bypass that fully-qualified-only
+# matching missed (`from os import getenv` then a bare getenv read).
+FUSE2 = getenv("DPF_TPU_FUSE", "off")

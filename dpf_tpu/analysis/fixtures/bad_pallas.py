@@ -1,0 +1,51 @@
+"""Seeded violations for the pallas-jit pass (NEVER imported by
+production code; excluded from real-tree scans — its namespace comes
+from the pass's constant-assignment fallback, so nothing here runs)."""
+
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+
+_VMEM_BUDGET = 1 << 20
+_TILE = 128
+
+
+def unannotated_kernel(x):
+    # seeded: no footprint model annotation at all.
+    return pl.pallas_call(lambda r, o: None, out_shape=x)(x)
+
+
+def over_budget_kernel(x):
+    # seeded: model evaluates fine but exceeds _VMEM_BUDGET.
+    # vmem: 64 * _TILE * _TILE * 4
+    return pl.pallas_call(lambda r, o: None, out_shape=x)(x)
+
+
+def fitting_kernel(x):
+    # CLEAN: within budget.
+    # vmem: 2 * _TILE * _TILE * 4
+    return pl.pallas_call(lambda r, o: None, out_shape=x)(x)
+
+
+# seeded: list static_argnums — the unhashable retrace hazard.
+bad_jit = functools.partial(jax.jit, static_argnums=[0, 1])
+
+
+# seeded: computed static_argnames via dict.
+worse_jit = jax.jit(lambda cfg, x: x, static_argnames={"cfg": 1})
+
+
+# CLEAN: tuple-of-int literals.
+good_jit = functools.partial(jax.jit, static_argnums=(0, 1))
+
+from jax import jit  # noqa: E402
+from jax.experimental.pallas import pallas_call  # noqa: E402
+
+# seeded: the ALIASED-import bypasses — a bare from-imported jit with a
+# list spec, and a bare pallas_call with no footprint model.
+aliased_jit = jit(lambda x: x, static_argnums=[0])
+
+
+def aliased_kernel(x):
+    return pallas_call(lambda r, o: None, out_shape=x)(x)

@@ -1,0 +1,32 @@
+"""Seeded violations for the secret-hygiene pass (NEVER imported by
+production code; excluded from real-tree scans)."""
+
+import hashlib
+import logging
+
+
+def leak_to_log(kb):
+    # Taint propagates through the assignment; logging is a sink.
+    seeds = kb.seeds
+    logging.info("debug: first seeds %r", seeds)
+
+
+def leak_in_raise(scw):
+    # Key material formatted into an exception string — which the
+    # sidecar would relay to the client as an HTTP 400 body.
+    raise ValueError(f"bad correction word {scw!r}")
+
+
+def stats(blob):
+    # A stats payload carrying raw key bytes (/v1/stats shape).
+    return {"last_key": blob}
+
+
+def sanctioned(blob):
+    # CLEAN: the sha256 digest is the sanctioned way to index key bytes
+    # (serving/keycache.py); len() is public metadata.
+    logging.info(
+        "cache key %s (%d bytes)",
+        hashlib.sha256(blob).hexdigest(),
+        len(blob),
+    )

@@ -1,0 +1,130 @@
+"""Pass 3: host-sync-in-hot-path check.
+
+A silent device->host synchronization in the kernel or serving layers —
+``.block_until_ready()``, ``.item()``, ``jax.device_get``, a bare
+``np.asarray(device_array)`` — stalls the dispatch pipeline the serving
+fast path exists to keep full (it is exactly what the streaming
+EvalFull's overlap test guards dynamically; this pass guards it
+statically, everywhere).
+
+Scope: the kernel modules (``dpf_tpu/ops/``), the serving fast path
+(``dpf_tpu/serving/``, ``core/plans.py``), and the streaming pipeline
+(``core/stream.py``).  The models' public eval routes are OUT of scope
+by design: returning a host array is their API contract (the boundary
+the sidecar calls "final reply marshalling").
+
+Flagged, unless the line (or the one above) carries a
+``# host-sync: <why>`` annotation naming the sanctioned sync point:
+
+  * ``<x>.block_until_ready()``
+  * ``<x>.item()``
+  * ``jax.device_get(...)``
+  * ``np.asarray(x)`` / ``np.array(x)`` with a single argument and no
+    dtype — in this tree that shape is always a device->host
+    materialization (host-side coercions all pass ``dtype=``)
+  * ``int(...)`` / ``float(...)`` over an expression mentioning
+    ``jax``/``jnp`` (a device scalar pulled to host)
+
+The annotations make every host sync explicit and reviewable: the chunk
+D2H in ``core/stream.py`` and the packed-word marshalling in
+``core/plans.py`` / the ops walk wrappers are the sanctioned points.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .common import (
+    Finding, import_aliases, in_scope, iter_py_files, parse_file, pragma,
+    resolve_dotted,
+)
+
+PASS = "host-sync"
+
+_SCOPE = (
+    "dpf_tpu/ops",
+    "dpf_tpu/serving",
+    "dpf_tpu/core/stream.py",
+    "dpf_tpu/core/plans.py",
+)
+
+_SYNC_METHODS = {"block_until_ready", "item"}
+
+
+def _mentions_jax(node: ast.AST, aliases: dict[str, str]) -> bool:
+    """A name bound to jax (any import spelling: jax, jnp, a from-import
+    of a jax submodule) appears under ``node``."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            origin = aliases.get(sub.id)
+            if origin is not None and (
+                origin == "jax" or origin.startswith("jax.")
+            ):
+                return True
+            if sub.id in ("jax", "jnp"):
+                return True
+    return False
+
+
+def _violation(node: ast.Call, aliases: dict[str, str]) -> str | None:
+    fn = node.func
+    resolved = resolve_dotted(fn, aliases)
+    if isinstance(fn, ast.Attribute) and fn.attr in _SYNC_METHODS:
+        if not node.args:
+            return f".{fn.attr}() forces a device sync"
+    if resolved == "jax.device_get":
+        return "jax.device_get is a blocking D2H copy"
+    if (
+        resolved in ("numpy.asarray", "numpy.array")
+        and len(node.args) == 1
+        and not any(
+            kw.arg == "dtype" or kw.arg is None for kw in node.keywords
+        )
+    ):
+        return (
+            f"bare np.{resolved.rsplit('.', 1)[1]}(x) materializes to "
+            "host (blocking D2H on device arrays)"
+        )
+    if (
+        isinstance(fn, ast.Name)
+        and fn.id in ("int", "float")
+        and len(node.args) == 1
+        and _mentions_jax(node.args[0], aliases)
+    ):
+        return f"{fn.id}() over a jax expression pulls a device scalar"
+    return None
+
+
+def check_file(root: str, rel: str) -> list[Finding]:
+    tree, lines = parse_file(root, rel)
+    out: list[Finding] = []
+    aliases = import_aliases(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        why = _violation(node, aliases)
+        if why is None:
+            continue
+        if pragma(lines, node.lineno, "host-sync:"):
+            continue  # annotated (with a non-empty why): sanctioned
+        out.append(
+            Finding(
+                rel, node.lineno, PASS,
+                f"{why} in a hot-path module — move it behind the "
+                "allowlisted sync points or annotate the line with "
+                "'# host-sync: <why>'",
+            )
+        )
+    return out
+
+
+def run(root: str, files=None) -> list[Finding]:
+    if files is None:
+        files = [f for f in iter_py_files(root) if in_scope(f, _SCOPE)]
+    out: list[Finding] = []
+    for rel in files:
+        try:
+            out.extend(check_file(root, rel))
+        except SyntaxError as e:
+            out.append(Finding(rel, e.lineno or 0, PASS, f"syntax error: {e}"))
+    return out

@@ -1,0 +1,180 @@
+"""Pass 1: knob registry enforcement.
+
+Three rules, tuned so the acceptance grep ("``environ``/``getenv``
+outside knobs.py in dpf_tpu/ returns only allowlisted infra lines")
+holds structurally:
+
+  R1  inside the ``dpf_tpu`` package, any READ of ``os.environ`` /
+      ``os.getenv`` outside ``core/knobs.py`` is a finding — modules
+      read knobs through the registry's typed accessors (env WRITES
+      stay legal here too, same as R2).  Allowlisted
+      infra: ``parallel/multihost.py`` (multi-host LAUNCHER detection —
+      TPU_WORKER_HOSTNAMES / SLURM / OMPI vars, not DPF knobs).
+  R2  anywhere in the tree, a READ of a ``DPF_TPU_*`` string literal
+      through ``os.environ.get`` / ``os.getenv`` / ``os.environ[...]``
+      / ``<dict>.get`` is a finding.  WRITES stay legal everywhere
+      (subscript stores, ``.pop``, ``del``, ``monkeypatch.setenv``):
+      A/B scripts and tests set knobs for child code; only reading
+      outside the registry re-creates scattered defaults.
+  R3  anywhere in the tree, a string literal that IS a ``DPF_TPU_*``
+      name but is not declared in the registry is a finding — the typo
+      catcher (``DPF_TPU_BATCH_WINDOW_MS`` can no longer fail silent
+      anywhere: not in code, not in tests, not in A/B scripts).
+
+``# knob-ok`` on the line suppresses R2/R3 (the lint suite's own tests
+must spell typo'd names on purpose).
+
+One violating line usually trips several rules on nested AST nodes (the
+``os.environ`` attribute, the enclosing ``.get`` call, the name
+literal); findings collapse to the most specific rule per line
+(R3 typo > R2 direct read > R1 generic access) so the CLI count matches
+the violation count.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import knobs
+from .common import (
+    Finding, import_aliases, in_scope, iter_py_files, parse_file, pragma,
+    resolve_dotted,
+)
+
+PASS = "knob-registry"
+
+_KNOB_RE = re.compile(r"DPF_TPU_[A-Z0-9_]+")
+
+# R1 scope and its allowlist (repo-relative, forward slashes).
+_PACKAGE = ("dpf_tpu",)
+_REGISTRY_FILE = "dpf_tpu/core/knobs.py"
+_INFRA_ALLOWLIST = ("dpf_tpu/parallel/multihost.py",)
+
+# Env-write method names: legal everywhere (setting knobs for child
+# processes / subtests is how A/Bs work; reading them back is not).
+_WRITE_METHODS = {"pop", "setdefault", "setenv", "delenv", "update"}
+
+
+def _is_os_environ(node: ast.AST, aliases: dict[str, str]) -> bool:
+    """os.environ in ANY imported spelling: ``os.environ``, ``o.environ``
+    (import os as o), or a bare ``environ`` (from os import environ)."""
+    return resolve_dotted(node, aliases) == "os.environ"
+
+
+def _knob_literal(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        if _KNOB_RE.fullmatch(node.value):
+            return node.value
+    return None
+
+
+def check_file(root: str, rel: str) -> list[Finding]:
+    tree, lines = parse_file(root, rel)
+    rel_fwd = rel.replace("\\", "/")
+    if rel_fwd == _REGISTRY_FILE:
+        return []
+    # (specificity, finding); collapsed to the best rule per line below.
+    raw: list[tuple[int, Finding]] = []
+    in_package = in_scope(rel_fwd, _PACKAGE)
+    allow_infra = rel_fwd in _INFRA_ALLOWLIST
+    aliases = import_aliases(tree)
+
+    # os.environ nodes in WRITE position (subscript store/del, .pop/
+    # .update/... calls) — legal everywhere, R1 skips them.
+    env_writes: set[int] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Subscript)
+            and not isinstance(node.ctx, ast.Load)
+            and _is_os_environ(node.value, aliases)
+        ):
+            env_writes.add(id(node.value))
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr in _WRITE_METHODS
+            and _is_os_environ(node.value, aliases)
+        ):
+            env_writes.add(id(node.value))
+
+    for node in ast.walk(tree):
+        # R1: os.environ / os.getenv READS anywhere in the package, in
+        # any imported spelling (incl. `from os import environ/getenv`).
+        if in_package and not allow_infra and id(node) not in env_writes:
+            if _is_os_environ(node, aliases) or (
+                resolve_dotted(node, aliases) == "os.getenv"
+            ):
+                raw.append((
+                    2,
+                    Finding(
+                        rel, node.lineno, PASS,
+                        "direct environment access inside dpf_tpu/ — "
+                        "declare the knob in core/knobs.py and read it "
+                        "through the typed accessors",
+                    ),
+                ))
+                continue
+
+        # R2: reads of DPF_TPU_* literals through env getters — attribute
+        # getters on any object (`<dict>.get`) or a bare imported getenv.
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if node.args and (
+                (isinstance(fn, ast.Attribute) and fn.attr in ("get", "getenv"))
+                or resolve_dotted(fn, aliases) == "os.getenv"
+            ):
+                name = _knob_literal(node.args[0])
+                if name and pragma(lines, node.lineno, "knob-ok") is None:
+                    raw.append((
+                        1,
+                        Finding(
+                            rel, node.lineno, PASS,
+                            f"direct env read of {name} — go through "
+                            "dpf_tpu.core.knobs",
+                        ),
+                    ))
+        if isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, ast.Load
+        ):
+            if _is_os_environ(node.value, aliases):
+                name = _knob_literal(node.slice)
+                if name and pragma(lines, node.lineno, "knob-ok") is None:
+                    raw.append((
+                        1,
+                        Finding(
+                            rel, node.lineno, PASS,
+                            f"direct env read of {name} — go through "
+                            "dpf_tpu.core.knobs",
+                        ),
+                    ))
+
+        # R3: undeclared DPF_TPU_* names anywhere (the typo catcher).
+        name = _knob_literal(node)
+        if name and name not in knobs.REGISTRY:
+            if pragma(lines, node.lineno, "knob-ok") is None:
+                raw.append((
+                    0,
+                    Finding(
+                        rel, node.lineno, PASS,
+                        f"{name} is not declared in dpf_tpu/core/knobs.py "
+                        "(typo, or a new knob missing its declaration)",
+                    ),
+                ))
+
+    best: dict[int, int] = {}
+    for spec, f in raw:
+        best[f.line] = min(best.get(f.line, spec), spec)
+    return list(dict.fromkeys(
+        f for spec, f in raw if spec == best[f.line]
+    ))
+
+
+def run(root: str, files=None) -> list[Finding]:
+    files = list(files) if files is not None else list(iter_py_files(root))
+    out: list[Finding] = []
+    for rel in files:
+        try:
+            out.extend(check_file(root, rel))
+        except SyntaxError as e:
+            out.append(Finding(rel, e.lineno or 0, PASS, f"syntax error: {e}"))
+    return out

@@ -1,0 +1,216 @@
+"""Pass 4: Pallas VMEM-budget and jax.jit static-arg discipline.
+
+VMEM rule — every ``pl.pallas_call`` site must carry a statically
+computable footprint model: a ``# vmem: <expr>`` annotation on the call
+(or the line above), evaluated against the OWNING MODULE's namespace
+(tile constants, the budget model functions like ``fuse_vmem_bytes``),
+and the result must fit the module's declared ``_VMEM_BUDGET``.  A
+kernel whose modeled footprint silently outgrows the budget stops
+lowering on real hardware with an opaque Mosaic error — this pass moves
+that failure to lint time, and makes "how much VMEM does this kernel
+think it uses" a reviewable, greppable fact next to the call.
+
+jit rule — ``jax.jit`` (bare or through ``functools.partial``) must
+spell ``static_argnums`` / ``static_argnames`` / ``donate_argnums`` as
+hashable literals: an int/str or a tuple of them.  A list/dict/set (or
+computed) spec is rejected — mutable static-arg plumbing is exactly the
+retrace hazard PR 3's zero-retrace-after-warmup assertion can only
+catch dynamically, on shapes the tests happened to exercise.
+
+Module namespaces come from importing the real module when the file
+lives in THIS checkout's ``dpf_tpu`` package (hermetic: CPU jax); files
+outside — fixtures, or any ``--root`` pointing at another tree (whose
+same-named modules would otherwise import from THIS checkout and
+evaluate its pragmas against the wrong constants) — get a namespace of
+their top-level constant assignments, so fixture tests run without
+importing seeded-violation code and foreign-tree models that need
+functions fail loudly as "failed to evaluate" rather than silently
+passing against mismatched budgets.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import os
+
+from .common import (
+    Finding, dotted_module, import_aliases, in_scope, iter_py_files,
+    parse_file, pragma, repo_root, resolve_dotted,
+)
+
+PASS = "pallas-jit"
+
+_SCOPE = ("dpf_tpu",)
+_BUDGET_NAME = "_VMEM_BUDGET"
+_SPEC_KEYWORDS = ("static_argnums", "static_argnames", "donate_argnums")
+
+
+def _const_namespace(tree: ast.Module) -> dict:
+    """Top-level ``NAME = <literal int expr>`` bindings — the fallback
+    namespace for files that are not importable package modules."""
+    ns: dict = {}
+    for stmt in tree.body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+        ):
+            try:
+                ns[stmt.targets[0].id] = eval(  # noqa: S307 — literals only
+                    compile(ast.Expression(stmt.value), "<const>", "eval"),
+                    {"__builtins__": {}},
+                    {},
+                )
+            except Exception:  # noqa: BLE001 — non-constant, skip
+                pass
+    return ns
+
+
+def _namespace(root: str, rel: str, tree: ast.Module) -> dict:
+    mod = dotted_module(rel)
+    if mod is not None and os.path.realpath(root) == os.path.realpath(
+        repo_root()
+    ):
+        try:
+            return vars(importlib.import_module(mod))
+        except Exception:  # noqa: BLE001 — fall back to constants
+            pass
+    return _const_namespace(tree)
+
+
+def _is_pallas_call(node: ast.Call, aliases: dict[str, str]) -> bool:
+    """pallas_call in any spelling: ``pl.pallas_call`` (attribute on any
+    base — the repo idiom), or a from-imported bare ``pallas_call``."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "pallas_call":
+        return True
+    resolved = resolve_dotted(fn, aliases)
+    return resolved is not None and resolved.endswith(".pallas_call")
+
+
+def _is_jit_expr(node: ast.AST, aliases: dict[str, str]) -> bool:
+    """``jax.jit`` as a call target — jax.jit(...) directly, a
+    from-imported bare ``jit``, or either through
+    partial(jax.jit, ...)."""
+    return resolve_dotted(node, aliases) == "jax.jit"
+
+
+def _hashable_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, str))
+    if isinstance(node, ast.Tuple):
+        return all(_hashable_literal(e) for e in node.elts)
+    return False
+
+
+def _check_jit_call(rel: str, node: ast.Call, out: list[Finding]) -> None:
+    """``node`` is a call whose arguments configure jax.jit (either
+    jax.jit(...) itself or partial(jax.jit, ...))."""
+    for kw in node.keywords:
+        if kw.arg in _SPEC_KEYWORDS:
+            if not _hashable_literal(kw.value):
+                out.append(
+                    Finding(
+                        rel, node.lineno, PASS,
+                        f"{kw.arg} must be an int/str literal or a tuple "
+                        "of them — a list/dict/computed spec is a "
+                        "retrace hazard the plan cache cannot see",
+                    )
+                )
+
+
+def check_file(root: str, rel: str) -> list[Finding]:
+    tree, lines = parse_file(root, rel)
+    out: list[Finding] = []
+    aliases = import_aliases(tree)
+    ns: dict | None = None  # built lazily, only when a kernel site needs it
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+
+        if _is_pallas_call(node, aliases):
+            expr = pragma(lines, node.lineno, "vmem:")
+            if expr is None or not expr:
+                out.append(
+                    Finding(
+                        rel, node.lineno, PASS,
+                        "pl.pallas_call without a '# vmem: <expr>' "
+                        "footprint model (statically computable, within "
+                        f"the module's {_BUDGET_NAME})",
+                    )
+                )
+                continue
+            if ns is None:
+                ns = _namespace(root, rel, tree)
+            budget = ns.get(_BUDGET_NAME)
+            if not isinstance(budget, int):
+                out.append(
+                    Finding(
+                        rel, node.lineno, PASS,
+                        f"module declares no integer {_BUDGET_NAME} to "
+                        "check its '# vmem:' models against",
+                    )
+                )
+                continue
+            try:
+                est = eval(  # noqa: S307 — repo-authored pragma exprs
+                    compile(ast.Expression(
+                        ast.parse(expr, mode="eval").body
+                    ), "<vmem>", "eval"),
+                    {"__builtins__": {}},
+                    dict(ns),
+                )
+            except Exception as e:  # noqa: BLE001
+                out.append(
+                    Finding(
+                        rel, node.lineno, PASS,
+                        f"'# vmem: {expr}' failed to evaluate statically: "
+                        f"{type(e).__name__}: {e}",
+                    )
+                )
+                continue
+            if not isinstance(est, (int, float)):
+                out.append(
+                    Finding(
+                        rel, node.lineno, PASS,
+                        f"'# vmem: {expr}' evaluated to {type(est).__name__},"
+                        " not bytes",
+                    )
+                )
+            elif est > budget:
+                out.append(
+                    Finding(
+                        rel, node.lineno, PASS,
+                        f"modeled VMEM footprint {int(est)} B exceeds "
+                        f"{_BUDGET_NAME} = {budget} B",
+                    )
+                )
+
+        elif _is_jit_expr(node.func, aliases):
+            _check_jit_call(rel, node, out)
+        elif (
+            isinstance(node.func, ast.Name)
+            and node.func.id == "partial"
+            or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "partial"
+            )
+        ):
+            if node.args and _is_jit_expr(node.args[0], aliases):
+                _check_jit_call(rel, node, out)
+
+    return out
+
+
+def run(root: str, files=None) -> list[Finding]:
+    if files is None:
+        files = [f for f in iter_py_files(root) if in_scope(f, _SCOPE)]
+    out: list[Finding] = []
+    for rel in files:
+        try:
+            out.extend(check_file(root, rel))
+        except SyntaxError as e:
+            out.append(Finding(rel, e.lineno or 0, PASS, f"syntax error: {e}"))
+    return out

@@ -1,0 +1,329 @@
+"""Pass 2: secret-hygiene taint check.
+
+Key material in this codebase — PRG seeds, GGM correction words, raw
+request key bytes — is secret-shared cryptographic state: one byte of it
+in a log line, an exception string, a stats payload, or a bench ledger
+breaks the two-party privacy guarantee just as surely as a wrong kernel.
+Like the constant-time discipline of cryptographic kernels, this is a
+STRUCTURAL property, checkable statically on every commit.
+
+Mechanics (deliberately simple so the result is auditable): name-based,
+intra-function forward taint.
+
+  sources    identifiers and attributes with secret names — ``seeds``,
+             ``scw``/``tcw``/``vcw``/``fcw`` (and their packed/
+             transposed variants), raw key blobs (``blob``,
+             ``key_bytes``), parsed key batches (``ka``/``kb``/...).
+             Assignments propagate: ``x = kb.seeds`` taints ``x``.
+  sinks      logging/warnings/print calls; f-strings (or %/.format)
+             inside ``raise``; return values of stats-shaped functions
+             (``stats``/``stats_dict``/``stats_snapshot``/``as_dict``
+             — the /v1/stats surface); calls whose name mentions the
+             bench ``ledger``.
+  sanitizers subtrees that reduce a secret to public data stop the
+             taint: ``len()``/``type()``, shape/count attributes
+             (``.shape``, ``.k``, ``.log_n``, ...), and ``hashlib``
+             digests — the sha256 key digest in ``serving/keycache.py``
+             is the sanctioned way to index on key bytes.
+
+False-negative honesty: this does not track flow through calls or
+containers; it pins the failure modes the serving surface actually has
+(a debug log of a key batch, a ValueError embedding request bytes, a
+stats counter built from key material) and the fixture tests keep it
+catching them.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .common import Finding, in_scope, iter_py_files, parse_file
+
+PASS = "secret-hygiene"
+
+# Scope: everything in the package (key material lives in core/keys,
+# models/keys_chacha, models/dcf, and flows through serving + server).
+_SCOPE = ("dpf_tpu",)
+
+# Exact identifier / attribute names that ARE key material in this tree.
+SECRET_NAMES = frozenset(
+    {
+        "seed", "seeds", "seed_planes", "seeds_t", "seeds_bm",
+        "scw", "scw_planes", "scw_t", "scw_bm", "scw_p", "scw_packed",
+        "tcw", "tcw_t", "tcw_p", "tlcw", "trcw", "tl_w", "tr_w",
+        "tl_words", "tr_words", "t_words",
+        "fcw", "fcw_planes", "fcw_t", "fcw_p", "fcw_canon",
+        "vcw", "vcw_t", "fvcw", "fvcw_t",
+        "key_bytes", "key_blob", "key_material", "raw_key", "blob",
+        "ka", "kb", "kbp", "kb_s",
+    }
+)
+
+# Attribute accesses that reduce a secret to public metadata.
+PUBLIC_ATTRS = frozenset(
+    {
+        "shape", "dtype", "nbytes", "size", "ndim", "k", "log_n",
+        "stats", "stats_dict", "as_dict",
+    }
+)
+_SANITIZER_FUNCS = frozenset({"len", "type", "id", "bool"})
+_STATS_FUNCS = frozenset({"stats", "stats_dict", "stats_snapshot", "as_dict"})
+_LOG_METHODS = frozenset(
+    {"debug", "info", "warning", "warn", "error", "exception", "critical",
+     "log"}
+)
+
+
+def _is_sanitizer_call(node: ast.Call) -> bool:
+    """len()/type()-style reductions and hashlib digests — e.g.
+    ``hashlib.sha256(blob).digest()``, the keycache's sanctioned key
+    index."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id in _SANITIZER_FUNCS or fn.id in ("sha256", "blake2b")
+    parts = []
+    while isinstance(fn, ast.Attribute):
+        parts.append(fn.attr)
+        fn = fn.value
+    if isinstance(fn, ast.Name):
+        parts.append(fn.id)
+    elif isinstance(fn, ast.Call) and _is_sanitizer_call(fn):
+        return True
+    return bool({"hashlib", "sha256", "blake2b"} & set(parts))
+
+
+# Calls whose result IS their (secret) input in another shape — taint
+# flows through these on assignment; any other call's result is treated
+# as derived/public (a return code, a length, a parsed header), which
+# keeps the pass auditable.  Sink checks descend through every call.
+_PROPAGATING_CALLS = frozenset(
+    {
+        "bytes", "bytearray", "memoryview", "tobytes", "to_bytes",
+        "asarray", "ascontiguousarray", "array", "frombuffer", "copy",
+        "view", "reshape", "astype", "concatenate", "stack", "transpose",
+        "hex", "join",
+    }
+)
+
+
+def _call_name(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+def _secret_in(
+    node: ast.AST, tainted: set[str], through_calls: bool = True
+) -> str | None:
+    """The first secret name mentioned in ``node`` (skipping sanitized
+    subtrees), or None.  ``through_calls=False`` is the assignment-
+    propagation mode: taint survives only shape/byte-preserving calls."""
+    if isinstance(node, ast.Call):
+        if _is_sanitizer_call(node):
+            return None
+        if not through_calls and _call_name(node) not in _PROPAGATING_CALLS:
+            return None
+    if isinstance(node, ast.Attribute):
+        if node.attr in PUBLIC_ATTRS:
+            return None  # kb.k, kb.shape, cache.stats() — public metadata
+        if node.attr in SECRET_NAMES:
+            return node.attr
+        return _secret_in(node.value, tainted, through_calls)
+    if isinstance(node, ast.Name):
+        if node.id in SECRET_NAMES or node.id in tainted:
+            return node.id
+        return None
+    for child in ast.iter_child_nodes(node):
+        hit = _secret_in(child, tainted, through_calls)
+        if hit:
+            return hit
+    return None
+
+
+def _is_log_call(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id == "print"
+    if isinstance(fn, ast.Attribute):
+        if fn.attr not in _LOG_METHODS:
+            return False
+        base = fn.value
+        return isinstance(base, ast.Name) and (
+            base.id in ("logging", "warnings")
+            or "log" in base.id.lower()
+        )
+    return False
+
+
+def _is_ledger_call(node: ast.Call) -> bool:
+    fn = node.func
+    name = ""
+    if isinstance(fn, ast.Name):
+        name = fn.id
+    elif isinstance(fn, ast.Attribute):
+        name = fn.attr
+    return "ledger" in name.lower()
+
+
+def _formatted_secret(node: ast.AST, tainted: set[str]) -> str | None:
+    """A secret inside a string-formatting expression (f-string, %, or
+    .format) anywhere under ``node``."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.JoinedStr):
+            hit = _secret_in(sub, tainted)
+            if hit:
+                return hit
+        if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Mod):
+            hit = _secret_in(sub.right, tainted)
+            if hit:
+                return hit
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr == "format"
+        ):
+            hit = _secret_in(sub, tainted)
+            if hit:
+                return hit
+    return None
+
+
+def _taint_target(tgt: ast.AST, tainted: set[str]) -> None:
+    """Taint the names an assignment target binds.  For ``arr[i] = s``
+    the container ``arr`` is tainted, the index ``i`` is not."""
+    if isinstance(tgt, ast.Name):
+        tainted.add(tgt.id)
+    elif isinstance(tgt, (ast.Tuple, ast.List)):
+        for e in tgt.elts:
+            _taint_target(e, tainted)
+    elif isinstance(tgt, ast.Starred):
+        _taint_target(tgt.value, tainted)
+    elif isinstance(tgt, ast.Subscript):
+        _taint_target(tgt.value, tainted)
+    # Attribute targets (self.x = ...) are covered by SECRET_NAMES on
+    # the attribute read side.
+
+
+def _scope_walk(body: list[ast.stmt]):
+    """Every node of this scope, in source order, WITHOUT descending
+    into nested function/class scopes (each gets its own taint set —
+    sharing one across a whole class body cross-contaminates methods)."""
+    stack = list(reversed(body))
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in reversed(list(ast.iter_child_nodes(node))):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                 ast.Lambda),
+            ):
+                continue
+            stack.append(child)
+
+
+def _check_scope(rel: str, body: list[ast.stmt], params: set[str],
+                 func_name: str, out: list[Finding]) -> None:
+    tainted = set(params & SECRET_NAMES)
+
+    for sub in _scope_walk(body):
+        # Propagate taint through simple assignments, in source order.
+        if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = sub.value
+            if value is not None and _secret_in(
+                value, tainted, through_calls=False
+            ):
+                targets = (
+                    sub.targets if isinstance(sub, ast.Assign)
+                    else [sub.target]
+                )
+                for tgt in targets:
+                    _taint_target(tgt, tainted)
+
+        elif isinstance(sub, ast.Call):
+            if _is_log_call(sub) or _is_ledger_call(sub):
+                where = (
+                    "logging/console" if _is_log_call(sub)
+                    else "bench ledger"
+                )
+                for arg in list(sub.args) + [
+                    kw.value for kw in sub.keywords
+                ]:
+                    hit = _secret_in(arg, tainted)
+                    if hit:
+                        out.append(
+                            Finding(
+                                rel, sub.lineno, PASS,
+                                f"secret {hit!r} flows into {where} "
+                                "(key material must never leave the "
+                                "computation)",
+                            )
+                        )
+                        break
+
+        elif isinstance(sub, ast.Raise) and sub.exc is not None:
+            hit = _formatted_secret(sub.exc, tainted)
+            if hit:
+                out.append(
+                    Finding(
+                        rel, sub.lineno, PASS,
+                        f"secret {hit!r} formatted into a raised "
+                        "exception (error strings cross the bridge "
+                        "as HTTP 400 bodies)",
+                    )
+                )
+
+        elif (
+            isinstance(sub, ast.Return)
+            and sub.value is not None
+            and func_name in _STATS_FUNCS
+        ):
+            hit = _secret_in(sub.value, tainted)
+            if hit:
+                out.append(
+                    Finding(
+                        rel, sub.lineno, PASS,
+                        f"secret {hit!r} reaches the return value of "
+                        f"stats surface {func_name}() "
+                        "(/v1/stats payload)",
+                    )
+                )
+
+
+def check_file(root: str, rel: str) -> list[Finding]:
+    tree, _ = parse_file(root, rel)
+    out: list[Finding] = []
+    # Module level counts as one scope; every function is its own (the
+    # scope walks descend into nested defs, so findings can repeat —
+    # deduped below rather than complicating the walk).
+    _check_scope(rel, tree.body, set(), "<module>", out)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            _check_scope(rel, node.body, set(), "<class>", out)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            params = {
+                a.arg
+                for a in (
+                    args.posonlyargs + args.args + args.kwonlyargs
+                    + ([args.vararg] if args.vararg else [])
+                    + ([args.kwarg] if args.kwarg else [])
+                )
+            }
+            _check_scope(rel, node.body, params, node.name, out)
+    return list(dict.fromkeys(out))
+
+
+def run(root: str, files=None) -> list[Finding]:
+    if files is None:
+        files = [f for f in iter_py_files(root) if in_scope(f, _SCOPE)]
+    out: list[Finding] = []
+    for rel in files:
+        try:
+            out.extend(check_file(root, rel))
+        except SyntaxError as e:
+            out.append(Finding(rel, e.lineno or 0, PASS, f"syntax error: {e}"))
+    return out

@@ -1,0 +1,350 @@
+"""Central registry of every ``DPF_TPU_*`` environment knob.
+
+The perf-heavy layers (Pallas kernels, packed output pipeline, threaded
+serving fast path) are steered by env knobs that used to be read at ~25
+scattered ``os.environ`` call sites with per-site defaults — which is how
+defaults drift apart (the fuse default was spelled in three modules) and
+how a typo'd knob (``DPF_TPU_BATCH_WINDOW_MS``) fails silently.  This
+module is the single source of truth:
+
+  * every knob is **declared** once — name, kind, default, allowed
+    values, one doc line, owning module;
+  * every read goes through the typed accessors below (``get_str`` /
+    ``get_int`` / ``get_float`` / ``get_bool`` / ``get_enum`` /
+    ``get_raw`` / ``is_set``) — reading an undeclared name raises
+    ``KeyError`` at the call site, so typos fail loudly at import/test
+    time instead of silently returning a default;
+  * the static-analysis suite (``python -m dpf_tpu.analysis``) rejects
+    any direct ``os.environ`` / ``os.getenv`` read of a ``DPF_TPU_*``
+    name outside this file, and any ``DPF_TPU_*`` string literal in the
+    tree that is not declared here;
+  * ``audit_environ()`` reports ``DPF_TPU_*`` vars present in the
+    process environment but not declared — the sidecar warns on boot
+    (a deployment's typo'd knob used to fail silent);
+  * ``render_markdown()`` generates ``docs/KNOBS.md`` (drift-tested).
+
+Value semantics (shared by every accessor except ``get_raw``/``is_set``):
+an UNSET or EMPTY env var means the declared default.  Aliased tri-state
+knobs (``DPF_TPU_DONATE``'s ``on|1|true`` spellings, ...) keep their
+alias handling at the owning call site, reading the raw value through
+``get_raw`` — the registry owns declaration and lookup, not every
+module's historical spelling rules.
+
+This module must stay import-light (no jax, no numpy): bench harnesses
+and the analysis suite import it before any backend initializes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+# Spellings that mean "off" for boolean knobs (get_bool).  Matches the
+# historical per-site parsers (server.py's DPF_TPU_BATCH, bench_all.py's
+# DPF_TPU_BENCH_LEDGER_RETRY_ERRORS).
+_FALSE_WORDS = ("off", "0", "false")
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One declared env knob."""
+
+    name: str  # full env var name (DPF_TPU_*)
+    kind: str  # "enum" | "int" | "float" | "bool" | "str" | "flag"
+    default: str  # raw string form; what an unset/empty var means
+    doc: str  # one line for docs/KNOBS.md
+    module: str  # owning module (repo-relative path)
+    choices: tuple[str, ...] = ()  # closed value set (get_enum enforces)
+    values: str = ""  # display form for docs; defaults to "|".join(choices)
+
+    def values_doc(self) -> str:
+        return self.values or "|".join(self.choices) or f"<{self.kind}>"
+
+
+REGISTRY: dict[str, Knob] = {}
+
+
+def _declare(
+    name: str, kind: str, default: str, doc: str, module: str,
+    choices: tuple[str, ...] = (), values: str = "",
+) -> None:
+    if name in REGISTRY:
+        raise ValueError(f"knob {name} declared twice")
+    REGISTRY[name] = Knob(name, kind, default, doc, module, choices, values)
+
+
+# ---------------------------------------------------------------------------
+# Declarations — the complete knob surface, grouped by layer.
+# ---------------------------------------------------------------------------
+
+# Kernel / route selection ---------------------------------------------------
+_declare(
+    "DPF_TPU_SBOX", "enum", "bp113",
+    "Active AES S-box circuit for every compat cipher path (bp113 = plain "
+    "Boyar-Peralta; lowlive = register-budgeted rematerializing schedule).",
+    "dpf_tpu/ops/sbox_circuit.py", choices=("bp113", "lowlive"),
+)
+_declare(
+    "DPF_TPU_PRG", "str", "",
+    "Compat-profile PRG backend override; unset picks pallas_bm on TPU "
+    "and xla elsewhere.",
+    "dpf_tpu/models/dpf.py",
+    values="xla|pallas|pallas_bm|pallas_bm_il (unset = auto)",
+)
+_declare(
+    "DPF_TPU_FUSE", "str", "off",
+    "Level-fused GGM expansion for BOTH profiles: off, auto (VMEM-budget "
+    "group size on TPU), or an explicit group size that re-raises on "
+    "lowering failure instead of latching the per-level fallback.",
+    "dpf_tpu/ops/__init__.py", values="off|auto|<levels>",
+)
+_declare(
+    "DPF_TPU_POINTS", "enum", "auto",
+    "Fast-profile pointwise walk backend (auto = pallas on TPU).",
+    "dpf_tpu/ops/chacha_pallas.py", choices=("auto", "xla", "pallas"),
+)
+_declare(
+    "DPF_TPU_FAST", "enum", "auto",
+    "Fast-profile full-domain expansion backend (auto = pallas on TPU).",
+    "dpf_tpu/ops/chacha_pallas.py", choices=("auto", "xla", "pallas"),
+)
+_declare(
+    "DPF_TPU_EXPAND_ENTRY", "enum", "auto",
+    "Small-domain whole-tree expansion route: auto (entry 0 only where "
+    "the classic kernel is ineligible), small (force entry 0, nu <= 12), "
+    "classic (disable the small route).",
+    "dpf_tpu/ops/chacha_pallas.py", choices=("auto", "small", "classic"),
+)
+_declare(
+    "DPF_TPU_POINTS_AES", "enum", "auto",
+    "Compat-profile pointwise walk backend (pallas forces the walk kernel "
+    "even for non-bit-major backends).",
+    "dpf_tpu/ops/aes_pallas.py", choices=("auto", "xla", "pallas"),
+)
+
+# Dispatch plans / serving fast path ----------------------------------------
+_declare(
+    "DPF_TPU_DONATE", "str", "auto",
+    "Buffer donation on the chunk-finish level-state carries "
+    "(auto = donate on TPU only).",
+    "dpf_tpu/core/plans.py", values="off|auto|on",
+)
+_declare(
+    "DPF_TPU_PLAN_KFLOOR", "int", "1",
+    "Minimum K bucket for dispatch plans (TPU deployments may pin a "
+    "kernel lane quantum, e.g. 128, so single-key requests take the "
+    "kernel route).",
+    "dpf_tpu/core/plans.py",
+)
+_declare(
+    "DPF_TPU_BATCH", "bool", "on",
+    "Sidecar micro-batcher for the pointwise/DCF routes "
+    "(off = direct per-request dispatch).",
+    "dpf_tpu/server.py",
+)
+_declare(
+    "DPF_TPU_BATCH_WINDOW_US", "float", "200",
+    "Burst-collection window per batcher lane, in microseconds "
+    "(0 = collect only what already queued).",
+    "dpf_tpu/serving/batcher.py",
+)
+_declare(
+    "DPF_TPU_BATCH_MAX_KEYS", "int", "1024",
+    "Maximum key-rows coalesced into one batcher dispatch.",
+    "dpf_tpu/serving/batcher.py",
+)
+_declare(
+    "DPF_TPU_KEY_CACHE_ENTRIES", "int", "32",
+    "Host-repack LRU capacity in whole key batches (0 disables).",
+    "dpf_tpu/serving/keycache.py",
+)
+_declare(
+    "DPF_TPU_WIRE_FORMAT", "enum", "bits",
+    "Server default response format for points endpoints when the "
+    "request omits format= (per-request param wins).",
+    "dpf_tpu/server.py", choices=("bits", "packed"),
+)
+_declare(
+    "DPF_TPU_STREAM", "str", "auto",
+    "Streamed /v1/evalfull default: on, off, or auto (stream responses "
+    ">= DPF_TPU_STREAM_MIN_BYTES).",
+    "dpf_tpu/server.py", values="off|auto|on",
+)
+_declare(
+    "DPF_TPU_STREAM_MIN_BYTES", "int", str(1 << 20),
+    "auto-streaming threshold for /v1/evalfull, in response bytes.",
+    "dpf_tpu/server.py",
+)
+
+# Bench harness --------------------------------------------------------------
+_declare(
+    "DPF_TPU_BENCH_BACKOFF", "float", "10",
+    "Seconds between bench infra-failure retries (watchdog child).",
+    "bench.py",
+)
+_declare(
+    "DPF_TPU_BENCH_TIMEOUT", "float", "900",
+    "Hard wall-clock budget for one bench measurement child, seconds.",
+    "bench.py",
+)
+_declare(
+    "DPF_TPU_BENCH_PROBE_TIMEOUT", "float", "120",
+    "Budget for the wedged-tunnel probe child (0 skips the probe), "
+    "seconds; deducted from DPF_TPU_BENCH_TIMEOUT.",
+    "bench.py",
+)
+_declare(
+    "DPF_TPU_BENCH_PROBE", "flag", "",
+    "Internal: set in the probe child's environment so test doubles can "
+    "recognize it.",
+    "bench.py",
+)
+_declare(
+    "DPF_TPU_BENCH_CHILD", "flag", "",
+    "Internal: marks the bench watchdog's measurement child process.",
+    "bench.py",
+)
+_declare(
+    "DPF_TPU_BENCH_LEDGER", "str", "",
+    "Path of the resumable bench-matrix ledger (empty = no ledger).",
+    "bench_all.py", values="<path>",
+)
+_declare(
+    "DPF_TPU_BENCH_LEDGER_KEY", "str", "",
+    "Test override pinning the ledger identity key regardless of tree "
+    "state.",
+    "bench_all.py", values="<opaque key>",
+)
+_declare(
+    "DPF_TPU_BENCH_LEDGER_RETRY_ERRORS", "bool", "off",
+    "Do not replay (or re-record) ledger sections whose recorded rows "
+    "contain an error row — re-measure them instead.",
+    "bench_all.py",
+)
+_declare(
+    "DPF_TPU_BENCH_ONLY", "str", "",
+    "Comma-separated bench-section filter (empty = all sections).",
+    "bench_all.py", values="<name,...>",
+)
+_declare(
+    "DPF_TPU_BENCH_FORCE_FAIL", "str", "",
+    "Test hook: comma-separated sections forced to raise "
+    "(name or name:transient).",
+    "bench_all.py", values="<name[:transient],...>",
+)
+
+
+# ---------------------------------------------------------------------------
+# Typed accessors
+# ---------------------------------------------------------------------------
+
+
+def knob(name: str) -> Knob:
+    """Declaration lookup; KeyError on an undeclared name (the typo
+    guard — never catch this to 'default' a knob)."""
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"undeclared knob {name!r}: declare it in dpf_tpu/core/knobs.py"
+        ) from None
+
+
+def get_raw(name: str) -> str | None:
+    """The raw env value (None when unset, '' preserved) — for call sites
+    with historical alias/empty-string semantics the typed accessors do
+    not model.  The name must still be declared."""
+    return os.environ.get(knob(name).name)
+
+
+def is_set(name: str) -> bool:
+    """True when the var is present AND non-empty (flag semantics)."""
+    return bool(os.environ.get(knob(name).name))
+
+
+def get_str(name: str) -> str:
+    k = knob(name)
+    raw = os.environ.get(k.name)
+    return k.default if raw is None or raw == "" else raw
+
+
+def get_int(name: str) -> int:
+    return int(get_str(name))
+
+
+def get_float(name: str) -> float:
+    return float(get_str(name))
+
+
+def get_bool(name: str) -> bool:
+    return get_str(name).lower() not in _FALSE_WORDS
+
+
+def get_enum(name: str) -> str:
+    k = knob(name)
+    v = get_str(name)
+    if v not in k.choices:
+        raise ValueError(
+            f"{k.name}={v!r} unknown (use {'|'.join(k.choices)})"
+        )
+    return v
+
+
+# ---------------------------------------------------------------------------
+# Environment audit + docs generation
+# ---------------------------------------------------------------------------
+
+
+def audit_environ(environ=None) -> list[str]:
+    """DPF_TPU_* names present in ``environ`` (default ``os.environ``)
+    but not declared here — a deployment's typo'd knobs.  The sidecar
+    warns with this list on boot."""
+    env = os.environ if environ is None else environ
+    return sorted(
+        name
+        for name in env
+        if name.startswith("DPF_TPU_") and name not in REGISTRY
+    )
+
+
+def snapshot(names=None) -> dict[str, str]:
+    """Raw values of declared knobs as they sit in the environment
+    ('' when unset) — ledger/route identity capture, not parsing.
+    ``DPF_TPU_*`` names must be declared (KeyError on a typo, like every
+    other accessor — it must not be silently recorded as ''); non-DPF
+    infra vars (``JAX_PLATFORMS``) pass through raw."""
+    if names is None:
+        names = sorted(REGISTRY)
+    out = {}
+    for n in names:
+        if n.startswith("DPF_TPU_"):
+            knob(n)  # KeyError on an undeclared knob
+        out[n] = os.environ.get(n, "")
+    return out
+
+
+def render_markdown() -> str:
+    """docs/KNOBS.md content — generated, never hand-edited (the drift
+    test fails when the committed file is stale)."""
+    lines = [
+        "# DPF_TPU_* knobs",
+        "",
+        "Generated from the central registry (`dpf_tpu/core/knobs.py`) by",
+        "`python -m dpf_tpu.analysis --write-knobs-doc`; "
+        "do not edit by hand.",
+        "Every knob read in the tree goes through the registry's typed",
+        "accessors — `python -m dpf_tpu.analysis` (the `knob-registry`",
+        "pass) rejects direct env reads and undeclared names, and the",
+        "sidecar warns on boot about `DPF_TPU_*` vars it does not know.",
+        "",
+        "| Knob | Values | Default | Owner | What it does |",
+        "|---|---|---|---|---|",
+    ]
+    for k in sorted(REGISTRY.values(), key=lambda k: k.name):
+        default = k.default if k.default != "" else "(unset)"
+        lines.append(
+            f"| `{k.name}` | {k.values_doc()} | `{default}` | "
+            f"`{k.module}` | {k.doc} |"
+        )
+    lines.append("")
+    return "\n".join(lines)

@@ -36,14 +36,13 @@ the aliasing hint with a warning).
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import NamedTuple
 
 import numpy as np
 
-from . import bitpack
+from . import bitpack, knobs
 
 # ---------------------------------------------------------------------------
 # Knobs
@@ -52,7 +51,8 @@ from . import bitpack
 
 def donation_enabled() -> bool:
     """Resolve DPF_TPU_DONATE (off|auto|on; default auto = TPU only)."""
-    v = os.environ.get("DPF_TPU_DONATE", "auto").lower()
+    raw = knobs.get_raw("DPF_TPU_DONATE")
+    v = knobs.knob("DPF_TPU_DONATE").default if raw is None else raw.lower()
     if v in ("on", "1", "true"):
         return True
     if v in ("off", "0", "false", ""):
@@ -69,7 +69,7 @@ def k_floor() -> int:
     TPU may pin this to a kernel lane quantum (e.g. 128 for the fast
     walk kernel) so even single-key requests take the kernel route; the
     default 1 keeps CPU smoke runs cheap."""
-    return int(os.environ.get("DPF_TPU_PLAN_KFLOOR", "1") or 1)
+    return knobs.get_int("DPF_TPU_PLAN_KFLOOR")
 
 
 def _pow2_bucket(n: int, floor: int = 1) -> int:
@@ -112,7 +112,7 @@ def plan_key(
     return PlanKey(
         route, profile, int(log_n), k_bucket(k),
         q_bucket(q) if q else 0, bool(packed),
-        os.environ.get("DPF_TPU_FUSE", "off") or "off",
+        knobs.get_str("DPF_TPU_FUSE"),
         sbox_circuit.active_sbox(),
     )
 
@@ -286,6 +286,8 @@ def run_points(route: str, profile: str, kb, xs: np.ndarray) -> np.ndarray:
     plan, first = _CACHE.get(key)
     t0 = time.perf_counter()
     kbp = _pad_keys(kb, key.k_bucket - K)
+    # The packed words leave the device exactly once per dispatch, here.
+    # host-sync: final reply marshalling (points route)
     words = np.asarray(
         _points_eval(
             route, profile, kbp,
@@ -330,6 +332,7 @@ def run_interval(ik, xs: np.ndarray) -> np.ndarray:
                 pass
     else:
         up, lp, cp_ = upper, lower, const
+    # host-sync: final reply marshalling (interval route)
     words = np.asarray(
         dcf.eval_interval_points(
             (up, lp, cp_),

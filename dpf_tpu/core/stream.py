@@ -52,6 +52,7 @@ def stream_chunks(c: int, dispatch, to_rows, events=None, timer=None):
         words.copy_to_host_async()
         rec("d2h_start", j)
         with ph("d2h"):
+            # host-sync: the allowlisted chunk D2H (after copy_to_host_async)
             out = np.asarray(words)
         rec("d2h_done", j)
         return to_rows(out)

@@ -29,7 +29,6 @@ compiled function.
 
 from __future__ import annotations
 
-import os
 from functools import partial
 
 import jax
@@ -37,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import bitpack
+from ..core import knobs
 from ..core.keys import KeyBatch
 from ..ops import aes_pallas
 from ..ops.aes_bitslice import (
@@ -74,7 +74,7 @@ _BM_BACKENDS = frozenset({"pallas_bm", "pallas_bm_il"})
 
 
 def default_backend() -> str:
-    env = os.environ.get("DPF_TPU_PRG")
+    env = knobs.get_raw("DPF_TPU_PRG")
     if env:
         if env not in _PRG_IMPLS:
             raise ValueError(

@@ -18,14 +18,14 @@ profiles share the knob but own separate budget models.
 
 from __future__ import annotations
 
-import os
+from ..core import knobs
 
 
 def fuse_request(auto_g: int = 0) -> int:
     """Requested fused-group size: 0 = off, g >= 1 = groups of <= g levels.
     ``auto_g`` is the caller's VMEM-budget cap (pass 0 off-TPU)."""
-    env = os.environ.get("DPF_TPU_FUSE", "off")
-    if env in ("", "off"):
+    env = knobs.get_str("DPF_TPU_FUSE")
+    if env == "off":
         return 0
     if env == "auto":
         return auto_g
@@ -44,8 +44,7 @@ def fuse_forced() -> bool:
     """True when DPF_TPU_FUSE names an explicit group size — the fused
     path must then re-raise on failure rather than latch the per-level
     fallback (mirrors aes_pallas.walk_forced)."""
-    env = os.environ.get("DPF_TPU_FUSE", "")
-    return bool(env) and env not in ("off", "auto")
+    return knobs.get_str("DPF_TPU_FUSE") not in ("off", "auto")
 
 
 def deinterleave_nodes(x, levels: int, wt: int):

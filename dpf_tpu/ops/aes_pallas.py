@@ -24,7 +24,6 @@ Mosaic path is in use.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +31,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 from ..core import aes_np
+from ..core import knobs
 from .aes_bitslice import (
     RK_MASKS_L,
     RK_MASKS_R,
@@ -270,6 +270,9 @@ def _tiled_call(S, kernel, n_out, bm):
     spec = pl.BlockSpec((128, bt), lambda i: (0, i))
     rk_spec = pl.BlockSpec((2, 11, 128), lambda i: (0, 0, 0))
     shapes = [jax.ShapeDtypeStruct((128, B), jnp.uint32)] * n_out
+    # One [128, _BT] state slab in, <= 2 out, round keys, 2x for Mosaic's
+    # double-buffered I/O windows (S-box temporaries live in registers).
+    # vmem: 2 * (1 + 2) * 128 * _BT * 4 + 2 * 11 * 128 * 4
     return pl.pallas_call(
         kernel,
         grid=(B // bt,),
@@ -343,9 +346,7 @@ _PQT = 128  # max walk query-word tile (lanes)
 def walk_backend() -> str:
     """'pallas' | 'xla' for the compat pointwise walk (env
     DPF_TPU_POINTS_AES)."""
-    env = os.environ.get("DPF_TPU_POINTS_AES", "auto")
-    if env not in ("auto", "xla", "pallas"):
-        raise ValueError("DPF_TPU_POINTS_AES must be auto|xla|pallas")
+    env = knobs.get_enum("DPF_TPU_POINTS_AES")
     if env != "auto":
         return env
     return "pallas" if _on_tpu() else "xla"
@@ -355,7 +356,7 @@ def walk_forced() -> bool:
     """True when DPF_TPU_POINTS_AES=pallas explicitly — an override that
     engages the walk kernel even for a non-bit-major ``backend`` argument
     (interpreter-mode tests and A/B runs)."""
-    return os.environ.get("DPF_TPU_POINTS_AES") == "pallas"
+    return knobs.get_raw("DPF_TPU_POINTS_AES") == "pallas"
 
 
 def _walk_kernel_bm(
@@ -436,6 +437,10 @@ def eval_points_walk_planes(
     qblock = pl.BlockSpec((n1, _PKT, qt), lambda k, q: (0, k, q))
     planes_q = pl.BlockSpec((128, _PKT, qt), lambda k, q: (0, k, q))
     kern = functools.partial(_walk_kernel_bm, nu=nu)
+    # Whole-walk residency at the worst case nu=64: per-level CW planes
+    # (scw 128-plane + tl/tr words), the [128, _PKT, qt] selector slab,
+    # path words, seeds/t/fcw columns, round keys; 2x I/O windows.
+    # vmem: 2 * 4 * (64 * 128 * _PKT + 2 * 64 * _PKT + 2 * 128 * _PKT * _PQT + 64 * _PKT * _PQT + 130 * _PKT + 2 * 11 * 128)
     return pl.pallas_call(
         kern,
         grid=(K // _PKT, qp // qt),
@@ -502,6 +507,9 @@ _FWT = 128  # fused node lane tile at kernel entry
 # the state slabs; auto group size is the largest g that fits.
 _FUSE_VMEM_BUDGET = 8 << 20
 _FUSE_MAX_G = 4
+# Module-wide bound the '# vmem:' kernel footprint models are linted
+# against (python -m dpf_tpu.analysis, pallas-jit pass).
+_VMEM_BUDGET = _FUSE_VMEM_BUDGET
 
 
 def fuse_vmem_bytes(g: int, kt: int = _FKT, wt: int = _FWT) -> int:
@@ -571,6 +579,9 @@ def fused_levels_planes(S, T, scw_bm, tl_w, tr_w):
     kt = fused_qkt(kp)
     wt = min(W, _FWT)
     kern = functools.partial(_fused_levels_kernel_bm, glevels=g)
+    # The declared budget model itself, at the auto group size (explicit
+    # DPF_TPU_FUSE=<g> overrides are forced A/B runs outside the budget).
+    # vmem: fuse_vmem_bytes(fuse_auto_levels())
     return pl.pallas_call(
         kern,
         grid=(kp // kt, W // wt),

@@ -45,7 +45,6 @@ native layout, no transposes anywhere.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -55,6 +54,7 @@ from jax.experimental import pallas as pl
 
 from ..core import bitpack
 from ..core import chacha_np as cc
+from ..core import knobs
 
 _C = [int(v) for v in cc._CONSTANTS]
 _DSX = [int(v) for v in cc.DS_EXPAND]
@@ -63,6 +63,12 @@ _DSL = [int(v) for v in cc.DS_LEAF]
 _KT = 128  # key-tile (lane) width
 _QT_CAP = 128  # max query-tile rows; actual tile = largest divisor of Q
 
+# Module-wide bound the '# vmem:' kernel footprint models are linted
+# against (python -m dpf_tpu.analysis, pallas-jit pass): ~16 MB/core
+# minus Mosaic's double-buffered I/O windows, matching the compat
+# profile's budget model (aes_pallas._FUSE_VMEM_BUDGET).
+_VMEM_BUDGET = 8 << 20
+
 
 def _on_tpu() -> bool:
     return jax.default_backend() == "tpu"
@@ -70,9 +76,7 @@ def _on_tpu() -> bool:
 
 def points_backend() -> str:
     """'pallas' | 'xla' for the pointwise walk (env DPF_TPU_POINTS)."""
-    env = os.environ.get("DPF_TPU_POINTS", "auto")
-    if env not in ("auto", "xla", "pallas"):
-        raise ValueError("DPF_TPU_POINTS must be auto|xla|pallas")
+    env = knobs.get_enum("DPF_TPU_POINTS")
     if env != "auto":
         return env
     return "pallas" if _on_tpu() else "xla"
@@ -215,6 +219,10 @@ def _walk_raw(
         return pl.BlockSpec((n, _KT), lambda q, k: (0, k))
 
     kern = functools.partial(_walk_kernel, nu=nu, log_n=log_n, dcf=dcf)
+    # Worst-case residency at nu=64 on [_QT_CAP, _KT] query tiles:
+    # xs_lo/xs_hi/out query slabs + the per-level CW rows (scw 4/level,
+    # tcw 2/level, DCF vcw 4/level) + fcw/meta/seed rows; 2x I/O windows.
+    # vmem: 2 * 4 * _KT * (3 * _QT_CAP + 10 * 64 + 23)
     return pl.pallas_call(
         kern,
         grid=(Q // qt, K // _KT),
@@ -378,7 +386,9 @@ def eval_points_walk(
             kb.log_n, kb.nu, qt, packed,
         )
     if packed:
+        # host-sync: final host marshalling of the walk output words
         return bitpack.mask_tail(np.asarray(out), q)
+    # host-sync: final host marshalling of the walk output bits
     return np.asarray(out)[:q].T
 
 
@@ -395,9 +405,7 @@ _EXP_LEVELS = 5
 
 def expand_backend() -> str:
     """'pallas' | 'xla' for the fast-profile expansion (env DPF_TPU_FAST)."""
-    env = os.environ.get("DPF_TPU_FAST", "auto")
-    if env not in ("auto", "xla", "pallas"):
-        raise ValueError("DPF_TPU_FAST must be auto|xla|pallas")
+    env = knobs.get_enum("DPF_TPU_FAST")
     if env != "auto":
         return env
     return "pallas" if _on_tpu() else "xla"
@@ -478,6 +486,9 @@ def fused_levels_raw(s0, s1, s2, s3, T, scw_p, tcw_p, levels: int):
     cw_spec = pl.BlockSpec((_EKT, 128), lambda k, w: (k, 0))
     out_spec = pl.BlockSpec((_EKT, wt << levels), lambda k, w: (k, w))
     kern = functools.partial(_fused_levels_kernel, levels=levels)
+    # 5 word arrays in at [_EKT, _EWT], 2 CW operand blocks, 5 out at
+    # <= _EWT << _EXP_LEVELS lanes; 2x I/O windows.
+    # vmem: 2 * 4 * _EKT * (5 * _EWT + 2 * 128 + 5 * (_EWT << _EXP_LEVELS))
     return pl.pallas_call(
         kern,
         grid=(K // _EKT, W // wt),
@@ -515,7 +526,7 @@ def small_tree_degraded(e: Exception) -> None:
     global _SMALL_TREE_BROKEN
     import warnings
 
-    if os.environ.get("DPF_TPU_EXPAND_ENTRY") == "small":
+    if knobs.get_raw("DPF_TPU_EXPAND_ENTRY") == "small":
         raise e
     _SMALL_TREE_BROKEN = True
     warnings.warn(
@@ -534,9 +545,7 @@ def small_tree_entry(nu: int):
     launches for latency-bound tiny expansions (BASELINE config 1's
     failure mode).  ``small`` forces entry 0 for every nu <= 12 (A/B
     experiments); ``classic`` disables the small route entirely."""
-    mode = os.environ.get("DPF_TPU_EXPAND_ENTRY", "auto")
-    if mode not in ("auto", "small", "classic"):
-        raise ValueError("DPF_TPU_EXPAND_ENTRY must be auto|small|classic")
+    mode = knobs.get_enum("DPF_TPU_EXPAND_ENTRY")
     if mode == "classic" or not 1 <= nu <= _EXP_SMALL_MAX_NU:
         return None
     # A latched failure disables the route for AUTO mode only: an explicit
@@ -621,6 +630,10 @@ def _expand_raw(s0, s1, s2, s3, T, scw_p, tcw_p, fcw_p, levels):
     cw_spec = pl.BlockSpec((_EKT, 128), lambda k, w: (k, 0))
     out_spec = pl.BlockSpec((_EKT, wt << levels), lambda k, w: (k, w))
     kern = functools.partial(_expand_kernel, levels=levels)
+    # 5 word arrays + 3 CW operand blocks in, 16 leaf word slabs out at
+    # <= _EWT << _EXP_LEVELS lanes (the 2 MB output bound that sized
+    # _EXP_LEVELS = 5); 2x I/O windows.
+    # vmem: 2 * 4 * _EKT * (5 * _EWT + 3 * 128 + 16 * (_EWT << _EXP_LEVELS))
     return pl.pallas_call(
         kern,
         grid=(K // _EKT, W // wt),
@@ -756,5 +769,7 @@ def eval_points_walk_dcf(
         *ops, xs_lo, xs_hi, kb.log_n, kb.nu, _qtile(xs_lo.shape[0]), packed
     )
     if packed:
+        # host-sync: final host marshalling of the walk output words
         return bitpack.mask_tail(np.asarray(out), q)
+    # host-sync: final host marshalling of the walk output bits
     return np.asarray(out)[:q].T

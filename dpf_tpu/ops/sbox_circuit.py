@@ -29,7 +29,7 @@ that actually ran.
 
 from __future__ import annotations
 
-import os
+from ..core import knobs
 
 
 def sbox_bp113(x):
@@ -429,11 +429,17 @@ def sbox_algebraic(x):
 # the on-hardware A/B (tpu_logs/*/DECISIONS.md) flips it.
 SBOX_IMPLS = {"bp113": sbox_bp113, "lowlive": sbox_bp113_lowlive}
 
-_SBOX = os.environ.get("DPF_TPU_SBOX", "bp113")
-if _SBOX not in SBOX_IMPLS:
-    raise ValueError(
-        f"DPF_TPU_SBOX={_SBOX!r} unknown; choose from {sorted(SBOX_IMPLS)}"
+# The registry's declared choices and the implementation table must agree
+# (the knob declaration is what docs/KNOBS.md and the lint pass see) —
+# an explicit raise, not an assert, so the check survives python -O.
+if set(SBOX_IMPLS) != set(knobs.knob("DPF_TPU_SBOX").choices):
+    raise RuntimeError(
+        "SBOX_IMPLS and the DPF_TPU_SBOX declaration in core/knobs.py "
+        f"disagree: {sorted(SBOX_IMPLS)} vs "
+        f"{sorted(knobs.knob('DPF_TPU_SBOX').choices)}"
     )
+
+_SBOX = knobs.get_enum("DPF_TPU_SBOX")
 
 
 def set_sbox(name: str) -> str:

@@ -90,15 +90,15 @@ from __future__ import annotations
 import argparse
 import contextlib
 import json
-import os
 import threading
 import time
+import warnings
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
-from .core import bitpack, plans
+from .core import bitpack, knobs, plans
 from .serving import Batcher, IntervalWork, KeyCache, PointsWork
 from .serving.batcher import dispatch_interval, dispatch_points
 from .utils.profiling import PhaseTimer
@@ -108,7 +108,7 @@ def _wire_format(q: dict) -> bool:
     """Resolve the response format for a points endpoint -> packed? bool.
     Per-request ``format`` param wins; ``DPF_TPU_WIRE_FORMAT`` sets the
     server default; unknown values are a 400 (ValueError)."""
-    fmt = q.get("format", os.environ.get("DPF_TPU_WIRE_FORMAT") or "bits")
+    fmt = q.get("format", knobs.get_str("DPF_TPU_WIRE_FORMAT"))
     if fmt not in ("bits", "packed"):
         raise ValueError(f"unknown format {fmt!r} (use bits|packed)")
     return fmt == "packed"
@@ -138,10 +138,7 @@ class _ServingState:
         self.batcher = Batcher()
         self.keys = KeyCache()
         self.phases = PhaseTimer()
-        self.batch_enabled = (
-            os.environ.get("DPF_TPU_BATCH", "on").lower()
-            not in ("off", "0", "false")
-        )
+        self.batch_enabled = knobs.get_bool("DPF_TPU_BATCH")
         self._lock = threading.Lock()
 
     @contextlib.contextmanager
@@ -230,16 +227,15 @@ def _stream_mode(q: dict, out_bytes: int) -> bool:
         if v not in ("0", "1"):
             raise ValueError(f"unknown stream {v!r} (use 0|1)")
         return v == "1"
-    env = os.environ.get("DPF_TPU_STREAM", "auto").lower()
+    raw = knobs.get_raw("DPF_TPU_STREAM")
+    env = knobs.knob("DPF_TPU_STREAM").default if raw is None else raw.lower()
     if env in ("on", "1", "true"):
         return True
     if env in ("off", "0", "false", ""):
         return False
     if env != "auto":
         raise ValueError(f"DPF_TPU_STREAM={env!r} unknown (off|auto|on)")
-    return out_bytes >= int(
-        os.environ.get("DPF_TPU_STREAM_MIN_BYTES", str(1 << 20)) or (1 << 20)
-    )
+    return out_bytes >= knobs.get_int("DPF_TPU_STREAM_MIN_BYTES")
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -513,9 +509,27 @@ class _Handler(BaseHTTPRequestHandler):
             self._bad(f"{type(e).__name__}: {e}")
 
 
+def audit_knobs() -> list[str]:
+    """Boot-time knob audit: warn about every DPF_TPU_* env var present
+    but not declared in the registry (a typo'd knob — e.g.
+    ``DPF_TPU_BATCH_WINDOW_MS`` — used to fail silent, quietly serving
+    with the default).  Returns the unknown names (tests)."""
+    unknown = knobs.audit_environ()
+    for name in unknown:
+        warnings.warn(
+            f"unknown knob {name} is set but not declared in "
+            "dpf_tpu/core/knobs.py — a typo? It has NO effect "
+            "(see docs/KNOBS.md for the knob surface)",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+    return unknown
+
+
 def serve(port: int = 8990, host: str = "127.0.0.1") -> ThreadingHTTPServer:
     """Start the sidecar in a daemon thread; returns the server object
     (call ``.shutdown()`` to stop)."""
+    audit_knobs()
     srv = ThreadingHTTPServer((host, port), _Handler)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
@@ -527,6 +541,7 @@ def main():
     ap.add_argument("--port", type=int, default=8990)
     ap.add_argument("--host", default="127.0.0.1")
     args = ap.parse_args()
+    audit_knobs()  # warns (stderr) once per unknown DPF_TPU_* var
     print(f"dpf-tpu sidecar on {args.host}:{args.port}")
     ThreadingHTTPServer((args.host, args.port), _Handler).serve_forever()
 

@@ -34,7 +34,6 @@ thin host-side unpack — so mixed-format requests share one executable.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
@@ -42,7 +41,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from ..core import bitpack, plans
+from ..core import bitpack, knobs, plans
 
 
 @dataclass
@@ -212,13 +211,9 @@ class Batcher:
         timeout_s: float = 600.0,
     ):
         if window_us is None:
-            window_us = float(
-                os.environ.get("DPF_TPU_BATCH_WINDOW_US", "200") or 200
-            )
+            window_us = knobs.get_float("DPF_TPU_BATCH_WINDOW_US")
         if max_keys is None:
-            max_keys = int(
-                os.environ.get("DPF_TPU_BATCH_MAX_KEYS", "1024") or 1024
-            )
+            max_keys = knobs.get_int("DPF_TPU_BATCH_MAX_KEYS")
         self.window_s = max(window_us, 0.0) / 1e6
         self.max_keys = max(max_keys, 1)
         self.timeout_s = timeout_s

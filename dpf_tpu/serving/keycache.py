@@ -18,17 +18,16 @@ the serving hot case is the same batch re-sent verbatim.
 from __future__ import annotations
 
 import hashlib
-import os
 import threading
 from collections import OrderedDict
+
+from ..core import knobs
 
 
 class KeyCache:
     def __init__(self, entries: int | None = None):
         if entries is None:
-            entries = int(
-                os.environ.get("DPF_TPU_KEY_CACHE_ENTRIES", "32") or 32
-            )
+            entries = knobs.get_int("DPF_TPU_KEY_CACHE_ENTRIES")
         self.entries = max(int(entries), 0)
         self._lru: OrderedDict = OrderedDict()
         self._lock = threading.Lock()

@@ -8,19 +8,28 @@
 #       excluded by the default -m; append your own -m to override, e.g.
 #       `./runtests.sh -m slow` for the fused acceptance sweep, or
 #       `./runtests.sh -m ''` for absolutely everything)
+#   ./runtests.sh --lint                 static-analysis lane: the four
+#       repo-native passes (knob registry, secret hygiene, host-sync,
+#       pallas/jit discipline) + docs/KNOBS.md drift + Go vet/fmt when a
+#       toolchain exists — scripts/lint_all.sh, hermetic, no TPU.
 #   ./runtests.sh --fast [pytest args]   kernel differential smoke lane:
 #       the Pallas kernel suites (fused + walk + expand routes, interpret
 #       mode), the S-box circuit invariants, the packed<->unpacked
 #       output differentials (every packed route vs its byte-per-bit twin
-#       plus the sidecar wire contract), and the serving fast path
+#       plus the sidecar wire contract), the serving fast path
 #       (plan cache / micro-batcher / streaming EvalFull differentials,
-#       tests/test_serving.py) — surfaces kernel + serving regressions
-#       in minutes instead of the full-suite half hour.
-if [ "${1:-}" = "--fast" ]; then
+#       tests/test_serving.py), the threaded keycache/batcher stress
+#       test, and the static-analysis suite's own tests — surfaces
+#       kernel + serving regressions in minutes instead of the
+#       full-suite half hour.
+if [ "${1:-}" = "--lint" ]; then
+  exec "$(dirname "$0")/scripts/lint_all.sh"
+elif [ "${1:-}" = "--fast" ]; then
   shift
   set -- tests/test_aes_pallas.py tests/test_chacha_pallas.py \
       tests/test_fused_expand.py tests/test_aes_bitslice.py \
       tests/test_packed.py tests/test_serving.py \
+      tests/test_serving_stress.py tests/test_analysis.py \
       -q -m 'not slow' "$@"
 else
   # -m is last-wins in pytest, so a caller-supplied -m overrides ours.

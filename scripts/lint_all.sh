@@ -1,0 +1,39 @@
+#!/bin/sh
+# One-command static-analysis gate (hermetic: CPU jax, no TPU, no axon
+# tunnel — safe in CI and on laptops).  Runs:
+#
+#   1. python -m dpf_tpu.analysis      the four repo-native passes
+#      (knob-registry, secret-hygiene, host-sync, pallas-jit)
+#   2. --check-knobs-doc               docs/KNOBS.md drift vs the registry
+#   3. gofmt -l / go vet               bridge/go hygiene (skipped with a
+#      notice when no Go toolchain is installed; bridge/go/conformance.sh
+#      additionally runs `go test -race` against a live sidecar)
+#
+# Exits nonzero on ANY finding.  Wired into `./runtests.sh --lint`.
+set -e
+cd "$(dirname "$0")/.."
+
+run_py() {
+  env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE \
+      -u PALLAS_AXON_TPU_GEN JAX_PLATFORMS=cpu python "$@"
+}
+
+status=0
+
+run_py -m dpf_tpu.analysis || status=1
+run_py -m dpf_tpu.analysis --check-knobs-doc || status=1
+
+if command -v go >/dev/null 2>&1; then
+  unformatted="$(gofmt -l bridge/go 2>/dev/null || true)"
+  if [ -n "$unformatted" ]; then
+    echo "gofmt: needs formatting:" >&2
+    echo "$unformatted" >&2
+    status=1
+  fi
+  (cd bridge/go && go vet ./...) || status=1
+else
+  echo "lint_all.sh: no Go toolchain; skipping gofmt/go vet" \
+       "(bridge/go/conformance.sh runs them plus 'go test -race')" >&2
+fi
+
+exit $status

@@ -1,0 +1,243 @@
+"""The static-analysis suite: fixtures trip every pass, the real tree is
+clean, the knob registry behaves, and docs/KNOBS.md does not drift.
+
+Tier-1 (runtests.sh --fast and the default lane); the passes themselves
+are hermetic AST walks — no TPU, no network.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dpf_tpu.analysis import LINT_SUITE_VERSION, PASSES, get_pass
+from dpf_tpu.analysis.common import iter_py_files, pragma, repo_root
+from dpf_tpu.core import knobs
+
+ROOT = repo_root()
+FIXDIR = "dpf_tpu/analysis/fixtures/"
+
+
+# ---------------------------------------------------------------------------
+# Each pass catches its seeded violations (and exits nonzero through the
+# CLI) — the fixture files encode the exact failure modes the passes
+# exist for.
+# ---------------------------------------------------------------------------
+
+
+def _run(pass_name: str, fixture: str):
+    return get_pass(pass_name)(ROOT, files=[FIXDIR + fixture])
+
+
+def test_knob_pass_catches_fixture():
+    found = _run("knob-registry", "bad_knobs.py")
+    messages = "\n".join(f.message for f in found)
+    # The three seeded reads...
+    assert "direct env read of DPF_TPU_FUSE" in messages
+    assert "direct env read of DPF_TPU_SBOX" in messages
+    # ...the typo catcher...
+    assert "DPF_TPU_BATCH_WINDOW_MS is not declared" in messages
+    # ...the aliased-import bypass (`from os import getenv`) fires too...
+    assert messages.count("direct env read of DPF_TPU_FUSE") == 2
+    # ...one finding per violating line, and the legal env WRITE of a
+    # declared knob is clean.
+    assert len(found) == 4
+    assert len({f.line for f in found}) == 4
+
+
+def test_secret_pass_catches_fixture():
+    found = _run("secret-hygiene", "bad_secrets.py")
+    messages = "\n".join(f.message for f in found)
+    assert "'seeds' flows into logging" in messages
+    assert "'scw' formatted into a raised exception" in messages
+    assert "'blob' reaches the return value of stats" in messages
+    # The sanctioned sha256/len usage stays clean: every finding lies in
+    # the three seeded functions, none in sanctioned().
+    assert len(found) == 3
+
+
+def test_hostsync_pass_catches_fixture():
+    found = _run("host-sync", "bad_hostsync.py")
+    messages = "\n".join(f.message for f in found)
+    assert ".block_until_ready() forces a device sync" in messages
+    assert "int() over a jax expression" in messages
+    assert "bare np.asarray(x) materializes" in messages
+    assert "jax.device_get is a blocking D2H copy" in messages
+    # The fully-qualified AND the aliased-import (`from jax import
+    # device_get`) spellings both fire.
+    assert messages.count("jax.device_get is a blocking D2H copy") == 2
+    # The dtype coercion and the '# host-sync:'-annotated line are clean.
+    assert len(found) == 5
+
+
+def test_pallas_pass_catches_fixture():
+    found = _run("pallas-jit", "bad_pallas.py")
+    messages = "\n".join(f.message for f in found)
+    assert "without a '# vmem: <expr>' footprint model" in messages
+    assert "exceeds _VMEM_BUDGET" in messages
+    assert "static_argnums must be an int/str literal" in messages
+    assert "static_argnames must be an int/str literal" in messages
+    # The aliased-import bypasses (`from jax import jit`,
+    # `from jax.experimental.pallas import pallas_call`) fire too.
+    assert messages.count("without a '# vmem: <expr>' footprint model") == 2
+    assert messages.count("static_argnums must be an int/str literal") == 2
+    assert len(found) == 6
+
+
+def test_cli_nonzero_on_fixture_dir():
+    """The module entrypoint exits 1 when the scan root contains seeded
+    violations (here: scanning the package WITH fixtures included by
+    pointing --root at a tree where fixtures are the only .py files is
+    overkill — instead assert the per-pass findings above AND that the
+    real-tree run exits 0 below; this test pins the exit-code contract
+    via a tiny synthetic tree)."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        with open(os.path.join(td, "bad.py"), "w") as f:
+            f.write("import os\nX = os.environ.get('DPF_TPU_TYPO_KNOB')\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "dpf_tpu.analysis", "--root", td,
+             "--pass", "knob-registry"],
+            capture_output=True, text=True,
+            env={**os.environ, "PYTHONPATH": ROOT},
+        )
+        assert proc.returncode == 1, proc.stderr
+        assert "DPF_TPU_TYPO_KNOB" in proc.stdout  # knob-ok: seeded typo
+
+
+# ---------------------------------------------------------------------------
+# The real tree is clean — the acceptance bar for every pass, and the
+# structural form of the "grep for environ/getenv" criterion.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("pass_name", sorted(PASSES))
+def test_real_tree_clean(pass_name):
+    findings = get_pass(pass_name)(ROOT)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_fixtures_excluded_from_default_scan():
+    files = list(iter_py_files(ROOT))
+    assert not any(f.replace(os.sep, "/").startswith(FIXDIR) for f in files)
+    assert any(
+        f.replace(os.sep, "/") == "dpf_tpu/core/knobs.py" for f in files
+    )
+
+
+# ---------------------------------------------------------------------------
+# Knob registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_registry_typed_accessors(monkeypatch):
+    monkeypatch.delenv("DPF_TPU_BATCH_MAX_KEYS", raising=False)
+    assert knobs.get_int("DPF_TPU_BATCH_MAX_KEYS") == 1024
+    monkeypatch.setenv("DPF_TPU_BATCH_MAX_KEYS", "64")
+    assert knobs.get_int("DPF_TPU_BATCH_MAX_KEYS") == 64
+    monkeypatch.setenv("DPF_TPU_BATCH_MAX_KEYS", "")  # empty = default
+    assert knobs.get_int("DPF_TPU_BATCH_MAX_KEYS") == 1024
+
+    monkeypatch.setenv("DPF_TPU_BATCH", "OFF")
+    assert knobs.get_bool("DPF_TPU_BATCH") is False
+    monkeypatch.delenv("DPF_TPU_BATCH", raising=False)
+    assert knobs.get_bool("DPF_TPU_BATCH") is True
+
+    monkeypatch.setenv("DPF_TPU_WIRE_FORMAT", "packed")
+    assert knobs.get_enum("DPF_TPU_WIRE_FORMAT") == "packed"
+    monkeypatch.setenv("DPF_TPU_WIRE_FORMAT", "sideways")
+    with pytest.raises(ValueError, match="DPF_TPU_WIRE_FORMAT"):
+        knobs.get_enum("DPF_TPU_WIRE_FORMAT")
+
+
+def test_registry_rejects_undeclared_names():
+    with pytest.raises(KeyError, match="undeclared knob"):
+        knobs.get_str("DPF_TPU_BATCH_WINDOW_MS")  # knob-ok: the typo demo
+    with pytest.raises(KeyError, match="undeclared knob"):
+        knobs.get_raw("DPF_TPU_NOT_A_KNOB")  # knob-ok: seeded typo
+
+
+def test_audit_environ_flags_typos():
+    env = {
+        "DPF_TPU_FUSE": "auto",
+        "DPF_TPU_BATCH_WINDOW_MS": "5",  # knob-ok: the typo demo
+        "HOME": "/root",
+    }
+    assert knobs.audit_environ(env) == [
+        "DPF_TPU_BATCH_WINDOW_MS"  # knob-ok: the typo demo
+    ]
+
+
+def test_server_boot_audit_warns(monkeypatch):
+    monkeypatch.setenv("DPF_TPU_BATCH_WINDOW_MS", "5")  # knob-ok: typo demo
+    from dpf_tpu import server
+
+    with pytest.warns(RuntimeWarning, match="BATCH_WINDOW_MS"):
+        unknown = server.audit_knobs()
+    assert unknown == ["DPF_TPU_BATCH_WINDOW_MS"]  # knob-ok: the typo demo
+
+
+def test_every_knob_read_in_tree_is_declared():
+    """Belt and braces for R3: every DPF_TPU_* literal in the scanned
+    tree resolves in the registry (the pass asserts this too; this test
+    keeps the property visible even if pass scoping changes)."""
+    import ast
+    import re
+
+    pat = re.compile(r"DPF_TPU_[A-Z0-9_]+")
+    for rel in iter_py_files(ROOT):
+        path = os.path.join(ROOT, rel)
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        lines = src.splitlines()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                if pat.fullmatch(node.value):
+                    if pragma(lines, node.lineno, "knob-ok") is not None:
+                        continue
+                    assert node.value in knobs.REGISTRY, (
+                        f"{rel}:{node.lineno}: {node.value} undeclared"
+                    )
+
+
+# ---------------------------------------------------------------------------
+# docs/KNOBS.md drift + ledger stamp
+# ---------------------------------------------------------------------------
+
+
+def test_knobs_doc_not_stale():
+    with open(os.path.join(ROOT, "docs", "KNOBS.md"), encoding="utf-8") as f:
+        committed = f.read()
+    assert committed == knobs.render_markdown(), (
+        "docs/KNOBS.md is stale — regenerate with "
+        "'python -m dpf_tpu.analysis --write-knobs-doc'"
+    )
+
+
+def test_knobs_doc_lists_every_knob():
+    doc = knobs.render_markdown()
+    for name in knobs.REGISTRY:
+        assert f"`{name}`" in doc
+
+
+def test_ledger_key_carries_lint_version(monkeypatch):
+    monkeypatch.setenv("DPF_TPU_BENCH_LEDGER_KEY", "pinned")
+    sys.path.insert(0, ROOT)
+    try:
+        import bench_all
+
+        key = bench_all._ledger_key("small")
+    finally:
+        sys.path.remove(ROOT)
+    assert key["lint"] == LINT_SUITE_VERSION
+    assert key["head"] == "pinned"
+    # knob-ok: comparing the snapshot against the raw env on purpose
+    assert key["knobs"]["DPF_TPU_FUSE"] == os.environ.get("DPF_TPU_FUSE", "")

@@ -1,0 +1,211 @@
+"""Threaded stress for the serving fast path's shared mutable state:
+``serving/keycache.py`` (concurrent hit/miss/evict) and
+``serving/batcher.py`` (concurrent submit/coalesce/slice).
+
+These are the two structures every sidecar request thread touches; the
+race-shaped bugs they can grow (a torn LRU under eviction, a batcher
+slicing another request's rows) would pass the single-threaded
+differentials and corrupt traffic only under load.  Registered in the
+``runtests.sh --fast`` lane.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from dpf_tpu.core import bitpack
+from dpf_tpu.serving import Batcher, KeyCache
+from dpf_tpu.serving.batcher import PointsWork, dispatch_points
+
+N_THREADS = 8
+pytestmark = pytest.mark.filterwarnings("ignore::RuntimeWarning")
+
+
+def _run_threads(fn):
+    """Run ``fn(i)`` on N_THREADS threads, re-raising the first error."""
+    errors = []
+    barrier = threading.Barrier(N_THREADS)
+
+    def wrap(i):
+        try:
+            barrier.wait(timeout=30)
+            fn(i)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=wrap, args=(i,)) for i in range(N_THREADS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "stress thread hung"
+    if errors:
+        raise errors[0]
+
+
+# ---------------------------------------------------------------------------
+# KeyCache: hit / miss / evict from 8 threads
+# ---------------------------------------------------------------------------
+
+
+def test_keycache_threaded_hit_miss_evict():
+    """Capacity 4 with 16 distinct blobs per thread forces constant
+    eviction; every get() must still return a value built from ITS blob
+    (never another thread's), and the hit/miss counters must add up."""
+    cache = KeyCache(entries=4)
+    blobs = [bytes([b]) * 64 for b in range(16)]
+    rounds = 50
+
+    def worker(i):
+        rng = np.random.default_rng(i)
+        for _ in range(rounds):
+            j = int(rng.integers(len(blobs)))
+            blob = blobs[j]
+            got = cache.get("stress", 10, blob, lambda b=blob: (b, len(b)))
+            assert got[0] == blob  # byte identity with the requested key
+            assert got[1] == 64
+
+    _run_threads(worker)
+    stats = cache.stats()
+    assert stats["hits"] + stats["misses"] == N_THREADS * rounds
+    assert stats["entries"] <= 4
+    assert stats["misses"] >= len(blobs)  # each blob missed at least once
+
+
+def test_keycache_disabled_is_safe_threaded():
+    cache = KeyCache(entries=0)
+
+    def worker(i):
+        for r in range(100):
+            v = cache.get("k", 8, b"%d" % i, lambda i=i, r=r: (i, r))
+            assert v[0] == i
+
+    _run_threads(worker)
+    assert cache.stats()["entries"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Batcher: concurrent submits coalesce, every requester gets ITS rows
+# ---------------------------------------------------------------------------
+
+
+class _FakeKb:
+    """Stands in for a key batch: the 'evaluation' below derives each
+    output row from the key id, so row mixups are detectable."""
+
+    def __init__(self, ids):
+        self.ids = list(ids)
+        self.log_n = 10
+
+
+def _fake_dispatch(items):
+    """Lane dispatcher double: concatenates like the real one, computes
+    row r of item i as (key_id * 1000 + query words), then slices —
+    exercising exactly the batcher's merge/slice seams."""
+    out = []
+    for it in items:
+        k, q = it.xs.shape
+        words = np.zeros((k, bitpack.packed_words(q)), np.uint32)
+        for r in range(k):
+            words[r] = np.uint32(it.kb.ids[r] * 1000) + np.arange(
+                bitpack.packed_words(q), dtype=np.uint32
+            )
+        out.append(words)
+    return out
+
+
+def test_batcher_threaded_row_identity():
+    batcher = Batcher(window_us=2000, max_keys=64)
+    per_thread = 25
+
+    def worker(i):
+        rng = np.random.default_rng(100 + i)
+        for r in range(per_thread):
+            key_id = i * 1000 + r
+            q = int(rng.integers(1, 40))
+            work = PointsWork(
+                "points", "compat", _FakeKb([key_id]),
+                np.zeros((1, q), np.uint64),
+            )
+            rows = batcher.submit(work, _fake_dispatch)
+            want = np.uint32(key_id * 1000) + np.arange(
+                bitpack.packed_words(q), dtype=np.uint32
+            )
+            assert rows.shape == (1, bitpack.packed_words(q))
+            np.testing.assert_array_equal(rows[0], want)
+
+    _run_threads(worker)
+    stats = batcher.stats_dict()
+    assert stats["requests"] == N_THREADS * per_thread
+    assert stats["keys_dispatched"] == N_THREADS * per_thread
+    assert stats["dispatches"] <= stats["requests"]
+
+
+def test_batcher_threaded_error_fanout():
+    """A dispatch failure must fan out to every coalesced request and
+    leave the lane reusable (no wedged leadership)."""
+    batcher = Batcher(window_us=2000, max_keys=64)
+    boom = {"on": True}
+
+    def dispatch(items):
+        if boom["on"]:
+            raise RuntimeError("stress boom")
+        return _fake_dispatch(items)
+
+    def worker(i):
+        work = PointsWork(
+            "points", "compat", _FakeKb([i]), np.zeros((1, 8), np.uint64)
+        )
+        with pytest.raises(RuntimeError, match="stress boom"):
+            batcher.submit(work, dispatch)
+
+    _run_threads(worker)
+    boom["on"] = False
+    ok = batcher.submit(
+        PointsWork("points", "compat", _FakeKb([7]),
+                  np.zeros((1, 8), np.uint64)),
+        dispatch,
+    )
+    assert ok.shape == (1, 1)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: real evaluators under the same thread pressure
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_threaded_real_eval_byte_identity():
+    """8 threads x real compat pointwise requests through the batcher +
+    plan cache: each thread's sliced rows must be byte-identical to its
+    own serial plan-cache answer (computed up front, single-threaded)."""
+    from dpf_tpu.core import plans
+    from dpf_tpu.core.keys import gen_batch
+
+    log_n, q = 8, 16
+    rng = np.random.default_rng(7)
+    per_thread = []
+    for i in range(N_THREADS):
+        alphas = rng.integers(0, 1 << log_n, size=1, dtype=np.uint64)
+        kb, _ = gen_batch(alphas, log_n, rng=rng)
+        xs = rng.integers(0, 1 << log_n, size=(1, q), dtype=np.uint64)
+        want = plans.run_points("points", "compat", kb, xs)
+        per_thread.append((kb, xs, want))
+
+    batcher = Batcher(window_us=5000, max_keys=64)
+
+    def worker(i):
+        kb, xs, want = per_thread[i]
+        for _ in range(3):
+            rows = batcher.submit(
+                PointsWork("points", "compat", kb, xs), dispatch_points
+            )
+            np.testing.assert_array_equal(rows, want)
+
+    _run_threads(worker)
+    stats = batcher.stats_dict()
+    assert stats["requests"] == N_THREADS * 3
