@@ -56,6 +56,7 @@ import time
 import numpy as np
 
 from dpf_tpu.analysis import LINT_SUITE_VERSION
+from dpf_tpu.analysis.trace import OBLIVIOUS_VERIFIER_VERSION
 from dpf_tpu.core import knobs
 
 from bench import (
@@ -124,6 +125,7 @@ def _ledger_key(scale: str) -> dict:
             "scale": scale,
             "knobs": knobs.snapshot(_ROUTE_KNOBS),
             "lint": LINT_SUITE_VERSION,
+            "oblivious": OBLIVIOUS_VERIFIER_VERSION,
         }
     try:
         rp = subprocess.run(
@@ -149,6 +151,9 @@ def _ledger_key(scale: str) -> dict:
         # suite bump re-measures (the discipline itself changed what the
         # benches are allowed to run).
         "lint": LINT_SUITE_VERSION,
+        # ...and which obliviousness discipline (docs/OBLIVIOUS.md)
+        # certified the routes the measured dispatches ran on.
+        "oblivious": OBLIVIOUS_VERIFIER_VERSION,
     }
 
 

@@ -25,6 +25,16 @@ if [ -n "$unformatted" ]; then
 fi
 go vet ./...
 
+# staticcheck is a stronger linter than vet (unused results, API misuse,
+# simplifications); like the -race lane it is part of the discipline
+# when the toolchain has it, and a loud skip when it does not.
+if command -v staticcheck >/dev/null 2>&1; then
+  staticcheck ./...
+else
+  echo "conformance.sh: staticcheck not installed; skipping" \
+       "(go install honnef.co/go/tools/cmd/staticcheck@latest)" >&2
+fi
+
 PYTHONPATH="$(cd ../.. && pwd)" python -m dpf_tpu.server --port "$PORT" &
 SIDECAR=$!
 trap 'kill "$SIDECAR" 2>/dev/null || true' EXIT INT TERM

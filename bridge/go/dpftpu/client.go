@@ -257,25 +257,9 @@ func (c *Client) DcfEvalPoints(keys []DPFkey, xs [][]uint64, logN uint) ([][]byt
 	if len(keys) == 0 {
 		return nil, nil
 	}
-	if len(xs) != len(keys) {
-		return nil, fmt.Errorf("dpftpu: xs rows != key count")
-	}
-	kl := len(keys[0])
-	nq := len(xs[0])
-	body := make([]byte, 0, kl*len(keys)+8*nq*len(keys))
-	for _, k := range keys {
-		if len(k) != kl {
-			return nil, fmt.Errorf("dpftpu: inconsistent key lengths")
-		}
-		body = append(body, k...)
-	}
-	for _, row := range xs {
-		if len(row) != nq {
-			return nil, fmt.Errorf("dpftpu: inconsistent query row lengths")
-		}
-		for _, x := range row {
-			body = binary.LittleEndian.AppendUint64(body, x)
-		}
+	body, nq, err := pointsBody(keys, xs)
+	if err != nil {
+		return nil, err
 	}
 	out, err := c.post(fmt.Sprintf(
 		"/v1/dcf_eval_points?log_n=%d&k=%d&q=%d", logN, len(keys), nq), body)

@@ -1,9 +1,9 @@
 """Repo-native static analysis: the discipline the ROADMAP's production
 north star needs, checked on every commit for free.
 
-Four AST-based passes over the whole tree (one entrypoint:
-``python -m dpf_tpu.analysis`` / ``scripts/lint_all.sh``; exits nonzero
-on any finding):
+Four AST-based passes plus one jaxpr-level pass over the whole tree
+(one entrypoint: ``python -m dpf_tpu.analysis`` / ``scripts/lint_all.sh``;
+exits nonzero on any finding):
 
   knob-registry   every DPF_TPU_* env knob is declared once in
                   dpf_tpu/core/knobs.py and read only through it —
@@ -23,6 +23,15 @@ on any finding):
                   module's declared VMEM budget, and every jax.jit's
                   static/donate argnum specs are hashable literals
                   (no list/dict retrace hazards).
+  oblivious-trace the jaxpr-level oblivious-dataflow verifier
+                  (``analysis/trace/``): every production route traced
+                  to a ClosedJaxpr, the interprocedural taint lattice
+                  run over it (secret-tainted branch predicates, memory
+                  indices, callbacks, float casts, dynamic shapes; Ref
+                  tracking inside Pallas kernels; VMEM block footprints
+                  vs the ops budget), and the resulting obliviousness
+                  certificates (docs/OBLIVIOUS.md + docs/oblivious.json)
+                  checked for drift against the committed tree.
 
 Each pass ships fixture files with seeded violations
 (``dpf_tpu/analysis/fixtures/``, excluded from real scans) and a test
@@ -38,8 +47,9 @@ tree they measured.
 from __future__ import annotations
 
 # Bump when a pass is added or materially tightened (bench ledgers keyed
-# on it re-measure).
-LINT_SUITE_VERSION = "1"
+# on it re-measure).  "2": the oblivious-trace jaxpr verifier joined the
+# suite and host-sync grew the models/ + parallel/ scope.
+LINT_SUITE_VERSION = "2"
 
 # name -> (module, callable); imported lazily so `import dpf_tpu.analysis`
 # stays cheap for the bench harness's version stamp.
@@ -48,6 +58,7 @@ PASSES = {
     "secret-hygiene": ("dpf_tpu.analysis.secret_hygiene_pass", "run"),
     "host-sync": ("dpf_tpu.analysis.host_sync_pass", "run"),
     "pallas-jit": ("dpf_tpu.analysis.pallas_discipline_pass", "run"),
+    "oblivious-trace": ("dpf_tpu.analysis.trace_pass", "run"),
 }
 
 

@@ -5,6 +5,8 @@
     python -m dpf_tpu.analysis --root /path/to/checkout
     python -m dpf_tpu.analysis --write-knobs-doc   # regenerate docs/KNOBS.md
     python -m dpf_tpu.analysis --check-knobs-doc   # fail when it is stale
+    python -m dpf_tpu.analysis --write-oblivious   # re-certify: regenerate
+                                                   # docs/OBLIVIOUS.md + json
 
 Exits 0 on a clean tree, 1 on any finding (CI contract:
 ``scripts/lint_all.sh`` / ``runtests.sh --lint``).
@@ -65,8 +67,42 @@ def main(argv=None) -> int:
         "--check-knobs-doc", action="store_true",
         help="exit 1 when docs/KNOBS.md is stale vs the registry",
     )
+    ap.add_argument(
+        "--write-oblivious", action="store_true",
+        help="re-certify: trace + verify every production route and "
+        "regenerate docs/OBLIVIOUS.md + docs/oblivious.json (fails "
+        "without writing when any route has findings)",
+    )
     args = ap.parse_args(argv)
     root = os.path.abspath(args.root) if args.root else repo_root()
+
+    if args.write_oblivious:
+        if os.path.realpath(root) != os.path.realpath(repo_root()):
+            # Same guard as trace_pass: the routes traced are always the
+            # imported checkout's — writing their certificates into a
+            # foreign --root would attest the wrong tree.
+            print(
+                "--write-oblivious certifies the checkout it is imported "
+                "from; run it from the target tree (foreign --root "
+                f"{root!r} refused)",
+                file=sys.stderr,
+            )
+            return 1
+        from .trace import certify
+
+        certs, findings = certify.verify_routes()
+        if findings:
+            for route_name, f in findings:
+                print(f"trace://{route_name}: [{f.kind}] {f.message}")
+            print(
+                f"{len(findings)} finding(s) — refusing to certify a "
+                "leaky tree",
+                file=sys.stderr,
+            )
+            return 1
+        for rel in certify.write(root, certs):
+            print(f"wrote {rel}")
+        return 0
 
     if args.write_knobs_doc:
         path = _knobs_doc_path(root)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import ast
 import dataclasses
 import os
+from typing import Iterator
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,7 +45,9 @@ _SKIP_DIRS = {"__pycache__", ".git", ".claude", "tpu_logs", "node_modules"}
 _FIXTURES = os.path.join("dpf_tpu", "analysis", "fixtures")
 
 
-def iter_py_files(root: str, include_fixtures: bool = False):
+def iter_py_files(
+    root: str, include_fixtures: bool = False
+) -> Iterator[str]:
     """Yield repo-relative paths of every .py file under ``root``,
     skipping caches and (by default) the seeded-violation fixtures."""
     for dirpath, dirnames, filenames in os.walk(root):
@@ -57,7 +60,7 @@ def iter_py_files(root: str, include_fixtures: bool = False):
                 yield os.path.normpath(os.path.join(rel_dir, fn))
 
 
-def parse_file(root: str, rel: str):
+def parse_file(root: str, rel: str) -> tuple[ast.Module, list[str]]:
     """-> (ast.Module, source lines).  Syntax errors become a one-line
     finding upstream; here they just raise."""
     path = os.path.join(root, rel)

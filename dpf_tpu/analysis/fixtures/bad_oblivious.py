@@ -1,0 +1,123 @@
+"""Seeded-LEAKY toy evaluators for the oblivious-trace verifier.
+
+Each function is a miniature "DPF evaluator" with exactly one
+data-obliviousness violation the taint lattice must catch — the jaxpr-
+level failure modes the verifier exists for (a secret-predicated
+``lax.cond``, a secret-indexed ``dynamic_slice``, a secret control word
+cast to float, a ``debug_print`` of a seed, a secret-bounded
+``while_loop``, a secret VMEM index inside a Pallas kernel).  The tests
+(tests/test_oblivious.py) trace each one through the real verifier and
+assert >= 1 finding of the expected kind; the real production routes
+must stay clean.
+
+This file lives in ``dpf_tpu/analysis/fixtures/`` so it is EXCLUDED
+from the AST passes' default scans and never imported by production
+code — only the tests trace it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def leaky_cond_eval(seeds, xs):
+    """Branches on the seed's low bit: the taken side is timing-visible.
+    jnp.where would be fine; lax.cond is the leak."""
+    return jax.lax.cond(
+        (seeds[0] & 1) == 1, lambda: xs + 1, lambda: xs - 1
+    )
+
+
+def leaky_slice_eval(seeds, table):
+    """Table lookup at a secret-derived index: the memory access pattern
+    IS the secret (the classic cache-timing shape, on-device)."""
+    start = (seeds[0] & 7).astype(jnp.int32)
+    return jax.lax.dynamic_slice(table, (start,), (1,))
+
+
+def leaky_gather_eval(seeds, table):
+    """Same leak through gather (jnp fancy indexing with a traced secret
+    index lowers to gather)."""
+    idx = (seeds & 3).astype(jnp.int32)
+    return table[idx]
+
+
+def leaky_float_eval(seeds):
+    """Secret words pushed through float32: float units are not
+    constant-time everywhere, and NaN/inf payloads encode bits."""
+    return seeds.astype(jnp.float32).sum()
+
+
+def leaky_debug_eval(seeds, xs):
+    """debug_print of a seed inside a jitted graph: the payload leaves
+    the device for the host console."""
+    jax.debug.print("seed word: {s}", s=seeds[0])
+    return xs ^ seeds
+
+
+def leaky_while_eval(seeds, xs):
+    """Trip count depends on a seed word: wall time leaks its magnitude."""
+
+    def cond(st):
+        i, _ = st
+        return i < (seeds[0] & jnp.uint32(15))
+
+    def body(st):
+        i, acc = st
+        return i + 1, acc ^ xs
+
+    _, acc = jax.lax.while_loop(cond, body, (jnp.uint32(0), xs))
+    return acc
+
+
+def leaky_kernel_eval(seeds, table):
+    """Secret-indexed VMEM load inside a Pallas kernel (the accelerator
+    form of leaky_slice_eval)."""
+    from jax.experimental import pallas as pl
+
+    def kernel(s_ref, t_ref, o_ref):
+        i = s_ref[0] & 7
+        o_ref[0] = pl.load(t_ref, (pl.dslice(i, 1),))[0]
+
+    # vmem: 4 * (8 + 8 + 1) * 2  # knob-ok: fixture (excluded from scans)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1,), jnp.uint32),
+        interpret=True,
+    )(seeds, table)
+
+
+def leaky_kernel_loop_eval(seeds, table):
+    """The same secret-indexed VMEM load, hidden inside a fori_loop body
+    — the kernel-mode Ref discipline must survive sub-jaxpr descent
+    (a level-walk loop is exactly the shape the real kernels have)."""
+    from jax.experimental import pallas as pl
+
+    def kernel(s_ref, t_ref, o_ref):
+        def body(j, acc):
+            i = s_ref[j] & 7
+            return acc ^ pl.load(t_ref, (pl.dslice(i, 1),))[0]
+
+        o_ref[0] = jax.lax.fori_loop(0, 4, body, jnp.uint32(0))
+
+    # vmem: 4 * (8 + 8 + 1) * 2  # knob-ok: fixture (excluded from scans)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1,), jnp.uint32),
+        interpret=True,
+    )(seeds, table)
+
+
+#: (function, n secret leading args, total args builder) — the tests
+#: iterate this to keep fixture and assertion lists in sync.
+LEAKY = (
+    ("leaky_cond_eval", leaky_cond_eval, "secret-branch"),
+    ("leaky_slice_eval", leaky_slice_eval, "secret-index"),
+    ("leaky_gather_eval", leaky_gather_eval, "secret-index"),
+    ("leaky_float_eval", leaky_float_eval, "secret-float"),
+    ("leaky_debug_eval", leaky_debug_eval, "callback"),
+    ("leaky_while_eval", leaky_while_eval, "secret-branch"),
+    ("leaky_kernel_eval", leaky_kernel_eval, "secret-index"),
+    ("leaky_kernel_loop_eval", leaky_kernel_loop_eval, "secret-index"),
+)

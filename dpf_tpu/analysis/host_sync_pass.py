@@ -8,10 +8,13 @@ EvalFull's overlap test guards dynamically; this pass guards it
 statically, everywhere).
 
 Scope: the kernel modules (``dpf_tpu/ops/``), the serving fast path
-(``dpf_tpu/serving/``, ``core/plans.py``), and the streaming pipeline
-(``core/stream.py``).  The models' public eval routes are OUT of scope
-by design: returning a host array is their API contract (the boundary
-the sidecar calls "final reply marshalling").
+(``dpf_tpu/serving/``, ``core/plans.py``), the streaming pipeline
+(``core/stream.py``), the models (``dpf_tpu/models/``), and the sharded
+evaluators (``dpf_tpu/parallel/``).  The models' public eval routes DO
+return host arrays by API contract — each of those boundaries is a
+``# host-sync: final reply marshalling``-style annotated point, so the
+sanctioned D2H crossings are enumerable by grep and everything else in
+the eval pipelines is statically sync-free.
 
 Flagged, unless the line (or the one above) carries a
 ``# host-sync: <why>`` annotation naming the sanctioned sync point:
@@ -46,6 +49,8 @@ _SCOPE = (
     "dpf_tpu/serving",
     "dpf_tpu/core/stream.py",
     "dpf_tpu/core/plans.py",
+    "dpf_tpu/models",
+    "dpf_tpu/parallel",
 )
 
 _SYNC_METHODS = {"block_until_ready", "item"}

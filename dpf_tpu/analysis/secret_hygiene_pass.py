@@ -46,13 +46,18 @@ PASS = "secret-hygiene"
 _SCOPE = ("dpf_tpu",)
 
 # Exact identifier / attribute names that ARE key material in this tree.
+# Includes the device-cached per-key lane masks (models/dpf._point_masks)
+# and the walk kernels' transposed operands — all derived from seeds/CWs
+# and exactly as secret as the bytes they pack.
 SECRET_NAMES = frozenset(
     {
         "seed", "seeds", "seed_planes", "seeds_t", "seeds_bm",
+        "seed_masks", "t_masks",
         "scw", "scw_planes", "scw_t", "scw_bm", "scw_p", "scw_packed",
+        "scw_masks",
         "tcw", "tcw_t", "tcw_p", "tlcw", "trcw", "tl_w", "tr_w",
-        "tl_words", "tr_words", "t_words",
-        "fcw", "fcw_planes", "fcw_t", "fcw_p", "fcw_canon",
+        "tl_words", "tr_words", "t_words", "tl_masks", "tr_masks",
+        "fcw", "fcw_planes", "fcw_t", "fcw_p", "fcw_canon", "fcw_masks",
         "vcw", "vcw_t", "fvcw", "fvcw_t",
         "key_bytes", "key_blob", "key_material", "raw_key", "blob",
         "ka", "kb", "kbp", "kb_s",

@@ -1,0 +1,57 @@
+"""Jaxpr-level oblivious-dataflow verifier.
+
+The AST passes (knob-registry, secret-hygiene, host-sync, pallas-jit)
+see source text; this package sees what JAX actually *traces*.  A DPF
+deployment's security story (BGI16 — PAPER.md) rests on each party's
+evaluation being data-oblivious: no branch predicate, no memory index,
+no output shape, and no host callback may depend on key material, or a
+2-server PIR deployment leaks ``alpha`` through its timing and access
+patterns.  ``jnp.where`` rewritten into a ``lax.cond`` by a refactor, a
+secret-derived ``dynamic_slice`` start index, a ``debug_print`` left in
+a jitted graph — none of those are visible to a source linter, all of
+them are visible in the jaxpr.
+
+Three modules:
+
+  taint.py        the interprocedural taint lattice over ClosedJaxpr
+                  equations: sources are the key-material operands,
+                  taint propagates through every primitive including
+                  ``scan``/``cond``/``while``/``pjit``/``pallas_call``
+                  sub-jaxprs (with Ref write-back inside Pallas
+                  kernels), findings fire on secret-tainted control
+                  flow, secret-tainted memory indices, callbacks,
+                  secret->float casts, and secret-dependent shapes.
+                  Also computes the primitive census, a deterministic
+                  structural hash of the jaxpr, and the traced
+                  VMEM-block cross-check against the ops modules'
+                  ``_VMEM_BUDGET``.
+  entrypoints.py  the production route matrix: every serving entrypoint
+                  (eval_points / eval_points_level_grouped / eval_full /
+                  eval_full_stream chunk bodies, DCF eval_lt_points /
+                  eval_interval_points, FSS gates, ge_full) x
+                  {AES-compat, ChaCha-fast} x {packed, unpacked} x
+                  {fuse off, fuse G} traced to a ClosedJaxpr under
+                  ``JAX_PLATFORMS=cpu``, with the key-material argument
+                  positions declared per route.  Routes trace the
+                  UNWRAPPED jit bodies, so the verifier never populates
+                  a compile cache (``core.plans.trace_count`` is
+                  asserted unchanged in tests).
+  certify.py      obliviousness certificates: a clean route emits
+                  (entrypoint, route/knob tuple, jaxpr hash, primitive
+                  census, verifier version) into docs/OBLIVIOUS.md + the
+                  docs/oblivious.json sidecar; the pass fails when a
+                  route's hash drifts from the committed certificate
+                  without re-certification
+                  (``python -m dpf_tpu.analysis --write-oblivious``).
+
+Run as the fifth analysis pass (``oblivious-trace``) under
+``python -m dpf_tpu.analysis`` / ``scripts/lint_all.sh`` /
+``runtests.sh --lint``.
+"""
+
+from __future__ import annotations
+
+# Bump when the lattice rules, the route matrix, or the hash scheme
+# change (committed certificates re-generate; bench ledgers keyed on it
+# re-measure).
+OBLIVIOUS_VERIFIER_VERSION = "1"

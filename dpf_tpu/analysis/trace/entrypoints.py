@@ -1,0 +1,578 @@
+"""The production route matrix: every serving entrypoint traced to a
+ClosedJaxpr with its key-material argument positions declared.
+
+Each route traces the UNWRAPPED body of the corresponding module-level
+jitted function (``fn.__wrapped__`` for decorated jits, the raw
+``*_body`` functions where the repo keeps them separate) — the same
+callables production dispatch lands on through ``core.plans`` — so the
+verifier sees exactly the traced graph of the deployed route while
+never touching a jit compile cache (``core.plans.trace_count`` counts
+compiled executables; tracing adds none — asserted in
+tests/test_oblivious.py).
+
+Shapes are the smallest that still exercise the real kernels: the
+Pallas routes need the kernel tile quanta (B % 128 for the plane
+kernels, K % 8 / % 128 for the walk kernels, Kp % 8 for the compat
+fused kernels), so those routes generate just enough keys to tile.  All
+key batches come from the profile's own ``gen_batch`` under a seeded
+rng — the traced shapes, and therefore the certificate hashes, are
+deterministic.
+
+Secret sources per route are the operands derived from key material:
+seeds, control bits/words (ts / t_words / tcw / tl / tr), seed CWs
+(scw), value CWs (vcw / fvcw), final CWs (fcw), the device-cached
+per-key lane masks built from all of the above, and prefix-expansion
+level state (S, T).  Query tensors (xs_hi / xs_lo, packed path words,
+leaf selectors) are public: they are the *client's* input, known to the
+server by definition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import numpy as np
+
+__all__ = ["Route", "ROUTES", "trace_route", "vmem_budgets"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Route:
+    name: str  # unique certificate key, e.g. "points/compat/walk/packed"
+    entrypoint: str  # production entrypoint(s) this jaxpr underlies
+    plan_route: str  # core.plans PlanKey.route ("-" when not plan-cached)
+    knobs: tuple  # (("profile", ...), ("backend", ...), ...) — hashable
+    build: Callable[[], tuple]  # () -> (closed_jaxpr, secret_invar_set)
+
+    def knob_dict(self) -> dict:
+        return dict(self.knobs)
+
+
+def _trace(fn, args, static_argnums=(), secret=()):
+    """make_jaxpr with per-ARGUMENT secrecy flags expanded to per-INVAR
+    flags (pytree args flatten to multiple invars; None flattens to
+    zero).  -> (ClosedJaxpr, set of secret invar indices)."""
+    import jax
+
+    static = set(static_argnums)
+    flags: list[bool] = []
+    for i, a in enumerate(args):
+        if i in static:
+            continue
+        flags.extend([i in secret] * len(jax.tree_util.tree_leaves(a)))
+    closed = jax.make_jaxpr(fn, static_argnums=tuple(sorted(static)))(*args)
+    if len(flags) != len(closed.jaxpr.invars):  # pragma: no cover — guard
+        raise AssertionError(
+            f"secrecy map mismatch: {len(flags)} flags vs "
+            f"{len(closed.jaxpr.invars)} invars"
+        )
+    return closed, {i for i, f in enumerate(flags) if f}
+
+
+def _rng():
+    return np.random.default_rng(2026)
+
+
+# ---------------------------------------------------------------------------
+# Compat (AES) profile
+# ---------------------------------------------------------------------------
+
+
+def _compat_batch(log_n: int, k: int):
+    from ...core.keys import gen_batch
+
+    alphas = np.arange(k, dtype=np.uint64) % (1 << min(log_n, 20))
+    ka, _ = gen_batch(alphas, log_n, rng=_rng())
+    return ka
+
+
+def _compat_masks(kb):
+    from ...models import dpf
+
+    return dpf._point_masks(kb)
+
+
+def _split32(k: int, q: int):
+    import jax.numpy as jnp
+
+    xs = np.zeros((k, q), np.uint64)
+    xs_lo = jnp.asarray((xs & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    xs_hi = jnp.zeros((1, 1), jnp.uint32)
+    return xs_hi, xs_lo
+
+
+def _points_compat_xla(packed: bool):
+    from ...models import dpf
+
+    kb = _compat_batch(9, 32)
+    masks = _compat_masks(kb)
+    xs_hi, xs_lo = _split32(32, 32)
+    fn = dpf._eval_points_packed_body if packed else dpf._eval_points_body
+    args = (kb.nu, kb.log_n, *masks, xs_hi, xs_lo, 1, "xla")
+    return _trace(
+        fn, args, static_argnums=(0, 1, 10, 11), secret=range(2, 8)
+    )
+
+
+def _points_compat_walk():
+    from ...models import dpf
+
+    kb = _compat_batch(9, 8)  # K % _PKT(8) == 0 — the kernel route
+    masks = _compat_masks(kb)
+    xs_hi, xs_lo = _split32(8, 32)
+    args = (kb.nu, kb.log_n, *masks, xs_hi, xs_lo, 1)
+    return _trace(
+        dpf._eval_points_walk_body, args, static_argnums=(0, 1, 10),
+        secret=range(2, 8),
+    )
+
+
+def _points_compat_grouped():
+    from ...models import dpf
+
+    log_n, G = 9, 8  # K = 1 * log_n * G = 72, % _PKT == 0
+    kb = _compat_batch(log_n, log_n * G)
+    masks = _compat_masks(kb)
+    import jax.numpy as jnp
+
+    xs = np.zeros((G, 32), np.uint64)
+    xs_lo = jnp.asarray((xs & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    xs_hi = jnp.zeros((1, 1), jnp.uint32)
+    args = (kb.nu, log_n, 1, G, *masks, xs_hi, xs_lo, 1, True)
+    return _trace(
+        dpf._grouped_walk_body, args, static_argnums=(0, 1, 2, 3, 12, 13),
+        secret=range(4, 10),
+    )
+
+
+def _evalfull_compat(log_n: int, k: int, backend: str):
+    from ...models import dpf
+
+    dk = dpf.DeviceKeys(_compat_batch(log_n, k))
+    args = (
+        dk.nu, dk.seed_planes, dk.t_words, dk.scw_planes, dk.tl_words,
+        dk.tr_words, dk.fcw_planes, backend,
+    )
+    return _trace(
+        dpf._eval_full_jit.__wrapped__, args, static_argnums=(0, 7),
+        secret=range(1, 7),
+    )
+
+
+def _evalfull_compat_fused():
+    from ...models import dpf
+
+    log_n = 16  # nu=9: levels beyond the fuse floor exist
+    dk = dpf.DeviceKeys(_compat_batch(log_n, 256))  # Kp=8 tiles _FKT
+    sched = dpf._fuse_schedule(dk.nu, 2)
+    args = (
+        dk.nu, dk.seed_planes, dk.t_words, dk.scw_planes, dk.tl_words,
+        dk.tr_words, dk.fcw_planes, "pallas_bm", sched,
+    )
+    return _trace(
+        dpf._eval_full_fused_jit.__wrapped__, args, static_argnums=(0, 7, 8),
+        secret=range(1, 7),
+    )
+
+
+def _evalfull_compat_chunked(single_chunk: bool):
+    import jax.numpy as jnp
+
+    from ...models import dpf
+
+    log_n, k = 9, 32
+    kb = _compat_batch(log_n, k)
+    dk = dpf.DeviceKeys(kb)
+    c = 1
+    # Deterministic stand-in for the prefix level state (same avals the
+    # real _expand_prefix_jit carries into the finish).
+    kp = dk.k_padded // 32
+    C = 1 << c
+    S = jnp.zeros((128, C, kp), jnp.uint32)
+    T = jnp.zeros((C, kp), jnp.uint32)
+    if single_chunk:  # the streaming pipeline's per-chunk dispatch
+        fn = dpf._finish_chunk_body
+        args = (
+            dk.nu - c, c, S[:, :1, :], T[:1], dk.scw_planes, dk.tl_words,
+            dk.tr_words, dk.fcw_planes, "xla",
+        )
+    else:
+        fn = dpf._finish_chunks_scan_body
+        args = (
+            dk.nu - c, c, S, T, dk.scw_planes, dk.tl_words, dk.tr_words,
+            dk.fcw_planes, "xla",
+        )
+    return _trace(fn, args, static_argnums=(0, 1, 8), secret=range(2, 8))
+
+
+def _ge_full_compat():
+    import jax.numpy as jnp
+
+    from ...models import fss
+
+    words = jnp.zeros((8, 16), jnp.uint32)
+    return _trace(
+        fss._prefix_xor_words.__wrapped__, (words,), secret=(0,)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fast (ChaCha) profile
+# ---------------------------------------------------------------------------
+
+
+def _fast_batch(log_n: int, k: int):
+    from ...models.keys_chacha import gen_batch
+
+    alphas = np.arange(k, dtype=np.uint64) % (1 << min(log_n, 20))
+    ka, _ = gen_batch(alphas, log_n, rng=_rng())
+    return ka
+
+
+def _points_fast_xla(packed: bool, level_groups: int = 0):
+    from ...models import dpf_chacha as dc
+
+    log_n = 10
+    G = 4
+    k = level_groups * log_n * G if level_groups else 32
+    kb = _fast_batch(log_n, k)
+    q = level_groups and G or k
+    import jax.numpy as jnp
+
+    xs_lo = jnp.zeros((32, q), jnp.uint32)  # query-major [Q, K or G]
+    xs_hi = jnp.zeros((1, 1), jnp.uint32)
+    fn = (
+        dc._eval_points_cc_packed_body if packed else dc._eval_points_cc_body
+    )
+    args = (
+        kb.nu, log_n, *kb.device_args(), xs_hi, xs_lo, level_groups, None
+    )
+    return _trace(
+        fn, args, static_argnums=(0, 1, 9), secret=range(2, 7)
+    )
+
+
+def _points_fast_walk(packed: bool):
+    from ...ops import chacha_pallas as cp
+
+    kb = _fast_batch(10, 128)  # K % _KT(128) == 0 — the kernel route
+    ops = cp.walk_operands(kb)  # (meta, seeds_t, scw_t, tcw_t, fcw_t)
+    import jax.numpy as jnp
+
+    xs_lo = jnp.zeros((32, 128), jnp.uint32)
+    xs_hi = jnp.zeros((1, 128), jnp.uint32)
+    args = (*ops, xs_lo, xs_hi, kb.log_n, kb.nu, cp._qtile(32), packed)
+    return _trace(
+        cp._walk_call.__wrapped__, args, static_argnums=(7, 8, 9, 10),
+        secret=range(0, 5),  # meta carries the root control bits
+    )
+
+
+def _points_fast_walk_reduced():
+    from ...ops import chacha_pallas as cp
+
+    log_n, G = 8, 16  # K = 1 * 8 * 16 = 128
+    kb = _fast_batch(log_n, log_n * G)
+    ops = cp.walk_operands(kb, groups=1)
+    import jax.numpy as jnp
+
+    xs_lo = jnp.zeros((32, 128), jnp.uint32)
+    xs_hi = jnp.zeros((1, 128), jnp.uint32)
+    args = (*ops, xs_lo, xs_hi, log_n, kb.nu, cp._qtile(32), G, True)
+    return _trace(
+        cp._walk_call_reduced.__wrapped__, args,
+        static_argnums=(7, 8, 9, 10, 11), secret=range(0, 5),
+    )
+
+
+def _dcf_points_xla(packed: bool, interval: bool = False):
+    from ...models import dcf
+    from ...models import dpf_chacha as dc
+
+    log_n = 10
+    alphas = np.arange(16, dtype=np.uint64)
+    ka, _ = dcf.gen_lt_batch(alphas, log_n, rng=_rng())
+    kb = dcf._concat_batches(ka, ka) if interval else ka
+    seeds, ts, scw, tcw, vcw, fvcw = kb.device_args()
+    import jax.numpy as jnp
+
+    xs_lo = jnp.zeros((32, kb.k), jnp.uint32)
+    xs_hi = jnp.zeros((1, 1), jnp.uint32)
+    fn = (
+        dc._eval_points_cc_packed_body if packed else dc._eval_points_cc_body
+    )
+    args = (kb.nu, log_n, seeds, ts, scw, tcw, fvcw, xs_hi, xs_lo, 0, vcw)
+    return _trace(
+        fn, args, static_argnums=(0, 1, 9),
+        secret=(2, 3, 4, 5, 6, 10),
+    )
+
+
+def _dcf_points_walk():
+    from ...models import dcf
+    from ...ops import chacha_pallas as cp
+
+    log_n = 10
+    alphas = np.arange(128, dtype=np.uint64)
+    ka, _ = dcf.gen_lt_batch(alphas, log_n, rng=_rng())
+    ops = cp.dcf_walk_operands(ka)  # meta..fvcw_t, all key material
+    import jax.numpy as jnp
+
+    xs_lo = jnp.zeros((32, 128), jnp.uint32)
+    xs_hi = jnp.zeros((1, 128), jnp.uint32)
+    args = (*ops, xs_lo, xs_hi, log_n, ka.nu, cp._qtile(32), True)
+    return _trace(
+        cp._walk_call_dcf.__wrapped__, args, static_argnums=(8, 9, 10, 11),
+        secret=range(0, 6),
+    )
+
+
+def _evalfull_fast_xla():
+    from ...models import dpf_chacha as dc
+
+    kb = _fast_batch(11, 8)
+    args = (kb.nu, *kb.device_args())
+    return _trace(
+        dc._eval_full_cc_jit.__wrapped__, args, static_argnums=(0,),
+        secret=range(1, 6),
+    )
+
+
+def _evalfull_fast_pallas():
+    from ...models import dpf_chacha as dc
+    from ...ops import chacha_pallas as cp
+
+    kb = _fast_batch(16, 8)  # nu=7; K % _EKT(8) == 0
+    first = kb.nu - cp._EXP_LEVELS
+    seeds, ts, scw, tcw, _ = kb.device_args()
+    scw_p, tcw_p, fcw_p = cp.expand_operands(kb, first)
+    args = (kb.nu, first, seeds, ts, scw, tcw, scw_p, tcw_p, fcw_p)
+    return _trace(
+        dc._eval_full_pk_jit.__wrapped__, args, static_argnums=(0, 1),
+        secret=range(2, 9),
+    )
+
+
+def _evalfull_fast_fused():
+    from ...models import dpf_chacha as dc
+    from ...ops import chacha_pallas as cp
+
+    kb = _fast_batch(22, 8)  # nu=13: mid levels exist beyond floor+tail
+    sched = dc._fuse_schedule_cc(kb.nu, 2)
+    seeds, ts, scw, tcw, fcw = kb.device_args()
+    scw_t, tcw_t, fcw_t = cp.expand_operands(kb, sched[2])
+    args = (
+        kb.nu, sched, seeds, ts, scw, tcw, fcw, scw_t, tcw_t, fcw_t
+    )
+    return _trace(
+        dc._eval_full_fused_cc_jit.__wrapped__, args, static_argnums=(0, 1),
+        secret=range(2, 10),
+    )
+
+
+def _evalfull_fast_chunked(single_chunk: bool):
+    import jax.numpy as jnp
+
+    from ...models import dpf_chacha as dc
+
+    kb = _fast_batch(11, 8)
+    seeds, ts, scw, tcw, fcw = kb.device_args()
+    c = 1
+    C = 1 << c
+    S = [jnp.zeros((kb.k, C), jnp.uint32) for _ in range(4)]
+    T = jnp.zeros((kb.k, C), jnp.uint32)
+    if single_chunk:
+        fn = dc._finish_chunk_cc_body
+        args = (
+            kb.nu - c, c, [s[:, :1] for s in S], T[:, :1], scw, tcw, fcw
+        )
+        return _trace(
+            fn, args, static_argnums=(0, 1), secret=range(2, 7)
+        )
+    fn = dc._finish_chunks_cc_scan_body
+    args = (kb.nu - c, c, *S, T, scw, tcw, fcw)
+    return _trace(fn, args, static_argnums=(0, 1), secret=range(2, 10))
+
+
+# ---------------------------------------------------------------------------
+# The matrix
+# ---------------------------------------------------------------------------
+
+
+def _route(name, entrypoint, plan_route, knobs, build):
+    return Route(name, entrypoint, plan_route, tuple(sorted(knobs.items())),
+                 build)
+
+
+ROUTES: tuple[Route, ...] = (
+    # -- pointwise, compat -------------------------------------------------
+    _route(
+        "points/compat/xla/bits", "models.dpf.eval_points", "points",
+        {"profile": "compat", "backend": "xla", "packed": False},
+        lambda: _points_compat_xla(False),
+    ),
+    _route(
+        "points/compat/xla/packed", "models.dpf.eval_points", "points",
+        {"profile": "compat", "backend": "xla", "packed": True},
+        lambda: _points_compat_xla(True),
+    ),
+    _route(
+        "points/compat/walk/packed-words", "models.dpf.eval_points",
+        "points",
+        {"profile": "compat", "backend": "pallas-walk", "packed": True},
+        _points_compat_walk,
+    ),
+    _route(
+        "points_grouped/compat/walk",
+        "models.dpf.eval_points_level_grouped + models.fss.eval_lt_points",
+        "points",
+        {"profile": "compat", "backend": "pallas-walk", "packed": True,
+         "reduce": True},
+        _points_compat_grouped,
+    ),
+    # -- full-domain, compat ----------------------------------------------
+    _route(
+        "evalfull/compat/xla", "models.dpf.eval_full", "evalfull",
+        {"profile": "compat", "backend": "xla", "fuse": "off"},
+        lambda: _evalfull_compat(9, 32, "xla"),
+    ),
+    _route(
+        "evalfull/compat/pallas_bm", "models.dpf.eval_full", "evalfull",
+        {"profile": "compat", "backend": "pallas_bm", "fuse": "off"},
+        lambda: _evalfull_compat(15, 32, "pallas_bm"),
+    ),
+    _route(
+        "evalfull/compat/fused", "models.dpf.eval_full", "evalfull",
+        {"profile": "compat", "backend": "pallas_bm", "fuse": "G=2"},
+        _evalfull_compat_fused,
+    ),
+    _route(
+        "evalfull_chunked/compat", "models.dpf.eval_full (chunked scan)",
+        "evalfull",
+        {"profile": "compat", "backend": "xla", "fuse": "off"},
+        lambda: _evalfull_compat_chunked(False),
+    ),
+    _route(
+        "evalfull_stream/compat", "models.dpf.eval_full_stream chunk body",
+        "evalfull",
+        {"profile": "compat", "backend": "xla", "stream": True},
+        lambda: _evalfull_compat_chunked(True),
+    ),
+    _route(
+        "ge_full/compat", "models.fss.ge_full_from_dpf prefix-XOR scan",
+        "-",
+        {"profile": "compat", "backend": "xla"},
+        _ge_full_compat,
+    ),
+    # -- pointwise, fast ---------------------------------------------------
+    _route(
+        "points/fast/xla/bits", "models.dpf_chacha.eval_points", "points",
+        {"profile": "fast", "backend": "xla", "packed": False},
+        lambda: _points_fast_xla(False),
+    ),
+    _route(
+        "points/fast/xla/packed", "models.dpf_chacha.eval_points", "points",
+        {"profile": "fast", "backend": "xla", "packed": True},
+        lambda: _points_fast_xla(True),
+    ),
+    _route(
+        "points_grouped/fast/xla",
+        "models.dpf_chacha.eval_points_level_grouped "
+        "+ models.fss.eval_lt_points",
+        "points",
+        {"profile": "fast", "backend": "xla", "packed": True, "groups": 2},
+        lambda: _points_fast_xla(True, level_groups=2),
+    ),
+    _route(
+        "points/fast/walk/bits", "models.dpf_chacha.eval_points", "points",
+        {"profile": "fast", "backend": "pallas-walk", "packed": False},
+        lambda: _points_fast_walk(False),
+    ),
+    _route(
+        "points/fast/walk/packed", "models.dpf_chacha.eval_points",
+        "points",
+        {"profile": "fast", "backend": "pallas-walk", "packed": True},
+        lambda: _points_fast_walk(True),
+    ),
+    _route(
+        "points_grouped/fast/walk-reduced",
+        "models.dpf_chacha.eval_points_level_grouped "
+        "+ models.fss.eval_lt_points / eval_interval_points",
+        "points",
+        {"profile": "fast", "backend": "pallas-walk", "packed": True,
+         "reduce": True},
+        _points_fast_walk_reduced,
+    ),
+    # -- DCF ---------------------------------------------------------------
+    _route(
+        "dcf_points/xla/bits", "models.dcf.eval_lt_points", "dcf_points",
+        {"profile": "fast", "backend": "xla", "packed": False},
+        lambda: _dcf_points_xla(False),
+    ),
+    _route(
+        "dcf_points/xla/packed", "models.dcf.eval_lt_points", "dcf_points",
+        {"profile": "fast", "backend": "xla", "packed": True},
+        lambda: _dcf_points_xla(True),
+    ),
+    _route(
+        "dcf_points/walk/packed", "models.dcf.eval_lt_points", "dcf_points",
+        {"profile": "fast", "backend": "pallas-walk", "packed": True},
+        _dcf_points_walk,
+    ),
+    _route(
+        "dcf_interval/xla/packed", "models.dcf.eval_interval_points",
+        "dcf_interval",
+        {"profile": "fast", "backend": "xla", "packed": True},
+        lambda: _dcf_points_xla(True, interval=True),
+    ),
+    # -- full-domain, fast -------------------------------------------------
+    _route(
+        "evalfull/fast/xla", "models.dpf_chacha.eval_full", "evalfull",
+        {"profile": "fast", "backend": "xla", "fuse": "off"},
+        _evalfull_fast_xla,
+    ),
+    _route(
+        "evalfull/fast/pallas", "models.dpf_chacha.eval_full", "evalfull",
+        {"profile": "fast", "backend": "pallas", "fuse": "off"},
+        _evalfull_fast_pallas,
+    ),
+    _route(
+        "evalfull/fast/fused", "models.dpf_chacha.eval_full", "evalfull",
+        {"profile": "fast", "backend": "pallas", "fuse": "G=2"},
+        _evalfull_fast_fused,
+    ),
+    _route(
+        "evalfull_chunked/fast",
+        "models.dpf_chacha.eval_full (chunked scan)", "evalfull",
+        {"profile": "fast", "backend": "xla", "fuse": "off"},
+        lambda: _evalfull_fast_chunked(False),
+    ),
+    _route(
+        "evalfull_stream/fast",
+        "models.dpf_chacha.eval_full_stream chunk body", "evalfull",
+        {"profile": "fast", "backend": "xla", "stream": True},
+        lambda: _evalfull_fast_chunked(True),
+    ),
+)
+
+
+def vmem_budgets() -> dict[str, int]:
+    """kernel-name-fragment -> budget from the ops modules' declared
+    ``_VMEM_BUDGET`` — the same bound the AST pallas-jit pass lints the
+    ``# vmem:`` models against, now cross-checked against TRACED block
+    shapes."""
+    out: dict[str, int] = {}
+    from ...ops import aes_pallas, chacha_pallas
+
+    for frag, mod in (("aes", aes_pallas), ("chacha", chacha_pallas),
+                      ("walk", chacha_pallas)):
+        b = getattr(mod, "_VMEM_BUDGET", None)
+        if isinstance(b, int):
+            out[frag] = b
+    return out
+
+
+def trace_route(route: Route):
+    """-> (ClosedJaxpr, secret invar set).  Separated for tests."""
+    return route.build()

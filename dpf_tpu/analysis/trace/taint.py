@@ -1,0 +1,520 @@
+"""Interprocedural taint lattice over jaxpr equations.
+
+Two-point lattice per variable: PUBLIC (bottom) or SECRET (top).  Taint
+enters at the key-material invars a route declares, joins upward through
+every equation (any secret input -> all outputs secret), and descends
+into sub-jaxprs:
+
+  * ``pjit`` / ``closed_call`` / ``custom_jvp_call`` / ``remat`` — the
+    sub-jaxpr's invars map 1:1 onto the equation's.
+  * ``scan`` — consts + carry + per-iteration slices; the carry taints
+    iterate to a fixpoint (a secret entering the carry on iteration k
+    taints it for all iterations).
+  * ``while`` — body carry to fixpoint; the cond sub-jaxpr's boolean
+    output is a *finding* when tainted (secret-dependent trip count).
+  * ``cond`` — a tainted branch index is a finding; operand taints run
+    through every branch and the outputs join.
+  * ``pallas_call`` — the kernel jaxpr's Ref invars take the operand
+    taints; ``get``/``swap`` track taint through the Refs (a store of a
+    secret value taints the Ref; loads read the Ref's taint) and any
+    *dynamic index operand* of a Ref access that is tainted is a finding
+    (a secret-dependent VMEM/HBM access pattern).
+
+Findings (the data-obliviousness contract, docs/DESIGN.md §10):
+
+  secret-branch     ``cond`` branch index / ``while`` predicate tainted
+  secret-index      tainted index operand of ``dynamic_slice`` /
+                    ``dynamic_update_slice`` / ``gather`` / ``scatter*``
+                    or of a kernel Ref access
+  callback          ``pure_callback`` / ``io_callback`` /
+                    ``debug_callback`` / ``debug_print`` anywhere in a
+                    traced graph (host round trip: timing channel, and
+                    the payload leaves the device)
+  secret-float      a tainted integer word converted to a float dtype
+                    (float arithmetic is not constant-time on all
+                    hardware paths, and NaN/inf payloads can leak bits)
+  secret-shape      a tainted value whose aval shape is not static
+  vmem-over-budget  a ``pallas_call``'s traced block footprint exceeds
+                    the owning ops module's ``_VMEM_BUDGET`` (the bound
+                    the AST pass lints the ``# vmem:`` models against)
+
+The walk also produces the primitive census and a deterministic
+structural hash of the jaxpr — the certificate identity in certify.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import re
+from collections import Counter
+from typing import Any
+
+import numpy as np
+
+_CALLBACK_PRIMS = frozenset(
+    {"pure_callback", "io_callback", "debug_callback", "debug_print"}
+)
+# Pallas/state Ref access primitives (kernel-mode handling: taint flows
+# through the Ref itself, and a tainted dynamic index is a finding).
+_REF_PRIMS = frozenset(
+    {"get", "swap", "masked_load", "masked_swap", "addupdate", "load",
+     "store"}
+)
+# invar index ranges of index operands, per primitive: (first, None) means
+# "from ``first`` to the end".
+_INDEXED_PRIMS: dict[str, tuple[int, int | None]] = {
+    "dynamic_slice": (1, None),
+    "dynamic_update_slice": (2, None),
+    "gather": (1, 2),
+    "scatter": (1, 2),
+    "scatter-add": (1, 2),
+    "scatter_add": (1, 2),
+    "scatter-mul": (1, 2),
+    "scatter-min": (1, 2),
+    "scatter-max": (1, 2),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TaintFinding:
+    kind: str  # secret-branch | secret-index | callback | secret-float |
+    #            secret-shape | vmem-over-budget
+    where: str  # eqn path inside the jaxpr, e.g. "eqn 41 (pjit) / eqn 3"
+    message: str
+
+
+@dataclasses.dataclass
+class TaintReport:
+    findings: list[TaintFinding]
+    census: Counter  # primitive name -> count, sub-jaxprs included
+    n_eqns: int  # total equations walked
+
+
+def _is_ref(aval) -> bool:
+    """Pallas/state Ref avals (duck-typed: jax version drift tolerant)."""
+    return type(aval).__name__ == "AbstractRef" or hasattr(aval, "inner_aval")
+
+
+def _is_float(dtype) -> bool:
+    try:
+        return np.issubdtype(np.dtype(dtype), np.floating)
+    except TypeError:
+        return False
+
+
+def _static_shape(aval) -> bool:
+    shape = getattr(aval, "shape", ())
+    return all(isinstance(d, (int, np.integer)) for d in shape)
+
+
+def _sub_jaxprs(value):
+    """Yield every open Jaxpr reachable inside one params value.
+    ClosedJaxpr forwards ``.eqns`` to its jaxpr, so the unwrap check
+    must come first."""
+    if hasattr(value, "jaxpr") and hasattr(value.jaxpr, "eqns"):
+        yield value.jaxpr  # ClosedJaxpr
+    elif hasattr(value, "eqns"):  # Jaxpr
+        yield value
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            yield from _sub_jaxprs(v)
+
+
+class _Analyzer:
+    def __init__(self, vmem_budgets: dict[str, int] | None = None):
+        self.findings: list[TaintFinding] = []
+        self.census: Counter = Counter()
+        self.n_eqns = 0
+        # kernel-name-fragment -> budget bytes (the ops modules'
+        # _VMEM_BUDGET values); empty disables the cross-check.
+        self.vmem_budgets = vmem_budgets or {}
+        # >0 while re-walking a loop body purely to reach the taint
+        # fixpoint: taints still propagate, but findings and the census
+        # are suppressed so each equation is reported/counted exactly
+        # once (by the final, converged walk).
+        self._mute = 0
+
+    # -- helpers ----------------------------------------------------------
+
+    def _emit(self, kind: str, path: str, msg: str) -> None:
+        if not self._mute:
+            self.findings.append(TaintFinding(kind, path, msg))
+
+    def _count(self, prim: str) -> None:
+        if not self._mute:
+            self.census[prim] += 1
+            self.n_eqns += 1
+
+    @staticmethod
+    def _read(env: dict, v) -> bool:
+        # Literals are trace-time constants: public by construction.
+        return env.get(id(v), False) if hasattr(v, "aval") and not hasattr(
+            v, "val"
+        ) else False
+
+    # -- the walk ---------------------------------------------------------
+
+    def run(
+        self, jaxpr, in_taints: list[bool], path: str = "",
+        kernel: bool = False,
+    ) -> list[bool]:
+        """Propagate taint through ``jaxpr`` (a Jaxpr, not Closed) with
+        the given invar taints; -> outvar taints.  ``kernel`` marks a
+        Pallas kernel context (Ref-aware handling), and is inherited by
+        every sub-jaxpr walked from inside one — a ``fori_loop`` body
+        inside a kernel gets the same Ref discipline as the kernel's top
+        level."""
+        env: dict[int, bool] = {}
+        for v in jaxpr.constvars:
+            env[id(v)] = False
+        if len(in_taints) < len(jaxpr.invars):
+            # conservative: unmapped trailing invars (e.g. kernel scratch
+            # Refs) start public
+            in_taints = list(in_taints) + [False] * (
+                len(jaxpr.invars) - len(in_taints)
+            )
+        for v, t in zip(jaxpr.invars, in_taints):
+            env[id(v)] = bool(t)
+
+        for idx, eqn in enumerate(jaxpr.eqns):
+            where = f"{path}eqn {idx} ({eqn.primitive.name})"
+            if kernel and eqn.primitive.name in _REF_PRIMS:
+                self._ref_access(env, eqn, where)
+            else:
+                self._eqn(env, eqn, where, kernel=kernel)
+
+        return [self._read(env, v) for v in jaxpr.outvars]
+
+    def _eqn(self, env: dict, eqn, where: str, kernel: bool = False) -> None:
+        prim = eqn.primitive.name
+        self._count(prim)
+        in_t = [self._read(env, v) for v in eqn.invars]
+        any_secret = any(in_t)
+
+        # ---- unconditional structural findings --------------------------
+        if prim in _CALLBACK_PRIMS:
+            self._emit(
+                "callback", where,
+                f"{prim} in a jitted graph — host round trips are a "
+                "timing channel and the payload leaves the device",
+            )
+        if prim == "pallas_call":
+            self._check_vmem(eqn, where)
+
+        # ---- secret-dependent control flow / memory indices -------------
+        if prim == "cond" and in_t and in_t[0]:
+            self._emit(
+                "secret-branch", where,
+                "lax.cond branch index is secret-tainted (the taken "
+                "branch is observable through timing)",
+            )
+        if prim in _INDEXED_PRIMS and any_secret:
+            first, last = _INDEXED_PRIMS[prim]
+            idx_ts = in_t[first:last] if last is not None else in_t[first:]
+            if any(idx_ts):
+                self._emit(
+                    "secret-index", where,
+                    f"{prim} index operand is secret-tainted (memory "
+                    "access pattern depends on key material)",
+                )
+
+        # ---- secret -> float --------------------------------------------
+        if prim == "convert_element_type" and any_secret:
+            new = eqn.params.get("new_dtype")
+            if new is not None and _is_float(new):
+                self._emit(
+                    "secret-float", where,
+                    f"secret word converted to {np.dtype(new).name} "
+                    "(float paths are not constant-time and leak via "
+                    "NaN/inf payloads)",
+                )
+
+        # ---- outputs + descent ------------------------------------------
+        out_t = self._descend(env, eqn, in_t, where, kernel)
+        if out_t is None:  # no sub-jaxpr handling: plain join
+            out_t = [any_secret] * len(eqn.outvars)
+        if kernel and any_secret:
+            # A call-like sub-jaxpr (fori_loop body, nested scan) may
+            # store a secret into any Ref it was handed; without per-Ref
+            # effect metadata, join conservatively: every Ref operand of
+            # a secret-fed equation becomes secret.
+            for v in eqn.invars:
+                if hasattr(v, "aval") and _is_ref(v.aval):
+                    env[id(v)] = True
+        for v, t in zip(eqn.outvars, out_t):
+            env[id(v)] = bool(t)
+            if t and not _static_shape(v.aval):
+                self._emit(
+                    "secret-shape", where,
+                    "secret-tainted value has a non-static shape "
+                    f"({getattr(v.aval, 'shape', '?')})",
+                )
+
+    def _ref_access(self, env: dict, eqn, where: str) -> None:
+        """get/swap/load/store & co. inside a kernel context: taint flows
+        through the Ref, and a tainted dynamic index operand is the
+        secret-shaped-VMEM-traffic finding."""
+        prim = eqn.primitive.name
+        self._count(prim)
+        in_t = [self._read(env, v) for v in eqn.invars]
+        val_i = 1 if prim in ("swap", "masked_swap", "addupdate",
+                              "store") else None
+        idx_from = (val_i + 1) if val_i is not None else 1
+        if any(in_t[idx_from:]):
+            self._emit(
+                "secret-index", where,
+                f"kernel Ref access ({prim}) uses a secret-"
+                "tainted dynamic index (VMEM access pattern "
+                "depends on key material)",
+            )
+        ref_var = eqn.invars[0]
+        t = self._read(env, ref_var)
+        if val_i is not None and val_i < len(in_t):
+            t = t or in_t[val_i]
+            env[id(ref_var)] = t
+        for v in eqn.outvars:
+            env[id(v)] = t
+
+    # -- per-primitive sub-jaxpr handling ---------------------------------
+
+    def _descend(
+        self, env, eqn, in_t, where, kernel: bool = False
+    ) -> list[bool] | None:
+        prim = eqn.primitive.name
+        params = eqn.params
+
+        if prim == "cond" and "branches" in params:
+            branch_in = in_t[1:]
+            outs = None
+            for closed in params["branches"]:
+                o = self.run(
+                    closed.jaxpr, list(branch_in), where + " / ",
+                    kernel=kernel,
+                )
+                outs = o if outs is None else [a or b for a, b in zip(outs, o)]
+            return outs if outs is not None else []
+
+        if prim == "while" and "body_jaxpr" in params:
+            cn = params.get("cond_nconsts", 0)
+            bn = params.get("body_nconsts", 0)
+            cond_consts = in_t[:cn]
+            body_consts = in_t[cn : cn + bn]
+            carry = list(in_t[cn + bn :])
+            self._mute += 1  # fixpoint re-walks: taint only, no reports
+            try:
+                for _ in range(len(carry) + 1):  # fixpoint: monotone joins
+                    out = self.run(
+                        params["body_jaxpr"].jaxpr, body_consts + carry,
+                        where + " / ", kernel=kernel,
+                    )
+                    new = [a or b for a, b in zip(carry, out)]
+                    if new == carry:
+                        break
+                    carry = new
+            finally:
+                self._mute -= 1
+            # One converged walk with reporting on: each body equation
+            # is counted and can fire exactly once.
+            self.run(
+                params["body_jaxpr"].jaxpr, body_consts + carry,
+                where + " / ", kernel=kernel,
+            )
+            pred = self.run(
+                params["cond_jaxpr"].jaxpr, cond_consts + carry,
+                where + " / ", kernel=kernel,
+            )
+            if any(pred):
+                self._emit(
+                    "secret-branch", where,
+                    "lax.while_loop predicate is secret-tainted (trip "
+                    "count depends on key material)",
+                )
+            return carry
+
+        if prim == "scan" and "jaxpr" in params:
+            nc = params.get("num_consts", 0)
+            ncar = params.get("num_carry", 0)
+            consts = in_t[:nc]
+            carry = list(in_t[nc : nc + ncar])
+            xs = in_t[nc + ncar :]
+            self._mute += 1
+            try:
+                for _ in range(len(carry) + 1):
+                    out = self.run(
+                        params["jaxpr"].jaxpr, consts + carry + xs,
+                        where + " / ", kernel=kernel,
+                    )
+                    new_carry = [a or b for a, b in zip(carry, out[:ncar])]
+                    if new_carry == carry:
+                        break
+                    carry = new_carry
+            finally:
+                self._mute -= 1
+            out = self.run(
+                params["jaxpr"].jaxpr, consts + carry + xs, where + " / ",
+                kernel=kernel,
+            )
+            return carry + out[ncar:]
+
+        if prim == "pallas_call" and "jaxpr" in params:
+            return self._kernel(eqn, in_t, where)
+
+        # Generic 1:1 call-like primitives (pjit, closed_call, remat,
+        # custom_jvp/vjp, shard_map, ...): exactly one sub-jaxpr whose
+        # invar count matches the equation's.
+        subs = [j for v in params.values() for j in _sub_jaxprs(v)]
+        if len(subs) == 1 and len(subs[0].invars) == len(eqn.invars):
+            return self.run(subs[0], list(in_t), where + " / ", kernel=kernel)
+        if subs:
+            # Unknown call structure: walk for census/structural findings
+            # with everything tainted iff any input is (conservative).
+            outs = None
+            t = any(in_t)
+            for sub in subs:
+                o = self.run(
+                    sub, [t] * len(sub.invars), where + " / ", kernel=kernel
+                )
+                outs = o
+            if outs is not None and len(outs) == len(eqn.outvars):
+                return [a or t for a in outs]
+            return [t or any(in_t)] * len(eqn.outvars)
+        return None
+
+    def _kernel(self, eqn, in_t, where) -> list[bool]:
+        """pallas_call: walk the kernel jaxpr in Ref-aware kernel mode.
+        Taint sources inside a kernel are its operands, so any secret
+        operand conservatively taints every output."""
+        kernel = eqn.params["jaxpr"]
+        self.run(kernel, list(in_t), where + " / ", kernel=True)
+        return [any(in_t)] * len(eqn.outvars)
+
+    # -- VMEM cross-check --------------------------------------------------
+
+    def _check_vmem(self, eqn, where) -> None:
+        if not self.vmem_budgets:
+            return
+        gm = eqn.params.get("grid_mapping")
+        mappings = getattr(gm, "block_mappings", None)
+        if not mappings:
+            return
+        total = 0
+        for bm in mappings:
+            shape = getattr(bm, "block_shape", None)
+            if shape is None:
+                continue
+            n = 1
+            for d in shape:
+                if isinstance(d, (int, np.integer)):
+                    n *= int(d)
+            total += n * 4  # every kernel operand in this tree is uint32
+        total *= 2  # Mosaic double-buffers the I/O windows
+        name = str(
+            eqn.params.get("name_and_src_info", eqn.params.get("name", ""))
+        )
+        budget = max(self.vmem_budgets.values())
+        for frag, b in self.vmem_budgets.items():
+            if frag and frag in name:
+                budget = b
+                break
+        if total > budget:
+            self._emit(
+                "vmem-over-budget", where,
+                f"traced pallas_call block footprint ~{total} B exceeds "
+                f"the ops _VMEM_BUDGET {budget} B (the bound the "
+                "'# vmem:' models are linted against)",
+            )
+
+
+def analyze(
+    closed_jaxpr, secret_invars, vmem_budgets: dict[str, int] | None = None
+) -> TaintReport:
+    """Run the lattice over ``closed_jaxpr`` with invar positions in
+    ``secret_invars`` (indices into ``jaxpr.invars``) as taint sources."""
+    a = _Analyzer(vmem_budgets)
+    jaxpr = closed_jaxpr.jaxpr
+    secret = set(int(i) for i in secret_invars)
+    in_t = [i in secret for i in range(len(jaxpr.invars))]
+    a.run(jaxpr, in_t)
+    return TaintReport(a.findings, a.census, a.n_eqns)
+
+
+# ---------------------------------------------------------------------------
+# Deterministic structural hash (the certificate identity)
+# ---------------------------------------------------------------------------
+
+_ADDR = re.compile(r"0x[0-9a-fA-F]+")
+
+
+def _const_token(value) -> str:
+    """Deterministic token for a trace-time constant (ndarray/jax array
+    contents included — a swapped lookup table must change the hash)."""
+    if isinstance(value, np.ndarray) or (
+        hasattr(value, "dtype") and hasattr(value, "shape")
+        and hasattr(value, "__array__")
+    ):
+        arr = np.ascontiguousarray(np.asarray(value))
+        return (
+            f"ndarray:{arr.dtype}:{arr.shape}:"
+            + hashlib.sha256(arr.tobytes()).hexdigest()[:16]
+        )
+    return _ADDR.sub("0x", repr(value))
+
+
+def _param_token(value) -> str:
+    if hasattr(value, "jaxpr") and hasattr(value.jaxpr, "eqns"):
+        return "jaxpr:" + _jaxpr_token(  # ClosedJaxpr: consts included
+            value.jaxpr, getattr(value, "consts", ())
+        )
+    if hasattr(value, "eqns"):
+        return "jaxpr:" + _jaxpr_token(value)
+    if isinstance(value, (tuple, list)):
+        return "[" + ",".join(_param_token(v) for v in value) + "]"
+    if callable(value):
+        return "fn:" + getattr(value, "__qualname__", type(value).__name__)
+    return _const_token(value)
+
+
+def _var_token(v, nums: dict) -> str:
+    """Canonical (de Bruijn) var token: vars are numbered in order of
+    first appearance, so dataflow REWIRING changes the hash even when
+    avals stay identical; inline Literals contribute their value."""
+    if hasattr(v, "val"):  # Literal (same discrimination as _read)
+        return "lit:" + _const_token(v.val)
+    n = nums.setdefault(id(v), len(nums))
+    aval = getattr(v, "aval", None)
+    return (
+        f"v{n}:{getattr(aval, 'dtype', '?')}{getattr(aval, 'shape', '?')}"
+    )
+
+
+def _jaxpr_token(jaxpr, consts=()) -> str:
+    nums: dict[int, int] = {}
+    parts = [
+        "in:" + ";".join(_var_token(v, nums) for v in jaxpr.invars),
+        "const:" + ";".join(_var_token(v, nums) for v in jaxpr.constvars),
+        "constvals:" + ";".join(_const_token(c) for c in consts),
+    ]
+    for eqn in jaxpr.eqns:
+        parts.append(
+            eqn.primitive.name
+            + "|"
+            + ";".join(_var_token(v, nums) for v in eqn.invars)
+            + "->"
+            + ";".join(_var_token(v, nums) for v in eqn.outvars)
+            + "|"
+            + ";".join(
+                f"{k}={_param_token(v)}" for k, v in sorted(eqn.params.items())
+            )
+        )
+    parts.append("out:" + ";".join(_var_token(v, nums) for v in jaxpr.outvars))
+    return hashlib.sha256("\n".join(parts).encode()).hexdigest()
+
+
+def jaxpr_hash(closed_jaxpr) -> str:
+    """Content hash of a ClosedJaxpr: primitives, canonically-numbered
+    operand wiring, avals, literal values, closed-over constants, and
+    params in equation order, with memory addresses and raw var names
+    normalized out — stable across runs under a pinned jax version,
+    which is exactly the staleness signal the certificates need."""
+    return _jaxpr_token(closed_jaxpr.jaxpr, closed_jaxpr.consts)

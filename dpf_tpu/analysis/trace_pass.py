@@ -1,0 +1,54 @@
+"""Pass 5: jaxpr-level oblivious-dataflow verification (the
+``oblivious-trace`` pass).
+
+Unlike the four AST passes this one runs the code's *traced* form: it
+re-traces every production route in ``trace/entrypoints.py`` under the
+hermetic CPU backend, runs the taint lattice (``trace/taint.py``), and
+fails on
+
+  * any lattice finding on any route (a secret-tainted branch, index,
+    callback, float cast, dynamic shape, or an over-budget Pallas
+    block), and
+  * certificate drift: a route whose jaxpr hash no longer matches the
+    committed ``docs/oblivious.json`` (re-certify with
+    ``python -m dpf_tpu.analysis --write-oblivious``).
+
+``files`` is accepted for CLI symmetry with the AST passes but ignored
+— routes are traced callables, not files.  The pass only runs against
+THIS checkout (tracing a foreign tree's routes would import this
+checkout's modules and certify the wrong code); a foreign ``--root``
+gets a single explanatory finding instead of a misleading pass.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .common import Finding, repo_root
+
+PASS = "oblivious-trace"
+
+
+def run(root: str, files=None) -> list[Finding]:
+    if os.path.realpath(root) != os.path.realpath(repo_root()):
+        return [
+            Finding(
+                "dpf_tpu/analysis/trace", 0, PASS,
+                "the jaxpr verifier only certifies the checkout it is "
+                "imported from; run it from the target tree",
+            )
+        ]
+    from .trace import certify
+
+    certs, taint_findings = certify.verify_routes()
+    out: list[Finding] = []
+    for route_name, f in taint_findings:
+        out.append(
+            Finding(
+                f"trace://{route_name}", 0, PASS,
+                f"[{f.kind}] {f.message} (at {f.where})",
+            )
+        )
+    for msg in certify.drift(root, certs):
+        out.append(Finding(certify.OBLIVIOUS_JSON, 0, PASS, msg))
+    return out

@@ -324,12 +324,13 @@ def _eval_points_xla(
         words = _eval_points_cc_packed_jit(
             kb.nu, kb.log_n, seeds, ts, scw, tcw, fvcw, xs_hi, xs_lo, 0, vcw
         )
+        # host-sync: final reply marshalling (DCF packed shares)
         return bitpack.mask_tail(np.asarray(words), Q)
     xs_hi, xs_lo = _split_queries(xs, kb.log_n)
     bits = _eval_points_cc_jit(
         kb.nu, kb.log_n, seeds, ts, scw, tcw, fvcw, xs_hi, xs_lo, 0, vcw
     )
-    return np.asarray(bits).T
+    return np.asarray(bits).T  # host-sync: final reply marshalling
 
 
 def gen_interval_batch(

@@ -105,6 +105,7 @@ def _pack_words_over_keys(words: np.ndarray) -> np.ndarray:
     Single source of truth for this layout is the device-side bit-matrix
     transpose in ``aes_bitslice.pack_padded_keys`` (whose absolute bit
     semantics are pinned in tests)."""
+    # host-sync: one-time key packing at batch build (not a serving path)
     return np.asarray(pack_padded_keys(jnp.asarray(words)))
 
 
@@ -494,6 +495,7 @@ def eval_full(
     out_bytes = 2^(log_n-3) (16 when log_n < 7), byte-identical to
     ``spec.eval_full`` / the reference's EvalFull per key."""
     dk = _cached_device_keys(kb)
+    # host-sync: final reply marshalling (full-domain words)
     words = np.asarray(
         eval_full_device(dk, max_plane_words, backend, fuse)
     )  # [Kpad, W, 4]
@@ -684,11 +686,12 @@ def eval_points(
         words = _eval_points_packed_jit(
             kb.nu, kb.log_n, *_point_masks(kb), xs_hi, xs_lo, qp, backend
         )
+        # host-sync: final reply marshalling (packed words)
         return bitpack.mask_tail(np.asarray(words), Q)
     bits = _eval_points_jit(
         kb.nu, kb.log_n, *_point_masks(kb), xs_hi, xs_lo, qp, backend
     )
-    return np.asarray(bits)[:, :Q]
+    return np.asarray(bits)[:, :Q]  # host-sync: final reply marshalling
 
 
 # Sticky failure latch for the compat walk kernel: a Mosaic lowering
@@ -744,6 +747,7 @@ def _eval_points_walk_compat(
         xs_hi = jnp.asarray((xs >> np.uint64(32)).astype(np.uint32))
     else:
         xs_hi = jnp.zeros((1, 1), jnp.uint32)
+    # host-sync: final reply marshalling (walk-kernel words)
     words = np.asarray(_eval_points_walk_jit(
         kb.nu, kb.log_n, *_point_masks(kb), xs_hi, xs_lo, qp
     ))  # [Kpad, qp]
@@ -851,6 +855,7 @@ def eval_points_level_grouped(
     else:
         xs_hi = jnp.zeros((1, 1), jnp.uint32)
     try:
+        # host-sync: final reply marshalling (grouped walk words)
         words = np.asarray(_grouped_walk_jit(
             kb.nu, n, groups, G, *_point_masks(kb), xs_hi, xs_lo, qp, reduce
         ))

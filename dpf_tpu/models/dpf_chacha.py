@@ -507,6 +507,7 @@ def eval_full(
     (out_bytes = 2^(log_n-3), min 64), byte-identical to the spec
     ``chacha_np.eval_full`` per key.  Domains too large to materialize in
     one pass split into independent GGM subtree chunks."""
+    # host-sync: final reply marshalling (full-domain words)
     words = np.asarray(eval_full_device(kb, max_leaf_nodes, backend, fuse))
     return np.ascontiguousarray(words).view("<u1").reshape(kb.k, -1)
 
@@ -725,7 +726,7 @@ def eval_points(
     bits = _eval_points_cc_jit(
         kb.nu, kb.log_n, *kb.device_args(), xs_hi, xs_lo
     )
-    return np.asarray(bits).T
+    return np.asarray(bits).T  # host-sync: final reply marshalling
 
 
 def _eval_points_cc_packed(
@@ -743,6 +744,7 @@ def _eval_points_cc_packed(
     words = _eval_points_cc_packed_jit(
         kb.nu, kb.log_n, *kb.device_args(), xs_hi, xs_lo, level_groups, vcw
     )
+    # host-sync: final reply marshalling (packed words)
     return bitpack.mask_tail(np.asarray(words), Q)
 
 
@@ -790,7 +792,7 @@ def eval_points_level_grouped(
         kb.nu, kb.log_n, *kb.device_args(), xs_hi, xs_lo,
         level_groups=groups,
     )
-    out = np.asarray(bits).T
+    out = np.asarray(bits).T  # host-sync: final reply marshalling
     if reduce:
         return np.bitwise_xor.reduce(
             out.reshape(groups * kb.log_n, G, -1), axis=0

@@ -325,5 +325,6 @@ def ge_full_from_dpf(kb) -> np.ndarray:
     else:
         words = eval_full_device(DeviceKeys(kb))  # [Kpad, W, 4]
     scanned = _prefix_xor_words(words.reshape(words.shape[0], -1))
+    # host-sync: final reply marshalling (comparison table)
     out = np.ascontiguousarray(np.asarray(scanned)[: kb.k])
     return out.view("<u1").reshape(kb.k, -1)

@@ -182,6 +182,7 @@ class PirServer:
                 from . import dpf as _mdpf
 
                 try:
+                    # host-sync: final reply marshalling (PIR answer rows)
                     words = np.asarray(
                         _pir_single(
                             dk.nu, self.chunk_rows, n_chunks, backend, sched
@@ -190,6 +191,7 @@ class PirServer:
                 except Exception as e:  # noqa: BLE001
                     _mdpf._fuse_degraded(e)
             if words is None:
+                # host-sync: final reply marshalling (PIR answer rows)
                 words = np.asarray(
                     _pir_single(dk.nu, self.chunk_rows, n_chunks, backend)(
                         *args
@@ -200,6 +202,7 @@ class PirServer:
                 self.mesh, dk.nu, self.subtree_levels, self.chunk_rows,
                 n_chunks, backend,
             )
+            # host-sync: final reply marshalling (PIR answer rows)
             words = np.asarray(fn(*args))  # [Kpad, row_words]
         return (
             np.ascontiguousarray(words[: queries.k])
@@ -242,6 +245,7 @@ class PirServer:
                     self.nu, self.subtree_levels, padded.k // k_shards
                 ),
             )
+        # host-sync: final reply marshalling (PIR answer rows)
         words = np.asarray(fn(*padded.device_args(), self.db_words))
         return (
             np.ascontiguousarray(words[: queries.k])

@@ -118,11 +118,11 @@ def distribute_fast_batch(kb, mesh: Mesh):
     quantum = _fast_pad_quantum(mesh, kb.nu, c)
     padded = _pad_fast_batch(kb, (-kb.k) % quantum)
     host = (
-        np.asarray(padded.seeds),
+        np.asarray(padded.seeds),  # host-sync: host-side key normalization
         np.asarray(padded.ts, dtype=np.uint32),
-        np.asarray(padded.scw),
+        np.asarray(padded.scw),  # host-sync: host-side key normalization
         np.asarray(padded.tcw, dtype=np.uint32),
-        np.asarray(padded.fcw),
+        np.asarray(padded.fcw),  # host-sync: host-side key normalization
     )
     out = []
     for arr, sh in zip(host, _fast_in_shardings(mesh)):
@@ -152,8 +152,11 @@ def distribute_compat_batch(kb, mesh: Mesh):
     n_keys = mesh.shape[KEYS_AXIS]
     dk = DeviceKeys(kb, pad_to=32 * n_keys)
     host = (
+        # host-sync: one-time D2H of the packed key planes for resharding
         np.asarray(dk.seed_planes), np.asarray(dk.t_words),
+        # host-sync: one-time D2H of the packed key planes for resharding
         np.asarray(dk.scw_planes), np.asarray(dk.tl_words),
+        # host-sync: one-time D2H of the packed key planes for resharding
         np.asarray(dk.tr_words), np.asarray(dk.fcw_planes),
     )
     out = []
@@ -185,7 +188,7 @@ def eval_full_distributed_compat(
         from jax.experimental import multihost_utils
 
         words = multihost_utils.process_allgather(words, tiled=True)
-    words = np.asarray(words)
+    words = np.asarray(words)  # host-sync: final reply marshalling
     return np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)
 
 
@@ -211,12 +214,12 @@ def distribute_dcf_batch(kb, mesh: Mesh):
             padk(kb.tcw), padk(kb.vcw), padk(kb.fvcw),
         )
     host = (
-        np.asarray(kb.seeds),
+        np.asarray(kb.seeds),  # host-sync: host-side key normalization
         np.asarray(kb.ts, dtype=np.uint32),
-        np.asarray(kb.scw),
+        np.asarray(kb.scw),  # host-sync: host-side key normalization
         np.asarray(kb.tcw, dtype=np.uint32),
         np.asarray(kb.vcw, dtype=np.uint32),
-        np.asarray(kb.fvcw),
+        np.asarray(kb.fvcw),  # host-sync: host-side key normalization
     )
     keys2 = NamedSharding(mesh, P(KEYS_AXIS, None))
     shardings = (
@@ -253,7 +256,7 @@ def eval_full_distributed(kb, mesh: Mesh, args=None) -> np.ndarray:
         from jax.experimental import multihost_utils
 
         words = multihost_utils.process_allgather(words, tiled=True)
-    words = np.asarray(words)
+    words = np.asarray(words)  # host-sync: final reply marshalling
     return np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)
 
 
@@ -297,7 +300,7 @@ def eval_lt_points_distributed(kb, mesh: Mesh, xs, args=None) -> np.ndarray:
         from jax.experimental import multihost_utils
 
         bits = multihost_utils.process_allgather(bits, tiled=True)
-    return np.asarray(bits).T[:K, :Q]
+    return np.asarray(bits).T[:K, :Q]  # host-sync: final reply marshalling
 
 
 def eval_full_distributed_device(kb, mesh: Mesh, args=None):

@@ -79,6 +79,7 @@ def make_mesh(
             f"mesh {n_keys}x{n_leaf} needs {n_keys * n_leaf} devices, "
             f"have {len(devices)}"
         )
+    # host-sync: host-side device-handle array, not a device tensor
     devs = np.array(devices[: n_keys * n_leaf]).reshape(n_keys, n_leaf)
     return Mesh(devs, (KEYS_AXIS, LEAF_AXIS))
 
@@ -188,6 +189,7 @@ def eval_full_sharded(
     c = leaf_axis_levels(mesh, kb.nu, kb.log_n)
     dk = DeviceKeys(kb, pad_to=32 * n_keys)
     fn = _sharded_eval_full(mesh, kb.nu, c, backend)
+    # host-sync: final reply marshalling (sharded full-domain words)
     words = np.asarray(
         fn(
             dk.seed_planes, dk.t_words, dk.scw_planes,
@@ -318,6 +320,7 @@ def eval_full_sharded_fast(kb, mesh: Mesh) -> np.ndarray:
     padded = _pad_fast_batch(kb, (-kb.k) % quantum)
     entry = _sharded_fast_entry_level(kb.nu, c, padded.k // n_keys)
     fn = _sharded_eval_full_fast(mesh, kb.nu, c, entry)
+    # host-sync: final reply marshalling (sharded full-domain words)
     words = np.asarray(fn(*padded.device_args()))
     return np.ascontiguousarray(words[: kb.k]).view("<u1").reshape(kb.k, -1)
 
@@ -477,6 +480,7 @@ def eval_points_sharded(
         mesh, kbp.nu, kbp.log_n, qp, backend, use_walk, packed
     )
     try:
+        # host-sync: final reply marshalling (sharded pointwise rows)
         out = np.asarray(fn(*_point_masks(kbp), xs_hi, xs_lo))
     except Exception as e:  # noqa: BLE001
         if not use_walk:
@@ -592,6 +596,7 @@ def eval_points_sharded_fast(
     if use_kernel and kb.log_n <= 32:
         xs_hi = jnp.zeros((1, padded.k), jnp.uint32)  # never read
     fn = _sharded_eval_points_fast(mesh, kb.nu, kb.log_n, qt, packed)
+    # host-sync: final reply marshalling (sharded pointwise rows)
     out = np.asarray(fn(*padded.device_args(), xs_hi, xs_lo))
     if packed:
         return bitpack.mask_tail(out[:K], Q)
@@ -688,5 +693,6 @@ def eval_lt_points_sharded(kb, xs: np.ndarray, mesh: Mesh) -> np.ndarray:
     if use_kernel and kb.log_n <= 32:
         xs_hi = jnp.zeros((1, kb.k), jnp.uint32)  # never read
     fn = _sharded_dcf_points(mesh, kb.nu, kb.log_n, qt)
+    # host-sync: final reply marshalling (sharded DCF shares)
     bits = np.asarray(fn(*kb.device_args(), xs_hi, xs_lo))
     return bits.T[:K, :Q]

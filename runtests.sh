@@ -8,10 +8,12 @@
 #       excluded by the default -m; append your own -m to override, e.g.
 #       `./runtests.sh -m slow` for the fused acceptance sweep, or
 #       `./runtests.sh -m ''` for absolutely everything)
-#   ./runtests.sh --lint                 static-analysis lane: the four
+#   ./runtests.sh --lint                 static-analysis lane: the five
 #       repo-native passes (knob registry, secret hygiene, host-sync,
-#       pallas/jit discipline) + docs/KNOBS.md drift + Go vet/fmt when a
-#       toolchain exists — scripts/lint_all.sh, hermetic, no TPU.
+#       pallas/jit discipline, and the oblivious-trace jaxpr verifier
+#       with its certificate drift check) + docs/KNOBS.md drift + mypy
+#       typed-core and Go vet/fmt when those toolchains exist —
+#       scripts/lint_all.sh, hermetic, no TPU.
 #   ./runtests.sh --fast [pytest args]   kernel differential smoke lane:
 #       the Pallas kernel suites (fused + walk + expand routes, interpret
 #       mode), the S-box circuit invariants, the packed<->unpacked
@@ -30,6 +32,7 @@ elif [ "${1:-}" = "--fast" ]; then
       tests/test_fused_expand.py tests/test_aes_bitslice.py \
       tests/test_packed.py tests/test_serving.py \
       tests/test_serving_stress.py tests/test_analysis.py \
+      tests/test_oblivious.py \
       -q -m 'not slow' "$@"
 else
   # -m is last-wins in pytest, so a caller-supplied -m overrides ours.

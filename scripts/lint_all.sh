@@ -2,12 +2,16 @@
 # One-command static-analysis gate (hermetic: CPU jax, no TPU, no axon
 # tunnel — safe in CI and on laptops).  Runs:
 #
-#   1. python -m dpf_tpu.analysis      the four repo-native passes
-#      (knob-registry, secret-hygiene, host-sync, pallas-jit)
+#   1. python -m dpf_tpu.analysis      the five repo-native passes
+#      (knob-registry, secret-hygiene, host-sync, pallas-jit, and the
+#      oblivious-trace jaxpr verifier with its certificate drift check)
 #   2. --check-knobs-doc               docs/KNOBS.md drift vs the registry
-#   3. gofmt -l / go vet               bridge/go hygiene (skipped with a
+#   3. mypy --strict (mypy.ini)        dpf_tpu/core + dpf_tpu/analysis
+#      (skipped with a notice when no mypy is installed)
+#   4. gofmt -l / go vet               bridge/go hygiene (skipped with a
 #      notice when no Go toolchain is installed; bridge/go/conformance.sh
-#      additionally runs `go test -race` against a live sidecar)
+#      additionally runs staticcheck + `go test -race` against a live
+#      sidecar)
 #
 # Exits nonzero on ANY finding.  Wired into `./runtests.sh --lint`.
 set -e
@@ -22,6 +26,16 @@ status=0
 
 run_py -m dpf_tpu.analysis || status=1
 run_py -m dpf_tpu.analysis --check-knobs-doc || status=1
+
+# Gate on the module, not a PATH binary: the lane runs `python -m mypy`,
+# and a pipx/system mypy outside this python's env must still skip.
+if run_py -m mypy --version >/dev/null 2>&1; then
+  run_py -m mypy --config-file mypy.ini dpf_tpu/core dpf_tpu/analysis \
+    || status=1
+else
+  echo "lint_all.sh: no mypy; skipping the typed-core lane" \
+       "(pip install mypy, then re-run)" >&2
+fi
 
 if command -v go >/dev/null 2>&1; then
   unformatted="$(gofmt -l bridge/go 2>/dev/null || true)"

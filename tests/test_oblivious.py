@@ -1,0 +1,182 @@
+"""The jaxpr-level oblivious-dataflow verifier: real production routes
+verify clean, certificates don't drift, and tracing never pollutes a
+compile cache.
+
+Cheap subset in the default lane (fast-profile XLA routes, <1 s each);
+the full route matrix — every entrypoint x profile x packed x fuse,
+including the Pallas kernel traces — is marked ``slow`` (it re-traces
+~25 graphs, minutes of jax tracing) and also runs on every lint-lane
+invocation (``python -m dpf_tpu.analysis``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from dpf_tpu.analysis.common import repo_root
+from dpf_tpu.analysis.trace import OBLIVIOUS_VERIFIER_VERSION
+from dpf_tpu.analysis.trace import certify
+from dpf_tpu.analysis.trace.entrypoints import ROUTES, vmem_budgets
+from dpf_tpu.analysis.trace.taint import analyze, jaxpr_hash
+
+ROOT = repo_root()
+
+# Routes cheap enough for the default lane (sub-second traces); the
+# pallas/fused/compat-bitsliced routes are covered by the slow test and
+# the lint lane.
+_CHEAP = (
+    "points/fast/xla/bits",
+    "points/fast/xla/packed",
+    "evalfull/fast/xla",
+    "evalfull_stream/fast",
+    "dcf_points/xla/packed",
+    "ge_full/compat",
+)
+
+
+def _committed():
+    with open(os.path.join(ROOT, "docs", "oblivious.json")) as f:
+        return json.load(f)
+
+
+def _route(name):
+    (r,) = [r for r in ROUTES if r.name == name]
+    return r
+
+
+# ---------------------------------------------------------------------------
+# Default lane
+# ---------------------------------------------------------------------------
+
+
+def test_route_names_unique_and_certified():
+    names = [r.name for r in ROUTES]
+    assert len(names) == len(set(names))
+    committed = _committed()
+    assert committed["verifier_version"] == OBLIVIOUS_VERIFIER_VERSION
+    assert sorted(committed["routes"]) == sorted(names), (
+        "docs/oblivious.json route set drifted from the matrix — "
+        "re-certify with 'python -m dpf_tpu.analysis --write-oblivious'"
+    )
+    for name, cert in committed["routes"].items():
+        for field in ("entrypoint", "jaxpr_sha256", "census", "n_eqns",
+                      "knobs", "plan_route"):
+            assert field in cert, (name, field)
+        assert not any(
+            p in cert["census"]
+            for p in ("pure_callback", "io_callback", "debug_callback",
+                      "debug_print")
+        ), f"{name}: a certified route census lists a host callback"
+
+
+def test_oblivious_md_in_sync_with_sidecar():
+    committed = _committed()
+    with open(os.path.join(ROOT, "docs", "OBLIVIOUS.md")) as f:
+        md = f.read()
+    assert md == certify.render_markdown(committed["routes"]), (
+        "docs/OBLIVIOUS.md is stale vs docs/oblivious.json — re-certify "
+        "with 'python -m dpf_tpu.analysis --write-oblivious'"
+    )
+
+
+@pytest.mark.parametrize("name", _CHEAP)
+def test_cheap_route_clean_and_hash_pinned(name):
+    """The default-lane drift check: these routes re-trace in well under
+    a second; a hash mismatch against the committed certificate means an
+    entrypoint changed without re-certification."""
+    route = _route(name)
+    closed, secret = route.build()
+    report = analyze(closed, secret, vmem_budgets())
+    assert report.findings == [], [
+        (f.kind, f.message) for f in report.findings
+    ]
+    assert secret, f"{name}: route declares no secret operands"
+    committed = _committed()["routes"][name]
+    assert jaxpr_hash(closed) == committed["jaxpr_sha256"], (
+        f"{name}: traced jaxpr hash drifted from the committed "
+        "certificate — re-certify with "
+        "'python -m dpf_tpu.analysis --write-oblivious'"
+    )
+
+
+def test_jaxpr_hash_sees_semantic_changes():
+    """The drift signal must not have false negatives on semantic edits
+    that keep the primitive/aval skeleton: operand rewiring, inline
+    literal changes, and swapped closed-over constant tables all
+    produce distinct hashes; re-tracing the same function does not."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    a = jnp.arange(8, dtype=jnp.uint32)
+    b = jnp.arange(8, dtype=jnp.uint32)
+
+    def h(fn, *args):
+        return jaxpr_hash(jax.make_jaxpr(fn)(*args))
+
+    assert h(lambda x, y: x ^ y, a, b) != h(lambda x, y: x ^ x, a, b)
+    assert h(lambda x: x + 3, a) != h(lambda x: x + 7, a)
+    t1 = np.arange(8, dtype=np.uint32)
+    t2 = t1 + 1
+    assert h(lambda x: x ^ jnp.asarray(t1), a) != h(
+        lambda x: x ^ jnp.asarray(t2), a
+    )
+    assert h(lambda x, y: x ^ y, a, b) == h(lambda x, y: x ^ y, a, b)
+
+
+def test_tracing_does_not_pollute_compile_caches():
+    """The verifier traces UNWRAPPED jit bodies: core.plans.trace_count
+    (compiled-executable census across the package) must not move."""
+    from dpf_tpu.core import plans
+
+    before = plans.trace_count()
+    closed, secret = _route("evalfull/fast/xla").build()
+    analyze(closed, secret)
+    assert plans.trace_count() == before
+
+
+def test_walk_kernel_route_contains_pallas_call():
+    """The kernel routes certify the actual Pallas kernel graphs, not an
+    XLA stand-in: the traced census must include pallas_call."""
+    closed, secret = _route("points/fast/walk/packed").build()
+    report = analyze(closed, secret, vmem_budgets())
+    assert report.findings == []
+    assert report.census.get("pallas_call", 0) >= 1
+    assert (
+        _committed()["routes"]["points/fast/walk/packed"]["census"].get(
+            "pallas_call", 0
+        )
+        >= 1
+    )
+
+
+def test_verifier_version_stamped_in_ledger_key(monkeypatch):
+    import sys
+
+    monkeypatch.setenv("DPF_TPU_BENCH_LEDGER_KEY", "pinned")
+    sys.path.insert(0, ROOT)
+    try:
+        import bench_all
+
+        key = bench_all._ledger_key("small")
+    finally:
+        sys.path.remove(ROOT)
+    assert key["oblivious"] == OBLIVIOUS_VERIFIER_VERSION
+
+
+# ---------------------------------------------------------------------------
+# Full matrix (slow: ~25 traced graphs)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_full_matrix_clean_and_no_drift():
+    certs, findings = certify.verify_routes()
+    assert findings == [], [
+        (name, f.kind, f.message) for name, f in findings
+    ]
+    assert sorted(certs) == sorted(r.name for r in ROUTES)
+    assert certify.drift(ROOT, certs) == []
