@@ -1,0 +1,182 @@
+// loadgen drives OPEN-LOOP load at a dpf_tpu sidecar through the pooled
+// dpftpu client — the harness behind the bench_all overload section's
+// hardware rows and the tool for answering "what does OUR deployment do
+// at 4x capacity?" against a real TPU.
+//
+// Open loop means arrivals are scheduled by a clock, not by completions:
+// a closed-loop client (fixed workers waiting for replies) slows itself
+// down exactly when the server is slow, hiding the overload it is meant
+// to measure (coordinated omission).  Here a ticker fires at -rps
+// regardless of in-flight work; when the in-flight cap is hit the
+// arrival is counted as client_dropped rather than silently delayed.
+//
+// The sidecar's load-survival contract is what this measures: accepted
+// requests' p50/p99, goodput (accepted/sec), and the shed rate (429/503
+// structured replies with Retry-After).  A healthy deployment at 4x
+// capacity keeps p99 bounded and converts the excess into sheds — it
+// does not collapse into timeouts.
+//
+// Usage:
+//
+//	loadgen -url http://127.0.0.1:8990 -rps 200 -duration 10s \
+//	        -logn 10 -q 64 -profile fast -deadline-ms 500
+//
+// Output: one JSON object on stdout (bench-ledger-shaped).
+package main
+
+import (
+	"encoding/json"
+	"errors"
+	"flag"
+	"fmt"
+	"math/rand"
+	"os"
+	"sort"
+	"sync"
+	"sync/atomic"
+	"time"
+
+	"github.com/dpf-tpu/bridge/go/dpftpu"
+)
+
+type result struct {
+	OfferedRPS    float64 `json:"offered_rps"`
+	DurationS     float64 `json:"duration_s"`
+	Sent          int64   `json:"sent"`
+	OK            int64   `json:"ok"`
+	Shed          int64   `json:"shed"`
+	Deadline      int64   `json:"deadline"`
+	Errors        int64   `json:"errors"`
+	ClientDropped int64   `json:"client_dropped"`
+	GoodputRPS    float64 `json:"goodput_rps"`
+	ShedRate      float64 `json:"shed_rate"`
+	P50Ms         float64 `json:"p50_ms"`
+	P99Ms         float64 `json:"p99_ms"`
+	RetryAfterP50 float64 `json:"retry_after_p50_s"`
+}
+
+func percentile(sorted []float64, p float64) float64 {
+	if len(sorted) == 0 {
+		return 0
+	}
+	i := int(float64(len(sorted)) * p)
+	if i >= len(sorted) {
+		i = len(sorted) - 1
+	}
+	return sorted[i]
+}
+
+func main() {
+	url := flag.String("url", "http://127.0.0.1:8990", "sidecar base URL")
+	rps := flag.Float64("rps", 100, "offered arrival rate, requests/sec")
+	duration := flag.Duration("duration", 10*time.Second, "run length")
+	logN := flag.Uint("logn", 10, "domain log2 size")
+	q := flag.Int("q", 64, "queries per request")
+	profile := flag.String("profile", "fast", "evaluation profile")
+	deadlineMs := flag.Int("deadline-ms", 0, "per-request deadline header (0 = none)")
+	maxInflight := flag.Int("max-inflight", 512, "in-flight cap; arrivals past it count as client_dropped")
+	seed := flag.Int64("seed", 2026, "query RNG seed")
+	flag.Parse()
+
+	c := dpftpu.New(*url)
+	c.Profile = *profile
+	c.DeadlineMs = *deadlineMs
+
+	// One key pair + a fixed query row: the load is the serving stack's
+	// dispatch path, not Gen.
+	ka, _, err := c.Gen(uint64(rand.New(rand.NewSource(*seed)).Int63n(int64(1)<<*logN)), *logN)
+	if err != nil {
+		fmt.Fprintf(os.Stderr, "loadgen: gen: %v\n", err)
+		os.Exit(1)
+	}
+	rng := rand.New(rand.NewSource(*seed + 1))
+	xs := [][]uint64{make([]uint64, *q)}
+	for j := range xs[0] {
+		xs[0][j] = uint64(rng.Int63n(int64(1) << *logN))
+	}
+	keys := []dpftpu.DPFkey{ka}
+
+	var sent, ok, shed, deadline, errCount, dropped, inflight int64
+	var mu sync.Mutex
+	var lats []float64
+	var retryAfters []float64
+	var wg sync.WaitGroup
+
+	interval := time.Duration(float64(time.Second) / *rps)
+	ticker := time.NewTicker(interval)
+	defer ticker.Stop()
+	stop := time.After(*duration)
+	start := time.Now()
+
+loop:
+	for {
+		select {
+		case <-stop:
+			break loop
+		case <-ticker.C:
+			if atomic.LoadInt64(&inflight) >= int64(*maxInflight) {
+				atomic.AddInt64(&dropped, 1)
+				continue
+			}
+			atomic.AddInt64(&sent, 1)
+			atomic.AddInt64(&inflight, 1)
+			wg.Add(1)
+			go func() {
+				defer wg.Done()
+				defer atomic.AddInt64(&inflight, -1)
+				t0 := time.Now()
+				_, err := c.EvalPointsBatchPacked(keys, xs, *logN)
+				dt := time.Since(t0).Seconds()
+				if err == nil {
+					atomic.AddInt64(&ok, 1)
+					mu.Lock()
+					lats = append(lats, dt)
+					mu.Unlock()
+					return
+				}
+				var apiErr *dpftpu.APIError
+				if errors.As(err, &apiErr) {
+					switch apiErr.Status {
+					case 429, 503:
+						atomic.AddInt64(&shed, 1)
+						mu.Lock()
+						retryAfters = append(retryAfters, apiErr.RetryAfter)
+						mu.Unlock()
+						return
+					case 504:
+						atomic.AddInt64(&deadline, 1)
+						return
+					}
+				}
+				atomic.AddInt64(&errCount, 1)
+			}()
+		}
+	}
+	wg.Wait()
+	elapsed := time.Since(start).Seconds()
+
+	sort.Float64s(lats)
+	sort.Float64s(retryAfters)
+	res := result{
+		OfferedRPS:    *rps,
+		DurationS:     elapsed,
+		Sent:          sent,
+		OK:            ok,
+		Shed:          shed,
+		Deadline:      deadline,
+		Errors:        errCount,
+		ClientDropped: dropped,
+		GoodputRPS:    float64(ok) / elapsed,
+		P50Ms:         percentile(lats, 0.50) * 1e3,
+		P99Ms:         percentile(lats, 0.99) * 1e3,
+		RetryAfterP50: percentile(retryAfters, 0.50),
+	}
+	if sent > 0 {
+		res.ShedRate = float64(shed) / float64(sent)
+	}
+	out, _ := json.Marshal(res)
+	fmt.Println(string(out))
+	if errCount > 0 {
+		os.Exit(2)
+	}
+}

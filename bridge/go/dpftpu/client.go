@@ -20,9 +20,11 @@ package dpftpu
 import (
 	"bytes"
 	"encoding/binary"
+	"encoding/json"
 	"fmt"
 	"io"
 	"net/http"
+	"strconv"
 	"time"
 )
 
@@ -33,10 +35,59 @@ type DPFkey []byte
 // Client talks to one dpf_tpu sidecar.  Profile selects the evaluation
 // profile: "compat" (reference-key-compatible AES-MMO; default) or "fast"
 // (the TPU-native ChaCha profile — keys are NOT reference-compatible).
+//
+// DeadlineMs, when positive, is sent as the X-DPF-Deadline-Ms header on
+// every request: the sidecar cancels work whose deadline expires while
+// queued (before it burns a device slot) and answers 504 — the
+// load-survival contract that keeps p99 bounded under overload.
 type Client struct {
-	BaseURL string
-	Profile string
-	HTTP    *http.Client
+	BaseURL    string
+	Profile    string
+	DeadlineMs int
+	HTTP       *http.Client
+}
+
+// APIError is a structured non-200 sidecar reply.  The load-survival
+// layer answers with {code, detail} JSON bodies: code "shed" (429, past
+// an admission watermark), "unavailable" (503, device circuit open),
+// "deadline" (504), "bad_request" (400), or "internal" (500).
+// RetryAfter carries the parsed Retry-After header in seconds (0 when
+// absent) — the sidecar derives it from observed dispatch latency, so
+// honoring it is the fastest route back to goodput.
+type APIError struct {
+	Status     int
+	Code       string
+	Detail     string
+	RetryAfter float64
+}
+
+func (e *APIError) Error() string {
+	return fmt.Sprintf("dpftpu: %d %s: %s", e.Status, e.Code, e.Detail)
+}
+
+// Temporary reports whether backing off and retrying is expected to
+// succeed (shed / open-circuit / missed-deadline replies).
+func (e *APIError) Temporary() bool {
+	return e.Status == http.StatusTooManyRequests ||
+		e.Status == http.StatusServiceUnavailable ||
+		e.Status == http.StatusGatewayTimeout
+}
+
+func newAPIError(resp *http.Response, body []byte) *APIError {
+	e := &APIError{Status: resp.StatusCode, Detail: string(body)}
+	var parsed struct {
+		Code   string `json:"code"`
+		Detail string `json:"detail"`
+	}
+	if json.Unmarshal(body, &parsed) == nil && parsed.Code != "" {
+		e.Code, e.Detail = parsed.Code, parsed.Detail
+	}
+	if ra := resp.Header.Get("Retry-After"); ra != "" {
+		if v, err := strconv.ParseFloat(ra, 64); err == nil {
+			e.RetryAfter = v
+		}
+	}
+	return e
 }
 
 // New returns a client for the sidecar at baseURL (e.g.
@@ -68,22 +119,49 @@ func New(baseURL string) *Client {
 
 func (c *Client) post(path string, body []byte) ([]byte, error) {
 	url := c.BaseURL + path + "&profile=" + c.Profile
-	resp, err := c.HTTP.Post(url, "application/octet-stream",
-		bytes.NewReader(body))
+	req, err := http.NewRequest(http.MethodPost, url, bytes.NewReader(body))
+	if err != nil {
+		return nil, fmt.Errorf("dpftpu: %w", err)
+	}
+	req.Header.Set("Content-Type", "application/octet-stream")
+	if c.DeadlineMs > 0 {
+		req.Header.Set("X-DPF-Deadline-Ms", strconv.Itoa(c.DeadlineMs))
+	}
+	resp, err := c.HTTP.Do(req)
 	if err != nil {
 		return nil, fmt.Errorf("dpftpu: %w", err)
 	}
 	defer resp.Body.Close()
 	out, err := io.ReadAll(resp.Body)
 	if err != nil {
+		// A short body against the declared Content-Length (the
+		// sidecar RSTs the connection on a mid-stream dispatch
+		// failure) surfaces here as unexpected EOF / connection reset:
+		// truncation is always a loud error, never a silent short read.
 		return nil, fmt.Errorf("dpftpu: reading response: %w", err)
 	}
 	if resp.StatusCode != http.StatusOK {
-		// The sidecar reports evaluation errors as 400 + text reason —
-		// surfaced here as a Go error, never a panic (SURVEY §5.3).
-		return nil, fmt.Errorf("dpftpu: %s: %s", resp.Status, out)
+		// Structured {code, detail} JSON errors (429/503/504/400/500)
+		// surface as *APIError — a Go error, never a panic (SURVEY
+		// §5.3); errors.As recovers status/code/Retry-After.
+		return nil, newAPIError(resp, out)
 	}
 	return out, nil
+}
+
+// expansionBytes is the sidecar's EvalFull output-row contract:
+// 2^(logN-3) bytes with the profile's leaf-width floor (compat 16,
+// fast 64) — dpf_tpu/server.py:_evalfull_out_bytes.
+func expansionBytes(logN uint, profile string) int {
+	n := (1 << logN) / 8
+	floor := 16
+	if profile == "fast" {
+		floor = 64
+	}
+	if n < floor {
+		n = floor
+	}
+	return n
 }
 
 // Gen generates a key pair hiding alpha in [0, 2^logN), mirroring the
@@ -118,9 +196,20 @@ func (c *Client) Eval(k DPFkey, x uint64, logN uint) (byte, error) {
 
 // EvalFull expands one share over the whole domain, mirroring the reference
 // EvalFull (dpf/dpf.go:243): returns 2^(logN-3) bit-packed bytes (bit x at
-// byte x/8, bit x%8 — the reference's LSB-first layout).
+// byte x/8, bit x%8 — the reference's LSB-first layout).  The reply length
+// is validated against the profile's output contract, so a truncated (or
+// corrupt) streamed body can never pass as a short-but-valid expansion.
 func (c *Client) EvalFull(k DPFkey, logN uint) ([]byte, error) {
-	return c.post(fmt.Sprintf("/v1/evalfull?log_n=%d", logN), k)
+	out, err := c.post(fmt.Sprintf("/v1/evalfull?log_n=%d", logN), k)
+	if err != nil {
+		return nil, err
+	}
+	if want := expansionBytes(logN, c.Profile); len(out) != want {
+		return nil, fmt.Errorf(
+			"dpftpu: evalfull reply is %d bytes, want %d (truncated or corrupt)",
+			len(out), want)
+	}
+	return out, nil
 }
 
 // pointsBody serializes K keys plus their K*Q little-endian query indices
@@ -361,10 +450,12 @@ func (c *Client) EvalFullBatch(keys []DPFkey, logN uint) ([][]byte, error) {
 	if err != nil {
 		return nil, err
 	}
-	if len(out)%len(keys) != 0 {
-		return nil, fmt.Errorf("dpftpu: bad batch reply length %d", len(out))
+	per := expansionBytes(logN, c.Profile)
+	if len(out) != per*len(keys) {
+		return nil, fmt.Errorf(
+			"dpftpu: batch reply is %d bytes, want %d*%d (truncated or corrupt)",
+			len(out), len(keys), per)
 	}
-	per := len(out) / len(keys)
 	res := make([][]byte, len(keys))
 	for i := range keys {
 		res[i] = out[i*per : (i+1)*per]

@@ -14,12 +14,14 @@ import (
 	"bytes"
 	"crypto/sha256"
 	"encoding/hex"
+	"errors"
 	"fmt"
 	"io"
 	"net"
 	"net/http"
 	"net/http/httptest"
 	"os"
+	"strings"
 	"sync"
 	"testing"
 	"time"
@@ -246,6 +248,119 @@ func TestConformancePointsPackedAndUnpacked(t *testing.T) {
 		if ra^rb != want {
 			t.Fatalf("packed reconstruction at query %d", j)
 		}
+	}
+}
+
+// TestStructuredErrorParsing pins the load-survival error contract: a
+// 429 shed reply with a {code, detail} JSON body and a Retry-After
+// header must surface as *APIError with every field recovered — that is
+// what lets a client (the loadgen, a production caller) distinguish
+// "back off and retry" from "your request is malformed".
+func TestStructuredErrorParsing(t *testing.T) {
+	srv := httptest.NewServer(http.HandlerFunc(
+		func(w http.ResponseWriter, r *http.Request) {
+			io.Copy(io.Discard, r.Body)
+			w.Header().Set("Content-Type", "application/json")
+			w.Header().Set("Retry-After", "2")
+			w.WriteHeader(http.StatusTooManyRequests)
+			w.Write([]byte(`{"code": "shed", "detail": "lane queue full"}`))
+		}))
+	defer srv.Close()
+	c := New(srv.URL)
+	_, err := c.Eval(DPFkey{1}, 0, 10)
+	var apiErr *APIError
+	if !errors.As(err, &apiErr) {
+		t.Fatalf("want *APIError, got %T: %v", err, err)
+	}
+	if apiErr.Status != 429 || apiErr.Code != "shed" ||
+		apiErr.Detail != "lane queue full" || apiErr.RetryAfter != 2 {
+		t.Fatalf("APIError fields not recovered: %+v", apiErr)
+	}
+	if !apiErr.Temporary() {
+		t.Fatal("429 shed must classify as Temporary")
+	}
+	// Legacy/plain-text error bodies still produce a usable error.
+	srv2 := httptest.NewServer(http.HandlerFunc(
+		func(w http.ResponseWriter, r *http.Request) {
+			io.Copy(io.Discard, r.Body)
+			http.Error(w, "ValueError: bad body", http.StatusBadRequest)
+		}))
+	defer srv2.Close()
+	_, err = New(srv2.URL).Eval(DPFkey{1}, 0, 10)
+	if !errors.As(err, &apiErr) || apiErr.Status != 400 ||
+		!strings.Contains(apiErr.Detail, "bad body") {
+		t.Fatalf("plain-text error not preserved: %v", err)
+	}
+	if apiErr.Temporary() {
+		t.Fatal("400 must not classify as Temporary")
+	}
+}
+
+// TestEvalFullTruncationDetected pins the mid-stream-failure contract
+// from the client side: a body shorter than the declared Content-Length
+// (the sidecar hard-aborts the connection on a mid-stream dispatch
+// error) must be an error, never a silently short expansion.
+func TestEvalFullTruncationDetected(t *testing.T) {
+	const logN = 10
+	srv := httptest.NewServer(http.HandlerFunc(
+		func(w http.ResponseWriter, r *http.Request) {
+			io.Copy(io.Discard, r.Body)
+			w.Header().Set("Content-Length", "128")
+			w.WriteHeader(http.StatusOK)
+			w.Write(make([]byte, 64)) // half the declared body, then close
+		}))
+	defer srv.Close()
+	if _, err := New(srv.URL).EvalFull(DPFkey{1}, logN); err == nil {
+		t.Fatal("truncated EvalFull body must be an error")
+	}
+}
+
+// TestEvalFullLengthChecked covers the other truncation shape: a
+// complete (Content-Length-consistent) reply of the WRONG length for
+// the profile's expansion contract must also fail.
+func TestEvalFullLengthChecked(t *testing.T) {
+	srv := httptest.NewServer(http.HandlerFunc(
+		func(w http.ResponseWriter, r *http.Request) {
+			io.Copy(io.Discard, r.Body)
+			w.Write(make([]byte, 5))
+		}))
+	defer srv.Close()
+	c := New(srv.URL)
+	if _, err := c.EvalFull(DPFkey{1}, 10); err == nil ||
+		!strings.Contains(err.Error(), "want 128") {
+		t.Fatalf("wrong-length EvalFull must fail the 128-byte contract, got %v",
+			err)
+	}
+	if _, err := c.EvalFullBatch([]DPFkey{{1}, {2}}, 10); err == nil {
+		t.Fatal("wrong-length EvalFullBatch must fail the contract")
+	}
+}
+
+// TestDeadlineHeaderSent pins the client half of the deadline contract.
+func TestDeadlineHeaderSent(t *testing.T) {
+	var mu sync.Mutex
+	got := []string{}
+	srv := httptest.NewServer(http.HandlerFunc(
+		func(w http.ResponseWriter, r *http.Request) {
+			io.Copy(io.Discard, r.Body)
+			mu.Lock()
+			got = append(got, r.Header.Get("X-DPF-Deadline-Ms"))
+			mu.Unlock()
+			w.Write([]byte{0})
+		}))
+	defer srv.Close()
+	c := New(srv.URL)
+	if _, err := c.Eval(DPFkey{1}, 0, 10); err != nil {
+		t.Fatal(err)
+	}
+	c.DeadlineMs = 250
+	if _, err := c.Eval(DPFkey{1}, 0, 10); err != nil {
+		t.Fatal(err)
+	}
+	mu.Lock()
+	defer mu.Unlock()
+	if got[0] != "" || got[1] != "250" {
+		t.Fatalf("deadline headers %v, want [\"\" \"250\"]", got)
 	}
 }
 

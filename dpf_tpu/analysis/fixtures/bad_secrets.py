@@ -22,6 +22,13 @@ def stats(blob):
     return {"last_key": blob}
 
 
+def leak_in_error_reply(handler, key_bytes):
+    # Request key bytes echoed into an HTTP error body (the _bad()/500
+    # reply path): the client on the other side is the OTHER party of
+    # the secret-sharing, so this breaks the two-server trust split.
+    handler._bad(f"cannot parse key {key_bytes!r}")
+
+
 def sanctioned(blob):
     # CLEAN: the sha256 digest is the sanctioned way to index key bytes
     # (serving/keycache.py); len() is public metadata.

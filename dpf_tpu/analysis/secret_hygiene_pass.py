@@ -19,7 +19,10 @@ intra-function forward taint.
              inside ``raise``; return values of stats-shaped functions
              (``stats``/``stats_dict``/``stats_snapshot``/``as_dict``
              — the /v1/stats surface); calls whose name mentions the
-             bench ``ledger``.
+             bench ``ledger``; error-reply calls (``_bad`` /
+             ``_reply_error`` / ``send_error`` — the sidecar's 4xx/5xx
+             bodies cross the bridge to the OTHER party, so request key
+             bytes in one break the two-server trust split).
   sanitizers subtrees that reduce a secret to public data stop the
              taint: ``len()``/``type()``, shape/count attributes
              (``.shape``, ``.k``, ``.log_n``, ...), and ``hashlib``
@@ -77,6 +80,9 @@ _LOG_METHODS = frozenset(
     {"debug", "info", "warning", "warn", "error", "exception", "critical",
      "log"}
 )
+# Error-reply surfaces (server.py): anything in their arguments becomes
+# an HTTP error body on the wire.
+_ERROR_REPLY_FUNCS = frozenset({"_bad", "_reply_error", "send_error"})
 
 
 def _is_sanitizer_call(node: ast.Call) -> bool:
@@ -249,11 +255,16 @@ def _check_scope(rel: str, body: list[ast.stmt], params: set[str],
                     _taint_target(tgt, tainted)
 
         elif isinstance(sub, ast.Call):
-            if _is_log_call(sub) or _is_ledger_call(sub):
-                where = (
-                    "logging/console" if _is_log_call(sub)
-                    else "bench ledger"
-                )
+            if (
+                _is_log_call(sub) or _is_ledger_call(sub)
+                or _call_name(sub) in _ERROR_REPLY_FUNCS
+            ):
+                if _is_log_call(sub):
+                    where = "logging/console"
+                elif _is_ledger_call(sub):
+                    where = "bench ledger"
+                else:
+                    where = "an error-reply body"
                 for arg in list(sub.args) + [
                     kw.value for kw in sub.keywords
                 ]:

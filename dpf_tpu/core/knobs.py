@@ -176,6 +176,77 @@ _declare(
     "dpf_tpu/server.py",
 )
 
+# Load survival: admission control, deadlines, circuit breaker, faults ------
+_declare(
+    "DPF_TPU_BATCH_TIMEOUT_S", "float", "600",
+    "Hard wall-clock bound a request waits on its batcher lane before "
+    "failing (the last-resort backstop behind the deadline machinery).",
+    "dpf_tpu/serving/batcher.py",
+)
+_declare(
+    "DPF_TPU_QUEUE_MAX_DEPTH", "int", "256",
+    "Admission watermark: requests queued per batcher lane beyond which "
+    "new arrivals are shed with 429 + Retry-After instead of queuing.",
+    "dpf_tpu/serving/batcher.py",
+)
+_declare(
+    "DPF_TPU_QUEUE_MAX_AGE_MS", "float", "2000",
+    "Age watermark: when the oldest queued request on a lane is older "
+    "than this, the lane is backed up and new arrivals are shed (429).",
+    "dpf_tpu/serving/batcher.py",
+)
+_declare(
+    "DPF_TPU_DEADLINE_MS", "float", "0",
+    "Default per-request deadline for serving routes when the client "
+    "sends no X-DPF-Deadline-Ms header (0 = no default deadline).",
+    "dpf_tpu/server.py",
+)
+_declare(
+    "DPF_TPU_DISPATCH_RETRIES", "int", "2",
+    "Transparent retries of a dispatch that failed with a TRANSIENT "
+    "signature (UNAVAILABLE / transport errors) before the failure "
+    "counts toward the circuit breaker.",
+    "dpf_tpu/serving/breaker.py",
+)
+_declare(
+    "DPF_TPU_RETRY_BACKOFF_MS", "float", "50",
+    "Base backoff between transient-dispatch retries, milliseconds "
+    "(doubles per attempt, capped at 1000 ms).",
+    "dpf_tpu/serving/breaker.py",
+)
+_declare(
+    "DPF_TPU_BREAKER_THRESHOLD", "int", "3",
+    "Consecutive transient dispatch failures (after retries) that trip "
+    "the device circuit breaker open.",
+    "dpf_tpu/serving/breaker.py",
+)
+_declare(
+    "DPF_TPU_BREAKER_COOLDOWN_MS", "float", "1000",
+    "Open-circuit cooldown before a half-open trial dispatch is allowed "
+    "(also the background probe's re-warm period).",
+    "dpf_tpu/serving/breaker.py",
+)
+_declare(
+    "DPF_TPU_BREAKER_PROBE", "bool", "on",
+    "Background probe thread while the breaker is open: re-warms the "
+    "plan cache and moves the breaker to half-open on success "
+    "(off = time-based half-open only, used by deterministic tests).",
+    "dpf_tpu/serving/breaker.py",
+)
+_declare(
+    "DPF_TPU_FAULTS", "str", "",
+    "Fault-injection spec (serving/faults.py): semicolon-separated "
+    "site:kind[:ms=V][:times=N][:after=N] clauses; refused outside "
+    "pytest unless DPF_TPU_FAULTS_ALLOW is set.  Empty = no faults.",
+    "dpf_tpu/serving/faults.py", values="<site:kind[:opts];...>",
+)
+_declare(
+    "DPF_TPU_FAULTS_ALLOW", "flag", "",
+    "Explicit opt-in that lets DPF_TPU_FAULTS activate outside a pytest "
+    "process (the bench overload section's injected-latency runs).",
+    "dpf_tpu/serving/faults.py",
+)
+
 # Bench harness --------------------------------------------------------------
 _declare(
     "DPF_TPU_BENCH_BACKOFF", "float", "10",

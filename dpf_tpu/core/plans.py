@@ -458,3 +458,39 @@ def warmup(shapes: list[dict]) -> list[dict]:
             }
         )
     return out
+
+
+def recent_shapes(limit: int = 4) -> list[dict]:
+    """Warmup-style shape specs of the most recently used plans — what a
+    recovering deployment was actually serving.  The circuit breaker's
+    background probe re-warms exactly these (serving/breaker.py) so the
+    half-open trial request lands on compiled executables, not a
+    recompile."""
+    with _CACHE._lock:
+        recent = sorted(
+            _CACHE._plans.values(), key=lambda p: p.last_used, reverse=True
+        )[: max(int(limit), 0)]
+    out = []
+    for p in recent:
+        key = p.key
+        spec = {
+            "route": key.route,
+            "profile": key.profile,
+            "log_n": key.log_n,
+            "k": key.k_bucket,
+        }
+        if key.q_bucket:
+            spec["q"] = key.q_bucket
+        out.append(spec)
+    return out
+
+
+def rewarm_recent(limit: int = 4) -> int:
+    """Re-drive the most recently used plans through ``warmup`` (a real
+    device dispatch per plan — this IS the breaker's recovery probe: it
+    fails while the device is still wedged and leaves the plan cache hot
+    when it succeeds).  Returns the number of shapes warmed."""
+    shapes = recent_shapes(limit)
+    if shapes:
+        warmup(shapes)
+    return len(shapes)

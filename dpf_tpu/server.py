@@ -55,9 +55,12 @@ Endpoints (all POST, binary bodies, profile/params in the query string):
   /v1/stats (GET)                             -> JSON observability:
         plan-cache hit/miss + live trace count, micro-batcher
         coalescing (requests, dispatches, batch_coalesced mean/max,
-        queue-wait), key-repack LRU hits, and per-phase timers
-        (queue_wait, pack, dispatch, compute, d2h, reply —
-        utils/profiling.PhaseTimer).
+        queue-wait) plus load-survival counters (shed_depth/shed_age,
+        expired_queue vs expired_flight, dispatch EWMA), key-repack LRU
+        hits, circuit-breaker state (closed|open|half_open, trips,
+        retries, fast-fails), active fault-injection clauses (when any),
+        and per-phase timers (queue_wait, pack, dispatch, compute, d2h,
+        reply — utils/profiling.PhaseTimer).
 
 Serving fast path (the request pipeline for the pointwise/DCF/interval
 endpoints):
@@ -79,8 +82,13 @@ env knob (bits).  Packed responses follow the core/bitpack contract —
 clients unpack with ``bitpack.unpack_bits`` / ``dpftpu.UnpackBits``.
 
 Batched endpoints amortize the device dispatch exactly like the in-process
-batch API; errors surface as HTTP 400 with a text reason (clean error
-propagation across the bridge — SURVEY §5.3 — never a crashed server).
+batch API; errors surface as structured ``{code, detail}`` JSON (clean
+error propagation across the bridge — SURVEY §5.3 — never a crashed
+server): 400 bad_request for validation, 429 shed past an admission
+watermark, 503 unavailable while the device circuit breaker is open (both
+with Retry-After derived from observed dispatch latency), 504 deadline
+when a request's ``X-DPF-Deadline-Ms`` budget expires, 500 internal with
+the exception TYPE only (reprs can embed key material; see DESIGN §11).
 
 Run: ``python -m dpf_tpu.server --port 8990``.
 """
@@ -90,6 +98,9 @@ from __future__ import annotations
 import argparse
 import contextlib
 import json
+import math
+import socket
+import struct
 import threading
 import time
 import warnings
@@ -99,9 +110,16 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from .core import bitpack, knobs, plans
-from .serving import Batcher, IntervalWork, KeyCache, PointsWork
+from .serving import Batcher, IntervalWork, KeyCache, PointsWork, faults
 from .serving.batcher import dispatch_interval, dispatch_points
+from .serving.breaker import CircuitBreaker, is_transient
+from .serving.errors import DeadlineError, ServingError
 from .utils.profiling import PhaseTimer
+
+# Per-request deadline header: remaining budget in milliseconds.  The
+# ``DPF_TPU_DEADLINE_MS`` knob sets the server default for requests that
+# omit it (0 = no default deadline).
+DEADLINE_HEADER = "X-DPF-Deadline-Ms"
 
 
 def _wire_format(q: dict) -> bool:
@@ -112,6 +130,27 @@ def _wire_format(q: dict) -> bool:
     if fmt not in ("bits", "packed"):
         raise ValueError(f"unknown format {fmt!r} (use bits|packed)")
     return fmt == "packed"
+
+
+def _deadline_from(headers) -> float | None:
+    """Resolve the request's absolute deadline (perf_counter seconds) or
+    None: the ``X-DPF-Deadline-Ms`` header wins, the DPF_TPU_DEADLINE_MS
+    knob is the server default, 0/absent means unbounded."""
+    raw = headers.get(DEADLINE_HEADER)
+    if raw is None:
+        ms = knobs.get_float("DPF_TPU_DEADLINE_MS")
+        if ms <= 0:
+            return None
+    else:
+        ms = float(raw)
+        if ms <= 0:
+            raise ValueError(f"{DEADLINE_HEADER} must be a positive ms count")
+    return time.perf_counter() + ms / 1e3
+
+
+def _run_evalfull(profile: str, kb):
+    faults.fire("dispatch.evalfull")
+    return plans.run_evalfull(profile, kb)
 
 
 def _profile_api(profile: str):
@@ -135,11 +174,27 @@ class _ServingState:
     knobs set by tests/deployments before traffic take effect."""
 
     def __init__(self):
+        # A DPF_TPU_FAULTS spec activates (or refuses loudly) before any
+        # traffic; programmatic test installs are left untouched when the
+        # knob is empty.
+        faults.install_from_env()
         self.batcher = Batcher()
         self.keys = KeyCache()
         self.phases = PhaseTimer()
         self.batch_enabled = knobs.get_bool("DPF_TPU_BATCH")
+        # The breaker's background probe re-warms what was being served
+        # (most recently used plans) so recovery never lands a recompile
+        # on the half-open trial request.
+        self.breaker = CircuitBreaker(probe=plans.rewarm_recent)
         self._lock = threading.Lock()
+
+    def degraded(self) -> bool:
+        """True while the breaker is not closed: the batcher is bypassed
+        (a failing dispatch fans to ONE request, not a coalesced batch)
+        and streamed EvalFull falls back to buffered replies (failures
+        surface as a clean status line, never a truncated body).  Both
+        degraded paths are byte-identical to the fast path."""
+        return self.breaker.degraded()
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -157,15 +212,40 @@ class _ServingState:
                 self.phases.add(name, dt, tm.counts[name])
 
     def run(self, work, dispatch):
-        """One request through the fast path: micro-batcher (when
-        enabled) -> plan cache -> per-request result rows."""
-        if self.batch_enabled:
-            res = self.batcher.submit(work, dispatch)
+        """One request through the fast path: breaker admission ->
+        micro-batcher (when enabled and healthy) -> plan cache ->
+        per-request result rows.  Dispatches run under the breaker
+        (transient retries + trip accounting); deadline checkpoints
+        bracket the passthrough path the same way the batcher brackets
+        its queue."""
+        self.breaker.admit()
+
+        def guarded(items):
+            return self.breaker.call(lambda: dispatch(items))
+
+        if self.batch_enabled and not self.breaker.degraded():
+            res = self.batcher.submit(work, guarded)
         else:
+            # Passthrough: batching disabled, or degraded while the
+            # breaker recovers.
+            if work.deadline is not None and (
+                time.perf_counter() >= work.deadline
+            ):
+                self.batcher.note_expired("queue")
+                raise DeadlineError(
+                    "deadline expired before dispatch", where="queue"
+                )
             t0 = time.perf_counter()
-            res = dispatch([work])[0]
+            res = guarded([work])[0]
             work.dispatch_s = time.perf_counter() - t0
             work.coalesced = work.n_keys
+            if work.deadline is not None and (
+                time.perf_counter() >= work.deadline
+            ):
+                self.batcher.note_expired("flight")
+                raise DeadlineError(
+                    "deadline expired in flight", where="flight"
+                )
         with self._lock:
             self.phases.add("queue_wait", work.queue_wait)
             # A coalesced dispatch is shared: attribute each request its
@@ -178,18 +258,41 @@ class _ServingState:
             )
         return res
 
+    def direct(self, fn, deadline: float | None = None):
+        """Breaker-guarded non-batched dispatch (the evalfull routes)
+        with the same deadline checkpoints as the batcher path; expiry
+        shares the batcher's /v1/stats counters."""
+        self.breaker.admit()
+        if deadline is not None and time.perf_counter() >= deadline:
+            self.batcher.note_expired("queue")
+            raise DeadlineError(
+                "deadline expired before dispatch", where="queue"
+            )
+        out = self.breaker.call(fn)
+        if deadline is not None and time.perf_counter() >= deadline:
+            self.batcher.note_expired("flight")
+            raise DeadlineError("deadline expired in flight", where="flight")
+        return out
+
     def stats_snapshot(self) -> dict:
         """Consistent /v1/stats payload: the phase dict is copied under
         the state lock (request threads mutate it concurrently)."""
         with self._lock:
             phases = self.phases.as_dict()
-        return {
+        out = {
             "plans": plans.cache().stats(),
             "batcher": self.batcher.stats_dict(),
             "key_cache": self.keys.stats(),
             "phases": phases,
             "batch_enabled": self.batch_enabled,
+            "breaker": self.breaker.stats(),
+            "degraded": self.degraded(),
         }
+        plan = faults.active()
+        if plan is not None:
+            # An injected run must never be mistakable for a healthy one.
+            out["faults"] = plan.stats()
+        return out
 
 
 _STATE: _ServingState | None = None
@@ -258,8 +361,45 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _reply_error(
+        self, status: int, code: str, detail: str,
+        retry_after_s: float | None = None,
+    ):
+        """Structured error reply: ``{code, detail}`` JSON plus a
+        Retry-After header (whole seconds, rounded up) when the error
+        carries a backoff hint.  ``detail`` must be client-safe — the
+        secret-hygiene lint treats this call as a taint sink."""
+        body = json.dumps({"code": code, "detail": detail}).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if retry_after_s is not None:
+            self.send_header(
+                "Retry-After", str(max(1, math.ceil(retry_after_s)))
+            )
+        self.end_headers()
+        self.wfile.write(body)
+
     def _bad(self, msg: str):
-        self._reply(400, msg.encode(), "text/plain")
+        self._reply_error(400, "bad_request", msg)
+
+    def _abort_connection(self):
+        """Hard-abort the connection: SO_LINGER(1, 0) + close sends a
+        TCP RST, so a mid-stream failure is an unambiguous connection
+        error at the client — never a silently truncated body that
+        parses as a short-but-well-formed reply."""
+        try:
+            self.connection.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER,
+                struct.pack("ii", 1, 0),
+            )
+        except OSError:
+            pass
+        try:
+            self.connection.close()
+        except OSError:
+            pass
+        self.close_connection = True
 
     def do_GET(self):
         path = urlparse(self.path).path
@@ -275,6 +415,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _points_reply(self, words: np.ndarray, nq: int, packed: bool, st):
         with st.phase("reply"):
+            faults.fire("reply.write")
             if packed:
                 self._reply(200, bitpack.words_to_wire(words, nq))
             else:
@@ -285,10 +426,20 @@ class _Handler(BaseHTTPRequestHandler):
                     ).tobytes(),
                 )
 
-    def _evalfull_stream(self, profile: str, kb, log_n: int, st):
+    def _evalfull_stream(self, profile: str, kb, log_n: int, st,
+                         deadline: float | None = None):
         """Write one key's expansion progressively from the streaming
         pipeline.  The first chunk is pulled BEFORE the status line so
-        evaluation errors still surface as a clean 400."""
+        evaluation errors still surface as a clean 400.  Deadline
+        checkpoints mirror the buffered path: expiry before the status
+        line is a clean 504; expiry mid-stream aborts the connection
+        (the body can no longer be completed honestly) and counts as
+        expired-in-flight."""
+        if deadline is not None and time.perf_counter() >= deadline:
+            st.batcher.note_expired("queue")
+            raise DeadlineError(
+                "deadline expired before dispatch", where="queue"
+            )
         tm = PhaseTimer()
         if profile == "fast":
             from .models.dpf_chacha import eval_full_stream
@@ -305,12 +456,21 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(declared))
         self.end_headers()
         written = 0
+        aborted = False
         try:
             # Only the socket writes belong to the "reply" phase — the
             # generator's resumption does device dispatch + D2H, which
             # the stream's own timer already records as dispatch/d2h.
             chunk = first
             while chunk is not None:
+                if deadline is not None and (
+                    time.perf_counter() >= deadline
+                ):
+                    st.batcher.note_expired("flight")
+                    raise DeadlineError(
+                        "deadline expired mid-stream", where="flight"
+                    )
+                faults.fire("stream.chunk")
                 row = chunk[0].tobytes()
                 with st.phase("reply"):
                     self.wfile.write(row)
@@ -320,14 +480,15 @@ class _Handler(BaseHTTPRequestHandler):
             # The 200 status line is already on the wire: a second
             # response here would corrupt the client's payload.  The only
             # honest signal for a mid-stream failure is an aborted
-            # connection (short read vs the declared Content-Length).
-            self.close_connection = True
+            # connection.
+            aborted = True
         finally:
-            if written != declared:
-                # Declared-length drift (or a mid-stream abort): never
-                # let a keep-alive client read the next response out of
-                # frame.
-                self.close_connection = True
+            if aborted or written != declared:
+                # Mid-stream failure or declared-length drift: RST the
+                # connection so truncation is a loud client-side error
+                # (and a keep-alive client can never read the next
+                # response out of frame).
+                self._abort_connection()
             st.merge_timer(tm)
 
     def do_POST(self):
@@ -358,6 +519,7 @@ class _Handler(BaseHTTPRequestHandler):
             profile = q.get("profile", "compat")
             api, key_len, batch_cls = _profile_api(profile)
             log_n = int(q["log_n"])
+            deadline = _deadline_from(self.headers)
 
             def cached_keys(kind, blob, k, kl, cls=None):
                 """Parse ``k`` concatenated keys through the repack LRU."""
@@ -386,11 +548,20 @@ class _Handler(BaseHTTPRequestHandler):
                 if len(body) != kl:
                     raise ValueError(f"body must be one {kl}-byte key")
                 kb = cached_keys(profile, bytes(body), 1, kl)
-                if _stream_mode(q, _evalfull_out_bytes(profile, log_n)):
-                    self._evalfull_stream(profile, kb, log_n, st)
+                if _stream_mode(
+                    q, _evalfull_out_bytes(profile, log_n)
+                ) and not st.degraded():
+                    # (Degraded mode buffers: a dispatch error surfaces
+                    # as a clean status line, never a truncated stream.)
+                    st.breaker.admit()
+                    self._evalfull_stream(
+                        profile, kb, log_n, st, deadline
+                    )
                 else:
                     with st.phase("dispatch"):
-                        out = plans.run_evalfull(profile, kb)
+                        out = st.direct(
+                            lambda: _run_evalfull(profile, kb), deadline
+                        )
                     with st.phase("reply"):
                         self._reply(200, out[0].tobytes())
             elif route == "/v1/evalfull_batch":
@@ -400,7 +571,9 @@ class _Handler(BaseHTTPRequestHandler):
                     raise ValueError(f"body must be {k}*{kl} bytes")
                 kb = cached_keys(profile, bytes(body), k, kl)
                 with st.phase("dispatch"):
-                    out = plans.run_evalfull(profile, kb)
+                    out = st.direct(
+                        lambda: _run_evalfull(profile, kb), deadline
+                    )
                 with st.phase("reply"):
                     self._reply(200, np.ascontiguousarray(out).tobytes())
             elif route == "/v1/eval_points_batch":
@@ -414,7 +587,8 @@ class _Handler(BaseHTTPRequestHandler):
                 kb = cached_keys(profile, bytes(body[: k * kl]), k, kl)
                 xs = np.frombuffer(body[k * kl :], dtype="<u8").reshape(k, nq)
                 words = st.run(
-                    PointsWork("points", profile, kb, xs), dispatch_points
+                    PointsWork("points", profile, kb, xs, deadline=deadline),
+                    dispatch_points,
                 )
                 self._points_reply(words, nq, packed, st)
             elif route == "/v1/dcf_gen":
@@ -443,7 +617,10 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 xs = np.frombuffer(body[k * kl :], dtype="<u8").reshape(k, nq)
                 words = st.run(
-                    PointsWork("dcf_points", "fast", kb, xs), dispatch_points
+                    PointsWork(
+                        "dcf_points", "fast", kb, xs, deadline=deadline
+                    ),
+                    dispatch_points,
                 )
                 self._points_reply(words, nq, packed, st)
             elif route == "/v1/dcf_interval_gen":
@@ -501,12 +678,40 @@ class _Handler(BaseHTTPRequestHandler):
                         build_triple,
                     )
                 xs = np.frombuffer(body[blob_len:], dtype="<u8").reshape(k, nq)
-                words = st.run(IntervalWork(triple, xs), dispatch_interval)
+                words = st.run(
+                    IntervalWork(triple, xs, deadline=deadline),
+                    dispatch_interval,
+                )
                 self._points_reply(words, nq, packed, st)
             else:
                 self._reply(404, b"not found", "text/plain")
+        except ServingError as e:
+            # Load-survival errors carry their own HTTP mapping: 429
+            # shed, 503 open circuit, 504 missed deadline — plus a
+            # Retry-After derived from observed dispatch latency.
+            self._reply_error(e.http_status, e.code, e.detail,
+                              e.retry_after_s)
+        except (ValueError, KeyError) as e:
+            # Validation failures: our own parameter/shape messages (the
+            # secret-hygiene pass keeps raises in this tree free of key
+            # bytes, so str(e) is client-safe here).
+            detail = (
+                f"missing parameter {e}" if isinstance(e, KeyError)
+                else str(e)
+            )
+            self._reply_error(400, "bad_request", detail)
         except Exception as e:  # noqa: BLE001 — bridge must not crash
-            self._bad(f"{type(e).__name__}: {e}")
+            # NEVER echo arbitrary exception reprs: deep library errors
+            # can embed operand values (key material).  Type name only;
+            # transient device signatures map to 503 so clients back off
+            # instead of hammering a wedged device.
+            if is_transient(e):
+                self._reply_error(
+                    503, "unavailable", type(e).__name__,
+                    retry_after_s=_serving_state().breaker.cooldown_s,
+                )
+            else:
+                self._reply_error(500, "internal", type(e).__name__)
 
 
 def audit_knobs() -> list[str]:
@@ -526,11 +731,25 @@ def audit_knobs() -> list[str]:
     return unknown
 
 
+class _Server(ThreadingHTTPServer):
+    # A load-surviving sidecar must not drop SYNs at 4x offered load:
+    # the stdlib default listen backlog (5) converts connection churn
+    # into 1-3 s SYN-retransmit latency spikes at the CLIENT long before
+    # the batcher's admission control ever sees the request.  Shedding
+    # must happen in the application (429 + Retry-After), not in the
+    # kernel's accept queue.
+    request_queue_size = 128
+
+
 def serve(port: int = 8990, host: str = "127.0.0.1") -> ThreadingHTTPServer:
     """Start the sidecar in a daemon thread; returns the server object
     (call ``.shutdown()`` to stop)."""
     audit_knobs()
-    srv = ThreadingHTTPServer((host, port), _Handler)
+    # A DPF_TPU_FAULTS spec in a non-test environment must be a BOOT
+    # error with the full refusal message — not a mystery 500 on the
+    # first request (the lazy serving state would strip the message).
+    faults.install_from_env()
+    srv = _Server((host, port), _Handler)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv
@@ -542,8 +761,9 @@ def main():
     ap.add_argument("--host", default="127.0.0.1")
     args = ap.parse_args()
     audit_knobs()  # warns (stderr) once per unknown DPF_TPU_* var
+    faults.install_from_env()  # refuse a leaked fault spec AT BOOT
     print(f"dpf-tpu sidecar on {args.host}:{args.port}")
-    ThreadingHTTPServer((args.host, args.port), _Handler).serve_forever()
+    _Server((args.host, args.port), _Handler).serve_forever()
 
 
 if __name__ == "__main__":

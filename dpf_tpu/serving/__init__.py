@@ -1,11 +1,23 @@
 """Serving-layer machinery for the sidecar fast path: the dynamic
 micro-batcher (batcher.py) that coalesces concurrent requests into one
-device dispatch, and the host-repack LRU (keycache.py) that lets
-repeated keys skip canonical-form validation + SoA packing entirely.
-Both sit BETWEEN dpf_tpu/server.py and the plan cache
-(core/plans.py); the evaluators themselves are untouched."""
+device dispatch, the host-repack LRU (keycache.py) that lets repeated
+keys skip canonical-form validation + SoA packing entirely, and the
+load-survival layer — structured serving errors (errors.py), the
+device-failure circuit breaker (breaker.py), and the knob-gated fault
+injection harness (faults.py) that makes overload/failure behavior
+deterministically testable on CPU.  All of it sits BETWEEN
+dpf_tpu/server.py and the plan cache (core/plans.py); the evaluators
+themselves are untouched."""
 
 from .batcher import Batcher, IntervalWork, PointsWork
+from .breaker import CircuitBreaker
+from .errors import (
+    DeadlineError, OverloadedError, ServingError, ShedError,
+)
 from .keycache import KeyCache
 
-__all__ = ["Batcher", "PointsWork", "IntervalWork", "KeyCache"]
+__all__ = [
+    "Batcher", "PointsWork", "IntervalWork", "KeyCache",
+    "CircuitBreaker", "ServingError", "ShedError", "OverloadedError",
+    "DeadlineError",
+]

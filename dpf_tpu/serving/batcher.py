@@ -26,6 +26,27 @@ The leader is one of the request threads itself (the sidecar is a
 leak).  A dispatch failure fans the exception back to every coalesced
 request — each HTTP thread reports its own 400.
 
+Load survival (the bounded-queue contract tests/test_load_survival.py
+pins):
+
+  * admission control — each lane's queue is bounded by a depth
+    watermark (``DPF_TPU_QUEUE_MAX_DEPTH``) and an age watermark
+    (``DPF_TPU_QUEUE_MAX_AGE_MS``, measured on the OLDEST queued
+    request).  Arrivals past either watermark are shed with
+    ``ShedError`` (HTTP 429) whose Retry-After derives from the lane's
+    observed dispatch latency (EWMA), instead of queuing unboundedly
+    into a timeout pileup.
+  * deadlines — a request carrying ``work.deadline`` (absolute
+    ``time.perf_counter`` seconds) is checked at queue admission and
+    again when the leader collects its batch: doomed work is cancelled
+    BEFORE it burns a device slot (``DeadlineError``, counted as
+    ``expired_queue``).  Work whose deadline passes while its dispatch
+    runs is counted separately (``expired_flight``) and its result
+    discarded.
+  * the per-request wait timeout is the ``DPF_TPU_BATCH_TIMEOUT_S``
+    knob — the last-resort backstop behind the deadline machinery, not
+    a tuning surface.
+
 Merged dispatches run through the plan cache (core/plans.py), always on
 the PACKED route — the packed words are the kernels' native output, XOR
 and slicing commute with the packing, and byte-per-bit responses are a
@@ -42,6 +63,8 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..core import bitpack, knobs, plans
+from . import faults
+from .errors import DeadlineError, ShedError
 
 
 @dataclass
@@ -53,6 +76,8 @@ class PointsWork:
     profile: str
     kb: object
     xs: np.ndarray  # uint64 [K, Q]
+    # Absolute deadline (time.perf_counter seconds), None = unbounded.
+    deadline: float | None = None
     # Filled by the batcher:
     queue_wait: float = 0.0
     dispatch_s: float = 0.0
@@ -74,6 +99,7 @@ class IntervalWork:
 
     ik: tuple
     xs: np.ndarray
+    deadline: float | None = None
     queue_wait: float = 0.0
     dispatch_s: float = 0.0
     coalesced: int = 0
@@ -126,6 +152,7 @@ def dispatch_points(items: list[PointsWork]) -> list[np.ndarray]:
     """Lane dispatcher for pointwise routes -> per-item packed words.
     A solo item keeps its own (possibly key-cached) batch so its
     device-resident operand caches survive across repeated requests."""
+    faults.fire("dispatch.points")
     if len(items) == 1:
         it = items[0]
         return [plans.run_points(it.route, it.profile, it.kb, it.xs)]
@@ -145,6 +172,7 @@ def dispatch_points(items: list[PointsWork]) -> list[np.ndarray]:
 
 def dispatch_interval(items: list[IntervalWork]) -> list[np.ndarray]:
     """Lane dispatcher for the DCF interval route."""
+    faults.fire("dispatch.interval")
     if len(items) == 1:
         it = items[0]
         return [plans.run_interval(it.ik, it.xs)]
@@ -186,6 +214,14 @@ class BatcherStats:
     coalesced_max: int = 0
     dispatch_seconds: float = 0.0
     queue_wait_seconds: float = 0.0
+    # Load survival: shed / expired accounting (requests counts ADMITTED
+    # work only — shed and admission-expired arrivals never queue).
+    shed_depth: int = 0  # refused: lane queue past the depth watermark
+    shed_age: int = 0  # refused: oldest queued request past the age mark
+    expired_queue: int = 0  # deadline passed before the dispatch started
+    expired_flight: int = 0  # deadline passed while the dispatch ran
+    dispatch_ewma_s: float = 0.0  # smoothed dispatch latency (Retry-After)
+    queue_wait_max_s: float = 0.0  # worst admitted in-queue wait observed
     recent: deque = field(default_factory=lambda: deque(maxlen=512))
 
     def as_dict(self) -> dict:
@@ -200,6 +236,12 @@ class BatcherStats:
             "batch_coalesced_max": self.coalesced_max,
             "dispatch_seconds": round(self.dispatch_seconds, 6),
             "queue_wait_seconds": round(self.queue_wait_seconds, 6),
+            "shed_depth": self.shed_depth,
+            "shed_age": self.shed_age,
+            "expired_queue": self.expired_queue,
+            "expired_flight": self.expired_flight,
+            "dispatch_ewma_ms": round(self.dispatch_ewma_s * 1e3, 3),
+            "queue_wait_max_ms": round(self.queue_wait_max_s * 1e3, 3),
         }
 
 
@@ -208,15 +250,24 @@ class Batcher:
 
     def __init__(
         self, window_us: float | None = None, max_keys: int | None = None,
-        timeout_s: float = 600.0,
+        timeout_s: float | None = None, max_depth: int | None = None,
+        max_age_ms: float | None = None,
     ):
         if window_us is None:
             window_us = knobs.get_float("DPF_TPU_BATCH_WINDOW_US")
         if max_keys is None:
             max_keys = knobs.get_int("DPF_TPU_BATCH_MAX_KEYS")
+        if timeout_s is None:
+            timeout_s = knobs.get_float("DPF_TPU_BATCH_TIMEOUT_S")
+        if max_depth is None:
+            max_depth = knobs.get_int("DPF_TPU_QUEUE_MAX_DEPTH")
+        if max_age_ms is None:
+            max_age_ms = knobs.get_float("DPF_TPU_QUEUE_MAX_AGE_MS")
         self.window_s = max(window_us, 0.0) / 1e6
         self.max_keys = max(max_keys, 1)
         self.timeout_s = timeout_s
+        self.max_depth = max(int(max_depth), 1)
+        self.max_age_s = max(float(max_age_ms), 0.0) / 1e3
         self._lock = threading.Lock()
         self._pending: dict[tuple, deque] = {}
         self._busy: set = set()
@@ -228,14 +279,67 @@ class Batcher:
         with self._lock:
             return self.stats.as_dict()
 
+    def _retry_after_locked(self, depth: int) -> float:
+        """Retry-After for a shed reply, derived from the observed
+        dispatch latency: roughly how long until the lane has drained
+        what is queued ahead (EWMA dispatch seconds x queued depth,
+        clamped to a sane wire range)."""
+        ewma = self.stats.dispatch_ewma_s or 0.05
+        return min(max(ewma * max(depth, 1), 0.05), 10.0)
+
+    def reset_peak(self) -> None:
+        """Zero the peak queue-wait watermark (``queue_wait_max_ms``) so
+        a measurement section can attribute the peak to ITS load run —
+        the bench overload section resets between the 1x/4x/16x rows
+        (counters and EWMA deliberately persist; only the peak is
+        per-window)."""
+        with self._lock:
+            self.stats.queue_wait_max_s = 0.0
+
+    def note_expired(self, where: str) -> None:
+        """Deadline-expiry accounting for work that never entered a lane
+        queue (the server's passthrough/evalfull paths share the
+        batcher's /v1/stats counters)."""
+        with self._lock:
+            if where == "flight":
+                self.stats.expired_flight += 1
+            else:
+                self.stats.expired_queue += 1
+
     def submit(self, work, dispatch):
         """Enqueue ``work`` on its lane and return its result (blocking).
         ``dispatch`` is the lane's batch function: list[work] -> list of
-        per-work results, index-aligned."""
+        per-work results, index-aligned.  Raises ``ShedError`` when the
+        lane is past a watermark and ``DeadlineError`` when the work's
+        deadline expires before (or during) its dispatch."""
+        now = time.perf_counter()
+        deadline = getattr(work, "deadline", None)
+        if deadline is not None and now >= deadline:
+            self.note_expired("queue")
+            raise DeadlineError(
+                "deadline expired before admission", where="queue"
+            )
         req = _Req(work)
         with self._lock:
-            self.stats.requests += 1
             q = self._pending.setdefault(work.lane, deque())
+            depth = len(q)
+            if depth >= self.max_depth:
+                self.stats.shed_depth += 1
+                raise ShedError(
+                    f"lane queue full (depth {depth} >= watermark "
+                    f"{self.max_depth})",
+                    retry_after_s=self._retry_after_locked(depth),
+                )
+            if q and self.max_age_s and (
+                now - q[0].t0 > self.max_age_s
+            ):
+                self.stats.shed_age += 1
+                raise ShedError(
+                    "lane backed up (oldest queued request past the "
+                    f"{self.max_age_s * 1e3:.0f} ms age watermark)",
+                    retry_after_s=self._retry_after_locked(depth),
+                )
+            self.stats.requests += 1
             q.append(req)
             leader = work.lane not in self._busy
             if leader:
@@ -308,16 +412,51 @@ class Batcher:
                         take.append(r)
                         nk += r.work.n_keys
                 t0 = time.perf_counter()
+                # Post-coalesce / pre-dispatch deadline check: work that
+                # expired while queued is cancelled HERE, before it burns
+                # a device slot, and fails alone — the rest of the batch
+                # dispatches without it.
+                live = []
+                expired = []
                 for r in take:
-                    r.work.queue_wait = t0 - r.t0
+                    d = getattr(r.work, "deadline", None)
+                    if d is not None and t0 >= d:
+                        r.error = DeadlineError(
+                            "deadline expired in queue", where="queue"
+                        )
+                        expired.append(r)
+                    else:
+                        live.append(r)
+                        r.work.queue_wait = t0 - r.t0
+                if expired:
+                    with self._lock:
+                        self.stats.expired_queue += len(expired)
+                    for r in expired:
+                        r.done.set()
+                if not live:
+                    continue
+                nk = sum(r.work.n_keys for r in live)
                 try:
-                    results = dispatch([r.work for r in take])
-                    for r, res in zip(take, results):
+                    results = dispatch([r.work for r in live])
+                    for r, res in zip(live, results):
                         r.result = res
                 except Exception as e:  # noqa: BLE001 — fan out per request
-                    for r in take:
+                    for r in live:
                         r.error = e
                 dt = time.perf_counter() - t0
+                t1 = time.perf_counter()
+                # Expired-in-flight: the dispatch outlived the deadline —
+                # the work already burned its device slot, so it is
+                # counted separately and its result discarded.
+                n_flight = 0
+                for r in live:
+                    d = getattr(r.work, "deadline", None)
+                    if r.error is None and d is not None and t1 >= d:
+                        r.result = None
+                        r.error = DeadlineError(
+                            "deadline expired in flight", where="flight"
+                        )
+                        n_flight += 1
                 with self._lock:
                     self.stats.dispatches += 1
                     self.stats.keys_dispatched += nk
@@ -325,11 +464,20 @@ class Batcher:
                         self.stats.coalesced_max, nk
                     )
                     self.stats.dispatch_seconds += dt
+                    self.stats.dispatch_ewma_s = (
+                        dt if not self.stats.dispatch_ewma_s
+                        else 0.2 * dt + 0.8 * self.stats.dispatch_ewma_s
+                    )
+                    self.stats.expired_flight += n_flight
                     self.stats.queue_wait_seconds += sum(
-                        r.work.queue_wait for r in take
+                        r.work.queue_wait for r in live
+                    )
+                    self.stats.queue_wait_max_s = max(
+                        self.stats.queue_wait_max_s,
+                        max(r.work.queue_wait for r in live),
                     )
                     self.stats.recent.append(nk)
-                for r in take:
+                for r in live:
                     r.work.dispatch_s = dt
                     r.work.coalesced = nk
                     r.done.set()

@@ -1,0 +1,65 @@
+"""Structured serving errors — the load-survival layer's reply contract.
+
+Under overload or device failure the sidecar must answer with a *defined*
+shape, not a stack trace: a machine-readable ``{code, detail}`` JSON body,
+an HTTP status a load balancer understands (429 shed, 503 open circuit,
+504 missed deadline), and a ``Retry-After`` derived from observed
+dispatch latency so well-behaved clients back off by the right amount.
+
+Every class here carries a client-safe ``detail`` string composed from
+public metadata only (queue depths, watermarks, lane names).  Raw request
+bytes and exception reprs never reach these messages — the secret-hygiene
+lint pass treats error-reply calls as taint sinks, and the server maps
+*unexpected* exceptions to their type name alone.
+"""
+
+from __future__ import annotations
+
+
+class ServingError(RuntimeError):
+    """Base for errors with a defined HTTP mapping.
+
+    ``http_status``/``code`` identify the failure class on the wire;
+    ``retry_after_s`` (when set) becomes the reply's ``Retry-After``
+    header, rounded up to whole seconds.
+    """
+
+    http_status = 500
+    code = "internal"
+
+    def __init__(self, detail: str, retry_after_s: float | None = None):
+        super().__init__(detail)
+        self.detail = detail
+        self.retry_after_s = retry_after_s
+
+
+class ShedError(ServingError):
+    """Admission control refused the request: the lane's queue is past a
+    depth or age watermark.  Shedding at the door keeps accepted-request
+    latency bounded instead of letting p99 collapse into timeouts."""
+
+    http_status = 429
+    code = "shed"
+
+
+class OverloadedError(ServingError):
+    """The device circuit breaker is open (or a dispatch failed with a
+    transient device signature after retries): fail fast instead of
+    burning a queue slot on work that cannot complete."""
+
+    http_status = 503
+    code = "unavailable"
+
+
+class DeadlineError(ServingError):
+    """The request's deadline expired.  ``where`` distinguishes work that
+    was cancelled before burning a device slot ("queue") from work whose
+    deadline passed while its dispatch ran ("flight") — counted
+    separately in /v1/stats."""
+
+    http_status = 504
+    code = "deadline"
+
+    def __init__(self, detail: str, where: str = "queue"):
+        super().__init__(detail)
+        self.where = where

@@ -24,8 +24,22 @@
 #       test, and the static-analysis suite's own tests — surfaces
 #       kernel + serving regressions in minutes instead of the
 #       full-suite half hour.
+#   ./runtests.sh --faults [pytest args] fault-injection lane: the
+#       load-survival suite (tests/test_load_survival.py — admission
+#       control/shedding, deadlines, circuit-breaker trip/recover,
+#       degraded-mode byte identity, mid-stream abort, the 4x-overload
+#       acceptance scenario) plus the threaded serving stress tests,
+#       all under injected faults on CPU.  The load-survival file is
+#       timing-sensitive (injected latencies, breaker cooldown sleeps),
+#       so it lives ONLY here and in the full tier-1 suite — CI runs
+#       this lane as its own job so a loaded fast-lane runner cannot
+#       flake it and the fast job stays fast.
 if [ "${1:-}" = "--lint" ]; then
   exec "$(dirname "$0")/scripts/lint_all.sh"
+elif [ "${1:-}" = "--faults" ]; then
+  shift
+  set -- tests/test_load_survival.py tests/test_serving_stress.py \
+      -q -m 'not slow' "$@"
 elif [ "${1:-}" = "--fast" ]; then
   shift
   set -- tests/test_aes_pallas.py tests/test_chacha_pallas.py \

@@ -54,9 +54,12 @@ def test_secret_pass_catches_fixture():
     assert "'seeds' flows into logging" in messages
     assert "'scw' formatted into a raised exception" in messages
     assert "'blob' reaches the return value of stats" in messages
+    # Error-reply bodies are a sink too (the sidecar's 4xx/5xx paths
+    # cross the bridge to the other party).
+    assert "'key_bytes' flows into an error-reply body" in messages
     # The sanctioned sha256/len usage stays clean: every finding lies in
-    # the three seeded functions, none in sanctioned().
-    assert len(found) == 3
+    # the four seeded functions, none in sanctioned().
+    assert len(found) == 4
 
 
 def test_hostsync_pass_catches_fixture():

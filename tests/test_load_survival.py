@@ -1,0 +1,849 @@
+"""Load-survival layer: admission control, deadlines, circuit breaker,
+fault injection, graceful degradation (ISSUE 6 acceptance tests).
+
+Everything here runs on CPU, made deterministic by the knob-gated fault
+harness (serving/faults.py): dispatch latency, transient UNAVAILABLE
+failures, poisoned batches, and mid-stream aborts are injected at named
+sites instead of waiting for a real TPU to wedge.
+
+The two acceptance contracts:
+
+  * overload — at 4x offered-vs-capacity load (fault-injected dispatch
+    latency), in-queue wait stays under the shed watermark, excess
+    requests get 429/503 with Retry-After, and accepted-request p99
+    stays within 2x the 1x p99 (test_overload_4x_*);
+  * circuit breaker — trips, fails fast, and recovers
+    (closed -> open -> half_open -> closed) under injected UNAVAILABLE
+    dispatch faults, with state visible in /v1/stats, and the degraded
+    modes (batcher passthrough, buffered EvalFull) are byte-identical
+    to the fast path (test_breaker_e2e_*, test_degraded_*).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpf_tpu.core import bitpack
+from dpf_tpu.serving import faults
+from dpf_tpu.serving.batcher import Batcher, PointsWork
+from dpf_tpu.serving.breaker import (
+    TRANSIENT_SIGNATURES, CircuitBreaker, is_transient,
+)
+from dpf_tpu.serving.errors import (
+    DeadlineError, OverloadedError, ShedError,
+)
+
+pytestmark = pytest.mark.filterwarnings("ignore::RuntimeWarning")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.clear()
+
+
+@pytest.fixture()
+def server_factory(monkeypatch):
+    """Build a sidecar with load-survival knobs set BEFORE the lazy
+    serving state reads them; tears everything down afterwards."""
+    from dpf_tpu import server as srv_mod
+
+    started = []
+
+    def start(**env):
+        for name, value in env.items():
+            monkeypatch.setenv(name, value)
+        srv_mod.reset_serving_state()
+        s = srv_mod.serve(port=0)
+        started.append(s)
+        return f"http://127.0.0.1:{s.server_address[1]}"
+
+    yield start
+    for s in started:
+        s.shutdown()
+    srv_mod.reset_serving_state()
+
+
+def _post(url, body=b"", headers=None, timeout=60):
+    req = urllib.request.Request(url, data=body, method="POST")
+    for name, value in (headers or {}).items():
+        req.add_header(name, value)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read()
+
+
+def _stats(base):
+    with urllib.request.urlopen(base + "/v1/stats", timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _fast_points_job(base, log_n=10, q=8, seed=5):
+    """One fast-profile single-key pointwise request: (path, body)."""
+    from dpf_tpu.core import chacha_np as cc
+
+    rng = np.random.default_rng(seed)
+    alpha = int(rng.integers(0, 1 << log_n))
+    keys = _post(f"{base}/v1/gen?log_n={log_n}&alpha={alpha}&profile=fast")
+    key = keys[: cc.key_len(log_n)]
+    xs = rng.integers(0, 1 << log_n, size=(1, q), dtype=np.uint64)
+    xs[0, 0] = alpha
+    path = (
+        f"/v1/eval_points_batch?log_n={log_n}&k=1&q={q}"
+        "&profile=fast&format=packed"
+    )
+    return path, key + xs.tobytes()
+
+
+class _FakeKb:
+    def __init__(self, n=1):
+        self.log_n = 10
+        self._n = n
+
+
+def _ok_dispatch(items):
+    faults.fire("dispatch.points")
+    return [
+        np.full(
+            (it.xs.shape[0], bitpack.packed_words(it.xs.shape[1])),
+            7, np.uint32,
+        )
+        for it in items
+    ]
+
+
+def _work(q=8, deadline=None):
+    return PointsWork(
+        "points", "compat", _FakeKb(), np.zeros((1, q), np.uint64),
+        deadline=deadline,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fault harness: spec grammar + activation guard
+# ---------------------------------------------------------------------------
+
+
+def test_fault_spec_parsing():
+    cls = faults.parse_spec(
+        "dispatch.points:unavailable:times=3;"
+        "stream.chunk:abort:after=1;dispatch.points:latency:ms=20"
+    )
+    assert [(c.site, c.kind) for c in cls] == [
+        ("dispatch.points", "unavailable"),
+        ("stream.chunk", "abort"),
+        ("dispatch.points", "latency"),
+    ]
+    assert cls[0].times == 3 and cls[1].after == 1 and cls[2].ms == 20.0
+    for bad in (
+        "nosuchsite:error", "dispatch.points:nosuchkind",
+        "dispatch.points", "dispatch.points:error:bogus",
+        "dispatch.points:error:what=1",
+    ):
+        with pytest.raises(ValueError):
+            faults.parse_spec(bad)
+
+
+def test_fault_counting_times_and_after():
+    plan = faults.install(
+        "dispatch.points:unavailable:times=2:after=1"
+    )
+    faults.fire("dispatch.points")  # skipped (after=1)
+    for _ in range(2):
+        with pytest.raises(faults.InjectedUnavailable, match="UNAVAILABLE"):
+            faults.fire("dispatch.points")
+    faults.fire("dispatch.points")  # budget exhausted: inert
+    st = plan.stats()["clauses"][0]
+    assert st["seen"] == 4 and st["fired"] == 2
+    # Other sites are untouched.
+    faults.fire("dispatch.interval")
+
+
+def test_fault_activation_refused_outside_tests():
+    """The guard itself (parameterized so it is testable from inside a
+    pytest process): no pytest module + no explicit allow-knob = refuse."""
+    assert faults._refusal(modules={"pytest": object()}, allow=False) is None
+    assert faults._refusal(modules={}, allow=True) is None
+    reason = faults._refusal(modules={}, allow=False)
+    assert reason is not None and "refused" in reason
+    # install() inside this pytest process is allowed (and cleans up).
+    assert faults.install("reply.write:latency:ms=0") is faults.active()
+
+
+# ---------------------------------------------------------------------------
+# Admission control: depth/age watermarks shed with Retry-After
+# ---------------------------------------------------------------------------
+
+
+def _gated_dispatch(gate, entered):
+    def dispatch(items):
+        if not entered.is_set():
+            entered.set()
+            assert gate.wait(30)
+        return _ok_dispatch(items)
+
+    return dispatch
+
+
+def test_depth_watermark_sheds_with_retry_after():
+    b = Batcher(window_us=0, max_depth=2, max_age_ms=60000)
+    gate, entered = threading.Event(), threading.Event()
+    dispatch = _gated_dispatch(gate, entered)
+    results, errors = [], []
+
+    def worker():
+        try:
+            results.append(b.submit(_work(), dispatch))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    leader = threading.Thread(target=worker)
+    leader.start()
+    assert entered.wait(30)  # leader is mid-dispatch; queue is empty
+    followers = [threading.Thread(target=worker) for _ in range(2)]
+    for t in followers:
+        t.start()
+    for _ in range(500):  # wait until both followers are queued
+        with b._lock:
+            if sum(len(q) for q in b._pending.values()) >= 2:
+                break
+        time.sleep(0.01)
+    with pytest.raises(ShedError) as ei:
+        b.submit(_work(), dispatch)  # third arrival: past the watermark
+    assert ei.value.http_status == 429
+    assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+    gate.set()
+    leader.join(30)
+    for t in followers:
+        t.join(30)
+    assert not errors and len(results) == 3
+    st = b.stats_dict()
+    assert st["shed_depth"] == 1 and st["requests"] == 3
+
+
+def test_age_watermark_sheds_backed_up_lane():
+    b = Batcher(window_us=0, max_depth=64, max_age_ms=50)
+    gate, entered = threading.Event(), threading.Event()
+    dispatch = _gated_dispatch(gate, entered)
+    done = []
+    leader = threading.Thread(
+        target=lambda: done.append(b.submit(_work(), dispatch))
+    )
+    leader.start()
+    assert entered.wait(30)
+    follower = threading.Thread(
+        target=lambda: done.append(b.submit(_work(), dispatch))
+    )
+    follower.start()
+    for _ in range(500):
+        with b._lock:
+            if sum(len(q) for q in b._pending.values()) >= 1:
+                break
+        time.sleep(0.01)
+    time.sleep(0.12)  # let the queued follower age past 50 ms
+    with pytest.raises(ShedError, match="age watermark"):
+        b.submit(_work(), dispatch)
+    gate.set()
+    leader.join(30)
+    follower.join(30)
+    assert len(done) == 2
+    assert b.stats_dict()["shed_age"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Deadlines: admission / post-coalesce / in-flight, counted separately
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_expired_at_admission():
+    b = Batcher(window_us=0)
+    calls = []
+
+    def dispatch(items):
+        calls.append(len(items))
+        return _ok_dispatch(items)
+
+    with pytest.raises(DeadlineError) as ei:
+        b.submit(_work(deadline=time.perf_counter() - 0.01), dispatch)
+    assert ei.value.where == "queue" and ei.value.http_status == 504
+    assert not calls, "doomed work must not burn a dispatch"
+    assert b.stats_dict()["expired_queue"] == 1
+
+
+def test_deadline_expired_in_queue_fails_alone():
+    """A request whose deadline expires while queued is culled when the
+    leader collects the batch; its batchmates still dispatch."""
+    b = Batcher(window_us=0, max_depth=64)
+    gate, entered = threading.Event(), threading.Event()
+    dispatch = _gated_dispatch(gate, entered)
+    outcome = {}
+
+    def worker(tag, deadline):
+        try:
+            outcome[tag] = b.submit(_work(deadline=deadline), dispatch)
+        except Exception as e:  # noqa: BLE001
+            outcome[tag] = e
+
+    leader = threading.Thread(target=worker, args=("leader", None))
+    leader.start()
+    assert entered.wait(30)
+    doomed = threading.Thread(
+        target=worker, args=("doomed", time.perf_counter() + 0.05)
+    )
+    healthy = threading.Thread(target=worker, args=("healthy", None))
+    doomed.start()
+    healthy.start()
+    for _ in range(500):
+        with b._lock:
+            if sum(len(q) for q in b._pending.values()) >= 2:
+                break
+        time.sleep(0.01)
+    time.sleep(0.1)  # the doomed follower's deadline expires in queue
+    gate.set()
+    for t in (leader, doomed, healthy):
+        t.join(30)
+    assert isinstance(outcome["doomed"], DeadlineError)
+    assert outcome["doomed"].where == "queue"
+    assert isinstance(outcome["leader"], np.ndarray)
+    assert isinstance(outcome["healthy"], np.ndarray)
+    st = b.stats_dict()
+    assert st["expired_queue"] == 1 and st["expired_flight"] == 0
+
+
+def test_deadline_expired_in_flight_counted_separately():
+    faults.install("dispatch.points:latency:ms=80")
+    b = Batcher(window_us=0)
+    with pytest.raises(DeadlineError) as ei:
+        b.submit(
+            _work(deadline=time.perf_counter() + 0.03), _ok_dispatch
+        )
+    assert ei.value.where == "flight"
+    st = b.stats_dict()
+    assert st["expired_flight"] == 1 and st["expired_queue"] == 0
+    assert st["dispatches"] == 1  # the slot WAS burned — hence the split
+
+
+# ---------------------------------------------------------------------------
+# Poisoned coalesced batch: error fan-out without wedging the lane
+# ---------------------------------------------------------------------------
+
+
+def test_poisoned_batch_fails_batch_only_lane_survives():
+    """One injected dispatch error inside a coalesced batch fails that
+    whole batch with the distinct injected error, never deadlocks queued
+    followers, and leaves the lane lock free for the next request."""
+    faults.install("dispatch.points:error:times=1:after=1")
+    b = Batcher(window_us=0, max_keys=64)
+    gate, entered = threading.Event(), threading.Event()
+
+    def dispatch(items):
+        if not entered.is_set():
+            entered.set()
+            assert gate.wait(30)
+        return _ok_dispatch(items)  # fires the fault site
+
+    outcome = {}
+
+    def worker(tag):
+        try:
+            outcome[tag] = b.submit(_work(), dispatch)
+        except Exception as e:  # noqa: BLE001
+            outcome[tag] = e
+
+    leader = threading.Thread(target=worker, args=("leader",))
+    leader.start()
+    assert entered.wait(30)  # fire #1 happens after the gate opens
+    followers = [
+        threading.Thread(target=worker, args=(f"f{i}",)) for i in range(4)
+    ]
+    for t in followers:
+        t.start()
+    for _ in range(500):
+        with b._lock:
+            if sum(len(q) for q in b._pending.values()) >= 4:
+                break
+        time.sleep(0.01)
+    gate.set()
+    leader.join(30)
+    for t in followers:
+        t.join(30)
+    # Leader's solo dispatch was fire #1 (skipped by after=1) -> ok;
+    # the coalesced follower batch was fire #2 -> poisoned.
+    assert isinstance(outcome["leader"], np.ndarray)
+    poisoned = [outcome[f"f{i}"] for i in range(4)]
+    assert all(isinstance(o, ValueError) for o in poisoned)
+    assert all("injected fault" in str(o) for o in poisoned)
+    # Lane fully released: a fresh request succeeds immediately.
+    assert not b._busy
+    assert isinstance(b.submit(_work(), dispatch), np.ndarray)
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker: classification, retries, state machine
+# ---------------------------------------------------------------------------
+
+
+def test_transient_classification_matches_bench_ledger():
+    import bench_all
+
+    assert bench_all._TRANSIENT_SIGS is TRANSIENT_SIGNATURES
+    assert is_transient(
+        faults.InjectedUnavailable("UNAVAILABLE: injected fault")
+    )
+    assert is_transient(OSError("Connection refused"))
+    assert not is_transient(ValueError("bad request shape"))
+    assert not is_transient(DeadlineError("deadline expired in queue"))
+
+
+def _raise_unavailable():
+    raise faults.InjectedUnavailable("UNAVAILABLE: injected")
+
+
+def test_breaker_retries_transients_with_backoff():
+    br = CircuitBreaker(
+        threshold=3, cooldown_ms=50, retries=2, backoff_ms=1, probe=None
+    )
+    attempts = {"n": 0}
+
+    def flaky():
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            _raise_unavailable()
+        return "ok"
+
+    assert br.call(flaky) == "ok"
+    assert br.state == "closed"
+    st = br.stats()
+    assert st["retries"] == 1 and st["transient_failures"] == 1
+    # Non-transient errors are NOT retried and do not count.
+    calls = {"n": 0}
+
+    def poisoned():
+        calls["n"] += 1
+        raise ValueError("poisoned request")
+
+    with pytest.raises(ValueError):
+        br.call(poisoned)
+    assert calls["n"] == 1
+    assert br.stats()["consecutive_failures"] == 0
+
+
+def test_breaker_state_machine_closed_open_halfopen_closed():
+    br = CircuitBreaker(
+        threshold=2, cooldown_ms=80, retries=0, backoff_ms=1, probe=None
+    )
+    for _ in range(2):
+        with pytest.raises(faults.InjectedUnavailable):
+            br.call(_raise_unavailable)
+    assert br.state == "open"
+    # Open: fail fast with a Retry-After hint, without running fn.
+    ran = []
+    with pytest.raises(OverloadedError) as ei:
+        br.call(lambda: ran.append(1))
+    assert not ran and ei.value.retry_after_s > 0
+    assert ei.value.http_status == 503
+    # Cooldown expiry -> half_open; a failing trial re-opens...
+    time.sleep(0.1)
+    assert br.state == "half_open"
+    with pytest.raises(faults.InjectedUnavailable):
+        br.call(_raise_unavailable)
+    assert br.state == "open"
+    # ...and a succeeding trial closes.
+    time.sleep(0.1)
+    assert br.call(lambda: 42) == 42
+    assert br.state == "closed"
+    st = br.stats()
+    assert st["trips"] == 2 and st["recoveries"] == 1
+    assert st["fast_fails"] >= 1
+
+
+def test_breaker_half_open_admits_exactly_one_trial():
+    """When the cooldown expires under load, exactly ONE dispatch is the
+    trial; concurrent callers fail fast instead of thundering-herding
+    into a possibly-still-dead device."""
+    br = CircuitBreaker(
+        threshold=1, cooldown_ms=40, retries=0, backoff_ms=1, probe=None
+    )
+    with pytest.raises(faults.InjectedUnavailable):
+        br.call(_raise_unavailable)
+    time.sleep(0.06)
+    assert br.state == "half_open"
+    gate, entered = threading.Event(), threading.Event()
+    outcome = {}
+
+    def trial():
+        entered.set()
+        assert gate.wait(30)
+        return "trial-ok"
+
+    t = threading.Thread(
+        target=lambda: outcome.update(r=br.call(trial))
+    )
+    t.start()
+    assert entered.wait(30)  # the trial holds the half-open claim
+    with pytest.raises(OverloadedError, match="trial dispatch in flight"):
+        br.call(lambda: "should not run")
+    gate.set()
+    t.join(30)
+    assert outcome["r"] == "trial-ok"
+    assert br.state == "closed"
+    # The claim is released: a later trip + trial works again.
+    with pytest.raises(faults.InjectedUnavailable):
+        br.call(_raise_unavailable)
+    time.sleep(0.06)
+    assert br.call(lambda: 7) == 7
+
+
+def test_breaker_background_probe_rewarns_and_half_opens():
+    probed = threading.Event()
+    br = CircuitBreaker(
+        threshold=1, cooldown_ms=40, retries=0, probe=probed.set,
+        probe_enabled=True,
+    )
+    with pytest.raises(faults.InjectedUnavailable):
+        br.call(_raise_unavailable)
+    assert br.stats()["state"] == "open"
+    assert probed.wait(5), "probe thread never ran"
+    for _ in range(100):
+        if br.stats()["state"] == "half_open":
+            break
+        time.sleep(0.01)
+    st = br.stats()
+    assert st["state"] == "half_open" and st["probe_runs"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# End-to-end through the sidecar
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_e2e_trip_failfast_recover(server_factory):
+    """closed -> open -> half_open -> closed through the real HTTP
+    stack, state visible in /v1/stats, fail-fast 503s carry Retry-After."""
+    faults.install("dispatch.points:unavailable:times=3")
+    base = server_factory(
+        DPF_TPU_BREAKER_THRESHOLD="2",
+        DPF_TPU_BREAKER_COOLDOWN_MS="400",
+        DPF_TPU_DISPATCH_RETRIES="0",
+        DPF_TPU_BREAKER_PROBE="off",
+        DPF_TPU_BATCH_WINDOW_US="0",
+    )
+    path, body = _fast_points_job(base)
+
+    def expect_503():
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base + path, body)
+        assert ei.value.code == 503
+        payload = json.loads(ei.value.read())
+        assert payload["code"] == "unavailable"
+        return ei.value.headers.get("Retry-After")
+
+    expect_503()  # transient failure 1
+    expect_503()  # transient failure 2 -> trips open
+    assert _stats(base)["breaker"]["state"] == "open"
+    assert _stats(base)["degraded"] is True
+    retry_after = expect_503()  # fail-fast (fault NOT consumed)
+    assert retry_after is not None and int(retry_after) >= 1
+    assert _stats(base)["breaker"]["fast_fails"] >= 1
+    time.sleep(0.5)  # cooldown -> half_open; trial consumes fault 3
+    expect_503()
+    assert _stats(base)["breaker"]["state"] == "open"
+    time.sleep(0.5)  # faults exhausted: the next trial recovers
+    out = _post(base + path, body)
+    assert len(out) == 1  # packed single-key q=8 reply
+    st = _stats(base)["breaker"]
+    assert st["state"] == "closed"
+    assert st["trips"] >= 2 and st["recoveries"] >= 1
+    assert _stats(base)["degraded"] is False
+
+
+def test_degraded_modes_byte_identical(server_factory):
+    """While the breaker is half-open the batcher is bypassed and
+    streamed EvalFull buffers — both must produce byte-identical output
+    to the healthy fast path."""
+    from dpf_tpu.core import spec
+
+    base = server_factory(
+        DPF_TPU_BREAKER_THRESHOLD="1",
+        DPF_TPU_BREAKER_COOLDOWN_MS="300",
+        DPF_TPU_DISPATCH_RETRIES="0",
+        DPF_TPU_BREAKER_PROBE="off",
+        DPF_TPU_BATCH_WINDOW_US="0",
+        DPF_TPU_STREAM="on",
+    )
+    log_n = 10
+    path, body = _fast_points_job(base, log_n=log_n)
+    key = _post(f"{base}/v1/gen?log_n={log_n}&alpha=700")[
+        : spec.key_len(log_n)
+    ]
+    healthy_points = _post(base + path, body)
+    healthy_full = _post(f"{base}/v1/evalfull?log_n={log_n}&stream=1", key)
+    assert healthy_full == spec.eval_full(key, log_n)
+
+    def trip_and_wait_half_open():
+        faults.install("dispatch.points:unavailable:times=1")
+        with pytest.raises(urllib.error.HTTPError):
+            _post(base + path, body)
+        assert _stats(base)["breaker"]["state"] == "open"
+        time.sleep(0.4)
+        assert _stats(base)["breaker"]["state"] == "half_open"
+
+    # Degraded pointwise: batcher passthrough, identical bytes.
+    trip_and_wait_half_open()
+    assert _stats(base)["degraded"] is True
+    assert _post(base + path, body) == healthy_points
+    assert _stats(base)["breaker"]["state"] == "closed"  # trial recovered
+    # Degraded EvalFull: stream=1 request served buffered, identical.
+    trip_and_wait_half_open()
+    assert (
+        _post(f"{base}/v1/evalfull?log_n={log_n}&stream=1", key)
+        == healthy_full
+    )
+    assert _stats(base)["breaker"]["state"] == "closed"
+
+
+def test_midstream_failure_aborts_connection_hard(server_factory):
+    """A dispatch error after the Content-Length header is on the wire
+    must abort the connection (RST), never leave a silently truncated
+    body — and the server must survive to serve the next request."""
+    from dpf_tpu.core import spec
+
+    base = server_factory(DPF_TPU_STREAM="on")
+    log_n = 10
+    key = _post(f"{base}/v1/gen?log_n={log_n}&alpha=3")[
+        : spec.key_len(log_n)
+    ]
+    want = _post(f"{base}/v1/evalfull?log_n={log_n}&stream=0", key)
+    faults.install("stream.chunk:abort")
+    req = urllib.request.Request(
+        f"{base}/v1/evalfull?log_n={log_n}&stream=1", data=key,
+        method="POST",
+    )
+    # The abort clause fires on every chunk: the client must observe a
+    # connection-level error (IncompleteRead / ECONNRESET), never a
+    # complete-looking short body.
+    with pytest.raises((OSError, http.client.HTTPException)):
+        with urllib.request.urlopen(req, timeout=30) as r:
+            r.read()
+    faults.clear()
+    assert _post(f"{base}/v1/evalfull?log_n={log_n}&stream=1", key) == want
+
+
+def test_streamed_evalfull_honors_deadline(server_factory):
+    """The streaming branch enforces the same deadline contract as the
+    buffered one: expiry before the status line is a clean 504 (the
+    largest-service-time route is where deadlines matter most)."""
+    from dpf_tpu.core import spec
+
+    base = server_factory(DPF_TPU_STREAM="on")
+    log_n = 10
+    key = _post(f"{base}/v1/gen?log_n={log_n}&alpha=9")[
+        : spec.key_len(log_n)
+    ]
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(
+            f"{base}/v1/evalfull?log_n={log_n}&stream=1", key,
+            headers={"X-DPF-Deadline-Ms": "0.001"},
+        )
+    assert ei.value.code == 504
+    assert json.loads(ei.value.read())["code"] == "deadline"
+    assert _stats(base)["batcher"]["expired_queue"] >= 1
+    # A generous budget streams normally, byte-identical to spec.
+    out = _post(
+        f"{base}/v1/evalfull?log_n={log_n}&stream=1", key,
+        headers={"X-DPF-Deadline-Ms": "60000"},
+    )
+    assert out == spec.eval_full(key, log_n)
+
+
+def test_deadline_e2e_504_and_stats(server_factory):
+    faults.install("dispatch.points:latency:ms=80")
+    base = server_factory(DPF_TPU_BATCH_WINDOW_US="0")
+    path, body = _fast_points_job(base)
+    _post(base + path, body)  # warm the plan so latency is the fault's
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(base + path, body, headers={"X-DPF-Deadline-Ms": "30"})
+    assert ei.value.code == 504
+    assert json.loads(ei.value.read())["code"] == "deadline"
+    st = _stats(base)["batcher"]
+    assert st["expired_flight"] >= 1
+    # A generous deadline sails through; a non-positive one is a 400.
+    assert _post(
+        base + path, body, headers={"X-DPF-Deadline-Ms": "60000"}
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(base + path, body, headers={"X-DPF-Deadline-Ms": "-5"})
+    assert ei.value.code == 400
+
+
+def test_env_knob_activates_faults_and_stats_expose_them(server_factory):
+    base = server_factory(
+        DPF_TPU_FAULTS="reply.write:latency:ms=1",
+        DPF_TPU_BATCH_WINDOW_US="0",
+    )
+    path, body = _fast_points_job(base)
+    _post(base + path, body)
+    st = _stats(base)
+    clauses = st["faults"]["clauses"]
+    assert clauses[0]["site"] == "reply.write"
+    assert clauses[0]["fired"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# The overload acceptance test: 4x offered load, bounded p99, shedding
+# ---------------------------------------------------------------------------
+
+
+def _drive(base, path, body, n_threads, per_thread):
+    """Closed-loop client pool -> (accepted latencies, sheds,
+    retry_afters).  Each worker holds ONE keep-alive connection — the
+    pooled-transport shape the real Go client uses — so the measurement
+    sees the batcher's queueing, not TCP connect churn."""
+    host, port = base.split("//")[1].rsplit(":", 1)
+    lat, sheds, retry_afters, errors = [], [], [], []
+    lock = threading.Lock()
+
+    def client():
+        conn = http.client.HTTPConnection(host, int(port), timeout=60)
+        try:
+            for _ in range(per_thread):
+                t0 = time.perf_counter()
+                try:
+                    conn.request("POST", path, body)
+                    r = conn.getresponse()
+                    payload = r.read()
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(e)
+                    return
+                dt = time.perf_counter() - t0
+                with lock:
+                    if r.status == 200:
+                        lat.append(dt)
+                    elif r.status in (429, 503):
+                        sheds.append(r.status)
+                        retry_afters.append(r.getheader("Retry-After"))
+                    else:
+                        errors.append((r.status, payload))
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=client) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errors, errors
+    return lat, sheds, retry_afters
+
+
+def _p99(lat):
+    a = sorted(lat)
+    return a[min(len(a) - 1, int(len(a) * 0.99))]
+
+
+def test_overload_4x_bounded_p99_with_shedding(server_factory):
+    """The acceptance criterion: with fault-injected dispatch latency
+    (50 ms — the deterministic stand-in for device compute), 4x the
+    offered load of the 1x run keeps accepted-request p99 within 2x the
+    1x p99, sheds the excess as 429 with Retry-After, and keeps
+    in-queue wait under the age watermark.
+
+    Offered load is thread-count-proportional (closed-loop clients whose
+    think time is ~0): 2 clients saturate one 50 ms serial lane, 8
+    clients offer 4x that.  The depth watermark (2) is what bounds the
+    accepted queue — and therefore p99."""
+    faults.install("dispatch.points:latency:ms=50")
+    watermark_age_ms = 1000.0
+    base = server_factory(
+        DPF_TPU_BATCH_WINDOW_US="0",
+        DPF_TPU_QUEUE_MAX_DEPTH="2",
+        DPF_TPU_QUEUE_MAX_AGE_MS=str(watermark_age_ms),
+    )
+    path, body = _fast_points_job(base)
+    # Warm every K bucket coalescing can produce (the deployment
+    # discipline /v1/warmup exists for): a first-coalesce compile in the
+    # middle of the measured run would be charged to queueing.
+    _post(
+        base + "/v1/warmup",
+        json.dumps(
+            {
+                "shapes": [
+                    {"route": "points", "profile": "fast", "log_n": 10,
+                     "k": k, "q": 8}
+                    for k in (1, 2, 4)
+                ]
+            }
+        ).encode(),
+    )
+    _post(base + path, body)
+
+    # One retry on the p99 bound: the contract is the sidecar's, but a
+    # momentarily loaded CI box can smear any single wall-clock sample.
+    all_sheds = []
+    for attempt in range(2):
+        lat_1x, sheds_1x, _ = _drive(base, path, body, n_threads=2,
+                                     per_thread=8)
+        p99_1x = _p99(lat_1x)
+        lat_4x, sheds_4x, retry_afters = _drive(
+            base, path, body, n_threads=8, per_thread=8
+        )
+        p99_4x = _p99(lat_4x)
+        all_sheds += sheds_1x + sheds_4x
+        if p99_4x <= 2 * p99_1x:
+            break
+
+    assert len(lat_4x) > 0, "overload must not collapse goodput to zero"
+    assert sheds_4x, "4x offered load must shed"
+    assert all(ra is not None and int(ra) >= 1 for ra in retry_afters), (
+        "every shed reply must carry Retry-After"
+    )
+    assert p99_4x <= 2 * p99_1x, (
+        f"accepted p99 {p99_4x * 1e3:.1f} ms exceeded 2x the 1x p99 "
+        f"{p99_1x * 1e3:.1f} ms (sheds 1x={len(sheds_1x)}, "
+        f"4x={len(sheds_4x)})"
+    )
+    st = _stats(base)["batcher"]
+    assert st["shed_depth"] + st["shed_age"] == len(all_sheds)
+    assert st["queue_wait_max_ms"] < watermark_age_ms, (
+        "in-queue wait must stay under the shed watermark"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Knob plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_batch_timeout_knob(monkeypatch):
+    monkeypatch.setenv("DPF_TPU_BATCH_TIMEOUT_S", "123.5")
+    assert Batcher().timeout_s == 123.5
+    monkeypatch.delenv("DPF_TPU_BATCH_TIMEOUT_S")
+    assert Batcher().timeout_s == 600.0
+    assert Batcher(timeout_s=7.0).timeout_s == 7.0
+
+
+def test_watermark_knobs(monkeypatch):
+    monkeypatch.setenv("DPF_TPU_QUEUE_MAX_DEPTH", "9")
+    monkeypatch.setenv("DPF_TPU_QUEUE_MAX_AGE_MS", "75")
+    b = Batcher()
+    assert b.max_depth == 9 and b.max_age_s == 0.075
+
+
+def test_queue_wait_peak_resets_per_window():
+    """reset_peak() zeroes the high-water mark (per-measurement-window
+    attribution in the bench overload section) without touching the
+    cumulative counters."""
+    b = Batcher(window_us=0)
+    b.stats.queue_wait_max_s = 1.23
+    b.stats.requests = 7
+    b.reset_peak()
+    st = b.stats_dict()
+    assert st["queue_wait_max_ms"] == 0.0 and st["requests"] == 7
