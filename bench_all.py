@@ -311,6 +311,19 @@ def _emit(name, value, unit, baseline=None, route=None, scale=1e9,
     _out(row)
 
 
+def _scrape_metrics(base: str):
+    """One strict-parsed /v1/metrics scrape (dpf_tpu/obs/promtext) — the
+    serving sections read counter deltas from the metrics plane, the
+    same surface operators and Prometheus scrape, so every bench run
+    exercises it."""
+    import urllib.request
+
+    from dpf_tpu.obs import promtext
+
+    with urllib.request.urlopen(base + "/v1/metrics", timeout=30) as r:
+        return promtext.parse(r.read().decode())
+
+
 def _percentiles_ms(lat: list[float]) -> dict:
     """p50/p95/p99 row fields from per-request wall latencies (seconds).
     Queue-wait is included by construction — the client-side clock starts
@@ -1009,9 +1022,7 @@ def main():
                 except Exception as e:  # noqa: BLE001
                     errs.append(e)
 
-            stats0 = json.loads(
-                urllib.request.urlopen(base + "/v1/stats", timeout=30).read()
-            )["batcher"]
+            m0 = _scrape_metrics(base)
             threads = [
                 _th.Thread(target=client, args=(i,)) for i in range(nthread)
             ]
@@ -1030,12 +1041,14 @@ def main():
                     "serving bench wedged: client threads still running "
                     "after 300s"
                 )
-            stats1 = json.loads(
-                urllib.request.urlopen(base + "/v1/stats", timeout=30).read()
-            )["batcher"]
-            d_req = stats1["requests"] - stats0["requests"]
-            d_disp = max(stats1["dispatches"] - stats0["dispatches"], 1)
-            d_keys = stats1["keys_dispatched"] - stats0["keys_dispatched"]
+            m1 = _scrape_metrics(base)
+
+            def delta(name):
+                return int(m1.value(name) - m0.value(name))
+
+            d_req = delta("dpf_requests_total")
+            d_disp = max(delta("dpf_dispatches_total"), 1)
+            d_keys = delta("dpf_keys_dispatched_total")
             pct = _percentiles_ms(lats)
             pct["batch_coalesced"] = round(d_keys / d_disp, 3)
             pct["dispatches"] = d_disp
@@ -1047,6 +1060,54 @@ def main():
                 "Mqueries/sec",
                 route=_route("sidecar,micro-batcher,packed"),
                 bytes_out=(qp1 + 7) // 8, extra=pct,
+            )
+
+            # Tracing overhead: the SAME single-key evalfull p50 with the
+            # flight recorder explicitly OFF, then explicitly ON (both
+            # legs pin DPF_TPU_TRACE so an ambient off/on in the bench
+            # environment can never turn this into an off-vs-off or
+            # on-vs-on non-measurement; off runs first, which if anything
+            # warms state in the traced leg's favor — an overhead number
+            # biased LOW would still be caught on drift).  This is the
+            # committed number for the <= 2% p50 budget (DESIGN §12).
+            # Plans are module-global, so resetting the serving state
+            # re-reads DPF_TPU_TRACE without recompiling anything.
+            def evalfull_p50(reps):
+                lats = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    post(f"/v1/evalfull?log_n={n1}&profile=fast", key1)
+                    lats.append(time.perf_counter() - t0)
+                return _percentiles_ms(lats)["p50_ms"]
+
+            reps_ab = 32 if not small else 8
+            saved_trace = knobs.get_raw("DPF_TPU_TRACE")
+            try:
+                os.environ["DPF_TPU_TRACE"] = "off"
+                srv_mod.reset_serving_state()
+                p50_off = evalfull_p50(reps_ab)
+                os.environ["DPF_TPU_TRACE"] = "on"
+                srv_mod.reset_serving_state()
+                p50_on = evalfull_p50(reps_ab)
+            finally:
+                if saved_trace is None:
+                    os.environ.pop("DPF_TPU_TRACE", None)
+                else:
+                    os.environ["DPF_TPU_TRACE"] = saved_trace
+                srv_mod.reset_serving_state()
+            overhead_pct = (
+                (p50_on - p50_off) / p50_off * 100 if p50_off else 0.0
+            )
+            _emit(
+                f"serving tracing overhead 1-key evalfull n={n1} "
+                "(p50 on vs off)",
+                overhead_pct, "pct_p50",
+                route=_route("sidecar,flight-recorder"),
+                extra={
+                    "p50_on_ms": round(p50_on, 3),
+                    "p50_off_ms": round(p50_off, 3),
+                    "reps": reps_ab,
+                },
             )
         finally:
             s.shutdown()
@@ -1227,16 +1288,31 @@ def main():
                 }
 
             duration_s = 1.5 if small else 4.0
-            stats_url = base + "/v1/stats"
+            # Server-side numbers come from the metrics plane
+            # (_scrape_metrics): per-window deltas of the shed/expired
+            # counters plus the queue-wait high-water gauge.
             for mult in (1, 4, 16):
                 # Per-row peak attribution: queue_wait_max is a high-water
                 # mark, so zero it before each offered-load window.
                 srv_mod._serving_state().batcher.reset_peak()
+                m0 = _scrape_metrics(base)
                 row = open_loop(capacity_rps * mult, duration_s)
-                srv_stats = json.loads(
-                    urllib.request.urlopen(stats_url, timeout=30).read()
-                )["batcher"]
-                row["queue_wait_max_ms"] = srv_stats["queue_wait_max_ms"]
+                m1 = _scrape_metrics(base)
+                row["queue_wait_max_ms"] = round(
+                    m1.value("dpf_queue_wait_max_seconds") * 1e3, 3
+                )
+                row["server_shed"] = int(
+                    m1.value("dpf_shed_total", {"kind": "depth"})
+                    + m1.value("dpf_shed_total", {"kind": "age"})
+                    - m0.value("dpf_shed_total", {"kind": "depth"})
+                    - m0.value("dpf_shed_total", {"kind": "age"})
+                )
+                row["server_expired"] = int(
+                    m1.value("dpf_expired_total", {"where": "queue"})
+                    + m1.value("dpf_expired_total", {"where": "flight"})
+                    - m0.value("dpf_expired_total", {"where": "queue"})
+                    - m0.value("dpf_expired_total", {"where": "flight"})
+                )
                 row["capacity_rps"] = round(capacity_rps, 1)
                 row["injected_latency_ms"] = inject_ms
                 _emit(

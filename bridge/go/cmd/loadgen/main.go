@@ -30,6 +30,7 @@ import (
 	"flag"
 	"fmt"
 	"math/rand"
+	"net/http"
 	"os"
 	"sort"
 	"sync"
@@ -38,6 +39,38 @@ import (
 
 	"github.com/dpf-tpu/bridge/go/dpftpu"
 )
+
+// waitReady polls GET /readyz until the sidecar reports ready (200) or
+// the budget expires.  Opening load against a cold or breaker-open
+// sidecar measures compile/recovery time, not serving behavior — the
+// readiness gate is what makes loadgen rows comparable across runs.
+func waitReady(base string, budget time.Duration) error {
+	deadline := time.Now().Add(budget)
+	// Per-poll timeout: a wedged sidecar that accepts connections but
+	// never answers (the degraded-TPU shape) must not hang the poll
+	// loop past the -wait-ready budget.
+	client := &http.Client{Timeout: 2 * time.Second}
+	for {
+		resp, err := client.Get(base + "/readyz")
+		if err == nil {
+			resp.Body.Close()
+			if resp.StatusCode == http.StatusOK {
+				return nil
+			}
+		}
+		if time.Now().After(deadline) {
+			if err != nil {
+				return fmt.Errorf("sidecar not reachable after %s: %w",
+					budget, err)
+			}
+			return fmt.Errorf(
+				"sidecar not ready after %s (last /readyz status %d; "+
+					"warm it with POST /v1/warmup, or pass -wait-ready 0)",
+				budget, resp.StatusCode)
+		}
+		time.Sleep(200 * time.Millisecond)
+	}
+}
 
 type result struct {
 	OfferedRPS    float64 `json:"offered_rps"`
@@ -76,7 +109,16 @@ func main() {
 	deadlineMs := flag.Int("deadline-ms", 0, "per-request deadline header (0 = none)")
 	maxInflight := flag.Int("max-inflight", 512, "in-flight cap; arrivals past it count as client_dropped")
 	seed := flag.Int64("seed", 2026, "query RNG seed")
+	waitReadyBudget := flag.Duration("wait-ready", 30*time.Second,
+		"poll GET /readyz for up to this long before opening load (0 = skip)")
 	flag.Parse()
+
+	if *waitReadyBudget > 0 {
+		if err := waitReady(*url, *waitReadyBudget); err != nil {
+			fmt.Fprintf(os.Stderr, "loadgen: %v\n", err)
+			os.Exit(1)
+		}
+	}
 
 	c := dpftpu.New(*url)
 	c.Profile = *profile

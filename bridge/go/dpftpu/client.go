@@ -19,7 +19,9 @@ package dpftpu
 
 import (
 	"bytes"
+	crand "crypto/rand"
 	"encoding/binary"
+	"encoding/hex"
 	"encoding/json"
 	"fmt"
 	"io"
@@ -40,11 +42,28 @@ type DPFkey []byte
 // every request: the sidecar cancels work whose deadline expires while
 // queued (before it burns a device slot) and answers 504 — the
 // load-survival contract that keeps p99 bounded under overload.
+//
+// Trace (on by default from New) stamps a fresh X-DPF-Trace id on every
+// request, so each request's span tree in the sidecar's flight recorder
+// (GET /v1/trace) carries a client-originated id — the handle for
+// answering "which of MY requests waited where" after an incident.
 type Client struct {
 	BaseURL    string
 	Profile    string
 	DeadlineMs int
+	Trace      bool
 	HTTP       *http.Client
+}
+
+// newTraceID returns a 16-hex-char request trace id.  crypto/rand so
+// concurrent goroutines never collide (math/rand's global source would
+// need locking anyway).
+func newTraceID() string {
+	var b [8]byte
+	if _, err := crand.Read(b[:]); err != nil {
+		return "" // header omitted; the sidecar generates one at ingress
+	}
+	return hex.EncodeToString(b[:])
 }
 
 // APIError is a structured non-200 sidecar reply.  The load-survival
@@ -109,6 +128,7 @@ func New(baseURL string) *Client {
 	return &Client{
 		BaseURL: baseURL,
 		Profile: "compat",
+		Trace:   true,
 		// Full-domain expansions at large n take seconds on first compile.
 		HTTP: &http.Client{
 			Timeout:   120 * time.Second,
@@ -126,6 +146,11 @@ func (c *Client) post(path string, body []byte) ([]byte, error) {
 	req.Header.Set("Content-Type", "application/octet-stream")
 	if c.DeadlineMs > 0 {
 		req.Header.Set("X-DPF-Deadline-Ms", strconv.Itoa(c.DeadlineMs))
+	}
+	if c.Trace {
+		if id := newTraceID(); id != "" {
+			req.Header.Set("X-DPF-Trace", id)
+		}
 	}
 	resp, err := c.HTTP.Do(req)
 	if err != nil {

@@ -364,6 +364,50 @@ func TestDeadlineHeaderSent(t *testing.T) {
 	}
 }
 
+// TestTraceHeaderSent pins the tracing contract: every request carries a
+// fresh 16-hex-char X-DPF-Trace id (the sidecar's flight recorder keys
+// span trees on it), distinct across requests, and Trace=false drops the
+// header entirely.
+func TestTraceHeaderSent(t *testing.T) {
+	var mu sync.Mutex
+	got := []string{}
+	srv := httptest.NewServer(http.HandlerFunc(
+		func(w http.ResponseWriter, r *http.Request) {
+			io.Copy(io.Discard, r.Body)
+			mu.Lock()
+			got = append(got, r.Header.Get("X-DPF-Trace"))
+			mu.Unlock()
+			w.Write([]byte{0})
+		}))
+	defer srv.Close()
+	c := New(srv.URL)
+	for i := 0; i < 2; i++ {
+		if _, err := c.Eval(DPFkey{1}, 0, 10); err != nil {
+			t.Fatal(err)
+		}
+	}
+	c.Trace = false
+	if _, err := c.Eval(DPFkey{1}, 0, 10); err != nil {
+		t.Fatal(err)
+	}
+	mu.Lock()
+	defer mu.Unlock()
+	for i := 0; i < 2; i++ {
+		if len(got[i]) != 16 {
+			t.Fatalf("trace id %d = %q, want 16 hex chars", i, got[i])
+		}
+		if _, err := hex.DecodeString(got[i]); err != nil {
+			t.Fatalf("trace id %d = %q is not hex", i, got[i])
+		}
+	}
+	if got[0] == got[1] {
+		t.Fatalf("trace ids must be unique per request, got %q twice", got[0])
+	}
+	if got[2] != "" {
+		t.Fatalf("Trace=false must omit the header, got %q", got[2])
+	}
+}
+
 // TestConcurrentClientRace drives one shared Client from 16 goroutines
 // through the pooled Transport against a local double — no sidecar
 // needed, so `go test -race ./dpftpu` exercises the connection pool and

@@ -29,6 +29,25 @@ def leak_in_error_reply(handler, key_bytes):
     handler._bad(f"cannot parse key {key_bytes!r}")
 
 
+def leak_in_span_attr(span, kb):
+    # Key material attached as a span attribute: /v1/trace exports span
+    # attrs verbatim, so this is the flight recorder leaking seeds.
+    seeds = kb.seeds
+    span.set_attrs(first_seed=seeds)
+
+
+def leak_in_metric_label(writer, key_bytes):
+    # A metric label built from raw key bytes: /v1/metrics exports label
+    # values verbatim to every scraper.
+    writer.sample("dpf_last_key", {"key": key_bytes}, 1)
+
+
+def sanctioned_telemetry(span, blob):
+    # CLEAN: shape/len reductions and digests are public metadata in
+    # span attributes, same rules as logging.
+    span.set_attrs(n_bytes=len(blob))
+
+
 def sanctioned(blob):
     # CLEAN: the sha256 digest is the sanctioned way to index key bytes
     # (serving/keycache.py); len() is public metadata.

@@ -18,11 +18,17 @@ intra-function forward taint.
   sinks      logging/warnings/print calls; f-strings (or %/.format)
              inside ``raise``; return values of stats-shaped functions
              (``stats``/``stats_dict``/``stats_snapshot``/``as_dict``
-             — the /v1/stats surface); calls whose name mentions the
-             bench ``ledger``; error-reply calls (``_bad`` /
+             — the /v1/stats surface AND the /v1/trace payload, which
+             is built from ``as_dict`` trees); calls whose name mentions
+             the bench ``ledger``; error-reply calls (``_bad`` /
              ``_reply_error`` / ``send_error`` — the sidecar's 4xx/5xx
              bodies cross the bridge to the OTHER party, so request key
-             bytes in one break the two-server trust split).
+             bytes in one break the two-server trust split); telemetry
+             calls (``set_attrs`` / ``add_span`` / ``add_event`` /
+             ``child_span`` / ``observe_phase`` / ``observe_coalesce``
+             and the metrics renderer's ``sample``/``histogram`` — span
+             attributes and metric labels are exported verbatim by
+             ``/v1/trace`` and ``/v1/metrics``).
   sanitizers subtrees that reduce a secret to public data stop the
              taint: ``len()``/``type()``, shape/count attributes
              (``.shape``, ``.k``, ``.log_n``, ...), and ``hashlib``
@@ -83,6 +89,15 @@ _LOG_METHODS = frozenset(
 # Error-reply surfaces (server.py): anything in their arguments becomes
 # an HTTP error body on the wire.
 _ERROR_REPLY_FUNCS = frozenset({"_bad", "_reply_error", "send_error"})
+# Telemetry surfaces (dpf_tpu/obs): span attributes, recorded spans/
+# events, and metric label/sample arguments are exported verbatim by
+# GET /v1/trace and GET /v1/metrics — public metadata only.
+_TELEMETRY_FUNCS = frozenset(
+    {
+        "set_attrs", "add_span", "add_event", "child_span",
+        "observe_phase", "observe_coalesce", "sample", "histogram",
+    }
+)
 
 
 def _is_sanitizer_call(node: ast.Call) -> bool:
@@ -258,13 +273,19 @@ def _check_scope(rel: str, body: list[ast.stmt], params: set[str],
             if (
                 _is_log_call(sub) or _is_ledger_call(sub)
                 or _call_name(sub) in _ERROR_REPLY_FUNCS
+                or _call_name(sub) in _TELEMETRY_FUNCS
             ):
                 if _is_log_call(sub):
                     where = "logging/console"
                 elif _is_ledger_call(sub):
                     where = "bench ledger"
-                else:
+                elif _call_name(sub) in _ERROR_REPLY_FUNCS:
                     where = "an error-reply body"
+                else:
+                    where = (
+                        "telemetry (span attrs / metric labels are "
+                        "exported by /v1/trace and /v1/metrics)"
+                    )
                 for arg in list(sub.args) + [
                     kw.value for kw in sub.keywords
                 ]:

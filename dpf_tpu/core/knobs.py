@@ -247,6 +247,39 @@ _declare(
     "dpf_tpu/serving/faults.py",
 )
 
+# Observability: tracing, metrics exposition, on-demand profiling -----------
+_declare(
+    "DPF_TPU_TRACE", "bool", "on",
+    "Per-request span tracing + flight recorder (GET /v1/trace); off "
+    "removes every instrumentation point down to an is-None check.",
+    "dpf_tpu/obs/trace.py",
+)
+_declare(
+    "DPF_TPU_TRACE_RING", "int", "256",
+    "Flight-recorder capacity in finished request traces (bounded ring; "
+    "oldest traces age out).",
+    "dpf_tpu/obs/trace.py",
+)
+_declare(
+    "DPF_TPU_METRICS_BUCKETS_MS", "str",
+    "0.5,1,2,5,10,20,50,100,200,500,1000,2000,5000",
+    "Fixed histogram bucket bounds (milliseconds, comma-separated) for "
+    "the per-phase latency histograms on GET /v1/metrics.",
+    "dpf_tpu/obs/metrics.py", values="<ms,ms,...>",
+)
+_declare(
+    "DPF_TPU_PROFILE_ALLOW", "flag", "",
+    "Explicit opt-in for POST /v1/profile (on-demand XProf capture); "
+    "unset, the endpoint answers 403.",
+    "dpf_tpu/obs/profile.py",
+)
+_declare(
+    "DPF_TPU_PROFILE_MAX_S", "float", "60",
+    "Hard upper bound on one XProf capture's duration, seconds (every "
+    "capture auto-stops at min(requested, this)).",
+    "dpf_tpu/obs/profile.py",
+)
+
 # Bench harness --------------------------------------------------------------
 _declare(
     "DPF_TPU_BENCH_BACKOFF", "float", "10",

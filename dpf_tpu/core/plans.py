@@ -43,6 +43,7 @@ from typing import NamedTuple
 import numpy as np
 
 from . import bitpack, knobs
+from ..obs import trace as obs_trace
 
 # ---------------------------------------------------------------------------
 # Knobs
@@ -284,16 +285,23 @@ def run_points(route: str, profile: str, kb, xs: np.ndarray) -> np.ndarray:
     K, Q = xs.shape
     key = plan_key(route, profile, kb.log_n, K, Q, packed=True)
     plan, first = _CACHE.get(key)
+    obs_trace.add_event(
+        "plan_lookup", hit=not first, route=route,
+        k_bucket=key.k_bucket, q_bucket=key.q_bucket,
+    )
     t0 = time.perf_counter()
     kbp = _pad_keys(kb, key.k_bucket - K)
-    # The packed words leave the device exactly once per dispatch, here.
-    # host-sync: final reply marshalling (points route)
-    words = np.asarray(
-        _points_eval(
+    # "compute" is the (async) jit dispatch; the asarray below blocks on
+    # the device result, so "d2h" includes the device wait.
+    with obs_trace.child_span("compute"):
+        dev = _points_eval(
             route, profile, kbp,
             _pad_queries(xs, key.k_bucket, key.q_bucket),
         )
-    )
+    # The packed words leave the device exactly once per dispatch, here.
+    with obs_trace.child_span("d2h"):
+        # host-sync: final reply marshalling (points route)
+        words = np.asarray(dev)
     if first:
         plan.compile_s = time.perf_counter() - t0
     plan.last_used = time.time()
@@ -312,6 +320,10 @@ def run_interval(ik, xs: np.ndarray) -> np.ndarray:
     K, Q = xs.shape
     key = plan_key("dcf_interval", "fast", upper.log_n, K, Q, packed=True)
     plan, first = _CACHE.get(key)
+    obs_trace.add_event(
+        "plan_lookup", hit=not first, route="dcf_interval",
+        k_bucket=key.k_bucket, q_bucket=key.q_bucket,
+    )
     t0 = time.perf_counter()
     pad = key.k_bucket - K
     if pad:
@@ -332,14 +344,15 @@ def run_interval(ik, xs: np.ndarray) -> np.ndarray:
                 pass
     else:
         up, lp, cp_ = upper, lower, const
-    # host-sync: final reply marshalling (interval route)
-    words = np.asarray(
-        dcf.eval_interval_points(
+    with obs_trace.child_span("compute"):
+        dev = dcf.eval_interval_points(
             (up, lp, cp_),
             _pad_queries(xs, key.k_bucket, key.q_bucket),
             packed=True,
         )
-    )
+    with obs_trace.child_span("d2h"):
+        # host-sync: final reply marshalling (interval route)
+        words = np.asarray(dev)
     if first:
         plan.compile_s = time.perf_counter() - t0
     plan.last_used = time.time()
@@ -353,16 +366,21 @@ def run_evalfull(profile: str, kb) -> np.ndarray:
     K = kb.k
     key = plan_key("evalfull", profile, kb.log_n, K, 0, packed=True)
     plan, first = _CACHE.get(key)
+    obs_trace.add_event(
+        "plan_lookup", hit=not first, route="evalfull",
+        k_bucket=key.k_bucket, q_bucket=0,
+    )
     t0 = time.perf_counter()
     kbp = _pad_keys(kb, key.k_bucket - K)
-    if profile == "fast":
-        from ..models import dpf_chacha
+    with obs_trace.child_span("compute"):
+        if profile == "fast":
+            from ..models import dpf_chacha
 
-        out = dpf_chacha.eval_full(kbp)
-    else:
-        from ..models import dpf
+            out = dpf_chacha.eval_full(kbp)
+        else:
+            from ..models import dpf
 
-        out = dpf.eval_full(kbp)
+            out = dpf.eval_full(kbp)
     if first:
         plan.compile_s = time.perf_counter() - t0
     plan.last_used = time.time()

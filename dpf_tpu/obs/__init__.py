@@ -1,0 +1,13 @@
+"""Observability plane for the serving stack: per-request tracing with a
+bounded flight recorder (trace.py, ``GET /v1/trace``), Prometheus
+text-format exposition rendered from the SAME consistent snapshot
+``/v1/stats`` reads (metrics.py, ``GET /v1/metrics``), a strict
+exposition parser for tests and scrape tooling (promtext.py), and
+knob-gated on-demand XProf capture (profile.py, ``POST /v1/profile``).
+All of it hangs off the serving state in ``dpf_tpu/server.py``; the
+evaluators and kernels are untouched — instrumentation lives at the
+request-pipeline seams (server, batcher, breaker, plan cache)."""
+
+from . import metrics, profile, promtext, trace
+
+__all__ = ["metrics", "profile", "promtext", "trace"]

@@ -1,0 +1,328 @@
+"""Prometheus text-format exposition for the sidecar (``GET /v1/metrics``).
+
+Design rule: every counter and gauge here is rendered FROM the single
+consistent ``/v1/stats`` snapshot (one stats lock, server.py) — the two
+surfaces read the same dict, so they cannot drift; the test suite pins
+exact equality.  The only state this module owns is what Prometheus
+needs and a JSON blob cannot carry: fixed-bucket histograms for
+per-phase latency and coalesce size (``MetricsHub``), observed at the
+same instrumentation points that feed the phase timers.
+
+Exposition follows the Prometheus text format v0.0.4: ``# HELP`` /
+``# TYPE`` per family, counters suffixed ``_total``, histograms with
+cumulative ``_bucket{le=...}`` series, an ``le="+Inf"`` bucket equal to
+``_count``, and a terminating newline.  ``dpf_tpu/obs/promtext.py`` is
+the strict parser the tests (and ``scripts/scrape_metrics.py``) hold
+this output against.
+
+Metric labels are exported verbatim, so — like span attributes — label
+values are secret-hygiene taint sinks: public metadata only.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+from ..core import knobs
+
+_NAMESPACE = "dpf"
+
+# Coalesce-size buckets: key-rows per dispatch, powers of two up to the
+# batcher's DPF_TPU_BATCH_MAX_KEYS default.
+_COALESCE_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+_BREAKER_STATE_CODE = {"closed": 0, "half_open": 1, "open": 2}
+
+
+def latency_bounds_s() -> tuple[float, ...]:
+    """Histogram bucket bounds for per-phase latency, in seconds, parsed
+    from the DPF_TPU_METRICS_BUCKETS_MS knob (comma-separated ms)."""
+    raw = knobs.get_str("DPF_TPU_METRICS_BUCKETS_MS")
+    # Deduplicated: a repeated bound would render two bucket samples
+    # with the same le label, which every strict consumer rejects.
+    bounds = sorted(
+        {float(tok) / 1e3 for tok in raw.split(",") if tok.strip()}
+    )
+    if not bounds:
+        raise ValueError("DPF_TPU_METRICS_BUCKETS_MS must name >= 1 bucket")
+    return tuple(bounds)
+
+
+class Histogram:
+    """Fixed-bucket histogram; ``counts[i]`` is the NON-cumulative count
+    of observations v with bounds[i-1] < v <= bounds[i] (counts[-1] is
+    the overflow / +Inf bucket).  Rendering cumulates."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds: tuple[float, ...]):
+        self.bounds = tuple(bounds)
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+        self.sum += v
+        self.count += 1
+
+    def as_dict(self) -> dict:
+        return {
+            "bounds": list(self.bounds),
+            "counts": list(self.counts),
+            "sum": self.sum,
+            "count": self.count,
+        }
+
+
+class MetricsHub:
+    """The histogram state behind /v1/metrics.  ``lock`` is the serving
+    state's single stats lock (an RLock) so histogram snapshots are
+    taken in the same critical section as the counter snapshot."""
+
+    def __init__(self, lock=None, bounds_s: tuple[float, ...] | None = None):
+        self._lock = lock if lock is not None else threading.RLock()
+        self._bounds = bounds_s if bounds_s is not None else latency_bounds_s()
+        self._phase: dict[str, Histogram] = {}
+        self._coalesce = Histogram(_COALESCE_BOUNDS)
+
+    def observe_phase(self, name: str, dt_s: float) -> None:
+        with self._lock:
+            h = self._phase.get(name)
+            if h is None:
+                h = self._phase[name] = Histogram(self._bounds)
+            h.observe(dt_s)
+
+    def observe_coalesce(self, n_keys: int) -> None:
+        with self._lock:
+            self._coalesce.observe(n_keys)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "phase_latency": {
+                    name: h.as_dict() for name, h in self._phase.items()
+                },
+                "coalesce_size": self._coalesce.as_dict(),
+            }
+
+
+def device_memory_gauges() -> list[tuple[str, str, float]]:
+    """(device, stat, value) triples from ``jax.local_devices()`` memory
+    stats — present on TPU backends, absent (empty list) on CPU where
+    the runtime reports none.  Never raises: metrics exposition must not
+    depend on backend health."""
+    out: list[tuple[str, str, float]] = []
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            ms_fn = getattr(d, "memory_stats", None)
+            ms = ms_fn() if callable(ms_fn) else None
+            if not ms:
+                continue
+            for stat in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+                if stat in ms:
+                    out.append(
+                        (f"{d.platform}:{d.id}", stat, float(ms[stat]))
+                    )
+    except Exception:  # noqa: BLE001 — observability must not take traffic down
+        return out
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+
+def _escape(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _fmt(v) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Writer:
+    def __init__(self):
+        self.lines: list[str] = []
+
+    def family(self, name: str, kind: str, help_text: str) -> None:
+        self.lines.append(f"# HELP {name} {help_text}")
+        self.lines.append(f"# TYPE {name} {kind}")
+
+    def sample(self, name: str, labels: dict | None, value) -> None:
+        if labels:
+            body = ",".join(
+                f'{k}="{_escape(v)}"' for k, v in sorted(labels.items())
+            )
+            self.lines.append(f"{name}{{{body}}} {_fmt(value)}")
+        else:
+            self.lines.append(f"{name} {_fmt(value)}")
+
+    def histogram(self, name: str, labels: dict | None, h: dict) -> None:
+        cum = 0
+        for bound, c in zip(h["bounds"], h["counts"]):
+            cum += c
+            lb = dict(labels or {})
+            lb["le"] = _fmt(bound)
+            self.sample(f"{name}_bucket", lb, cum)
+        lb = dict(labels or {})
+        lb["le"] = "+Inf"
+        self.sample(f"{name}_bucket", lb, h["count"])
+        self.sample(f"{name}_sum", labels, h["sum"])
+        self.sample(f"{name}_count", labels, h["count"])
+
+    def text(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def render(stats: dict, hists: dict,
+           device_mem: list[tuple[str, str, float]] | None = None) -> str:
+    """The /v1/metrics body: ``stats`` is the /v1/stats snapshot (the
+    SAME dict — counter equality between the two surfaces is structural,
+    not coincidental), ``hists`` is ``MetricsHub.snapshot()``."""
+    w = _Writer()
+    ns = _NAMESPACE
+    b = stats["batcher"]
+    br = stats["breaker"]
+    pl = stats["plans"]
+    kc = stats["key_cache"]
+    tr = stats.get("trace", {})
+
+    # -- counters ----------------------------------------------------------
+    w.family(f"{ns}_requests_total", "counter",
+             "Requests admitted to the serving fast path.")
+    w.sample(f"{ns}_requests_total", None, b["requests"])
+    w.family(f"{ns}_dispatches_total", "counter",
+             "Device dispatches issued (coalesced batches count once).")
+    w.sample(f"{ns}_dispatches_total", None, b["dispatches"])
+    w.family(f"{ns}_keys_dispatched_total", "counter",
+             "Key-rows dispatched across all batches.")
+    w.sample(f"{ns}_keys_dispatched_total", None, b["keys_dispatched"])
+    w.family(f"{ns}_shed_total", "counter",
+             "Requests shed by admission control, by watermark kind.")
+    w.sample(f"{ns}_shed_total", {"kind": "depth"}, b["shed_depth"])
+    w.sample(f"{ns}_shed_total", {"kind": "age"}, b["shed_age"])
+    w.family(f"{ns}_expired_total", "counter",
+             "Deadline expirations, by where the deadline passed.")
+    w.sample(f"{ns}_expired_total", {"where": "queue"}, b["expired_queue"])
+    w.sample(f"{ns}_expired_total", {"where": "flight"}, b["expired_flight"])
+    w.family(f"{ns}_queue_wait_seconds_total", "counter",
+             "Cumulative in-queue wait across admitted requests.")
+    w.sample(f"{ns}_queue_wait_seconds_total", None,
+             b["queue_wait_seconds"])
+    w.family(f"{ns}_dispatch_seconds_total", "counter",
+             "Cumulative wall seconds inside device dispatches.")
+    w.sample(f"{ns}_dispatch_seconds_total", None, b["dispatch_seconds"])
+
+    w.family(f"{ns}_breaker_transitions_total", "counter",
+             "Circuit-breaker transitions, by kind (trip = -> open, "
+             "recovery = -> closed).")
+    w.sample(f"{ns}_breaker_transitions_total", {"kind": "trip"},
+             br["trips"])
+    w.sample(f"{ns}_breaker_transitions_total", {"kind": "recovery"},
+             br["recoveries"])
+    w.family(f"{ns}_breaker_fast_fails_total", "counter",
+             "Requests failed fast while the circuit was open/half-open.")
+    w.sample(f"{ns}_breaker_fast_fails_total", None, br["fast_fails"])
+    w.family(f"{ns}_breaker_retries_total", "counter",
+             "Transparent transient-dispatch retries.")
+    w.sample(f"{ns}_breaker_retries_total", None, br["retries"])
+    w.family(f"{ns}_breaker_transient_failures_total", "counter",
+             "Dispatch failures classified transient (pre-retry).")
+    w.sample(f"{ns}_breaker_transient_failures_total", None,
+             br["transient_failures"])
+    w.family(f"{ns}_breaker_probe_runs_total", "counter",
+             "Background re-warm probe executions while open.")
+    w.sample(f"{ns}_breaker_probe_runs_total", None, br["probe_runs"])
+
+    w.family(f"{ns}_plan_hits_total", "counter",
+             "Dispatch-plan cache hits.")
+    w.sample(f"{ns}_plan_hits_total", None, pl["hits"])
+    w.family(f"{ns}_plan_compiles_total", "counter",
+             "Dispatch-plan compiles (cache misses).")
+    w.sample(f"{ns}_plan_compiles_total", None, pl["misses"])
+
+    w.family(f"{ns}_keycache_hits_total", "counter",
+             "Host-repack LRU hits.")
+    w.sample(f"{ns}_keycache_hits_total", None, kc["hits"])
+    w.family(f"{ns}_keycache_misses_total", "counter",
+             "Host-repack LRU misses.")
+    w.sample(f"{ns}_keycache_misses_total", None, kc["misses"])
+
+    phases = stats.get("phases", {})
+    w.family(f"{ns}_phase_seconds_total", "counter",
+             "Cumulative wall seconds per request phase.")
+    for name in sorted(phases):
+        w.sample(f"{ns}_phase_seconds_total", {"phase": name},
+                 phases[name]["seconds"])
+    w.family(f"{ns}_phase_events_total", "counter",
+             "Events recorded per request phase.")
+    for name in sorted(phases):
+        w.sample(f"{ns}_phase_events_total", {"phase": name},
+                 phases[name]["count"])
+
+    if tr:
+        w.family(f"{ns}_traces_recorded_total", "counter",
+                 "Traces recorded into the flight-recorder ring.")
+        w.sample(f"{ns}_traces_recorded_total", None, tr["recorded"])
+        w.family(f"{ns}_traces_evicted_total", "counter",
+                 "Traces aged out of the flight-recorder ring.")
+        w.sample(f"{ns}_traces_evicted_total", None, tr["evicted"])
+
+    # -- gauges ------------------------------------------------------------
+    w.family(f"{ns}_queue_depth", "gauge",
+             "Requests currently queued across batcher lanes.")
+    w.sample(f"{ns}_queue_depth", None, b.get("queue_depth", 0))
+    w.family(f"{ns}_queue_wait_max_seconds", "gauge",
+             "Worst admitted in-queue wait since the last reset_peak.")
+    w.sample(f"{ns}_queue_wait_max_seconds", None,
+             b["queue_wait_max_ms"] / 1e3)
+    w.family(f"{ns}_breaker_state", "gauge",
+             "Circuit-breaker state: 0 closed, 1 half_open, 2 open.")
+    w.sample(f"{ns}_breaker_state", None,
+             _BREAKER_STATE_CODE.get(br["state"], -1))
+    w.family(f"{ns}_plan_cache_plans", "gauge",
+             "Distinct dispatch plans in the cache.")
+    w.sample(f"{ns}_plan_cache_plans", None, len(pl["plans"]))
+    w.family(f"{ns}_keycache_entries", "gauge",
+             "Key batches resident in the host-repack LRU.")
+    w.sample(f"{ns}_keycache_entries", None, kc["entries"])
+    if tr:
+        w.family(f"{ns}_trace_ring_size", "gauge",
+                 "Traces currently held by the flight recorder.")
+        w.sample(f"{ns}_trace_ring_size", None, tr["size"])
+
+    mem = device_memory_gauges() if device_mem is None else device_mem
+    if mem:
+        w.family(f"{ns}_device_memory_bytes", "gauge",
+                 "Per-device memory from jax.local_devices() stats.")
+        for device, stat, value in mem:
+            w.sample(f"{ns}_device_memory_bytes",
+                     {"device": device, "stat": stat}, value)
+
+    # -- histograms --------------------------------------------------------
+    phase_hists = hists.get("phase_latency", {})
+    if phase_hists:
+        w.family(f"{ns}_phase_latency_seconds", "histogram",
+                 "Per-event phase latency (fixed buckets, "
+                 "DPF_TPU_METRICS_BUCKETS_MS).")
+        for name in sorted(phase_hists):
+            w.histogram(f"{ns}_phase_latency_seconds", {"phase": name},
+                        phase_hists[name])
+    w.family(f"{ns}_coalesce_size", "histogram",
+             "Key-rows coalesced per device dispatch.")
+    w.histogram(f"{ns}_coalesce_size", None, hists["coalesce_size"])
+
+    return w.text()

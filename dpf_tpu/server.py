@@ -51,16 +51,44 @@ Endpoints (all POST, binary bodies, profile/params in the query string):
         traffic.  An evalfull spec with "stream": true also warms the
         streaming pipeline's per-chunk executables (distinct compiles).
         Replies JSON with per-shape compile seconds.
-  /healthz                                    -> "ok"
+  /healthz                                    -> "ok" (liveness ONLY:
+        200 while the process serves, regardless of breaker/warmup)
+  /readyz (GET)                               -> readiness: 200 "ready",
+        or 503 {code:"breaker_open"} while the circuit breaker is not
+        closed / {code:"cold"} until the first POST /v1/warmup — load
+        generators (bridge/go/cmd/loadgen -wait-ready) hold fire on it
   /v1/stats (GET)                             -> JSON observability:
         plan-cache hit/miss + live trace count, micro-batcher
         coalescing (requests, dispatches, batch_coalesced mean/max,
-        queue-wait) plus load-survival counters (shed_depth/shed_age,
-        expired_queue vs expired_flight, dispatch EWMA), key-repack LRU
-        hits, circuit-breaker state (closed|open|half_open, trips,
-        retries, fast-fails), active fault-injection clauses (when any),
+        queue-wait, live queue_depth) plus load-survival counters
+        (shed_depth/shed_age, expired_queue vs expired_flight, dispatch
+        EWMA), key-repack LRU hits, circuit-breaker state
+        (closed|open|half_open, trips, retries, fast-fails), active
+        fault-injection clauses (when any), flight-recorder ring state,
         and per-phase timers (queue_wait, pack, dispatch, compute, d2h,
-        reply — utils/profiling.PhaseTimer).
+        reply — utils/profiling.PhaseTimer).  The whole payload is ONE
+        critical section under a single stats lock — never a torn read.
+  /v1/metrics (GET)                           -> the same snapshot in
+        Prometheus text format (obs/metrics.py): counters (sheds,
+        expirations, breaker transitions, plan compiles, keycache hits),
+        gauges (queue depth, breaker state, per-device memory), and
+        fixed-bucket histograms for per-phase latency + coalesce size
+        (DPF_TPU_METRICS_BUCKETS_MS).  Counter equality with /v1/stats
+        is structural: both render one snapshot dict.
+  /v1/trace (GET)                             -> the flight recorder
+        (obs/trace.py; DPF_TPU_TRACE / DPF_TPU_TRACE_RING): one span
+        tree per recent request — ingress/admission/queue_wait/coalesce/
+        dispatch/plan_lookup/compute/d2h/reply, with shed / expired /
+        breaker-rejected outcomes recorded too.  Query params:
+        ?n=N (recent N), ?slowest=1, ?id=<trace-id>, ?outcome=shed|....
+        Trace ids arrive via the X-DPF-Trace request header (the Go
+        client stamps one per request) or are generated at ingress.
+  /v1/profile (POST, JSON)                    -> on-demand XProf capture
+        of the LIVE process (obs/profile.py): {"action": "start"|"stop"|
+        "status"[, "seconds": S][, "dir": path]}.  Refused (403) unless
+        DPF_TPU_PROFILE_ALLOW is set; every capture auto-stops after
+        min(S, DPF_TPU_PROFILE_MAX_S); the reply reports the trace
+        directory for xprof/tensorboard.
 
 Serving fast path (the request pipeline for the pointwise/DCF/interval
 endpoints):
@@ -110,6 +138,9 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from .core import bitpack, knobs, plans
+from .obs import metrics as obs_metrics
+from .obs import profile as obs_profile
+from .obs import trace as obs_trace
 from .serving import Batcher, IntervalWork, KeyCache, PointsWork, faults
 from .serving.batcher import dispatch_interval, dispatch_points
 from .serving.breaker import CircuitBreaker, is_transient
@@ -120,6 +151,17 @@ from .utils.profiling import PhaseTimer
 # ``DPF_TPU_DEADLINE_MS`` knob sets the server default for requests that
 # omit it (0 = no default deadline).
 DEADLINE_HEADER = "X-DPF-Deadline-Ms"
+
+# Per-request trace id header (obs/trace.py): propagated from the client
+# (the Go client stamps one per request) or generated at ingress.
+TRACE_HEADER = "X-DPF-Trace"
+
+# ServingError.code -> flight-recorder outcome (obs/trace.OUTCOMES).
+_ERROR_OUTCOMES = {
+    "shed": "shed",
+    "deadline": "expired",
+    "unavailable": "breaker_rejected",
+}
 
 
 def _wire_format(q: dict) -> bool:
@@ -178,15 +220,30 @@ class _ServingState:
         # traffic; programmatic test installs are left untouched when the
         # knob is empty.
         faults.install_from_env()
-        self.batcher = Batcher()
-        self.keys = KeyCache()
+        # ONE stats lock (re-entrant) shared by every counter surface —
+        # batcher stats, breaker counters, key-cache LRU, phase timers,
+        # metrics histograms — so ``stats_snapshot`` (and /v1/metrics,
+        # rendered from the same snapshot) is a single consistent cut
+        # across all of them, never a torn read of one component mid-
+        # update.  Queue/state structure sharing the same lock is fine:
+        # no component holds it across a dispatch, sleep, or socket op.
+        self.stats_lock = threading.RLock()
+        self.metrics = obs_metrics.MetricsHub(lock=self.stats_lock)
+        self.batcher = Batcher(lock=self.stats_lock, metrics=self.metrics)
+        self.keys = KeyCache(lock=self.stats_lock)
         self.phases = PhaseTimer()
         self.batch_enabled = knobs.get_bool("DPF_TPU_BATCH")
         # The breaker's background probe re-warms what was being served
         # (most recently used plans) so recovery never lands a recompile
         # on the half-open trial request.
-        self.breaker = CircuitBreaker(probe=plans.rewarm_recent)
-        self._lock = threading.Lock()
+        self.breaker = CircuitBreaker(
+            probe=plans.rewarm_recent, lock=self.stats_lock
+        )
+        self.tracer = obs_trace.Tracer()
+        # Readiness (GET /readyz): flipped by the first successful
+        # POST /v1/warmup — a sidecar that never warmed serves traffic
+        # but advertises not-ready so load generators hold fire.
+        self.warmed = False
 
     def degraded(self) -> bool:
         """True while the breaker is not closed: the batcher is bypassed
@@ -196,20 +253,28 @@ class _ServingState:
         degraded paths are byte-identical to the fast path."""
         return self.breaker.degraded()
 
+    def _note_phase(self, name: str, dt: float, n: int = 1) -> None:
+        """One phase observation into BOTH surfaces — the /v1/stats sum
+        counters and the /v1/metrics latency histogram — under the single
+        stats lock."""
+        with self.stats_lock:
+            self.phases.add(name, dt, n)
+            self.metrics.observe_phase(name, dt)
+
     @contextlib.contextmanager
     def phase(self, name: str):
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            with self._lock:
-                self.phases.add(name, dt)
+            self._note_phase(name, time.perf_counter() - t0)
 
     def merge_timer(self, tm: PhaseTimer) -> None:
-        with self._lock:
+        # A streamed run's timer arrives pre-accumulated; each merged
+        # phase is one histogram observation of its total.
+        with self.stats_lock:
             for name, dt in tm.phases.items():
-                self.phases.add(name, dt, tm.counts[name])
+                self._note_phase(name, dt, tm.counts[name])
 
     def run(self, work, dispatch):
         """One request through the fast path: breaker admission ->
@@ -218,7 +283,9 @@ class _ServingState:
         (transient retries + trip accounting); deadline checkpoints
         bracket the passthrough path the same way the batcher brackets
         its queue."""
-        self.breaker.admit()
+        tr = getattr(work, "trace", None)
+        with obs_trace.maybe_span(tr, "admission"):
+            self.breaker.admit()
 
         def guarded(items):
             return self.breaker.call(lambda: dispatch(items))
@@ -236,7 +303,10 @@ class _ServingState:
                     "deadline expired before dispatch", where="queue"
                 )
             t0 = time.perf_counter()
-            res = guarded([work])[0]
+            with obs_trace.traced_dispatch(tr) as dspan:
+                res = guarded([work])[0]
+                if dspan is not None:
+                    dspan.set_attrs(coalesced=work.n_keys)
             work.dispatch_s = time.perf_counter() - t0
             work.coalesced = work.n_keys
             if work.deadline is not None and (
@@ -246,53 +316,66 @@ class _ServingState:
                 raise DeadlineError(
                     "deadline expired in flight", where="flight"
                 )
-        with self._lock:
-            self.phases.add("queue_wait", work.queue_wait)
-            # A coalesced dispatch is shared: attribute each request its
-            # key-row share so phases.compute sums to real device time
-            # (the batcher's dispatch_seconds holds the per-dispatch
-            # truth).
-            self.phases.add(
-                "compute",
-                work.dispatch_s * work.n_keys / max(work.coalesced, 1),
-            )
+        self._note_phase("queue_wait", work.queue_wait)
+        # A coalesced dispatch is shared: attribute each request its
+        # key-row share so phases.compute sums to real device time
+        # (the batcher's dispatch_seconds holds the per-dispatch
+        # truth).
+        self._note_phase(
+            "compute",
+            work.dispatch_s * work.n_keys / max(work.coalesced, 1),
+        )
         return res
 
-    def direct(self, fn, deadline: float | None = None):
+    def direct(self, fn, deadline: float | None = None, trace=None):
         """Breaker-guarded non-batched dispatch (the evalfull routes)
         with the same deadline checkpoints as the batcher path; expiry
         shares the batcher's /v1/stats counters."""
-        self.breaker.admit()
+        with obs_trace.maybe_span(trace, "admission"):
+            self.breaker.admit()
         if deadline is not None and time.perf_counter() >= deadline:
             self.batcher.note_expired("queue")
             raise DeadlineError(
                 "deadline expired before dispatch", where="queue"
             )
-        out = self.breaker.call(fn)
+        with obs_trace.traced_dispatch(trace):
+            out = self.breaker.call(fn)
         if deadline is not None and time.perf_counter() >= deadline:
             self.batcher.note_expired("flight")
             raise DeadlineError("deadline expired in flight", where="flight")
         return out
 
     def stats_snapshot(self) -> dict:
-        """Consistent /v1/stats payload: the phase dict is copied under
-        the state lock (request threads mutate it concurrently)."""
-        with self._lock:
-            phases = self.phases.as_dict()
-        out = {
-            "plans": plans.cache().stats(),
-            "batcher": self.batcher.stats_dict(),
-            "key_cache": self.keys.stats(),
-            "phases": phases,
-            "batch_enabled": self.batch_enabled,
-            "breaker": self.breaker.stats(),
-            "degraded": self.degraded(),
-        }
+        """Consistent /v1/stats payload, taken as ONE critical section
+        under the single stats lock (the component stats() calls
+        re-acquire the same RLock): batcher, breaker, and key-cache
+        counters can never be torn against each other mid-update.
+        /v1/metrics renders from this same snapshot, so the two surfaces
+        cannot drift."""
+        with self.stats_lock:
+            out = {
+                "plans": plans.cache().stats(),
+                "batcher": self.batcher.stats_dict(),
+                "key_cache": self.keys.stats(),
+                "phases": self.phases.as_dict(),
+                "batch_enabled": self.batch_enabled,
+                "breaker": self.breaker.stats(),
+                "degraded": self.degraded(),
+                "trace": self.tracer.stats(),
+            }
         plan = faults.active()
         if plan is not None:
             # An injected run must never be mistakable for a healthy one.
             out["faults"] = plan.stats()
         return out
+
+    def metrics_text(self) -> str:
+        """The /v1/metrics body: stats + histogram state captured in one
+        critical section, rendered outside it."""
+        with self.stats_lock:
+            snap = self.stats_snapshot()
+            hists = self.metrics.snapshot()
+        return obs_metrics.render(snap, hists)
 
 
 _STATE: _ServingState | None = None
@@ -402,19 +485,78 @@ class _Handler(BaseHTTPRequestHandler):
         self.close_connection = True
 
     def do_GET(self):
-        path = urlparse(self.path).path
+        url = urlparse(self.path)
+        path = url.path
         if path == "/healthz":
+            # Liveness ONLY: "ok" while the process serves requests,
+            # regardless of breaker state or warmup.  Readiness is
+            # /readyz — a restart-the-pod signal must never be
+            # conflated with a hold-the-traffic signal.
             self._reply(200, b"ok", "text/plain")
+        elif path == "/readyz":
+            st = _serving_state()
+            if st.breaker.degraded():
+                self._reply_error(
+                    503, "breaker_open",
+                    f"circuit breaker is {st.breaker.state}",
+                    retry_after_s=st.breaker.cooldown_s,
+                )
+            elif not st.warmed:
+                self._reply_error(
+                    503, "cold",
+                    "warmup has not run (POST /v1/warmup first)",
+                )
+            else:
+                self._reply(200, b"ready", "text/plain")
         elif path == "/v1/stats":
             payload = _serving_state().stats_snapshot()
+            self._reply(
+                200, json.dumps(payload).encode(), "application/json"
+            )
+        elif path == "/v1/metrics":
+            self._reply(
+                200, _serving_state().metrics_text().encode(),
+                "text/plain; version=0.0.4",
+            )
+        elif path == "/v1/trace":
+            # Only the QUERY-PARAM parsing maps to 400 — a rendering
+            # failure must stay a 500, not masquerade as a scraper
+            # misconfiguration.
+            try:
+                q = {k: v[0] for k, v in parse_qs(url.query).items()}
+                outcome = q.get("outcome")
+                if outcome is not None and (
+                    outcome not in obs_trace.OUTCOMES
+                ):
+                    raise ValueError(
+                        f"unknown outcome {outcome!r} "
+                        f"(one of {', '.join(obs_trace.OUTCOMES)})"
+                    )
+                n = int(q.get("n", 32))
+            except ValueError as e:
+                self._reply_error(400, "bad_request", str(e))
+                return
+            st = _serving_state()
+            traces = st.tracer.recorder.query(
+                n=n,
+                slowest=q.get("slowest") == "1",
+                trace_id=q.get("id"),
+                outcome=outcome,
+            )
+            payload = {
+                "enabled": st.tracer.enabled,
+                "ring": st.tracer.recorder.stats(),
+                "traces": [t.as_dict() for t in traces],
+            }
             self._reply(
                 200, json.dumps(payload).encode(), "application/json"
             )
         else:
             self._reply(404, b"not found", "text/plain")
 
-    def _points_reply(self, words: np.ndarray, nq: int, packed: bool, st):
-        with st.phase("reply"):
+    def _points_reply(self, words: np.ndarray, nq: int, packed: bool, st,
+                      trace=None):
+        with st.phase("reply"), obs_trace.maybe_span(trace, "reply"):
             faults.fire("reply.write")
             if packed:
                 self._reply(200, bitpack.words_to_wire(words, nq))
@@ -491,7 +633,41 @@ class _Handler(BaseHTTPRequestHandler):
                 self._abort_connection()
             st.merge_timer(tm)
 
+    def _profile_request(self, body: bytes):
+        """POST /v1/profile: knob-gated, duration-bounded XProf capture
+        (obs/profile.py).  Body: ``{"action": "start"|"stop"|"status"
+        [, "seconds": S][, "dir": path]}``."""
+        spec = json.loads(body or b"{}")
+        action = spec.get("action", "start")
+        try:
+            if action == "start":
+                out = obs_profile.start(
+                    spec.get("dir"),
+                    spec.get("seconds"),
+                )
+            elif action == "stop":
+                out = obs_profile.stop()
+            elif action == "status":
+                out = obs_profile.status()
+            else:
+                raise ValueError(
+                    f"unknown action {action!r} (start|stop|status)"
+                )
+        except obs_profile.ProfileForbidden as e:
+            self._reply_error(403, "profile_forbidden", str(e))
+            return
+        except obs_profile.ProfileBusy as e:
+            self._reply_error(409, "profile_active", str(e))
+            return
+        except obs_profile.ProfileError as e:
+            self._reply_error(400, "bad_request", str(e))
+            return
+        self._reply(200, json.dumps(out).encode(), "application/json")
+
     def do_POST(self):
+        trace = None
+        st = None
+        outcome = "ok"
         try:
             url = urlparse(self.path)
             q = {k: v[0] for k, v in parse_qs(url.query).items()}
@@ -504,6 +680,11 @@ class _Handler(BaseHTTPRequestHandler):
                 shapes = spec.get("shapes", []) if isinstance(spec, dict) \
                     else spec
                 warmed = plans.warmup(shapes)
+                if warmed:
+                    # /readyz flips to 200 — but only when this warmup
+                    # actually compiled something: an empty spec must
+                    # not advertise readiness over a cold plan cache.
+                    st.warmed = True
                 self._reply(
                     200,
                     json.dumps(
@@ -515,11 +696,21 @@ class _Handler(BaseHTTPRequestHandler):
                     "application/json",
                 )
                 return
+            if route == "/v1/profile":
+                self._profile_request(body)
+                return
+
+            # Flight-recorder trace for the serving routes (None when
+            # DPF_TPU_TRACE=off): id from the client's X-DPF-Trace
+            # header, or generated here at ingress.
+            trace = st.tracer.begin(self.headers.get(TRACE_HEADER), route)
 
             profile = q.get("profile", "compat")
             api, key_len, batch_cls = _profile_api(profile)
             log_n = int(q["log_n"])
             deadline = _deadline_from(self.headers)
+            if trace is not None:
+                trace.set_attrs(profile=profile, log_n=log_n)
 
             def cached_keys(kind, blob, k, kl, cls=None):
                 """Parse ``k`` concatenated keys through the repack LRU."""
@@ -553,16 +744,20 @@ class _Handler(BaseHTTPRequestHandler):
                 ) and not st.degraded():
                     # (Degraded mode buffers: a dispatch error surfaces
                     # as a clean status line, never a truncated stream.)
-                    st.breaker.admit()
+                    with obs_trace.maybe_span(trace, "admission"):
+                        st.breaker.admit()
                     self._evalfull_stream(
                         profile, kb, log_n, st, deadline
                     )
                 else:
                     with st.phase("dispatch"):
                         out = st.direct(
-                            lambda: _run_evalfull(profile, kb), deadline
+                            lambda: _run_evalfull(profile, kb), deadline,
+                            trace=trace,
                         )
-                    with st.phase("reply"):
+                    with st.phase("reply"), obs_trace.maybe_span(
+                        trace, "reply"
+                    ):
                         self._reply(200, out[0].tobytes())
             elif route == "/v1/evalfull_batch":
                 k = int(q["k"])
@@ -572,9 +767,12 @@ class _Handler(BaseHTTPRequestHandler):
                 kb = cached_keys(profile, bytes(body), k, kl)
                 with st.phase("dispatch"):
                     out = st.direct(
-                        lambda: _run_evalfull(profile, kb), deadline
+                        lambda: _run_evalfull(profile, kb), deadline,
+                        trace=trace,
                     )
-                with st.phase("reply"):
+                with st.phase("reply"), obs_trace.maybe_span(
+                    trace, "reply"
+                ):
                     self._reply(200, np.ascontiguousarray(out).tobytes())
             elif route == "/v1/eval_points_batch":
                 k, nq = int(q["k"]), int(q["q"])
@@ -587,10 +785,13 @@ class _Handler(BaseHTTPRequestHandler):
                 kb = cached_keys(profile, bytes(body[: k * kl]), k, kl)
                 xs = np.frombuffer(body[k * kl :], dtype="<u8").reshape(k, nq)
                 words = st.run(
-                    PointsWork("points", profile, kb, xs, deadline=deadline),
+                    PointsWork(
+                        "points", profile, kb, xs, deadline=deadline,
+                        trace=trace,
+                    ),
                     dispatch_points,
                 )
-                self._points_reply(words, nq, packed, st)
+                self._points_reply(words, nq, packed, st, trace)
             elif route == "/v1/dcf_gen":
                 from .models import dcf
 
@@ -618,11 +819,12 @@ class _Handler(BaseHTTPRequestHandler):
                 xs = np.frombuffer(body[k * kl :], dtype="<u8").reshape(k, nq)
                 words = st.run(
                     PointsWork(
-                        "dcf_points", "fast", kb, xs, deadline=deadline
+                        "dcf_points", "fast", kb, xs, deadline=deadline,
+                        trace=trace,
                     ),
                     dispatch_points,
                 )
-                self._points_reply(words, nq, packed, st)
+                self._points_reply(words, nq, packed, st, trace)
             elif route == "/v1/dcf_interval_gen":
                 from .models import dcf
 
@@ -679,22 +881,27 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                 xs = np.frombuffer(body[blob_len:], dtype="<u8").reshape(k, nq)
                 words = st.run(
-                    IntervalWork(triple, xs, deadline=deadline),
+                    IntervalWork(triple, xs, deadline=deadline, trace=trace),
                     dispatch_interval,
                 )
-                self._points_reply(words, nq, packed, st)
+                self._points_reply(words, nq, packed, st, trace)
             else:
+                # A misrouted client is a client error, not a healthy
+                # request — its trace must not pollute ?outcome=ok.
+                outcome = "bad_request"
                 self._reply(404, b"not found", "text/plain")
         except ServingError as e:
             # Load-survival errors carry their own HTTP mapping: 429
             # shed, 503 open circuit, 504 missed deadline — plus a
             # Retry-After derived from observed dispatch latency.
+            outcome = _ERROR_OUTCOMES.get(e.code, "error")
             self._reply_error(e.http_status, e.code, e.detail,
                               e.retry_after_s)
         except (ValueError, KeyError) as e:
             # Validation failures: our own parameter/shape messages (the
             # secret-hygiene pass keeps raises in this tree free of key
             # bytes, so str(e) is client-safe here).
+            outcome = "bad_request"
             detail = (
                 f"missing parameter {e}" if isinstance(e, KeyError)
                 else str(e)
@@ -705,6 +912,7 @@ class _Handler(BaseHTTPRequestHandler):
             # can embed operand values (key material).  Type name only;
             # transient device signatures map to 503 so clients back off
             # instead of hammering a wedged device.
+            outcome = "error"
             if is_transient(e):
                 self._reply_error(
                     503, "unavailable", type(e).__name__,
@@ -712,6 +920,12 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             else:
                 self._reply_error(500, "internal", type(e).__name__)
+        finally:
+            # Shed/expired/breaker-rejected requests are recorded too —
+            # an overload incident must be reconstructable from the
+            # flight recorder after the fact.
+            if st is not None:
+                st.tracer.finish(trace, outcome)
 
 
 def audit_knobs() -> list[str]:

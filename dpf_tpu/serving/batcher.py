@@ -63,6 +63,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..core import bitpack, knobs, plans
+from ..obs import trace as obs_trace
 from . import faults
 from .errors import DeadlineError, ShedError
 
@@ -78,6 +79,8 @@ class PointsWork:
     xs: np.ndarray  # uint64 [K, Q]
     # Absolute deadline (time.perf_counter seconds), None = unbounded.
     deadline: float | None = None
+    # The request's RequestTrace (obs/trace.py), None when tracing is off.
+    trace: object = None
     # Filled by the batcher:
     queue_wait: float = 0.0
     dispatch_s: float = 0.0
@@ -100,6 +103,7 @@ class IntervalWork:
     ik: tuple
     xs: np.ndarray
     deadline: float | None = None
+    trace: object = None
     queue_wait: float = 0.0
     dispatch_s: float = 0.0
     coalesced: int = 0
@@ -251,7 +255,7 @@ class Batcher:
     def __init__(
         self, window_us: float | None = None, max_keys: int | None = None,
         timeout_s: float | None = None, max_depth: int | None = None,
-        max_age_ms: float | None = None,
+        max_age_ms: float | None = None, lock=None, metrics=None,
     ):
         if window_us is None:
             window_us = knobs.get_float("DPF_TPU_BATCH_WINDOW_US")
@@ -268,16 +272,27 @@ class Batcher:
         self.timeout_s = timeout_s
         self.max_depth = max(int(max_depth), 1)
         self.max_age_s = max(float(max_age_ms), 0.0) / 1e3
-        self._lock = threading.Lock()
+        # ``lock`` lets the serving state share ONE stats lock across the
+        # batcher, breaker, key cache, phase timers, and metrics hub so
+        # /v1/stats + /v1/metrics snapshots are consistent across all of
+        # them (must then be an RLock); standalone batchers get their own.
+        self._lock = lock if lock is not None else threading.Lock()
+        # Metrics hub (obs/metrics.py) for the coalesce-size histogram.
+        self._metrics = metrics
         self._pending: dict[tuple, deque] = {}
         self._busy: set = set()
         self.stats = BatcherStats()
 
     def stats_dict(self) -> dict:
         """Consistent stats snapshot (taken under the batcher lock —
-        leaders mutate the counters concurrently)."""
+        leaders mutate the counters concurrently).  Includes the live
+        ``queue_depth`` gauge across lanes."""
         with self._lock:
-            return self.stats.as_dict()
+            out = self.stats.as_dict()
+            out["queue_depth"] = sum(
+                len(q) for q in self._pending.values()
+            )
+            return out
 
     def _retry_after_locked(self, depth: int) -> float:
         """Retry-After for a shed reply, derived from the observed
@@ -436,14 +451,46 @@ class Batcher:
                 if not live:
                     continue
                 nk = sum(r.work.n_keys for r in live)
+                # Tracing: each batch-mate's tree gets its own queue_wait
+                # + coalesce spans (with the OTHER mates' trace ids), and
+                # every tree adopts the SAME dispatch span object below —
+                # the shared span_id is how /v1/trace shows one slow
+                # device dispatch across all the requests that rode it.
+                traced = [
+                    r for r in live
+                    if getattr(r.work, "trace", None) is not None
+                ]
+                dspan = None
+                if traced:
+                    mates = [r.work.trace.trace_id for r in traced]
+                    for r in traced:
+                        tr = r.work.trace
+                        tr.add_span(
+                            "queue_wait", t0=r.t0, dur_s=r.work.queue_wait
+                        )
+                        tr.add_span(
+                            "coalesce", t0=t0, dur_s=0.0, coalesced=nk,
+                            batch_mates=[
+                                m for m in mates if m != tr.trace_id
+                            ],
+                        )
+                    dspan = obs_trace.Span("dispatch")
                 try:
-                    results = dispatch([r.work for r in live])
+                    with obs_trace.dispatch_scope(dspan):
+                        results = dispatch([r.work for r in live])
                     for r, res in zip(live, results):
                         r.result = res
                 except Exception as e:  # noqa: BLE001 — fan out per request
                     for r in live:
                         r.error = e
+                    if dspan is not None:
+                        dspan.set_attrs(error=type(e).__name__)
                 dt = time.perf_counter() - t0
+                if dspan is not None:
+                    dspan.end()
+                    dspan.set_attrs(coalesced=nk)
+                    for r in traced:
+                        r.work.trace.attach(dspan)
                 t1 = time.perf_counter()
                 # Expired-in-flight: the dispatch outlived the deadline —
                 # the work already burned its device slot, so it is
@@ -477,6 +524,8 @@ class Batcher:
                         max(r.work.queue_wait for r in live),
                     )
                     self.stats.recent.append(nk)
+                    if self._metrics is not None:
+                        self._metrics.observe_coalesce(nk)
                 for r in live:
                     r.work.dispatch_s = dt
                     r.work.coalesced = nk

@@ -43,6 +43,7 @@ import threading
 import time
 
 from ..core import knobs
+from ..obs import trace as obs_trace
 from .errors import OverloadedError
 
 # Substrings that mark an exception as environment-transient — the same
@@ -85,6 +86,7 @@ class CircuitBreaker:
         backoff_ms: float | None = None,
         probe=None,
         probe_enabled: bool | None = None,
+        lock=None,
     ):
         if threshold is None:
             threshold = knobs.get_int("DPF_TPU_BREAKER_THRESHOLD")
@@ -102,7 +104,10 @@ class CircuitBreaker:
         self.backoff_s = max(float(backoff_ms), 0.0) / 1e3
         self._probe = probe
         self._probe_enabled = probe_enabled and probe is not None
-        self._lock = threading.Lock()
+        # ``lock`` lets the serving state share its single stats RLock so
+        # breaker counters land in the same consistent /v1/stats snapshot
+        # as the batcher's; standalone breakers keep their own.
+        self._lock = lock if lock is not None else threading.Lock()
         self._state = CLOSED
         self._consecutive = 0
         self._opened_at = 0.0
@@ -197,6 +202,12 @@ class CircuitBreaker:
                     if not can_retry:
                         self._record_failure()
                         raise
+                    # The retry is visible in the request's span tree
+                    # (child of the active dispatch span).
+                    obs_trace.add_event(
+                        "retry", attempt=attempt + 1,
+                        error=type(e).__name__,
+                    )
                     time.sleep(
                         min(
                             self.backoff_s * (2 ** attempt),

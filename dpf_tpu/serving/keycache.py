@@ -25,12 +25,15 @@ from ..core import knobs
 
 
 class KeyCache:
-    def __init__(self, entries: int | None = None):
+    def __init__(self, entries: int | None = None, lock=None):
         if entries is None:
             entries = knobs.get_int("DPF_TPU_KEY_CACHE_ENTRIES")
         self.entries = max(int(entries), 0)
         self._lru: OrderedDict = OrderedDict()
-        self._lock = threading.Lock()
+        # ``lock`` lets the serving state share its single stats RLock
+        # (consistent /v1/stats + /v1/metrics snapshots); standalone
+        # caches keep their own.
+        self._lock = lock if lock is not None else threading.Lock()
         self.hits = 0
         self.misses = 0
 

@@ -20,10 +20,12 @@
 #       output differentials (every packed route vs its byte-per-bit twin
 #       plus the sidecar wire contract), the serving fast path
 #       (plan cache / micro-batcher / streaming EvalFull differentials,
-#       tests/test_serving.py), the threaded keycache/batcher stress
-#       test, and the static-analysis suite's own tests — surfaces
-#       kernel + serving regressions in minutes instead of the
-#       full-suite half hour.
+#       tests/test_serving.py), the observability plane (flight-recorder
+#       span trees, strict Prometheus exposition + /v1/stats equality,
+#       readyz/profile gating, tests/test_obs.py), the threaded
+#       keycache/batcher stress test, and the static-analysis suite's
+#       own tests — surfaces kernel + serving regressions in minutes
+#       instead of the full-suite half hour.
 #   ./runtests.sh --faults [pytest args] fault-injection lane: the
 #       load-survival suite (tests/test_load_survival.py — admission
 #       control/shedding, deadlines, circuit-breaker trip/recover,
@@ -44,7 +46,7 @@ elif [ "${1:-}" = "--fast" ]; then
   shift
   set -- tests/test_aes_pallas.py tests/test_chacha_pallas.py \
       tests/test_fused_expand.py tests/test_aes_bitslice.py \
-      tests/test_packed.py tests/test_serving.py \
+      tests/test_packed.py tests/test_serving.py tests/test_obs.py \
       tests/test_serving_stress.py tests/test_analysis.py \
       tests/test_oblivious.py \
       -q -m 'not slow' "$@"

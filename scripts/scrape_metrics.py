@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Diff two /v1/metrics scrapes into per-second rates.
+
+The sidecar's counters are cumulative; what an operator watching a
+hardware run wants is RATES — sheds/sec, dispatches/sec, keys/sec —
+over a window they chose.  This helper takes two scrapes and a time
+base and prints exactly that, plus the current gauges and the window's
+per-phase latency / coalesce-size distributions (histogram bucket
+deltas, de-cumulated, with the window mean).
+
+Live (scrape, wait, scrape):
+
+    python scripts/scrape_metrics.py --url http://127.0.0.1:8990 \
+        --interval 10
+
+Offline (two saved expositions, e.g. from a TPU run's artifacts):
+
+    curl -s $BASE/v1/metrics > a.prom; sleep 30
+    curl -s $BASE/v1/metrics > b.prom
+    python scripts/scrape_metrics.py a.prom b.prom --seconds 30
+
+Parsing is the strict shared parser (dpf_tpu/obs/promtext.py), so a
+malformed exposition fails loudly here exactly as it would in the test
+suite.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from dpf_tpu.obs import promtext  # noqa: E402
+
+
+def _fetch(url: str) -> str:
+    with urllib.request.urlopen(url + "/v1/metrics", timeout=30) as r:
+        return r.read().decode()
+
+
+def _fmt_labels(labels: tuple) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in labels) + "}"
+
+
+def _histogram_window(a: promtext.Scrape, b: promtext.Scrape,
+                      name: str) -> list[str]:
+    """The window's observation distribution for one histogram family:
+    per-series bucket count deltas (de-cumulated) plus the window mean
+    from the _sum/_count deltas."""
+    lines: list[str] = []
+    grouped: dict[tuple, list[tuple[float, float]]] = {}
+    for labels, after in b.family(f"{name}_bucket").items():
+        le = dict(labels)["le"]
+        rest = tuple(kv for kv in labels if kv[0] != "le")
+        before = a.samples.get((f"{name}_bucket", labels), 0.0)
+        bound = float("inf") if le == "+Inf" else float(le)
+        grouped.setdefault(rest, []).append((bound, after - before))
+    for rest in sorted(grouped):
+        series = sorted(grouped[rest], key=lambda bv: bv[0])
+        d_count = b.value(f"{name}_count", dict(rest)) - a.samples.get(
+            (f"{name}_count", rest), 0.0
+        )
+        if not d_count:
+            continue
+        d_sum = b.value(f"{name}_sum", dict(rest)) - a.samples.get(
+            (f"{name}_sum", rest), 0.0
+        )
+        mean = d_sum / d_count
+        mean_txt = (
+            f"mean={mean * 1e3:.3f}ms" if name.endswith("_seconds")
+            else f"mean={mean:g}"
+        )
+        lines.append(
+            f"  {name + _fmt_labels(rest):<58} n={d_count:g} {mean_txt}"
+        )
+        prev = 0.0
+        for bound, cum in series:
+            if cum - prev:
+                label = "+Inf" if bound == float("inf") else f"{bound:g}"
+                lines.append(f"    le={label:<10} +{cum - prev:g}")
+            prev = cum
+    return lines
+
+
+def diff_report(a: promtext.Scrape, b: promtext.Scrape,
+                seconds: float) -> str:
+    lines = [f"# rates over {seconds:g}s (counter deltas / seconds)"]
+    rows = []
+    for (name, labels), after in sorted(b.counters().items()):
+        before = a.samples.get((name, labels), 0.0)
+        delta = after - before
+        if delta < 0:
+            rows.append((name, labels, delta, "COUNTER RESET?"))
+        elif delta:
+            rows.append((name, labels, delta, f"{delta / seconds:.3f}/s"))
+    if not rows:
+        lines.append("  (no counter movement)")
+    for name, labels, delta, rate in rows:
+        lines.append(
+            f"  {name + _fmt_labels(labels):<58} +{delta:<12g} {rate}"
+        )
+    lines.append("# gauges (second scrape)")
+    for (name, labels), v in sorted(b.samples.items()):
+        if b.types.get(name) == "gauge":
+            lines.append(f"  {name + _fmt_labels(labels):<58} {v:g}")
+    lines.append("# latency / size distributions over the window")
+    hist_lines: list[str] = []
+    for name, kind in sorted(b.types.items()):
+        if kind == "histogram":
+            hist_lines.extend(_histogram_window(a, b, name))
+    lines.extend(hist_lines or ["  (no observations in the window)"])
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("files", nargs="*",
+                    help="two saved expositions (offline mode)")
+    ap.add_argument("--url", help="sidecar base URL (live mode)")
+    ap.add_argument("--interval", type=float, default=10.0,
+                    help="live mode: seconds between the two scrapes")
+    ap.add_argument("--seconds", type=float,
+                    help="offline mode: seconds between the saved scrapes")
+    args = ap.parse_args(argv)
+
+    if args.url:
+        text_a = _fetch(args.url)
+        time.sleep(args.interval)
+        text_b = _fetch(args.url)
+        seconds = args.interval
+    elif len(args.files) == 2:
+        if not args.seconds:
+            ap.error("offline mode needs --seconds (time between scrapes)")
+        with open(args.files[0], encoding="utf-8") as f:
+            text_a = f.read()
+        with open(args.files[1], encoding="utf-8") as f:
+            text_b = f.read()
+        seconds = args.seconds
+    else:
+        ap.error("pass --url (live) or exactly two exposition files")
+        return 2
+    print(diff_report(promtext.parse(text_a), promtext.parse(text_b),
+                      seconds))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,9 +57,14 @@ def test_secret_pass_catches_fixture():
     # Error-reply bodies are a sink too (the sidecar's 4xx/5xx paths
     # cross the bridge to the other party).
     assert "'key_bytes' flows into an error-reply body" in messages
-    # The sanctioned sha256/len usage stays clean: every finding lies in
-    # the four seeded functions, none in sanctioned().
-    assert len(found) == 4
+    # Telemetry sinks: span attributes and metric labels are exported
+    # verbatim by /v1/trace and /v1/metrics.
+    assert "'seeds' flows into telemetry" in messages
+    assert "'key_bytes' flows into telemetry" in messages
+    # The sanctioned sha256/len usages stay clean: every finding lies in
+    # the six seeded functions, none in sanctioned()/
+    # sanctioned_telemetry().
+    assert len(found) == 6
 
 
 def test_hostsync_pass_catches_fixture():

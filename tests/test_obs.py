@@ -1,0 +1,684 @@
+"""Observability plane: flight recorder, metrics exposition, profiling.
+
+Pins the PR's acceptance contracts on CPU:
+
+  * a coalesced batch yields a COMPLETE span tree for every batch-mate
+    (ingress/admission/queue_wait/coalesce/dispatch/plan_lookup/compute/
+    d2h/reply), the dispatch span is the SAME span_id in every mate's
+    tree, and the coalesce span names the other mates' trace ids;
+  * the flight-recorder ring never exceeds DPF_TPU_TRACE_RING and keeps
+    the most recent traces;
+  * GET /v1/metrics parses under the STRICT Prometheus text-format
+    parser (obs/promtext.py) and its counters equal /v1/stats exactly;
+  * fault-injected shed and expired requests appear in /v1/trace with
+    the right outcome (overload incidents are reconstructable);
+  * /v1/stats is one consistent snapshot under a single stats lock
+    (threaded mutation test);
+  * /healthz is liveness-only; /readyz gates on warmup + breaker;
+  * POST /v1/profile refuses without DPF_TPU_PROFILE_ALLOW and emits an
+    XProf directory with it.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpf_tpu.obs import promtext
+from dpf_tpu.serving import faults
+
+pytestmark = pytest.mark.filterwarnings("ignore::RuntimeWarning")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.clear()
+
+
+@pytest.fixture()
+def server_factory(monkeypatch):
+    """Sidecar factory: env knobs set BEFORE the lazy serving state reads
+    them; every started server torn down afterwards."""
+    from dpf_tpu import server as srv_mod
+
+    started = []
+
+    def start(**env):
+        for name, value in env.items():
+            monkeypatch.setenv(name, value)
+        srv_mod.reset_serving_state()
+        s = srv_mod.serve(port=0)
+        started.append(s)
+        return f"http://127.0.0.1:{s.server_address[1]}"
+
+    yield start
+    for s in started:
+        s.shutdown()
+    srv_mod.reset_serving_state()
+
+
+def _post(url, body=b"", headers=None, timeout=60):
+    req = urllib.request.Request(url, data=body, method="POST")
+    for name, value in (headers or {}).items():
+        req.add_header(name, value)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read()
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def _traces(base, **params):
+    qs = "&".join(f"{k}={v}" for k, v in params.items())
+    return json.loads(_get(f"{base}/v1/trace?{qs}"))["traces"]
+
+
+def _traces_settled(base, want_ids, timeout=5.0, **params):
+    """{trace_id: trace} once every id in ``want_ids`` is recorded.
+    A handler finishes its trace AFTER writing the reply bytes, so a
+    client that races straight to /v1/trace can observe the ring a few
+    microseconds early — poll briefly instead of flaking."""
+    deadline = time.time() + timeout
+    while True:
+        got = {t["trace_id"]: t for t in _traces(base, **params)}
+        if set(want_ids) <= set(got) or time.time() > deadline:
+            return got
+        time.sleep(0.02)
+
+
+def _points_job(base, log_n=10, q=8, seed=5):
+    """(path, body) of one fast-profile single-key pointwise request."""
+    from dpf_tpu.core import chacha_np as cc
+
+    rng = np.random.default_rng(seed)
+    alpha = int(rng.integers(0, 1 << log_n))
+    keys = _post(f"{base}/v1/gen?log_n={log_n}&alpha={alpha}&profile=fast")
+    key = keys[: cc.key_len(log_n)]
+    xs = rng.integers(0, 1 << log_n, size=(1, q), dtype=np.uint64)
+    path = (
+        f"/v1/eval_points_batch?log_n={log_n}&k=1&q={q}"
+        "&profile=fast&format=packed"
+    )
+    return path, key + xs.tobytes()
+
+
+def _span_index(trace_dict):
+    """{name: [span dicts]} over the whole tree of one /v1/trace entry."""
+    out = {}
+    stack = list(trace_dict["spans"])
+    while stack:
+        sp = stack.pop()
+        out.setdefault(sp["name"], []).append(sp)
+        stack.extend(sp["children"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Span-tree completeness for a coalesced batch
+# ---------------------------------------------------------------------------
+
+
+def test_coalesced_batch_span_trees_complete(server_factory):
+    """Every batch-mate of one coalesced dispatch shows the full span
+    tree, shares the SAME dispatch span (by span_id), and its coalesce
+    span names the other mates."""
+    base = server_factory(DPF_TPU_BATCH_WINDOW_US="20000")
+    path, body = _points_job(base)
+    n = 6
+    ids = [f"mate-{i}" for i in range(n)]
+    errs = []
+
+    def client(i):
+        try:
+            _post(base + path, body, {"X-DPF-Trace": ids[i]})
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errs
+
+    by_id = {
+        tid: t for tid, t in _traces_settled(base, ids, n=64).items()
+        if tid in ids
+    }
+    assert set(by_id) == set(ids), "every request must be recorded"
+
+    want = {
+        "ingress", "admission", "queue_wait", "coalesce", "dispatch",
+        "plan_lookup", "compute", "d2h", "reply",
+    }
+    dispatch_ids = {}
+    coalesced_counts = {}
+    for tid, tr in by_id.items():
+        assert tr["outcome"] == "ok"
+        idx = _span_index(tr)
+        assert want <= set(idx), (
+            f"{tid}: missing spans {want - set(idx)}"
+        )
+        dspan = idx["dispatch"][0]
+        dispatch_ids[tid] = dspan["span_id"]
+        coalesced_counts[tid] = idx["coalesce"][0]["attrs"]["coalesced"]
+        # plan_lookup/compute/d2h are children OF the dispatch span.
+        child_names = {c["name"] for c in dspan["children"]}
+        assert {"plan_lookup", "compute", "d2h"} <= child_names
+
+    # At least one group of >= 2 requests rode one shared dispatch span,
+    # and within that group the coalesce attrs cross-reference the mates.
+    groups = {}
+    for tid, sid in dispatch_ids.items():
+        groups.setdefault(sid, []).append(tid)
+    biggest = max(groups.values(), key=len)
+    assert len(biggest) >= 2, f"no coalescing observed: {groups}"
+    for tid in biggest:
+        mates = by_id[tid]["spans"][0]
+        idx = _span_index(by_id[tid])
+        listed = set(idx["coalesce"][0]["attrs"]["batch_mates"])
+        others = set(biggest) - {tid}
+        assert others <= listed, (
+            f"{tid}: batch_mates {listed} missing {others - listed}"
+        )
+        assert coalesced_counts[tid] >= len(biggest)
+
+
+def test_generated_trace_id_and_hostile_header(server_factory):
+    """Requests without X-DPF-Trace get a generated id; a hostile header
+    is replaced, never echoed into the payload."""
+    base = server_factory()
+    path, body = _points_job(base)
+    _post(base + path, body)
+    evil = 'x" }<script>' + "A" * 100
+    _post(base + path, body, {"X-DPF-Trace": evil})
+    deadline = time.time() + 5
+    while True:
+        got = _traces(base, n=8)
+        if len(got) >= 3 or time.time() > deadline:  # gen + 2 posts
+            break
+        time.sleep(0.02)
+    assert len(got) >= 3
+    assert all(t["trace_id"] for t in got)
+    assert all(evil not in json.dumps(t) for t in got)
+
+
+# ---------------------------------------------------------------------------
+# Flight-recorder ring bounds
+# ---------------------------------------------------------------------------
+
+
+def test_ring_eviction_bounds(server_factory):
+    base = server_factory(DPF_TPU_TRACE_RING="5")
+    path, body = _points_job(base)
+    for i in range(12):
+        _post(base + path, body, {"X-DPF-Trace": f"req-{i:02d}"})
+    _traces_settled(base, ["req-11"], n=100)
+    payload = json.loads(_get(f"{base}/v1/trace?n=100"))
+    assert payload["ring"]["capacity"] == 5
+    assert payload["ring"]["size"] == 5
+    # 12 points requests + the _points_job helper's /v1/gen.
+    assert payload["ring"]["recorded"] == 13
+    assert payload["ring"]["evicted"] == 8
+    got = [t["trace_id"] for t in payload["traces"]]
+    # Newest first, only the 5 most recent survive.
+    assert got == [f"req-{i:02d}" for i in (11, 10, 9, 8, 7)]
+
+
+def test_trace_query_filters(server_factory):
+    base = server_factory()
+    path, body = _points_job(base)
+    for i in range(4):
+        _post(base + path, body, {"X-DPF-Trace": f"q-{i}"})
+    _traces_settled(base, [f"q-{i}" for i in range(4)], n=100)
+    assert [t["trace_id"] for t in _traces(base, n=2)] == ["q-3", "q-2"]
+    by_id = _traces(base, id="q-1")
+    assert len(by_id) == 1 and by_id[0]["trace_id"] == "q-1"
+    slowest = _traces(base, slowest=1, n=100)
+    durs = [t["duration_ms"] for t in slowest]
+    assert durs == sorted(durs, reverse=True)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(f"{base}/v1/trace?outcome=bogus")
+    assert ei.value.code == 400
+
+
+def test_trace_off_disables_recording(server_factory):
+    base = server_factory(DPF_TPU_TRACE="off")
+    path, body = _points_job(base)
+    _post(base + path, body, {"X-DPF-Trace": "invisible"})
+    payload = json.loads(_get(f"{base}/v1/trace?n=10"))
+    assert payload["enabled"] is False
+    assert payload["traces"] == []
+
+
+# ---------------------------------------------------------------------------
+# Shed / expired / breaker-rejected outcomes in the flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_shed_and_expired_recorded_with_outcome(server_factory):
+    """Overload reconstruction: a shed arrival and a deadline-expired
+    request both land in the ring with their outcome — even though
+    neither produced a 200."""
+    base = server_factory(
+        DPF_TPU_QUEUE_MAX_DEPTH="1",
+        DPF_TPU_BATCH_WINDOW_US="0",
+    )
+    path, body = _points_job(base)
+    _post(base + path, body)  # plans compiled off the critical path
+
+    with faults.injected("dispatch.points:latency:ms=300"):
+        statuses = {}
+        lock = threading.Lock()
+
+        def client(i):
+            try:
+                _post(base + path, body, {"X-DPF-Trace": f"ov-{i}"})
+                code = 200
+            except urllib.error.HTTPError as e:
+                code = e.code
+            with lock:
+                statuses[f"ov-{i}"] = code
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+            time.sleep(0.02)  # leader in flight, queue fills, then sheds
+        for t in threads:
+            t.join(60)
+    assert 429 in statuses.values(), f"no shed: {statuses}"
+
+    # Every shed request's trace is in the ring with outcome "shed".
+    shed_ids = {tid for tid, code in statuses.items() if code == 429}
+    recorded = _traces_settled(base, shed_ids, n=64, outcome="shed")
+    assert shed_ids <= set(recorded)
+    for tid in shed_ids:
+        idx = _span_index(recorded[tid])
+        assert "ingress" in idx and "admission" in idx
+
+    # An expired-before-dispatch request is recorded as "expired".
+    with faults.injected("dispatch.points:latency:ms=150"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(
+                base + path, body,
+                {"X-DPF-Trace": "doomed", "X-DPF-Deadline-Ms": "40"},
+            )
+    assert ei.value.code == 504
+    expired = _traces_settled(base, ["doomed"], outcome="expired")
+    assert "doomed" in expired
+
+
+def test_breaker_rejected_recorded(server_factory):
+    base = server_factory(
+        DPF_TPU_BREAKER_THRESHOLD="1",
+        DPF_TPU_DISPATCH_RETRIES="0",
+        DPF_TPU_BREAKER_COOLDOWN_MS="60000",
+        DPF_TPU_BREAKER_PROBE="off",
+    )
+    path, body = _points_job(base)
+    with faults.injected("dispatch.points:unavailable:times=1"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base + path, body, {"X-DPF-Trace": "tripper"})
+        assert ei.value.code == 503
+        with pytest.raises(urllib.error.HTTPError) as ei2:
+            _post(base + path, body, {"X-DPF-Trace": "rejected"})
+        assert ei2.value.code == 503
+    got = _traces_settled(base, ["rejected"], outcome="breaker_rejected")
+    assert "rejected" in got
+
+
+def test_dispatch_retry_event_in_span(server_factory):
+    """A transient dispatch failure that retries leaves a retry event
+    under the shared dispatch span."""
+    base = server_factory(
+        DPF_TPU_DISPATCH_RETRIES="2",
+        DPF_TPU_RETRY_BACKOFF_MS="1",
+    )
+    path, body = _points_job(base)
+    with faults.injected("dispatch.points:unavailable:times=1"):
+        _post(base + path, body, {"X-DPF-Trace": "retried"})
+    tr = _traces_settled(base, ["retried"], id="retried")["retried"]
+    idx = _span_index(tr)
+    assert tr["outcome"] == "ok"
+    assert "retry" in idx
+    assert idx["retry"][0]["attrs"]["attempt"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition: strict parse + exact /v1/stats equality
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_strict_parse_and_stats_equality(server_factory):
+    base = server_factory(DPF_TPU_BATCH_WINDOW_US="20000")
+    path, body = _points_job(base)
+    # Produce movement on several counters first: traffic, a shed, a
+    # keycache hit (repeat body), a deadline miss.
+    for _ in range(3):
+        _post(base + path, body)
+    with faults.injected("dispatch.points:latency:ms=120"):
+        with pytest.raises(urllib.error.HTTPError):
+            _post(base + path, body, {"X-DPF-Deadline-Ms": "30"})
+
+    # Quiesce: the last request's trace is recorded in its handler's
+    # finally block, possibly after the 504 reached us — wait until all
+    # 5 traces (gen + 3 points + 1 expired) landed before scraping.
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if json.loads(_get(f"{base}/v1/stats"))["trace"]["recorded"] >= 5:
+            break
+        time.sleep(0.02)
+
+    # Quiesced: scrape both surfaces back to back.
+    text = _get(f"{base}/v1/metrics").decode()
+    stats = json.loads(_get(f"{base}/v1/stats"))
+    scrape = promtext.parse(text, strict=True)  # raises on any violation
+
+    b = stats["batcher"]
+    br = stats["breaker"]
+    pl = stats["plans"]
+    kc = stats["key_cache"]
+
+    def v(name, labels=None):
+        return scrape.value(name, labels)
+
+    assert v("dpf_requests_total") == b["requests"]
+    assert v("dpf_dispatches_total") == b["dispatches"]
+    assert v("dpf_keys_dispatched_total") == b["keys_dispatched"]
+    assert v("dpf_shed_total", {"kind": "depth"}) == b["shed_depth"]
+    assert v("dpf_shed_total", {"kind": "age"}) == b["shed_age"]
+    assert v("dpf_expired_total", {"where": "queue"}) == b["expired_queue"]
+    assert v("dpf_expired_total", {"where": "flight"}) == b["expired_flight"]
+    assert v("dpf_queue_wait_seconds_total") == b["queue_wait_seconds"]
+    assert v("dpf_dispatch_seconds_total") == b["dispatch_seconds"]
+    assert v("dpf_breaker_transitions_total", {"kind": "trip"}) == br["trips"]
+    assert (
+        v("dpf_breaker_transitions_total", {"kind": "recovery"})
+        == br["recoveries"]
+    )
+    assert v("dpf_breaker_fast_fails_total") == br["fast_fails"]
+    assert v("dpf_breaker_retries_total") == br["retries"]
+    assert (
+        v("dpf_breaker_transient_failures_total") == br["transient_failures"]
+    )
+    assert v("dpf_plan_hits_total") == pl["hits"]
+    assert v("dpf_plan_compiles_total") == pl["misses"]
+    assert v("dpf_keycache_hits_total") == kc["hits"]
+    assert v("dpf_keycache_misses_total") == kc["misses"]
+    assert v("dpf_keycache_entries") == kc["entries"]
+    assert v("dpf_plan_cache_plans") == len(pl["plans"])
+    assert v("dpf_breaker_state") == {"closed": 0, "half_open": 1,
+                                      "open": 2}[br["state"]]
+    assert v("dpf_traces_recorded_total") == stats["trace"]["recorded"]
+    for phase, entry in stats["phases"].items():
+        assert v("dpf_phase_seconds_total", {"phase": phase}) == (
+            entry["seconds"]
+        )
+        assert v("dpf_phase_events_total", {"phase": phase}) == (
+            entry["count"]
+        )
+    # The keycache hit above also proves cross-component consistency:
+    # metrics and stats were rendered from one snapshot function.
+    assert kc["hits"] >= 1
+
+
+def test_metrics_histograms_populated(server_factory):
+    base = server_factory()
+    path, body = _points_job(base)
+    for _ in range(4):
+        _post(base + path, body)
+    scrape = promtext.parse(_get(f"{base}/v1/metrics").decode())
+    stats = json.loads(_get(f"{base}/v1/stats"))
+    # The strict parser already proved bucket monotonicity and
+    # +Inf == _count; here: observations landed, and the histogram
+    # count is structurally tied to its counter twin (one observation
+    # per dispatch / per phase event).
+    coalesce = scrape.value("dpf_coalesce_size_count")
+    assert coalesce == stats["batcher"]["dispatches"] >= 1
+    reply = scrape.value(
+        "dpf_phase_latency_seconds_count", {"phase": "reply"}
+    )
+    assert reply == stats["phases"]["reply"]["count"] >= 4
+    assert scrape.types["dpf_phase_latency_seconds"] == "histogram"
+
+
+def test_metrics_bucket_knob_deduplicates(server_factory):
+    """A repeated bound in DPF_TPU_METRICS_BUCKETS_MS must not emit two
+    bucket samples with the same le label (strict consumers reject the
+    whole exposition)."""
+    base = server_factory(DPF_TPU_METRICS_BUCKETS_MS="1,2,2,5,5,10")
+    path, body = _points_job(base)
+    _post(base + path, body)
+    promtext.parse(_get(f"{base}/v1/metrics").decode(), strict=True)
+
+
+def test_promtext_parser_rejects_malformed():
+    with pytest.raises(promtext.PromFormatError):
+        promtext.parse("no_type_declared 1\n")
+    with pytest.raises(promtext.PromFormatError):
+        promtext.parse("# TYPE x counter\nx 1\n")  # counter w/o _total
+    with pytest.raises(promtext.PromFormatError):
+        promtext.parse("# TYPE x_total counter\nx_total 1")  # no newline
+    with pytest.raises(promtext.PromFormatError):
+        promtext.parse(
+            "# TYPE h histogram\n"
+            'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 3\n'
+            "h_sum 1\nh_count 3\n"
+        )  # non-cumulative buckets
+    # A well-formed exposition parses.
+    ok = promtext.parse(
+        "# HELP x_total say\n# TYPE x_total counter\n"
+        'x_total{a="b"} 3\n'
+    )
+    assert ok.value("x_total", {"a": "b"}) == 3
+
+
+# ---------------------------------------------------------------------------
+# Single-stats-lock snapshot consistency (the /v1/stats race fix)
+# ---------------------------------------------------------------------------
+
+
+def test_stats_snapshot_single_lock_consistency(server_factory):
+    """Paired mutations across DIFFERENT components (batcher counter +
+    keycache counter) under the stats lock must never be observed torn
+    by a snapshot — the exact race the old per-component copies had."""
+    server_factory()
+    from dpf_tpu import server as srv_mod
+
+    st = srv_mod._serving_state()
+    stop = threading.Event()
+
+    def mutate():
+        while not stop.is_set():
+            with st.stats_lock:
+                st.batcher.stats.requests += 1
+                time.sleep(0.0002)  # widen the torn-read window
+                st.keys.hits += 1
+
+    threads = [threading.Thread(target=mutate) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            snap = st.stats_snapshot()
+            assert (
+                snap["batcher"]["requests"] == snap["key_cache"]["hits"]
+            ), "snapshot observed a torn cross-component update"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(10)
+
+
+def test_stats_and_metrics_share_one_lock(server_factory):
+    server_factory()
+    from dpf_tpu import server as srv_mod
+
+    st = srv_mod._serving_state()
+    # The refactor's structural claim: every counter surface guards with
+    # THE SAME RLock object.
+    assert st.batcher._lock is st.stats_lock
+    assert st.keys._lock is st.stats_lock
+    assert st.breaker._lock is st.stats_lock
+    assert st.metrics._lock is st.stats_lock
+
+
+# ---------------------------------------------------------------------------
+# Liveness vs readiness
+# ---------------------------------------------------------------------------
+
+
+def test_healthz_liveness_readyz_readiness(server_factory):
+    base = server_factory()
+    assert _get(f"{base}/healthz") == b"ok"
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(f"{base}/readyz")
+    assert ei.value.code == 503
+    assert json.loads(ei.value.read())["code"] == "cold"
+    # An EMPTY warmup spec compiles nothing and must not advertise
+    # readiness over a cold plan cache.
+    _post(f"{base}/v1/warmup", b"[]")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(f"{base}/readyz")
+    assert ei.value.code == 503
+    _post(
+        f"{base}/v1/warmup",
+        json.dumps(
+            {"shapes": [{"route": "points", "profile": "fast",
+                         "log_n": 10, "k": 1, "q": 8}]}
+        ).encode(),
+    )
+    assert _get(f"{base}/readyz") == b"ready"
+
+
+def test_readyz_503_while_breaker_open(server_factory):
+    base = server_factory(
+        DPF_TPU_BREAKER_THRESHOLD="1",
+        DPF_TPU_DISPATCH_RETRIES="0",
+        DPF_TPU_BREAKER_COOLDOWN_MS="60000",
+        DPF_TPU_BREAKER_PROBE="off",
+    )
+    _post(
+        f"{base}/v1/warmup",
+        json.dumps(
+            {"shapes": [{"route": "points", "profile": "fast",
+                         "log_n": 10, "k": 1, "q": 8}]}
+        ).encode(),
+    )
+    assert _get(f"{base}/readyz") == b"ready"
+    path, body = _points_job(base)
+    with faults.injected("dispatch.points:unavailable:times=1"):
+        with pytest.raises(urllib.error.HTTPError):
+            _post(base + path, body)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(f"{base}/readyz")
+    assert ei.value.code == 503
+    assert json.loads(ei.value.read())["code"] == "breaker_open"
+    # Liveness is unaffected: the process still serves.
+    assert _get(f"{base}/healthz") == b"ok"
+
+
+# ---------------------------------------------------------------------------
+# On-demand XProf capture
+# ---------------------------------------------------------------------------
+
+
+def test_profile_refused_without_allow(server_factory):
+    base = server_factory()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{base}/v1/profile",
+              json.dumps({"action": "start"}).encode())
+    assert ei.value.code == 403
+    assert json.loads(ei.value.read())["code"] == "profile_forbidden"
+
+
+def test_profile_start_stop_reports_dir(server_factory, tmp_path):
+    import os
+
+    base = server_factory(DPF_TPU_PROFILE_ALLOW="1")
+    out = json.loads(
+        _post(
+            f"{base}/v1/profile",
+            json.dumps(
+                {"action": "start", "dir": str(tmp_path), "seconds": 30}
+            ).encode(),
+        )
+    )
+    assert out["status"] == "started"
+    assert out["dir"] == str(tmp_path)
+    # Double-start is refused while a capture runs.
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{base}/v1/profile",
+              json.dumps({"action": "start"}).encode())
+    assert ei.value.code == 409
+    status = json.loads(
+        _post(f"{base}/v1/profile",
+              json.dumps({"action": "status"}).encode())
+    )
+    assert status["status"] == "running"
+    # Some profiled work, then stop: the capture directory materializes.
+    path, body = _points_job(base)
+    _post(base + path, body)
+    out = json.loads(
+        _post(f"{base}/v1/profile",
+              json.dumps({"action": "stop"}).encode())
+    )
+    assert out["status"] == "stopped" and out["dir"] == str(tmp_path)
+    assert os.path.isdir(str(tmp_path))
+    # Stop with nothing running is a clean 400.
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{base}/v1/profile",
+              json.dumps({"action": "stop"}).encode())
+    assert ei.value.code == 400
+
+
+def test_profile_duration_is_bounded(server_factory, monkeypatch):
+    """The capture must auto-stop at DPF_TPU_PROFILE_MAX_S even when the
+    client never sends stop."""
+    base = server_factory(
+        DPF_TPU_PROFILE_ALLOW="1", DPF_TPU_PROFILE_MAX_S="0.3"
+    )
+    out = json.loads(
+        _post(
+            f"{base}/v1/profile",
+            json.dumps({"action": "start", "seconds": 9999}).encode(),
+        )
+    )
+    assert out["max_seconds"] == 0.3
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        status = json.loads(
+            _post(f"{base}/v1/profile",
+                  json.dumps({"action": "status"}).encode())
+        )
+        if status["status"] == "idle":
+            break
+        time.sleep(0.05)
+    assert status["status"] == "idle", "capture did not auto-stop"
+
+
+# ---------------------------------------------------------------------------
+# Overhead guard: tracing off means no per-request ring growth
+# ---------------------------------------------------------------------------
+
+
+def test_trace_off_run_has_no_tracer_work(server_factory):
+    base = server_factory(DPF_TPU_TRACE="off")
+    from dpf_tpu import server as srv_mod
+
+    path, body = _points_job(base)
+    for _ in range(3):
+        _post(base + path, body)
+    st = srv_mod._serving_state()
+    assert st.tracer.recorder.stats()["recorded"] == 0
