@@ -107,6 +107,10 @@ _ROUTE_KNOBS = (
     "DPF_TPU_DISPATCH_RETRIES", "DPF_TPU_RETRY_BACKOFF_MS",
     "DPF_TPU_BREAKER_THRESHOLD", "DPF_TPU_BREAKER_COOLDOWN_MS",
     "DPF_TPU_FAULTS",
+    # Protocol-application knobs (cfg-apps): descent geometry and the
+    # streamed-fold chunk size shape what the hh/agg rows measure.
+    "DPF_TPU_HH_THRESHOLD", "DPF_TPU_HH_LEVELS_PER_ROUND",
+    "DPF_TPU_HH_MAX_CANDIDATES", "DPF_TPU_AGG_CHUNK_BYTES",
 )
 # DPF_TPU_BENCH_LEDGER_RETRY_ERRORS=1: sections whose recorded rows
 # contain an error row are NOT replayed (and not re-recorded) — the
@@ -1332,6 +1336,108 @@ def main():
                     os.environ[k] = v
 
     _section("cfg-serving-overload", cfg_serving_overload)
+
+    # ---- protocol applications: heavy hitters + secure aggregation ---------
+    # ROADMAP item 4 as committed rows (dpf_tpu/apps/): (a) prefix-tree
+    # heavy hitters — dealer gen_batch throughput over clients x levels,
+    # then per-round key-evaluations/s of the levelwise descent (clients x
+    # candidates x 2 aggregators, every round one plan-cached grouped
+    # dispatch); (b) secure aggregation — client share rows/s through the
+    # streamed XOR and additive-mod-2^32 folds at K beyond the pointwise
+    # sections' key scales.  Rows only commit when the protocol output is
+    # exact (planted hitters recovered, folds equal the NumPy reference).
+    def cfg_apps():
+        from dpf_tpu.apps import aggregation as agg_app
+        from dpf_tpu.apps import heavy_hitters as hh_app
+        from dpf_tpu.core import plans as plans_mod
+
+        g_hh, n_hh, per_hh = (16384, 16, 320) if not small else (256, 10, 16)
+        rng_a = np.random.default_rng(24)
+        hh_planted = np.array(
+            [5, 1234 % (1 << n_hh), (1 << n_hh) - 7, (1 << n_hh) // 3],
+            dtype=np.uint64,
+        )
+        vals = rng_a.integers(0, 1 << n_hh, size=g_hh, dtype=np.uint64)
+        for i, hv in enumerate(hh_planted):
+            vals[i * per_hh : (i + 1) * per_hh] = hv
+        thr = per_hh // 2
+        t0 = time.perf_counter()
+        sh_a, sh_b = hh_app.gen_shares(vals, n_hh, profile="fast", rng=rng_a)
+        dt = time.perf_counter() - t0
+        _emit(
+            f"hh dealer gen {g_hh} clients x {n_hh} levels (fast)",
+            g_hh * n_hh / dt / 1e3, "kkeys/sec",
+            route=_route("apps,gen_batch"), scale=1e3,
+        )
+        # First run warms every (K, Q)-bucket executable AND proves the
+        # protocol output; the timed second run measures steady-state
+        # descent (the zero-retrace serving shape).
+        res = hh_app.find_heavy_hitters(sh_a, sh_b, threshold=thr)
+        got = {int(v): int(c) for v, c in zip(res.values, res.counts)}
+        want = {
+            int(hv): int((vals == hv).sum()) for hv in set(hh_planted.tolist())
+        }
+        if got != want:
+            raise RuntimeError(
+                f"hh recovery mismatch: {len(got)} found, "
+                f"{len(want)} planted"
+            )
+        res = hh_app.find_heavy_hitters(sh_a, sh_b, threshold=thr)
+        for r in res.rounds:
+            _emit(
+                f"hh round depth={r.depth} {g_hh}x{r.n_candidates} "
+                f"n={n_hh} (fast, plan-cached)",
+                r.key_evals / r.eval_s / 1e6, "Mkeyevals/sec",
+                route=_route("apps,hh-descent,packed"),
+                bytes_out=2 * g_hh * ((r.n_candidates + 7) // 8),
+                extra={"survivors": r.n_survivors, "levels": r.levels},
+            )
+        total_evals = sum(r.key_evals for r in res.rounds)
+        total_s = sum(r.eval_s for r in res.rounds)
+        _emit(
+            f"hh e2e {len(got)} hitters from {g_hh} clients n={n_hh} "
+            f"({g_hh * n_hh} keys, fast)",
+            total_evals / total_s / 1e6, "Mkeyevals/sec",
+            route=_route("apps,hh-descent,packed"),
+            extra={"rounds": len(res.rounds), "threshold": thr},
+        )
+
+        k_agg, w_agg = (1 << 20, 64) if not small else (1 << 14, 16)
+        rows_agg = rng_a.integers(
+            0, 1 << 32, size=(k_agg, w_agg), dtype=np.uint64
+        ).astype(np.uint32)
+        # Warm the ACTUAL chunk shapes the timed fold dispatches: the
+        # steady chunk (capped at k_agg when the whole upload is one
+        # chunk) plus the ragged tail's bucket when one exists.
+        step_agg = agg_app.chunk_rows(w_agg)
+        warm_ks = {min(step_agg, k_agg)}
+        if k_agg > step_agg and k_agg % step_agg:
+            warm_ks.add(k_agg % step_agg)
+        plans_mod.warmup(
+            [{"route": f"agg_{o}", "k": kk, "q": w_agg * 32}
+             for o in ("xor", "add") for kk in sorted(warm_ks)]
+        )
+        for op, ref in (
+            ("xor", np.bitwise_xor.reduce(rows_agg, axis=0)),
+            ("add", rows_agg.astype(np.uint64).sum(0).astype(np.uint32)),
+        ):
+            t0 = time.perf_counter()
+            fold = agg_app.aggregate_rows(rows_agg, op)
+            dt = time.perf_counter() - t0
+            np.testing.assert_array_equal(fold, ref)
+            _emit(
+                f"agg {op} fold {k_agg} client shares x {w_agg} words "
+                "(streamed chunks)",
+                k_agg / dt / 1e6, "Mshares/sec",
+                route=_route("apps,agg-fold"),
+                bytes_out=w_agg * 4,
+                extra={
+                    "chunk_rows": min(step_agg, k_agg),
+                    "upload_mb": round(k_agg * w_agg * 4 / 2**20, 1),
+                },
+            )
+
+    _section("cfg-apps", cfg_apps)
 
     # ---- config 4: 2-server PIR, 2^24 x 32 B, 1k queries --------------------
     def cfg4():

@@ -455,6 +455,197 @@ func (c *Client) DcfIntervalEval(blob []byte, xs [][]uint64, logN uint) ([][]byt
 	return res, nil
 }
 
+// hhKeyLen is one serialized DPF key's size for a profile:
+// 17 + 18*nu + leafBytes, where nu = logN - log2(leafBits) (compat: 128-bit
+// leaves, 16-byte final CW; fast: 512-bit leaves, 64-byte final CW) —
+// dpf_tpu/core/spec.key_len and core/chacha_np.key_len.
+func hhKeyLen(logN uint, profile string) int {
+	leafLog, leafBytes := uint(7), 16
+	if profile == "fast" {
+		leafLog, leafBytes = 9, 64
+	}
+	nu := 0
+	if logN > leafLog {
+		nu = int(logN - leafLog)
+	}
+	return 17 + 18*nu + leafBytes
+}
+
+// HHGen asks the sidecar's trusted dealer for both aggregators' share
+// blobs of the prefix-tree heavy-hitters protocol: values[c] is client
+// c's private value in [0, 2^logN).  Each blob holds one DPF key per
+// (client, tree level), client-major — slice one round's key column out
+// with HHLevelKeys.  In a real deployment clients generate their own
+// pairs and upload to the two aggregators separately; this endpoint is
+// the dealer convenience for tests and benchmarks.
+func (c *Client) HHGen(values []uint64, logN uint) ([]byte, []byte, error) {
+	if len(values) == 0 {
+		return nil, nil, nil
+	}
+	body := make([]byte, 0, 8*len(values))
+	for _, v := range values {
+		body = binary.LittleEndian.AppendUint64(body, v)
+	}
+	out, err := c.post(
+		fmt.Sprintf("/v1/hh/gen?log_n=%d&k=%d", logN, len(values)), body)
+	if err != nil {
+		return nil, nil, err
+	}
+	want := 2 * len(values) * int(logN) * hhKeyLen(logN, c.Profile)
+	if len(out) != want {
+		return nil, nil, fmt.Errorf(
+			"dpftpu: bad hh gen reply length %d, want %d", len(out), want)
+	}
+	h := len(out) / 2
+	return out[:h], out[h:], nil
+}
+
+// HHLevelKeys slices level ``level``'s key column (one key per client)
+// out of a client-major share blob from HHGen — the upload body of one
+// HHEvalLevel round.
+func (c *Client) HHLevelKeys(shareBlob []byte, logN, level uint) ([]DPFkey, error) {
+	kl := hhKeyLen(logN, c.Profile)
+	per := int(logN) * kl
+	if per == 0 || len(shareBlob) == 0 || len(shareBlob)%per != 0 {
+		return nil, fmt.Errorf(
+			"dpftpu: hh share blob length %d is not a multiple of %d",
+			len(shareBlob), per)
+	}
+	if level >= logN {
+		return nil, fmt.Errorf("dpftpu: hh level %d out of range", level)
+	}
+	keys := make([]DPFkey, len(shareBlob)/per)
+	for i := range keys {
+		off := i*per + int(level)*kl
+		keys[i] = DPFkey(shareBlob[off : off+kl])
+	}
+	return keys, nil
+}
+
+// HHEvalLevel runs one heavy-hitters round at one aggregator: every
+// client's level key evaluated at every candidate (candidates are raw
+// n-bit domain values — a depth d prefix p goes in as p << (logN - d);
+// see HHQueryValues).  The reply is one bit-packed row per client
+// (ceil(Q/8) bytes, the packed wire contract); XOR two aggregators'
+// rows and popcount with HHCounts for the public per-candidate counts.
+func (c *Client) HHEvalLevel(levelKeys []DPFkey, candidates []uint64, logN, level uint) ([][]byte, error) {
+	if len(levelKeys) == 0 || len(candidates) == 0 {
+		return nil, nil
+	}
+	kl := len(levelKeys[0])
+	body := make([]byte, 0, kl*len(levelKeys)+8*len(candidates))
+	for _, k := range levelKeys {
+		if len(k) != kl {
+			return nil, fmt.Errorf("dpftpu: inconsistent key lengths")
+		}
+		body = append(body, k...)
+	}
+	for _, x := range candidates {
+		body = binary.LittleEndian.AppendUint64(body, x)
+	}
+	out, err := c.post(fmt.Sprintf(
+		"/v1/hh/eval?log_n=%d&k=%d&q=%d&level=%d&format=packed",
+		logN, len(levelKeys), len(candidates), level), body)
+	if err != nil {
+		return nil, err
+	}
+	row := (len(candidates) + 7) / 8
+	if len(out) != len(levelKeys)*row {
+		return nil, fmt.Errorf("dpftpu: bad hh eval reply length %d", len(out))
+	}
+	res := make([][]byte, len(levelKeys))
+	for i := range res {
+		res[i] = out[i*row : (i+1)*row]
+	}
+	return res, nil
+}
+
+// HHCounts XOR-reconstructs two aggregators' packed share rows and sums
+// the per-candidate client bits into counts.  The counts — and the
+// threshold compare the caller runs on them — are PUBLIC by protocol
+// construction (they are each round's output); see docs/DESIGN.md §13.
+func HHCounts(rowsA, rowsB [][]byte, q int) ([]int, error) {
+	if len(rowsA) != len(rowsB) {
+		return nil, fmt.Errorf("dpftpu: hh share row counts differ")
+	}
+	row := (q + 7) / 8
+	counts := make([]int, q)
+	for i := range rowsA {
+		if len(rowsA[i]) != len(rowsB[i]) {
+			return nil, fmt.Errorf("dpftpu: hh share row lengths differ")
+		}
+		if len(rowsA[i]) < row {
+			return nil, fmt.Errorf(
+				"dpftpu: hh share row %d is %d bytes, need %d for q=%d",
+				i, len(rowsA[i]), row, q)
+		}
+		for j := 0; j < q; j++ {
+			if (rowsA[i][j>>3]^rowsB[i][j>>3])>>(j&7)&1 == 1 {
+				counts[j]++
+			}
+		}
+	}
+	return counts, nil
+}
+
+// HHExtend extends every surviving prefix by r bits: the next round's
+// candidate prefixes, depth-relative (pass through HHQueryValues for
+// the wire values).
+func HHExtend(survivors []uint64, r uint) []uint64 {
+	out := make([]uint64, 0, len(survivors)<<r)
+	for _, p := range survivors {
+		for j := uint64(0); j < 1<<r; j++ {
+			out = append(out, p<<r|j)
+		}
+	}
+	return out
+}
+
+// HHQueryValues shifts depth-d candidate prefixes up to full n-bit
+// domain values (the /v1/hh/eval candidate encoding).
+func HHQueryValues(prefixes []uint64, logN, depth uint) []uint64 {
+	out := make([]uint64, len(prefixes))
+	for i, p := range prefixes {
+		out[i] = p << (logN - depth)
+	}
+	return out
+}
+
+// AggregateSubmit streams K client share rows (W uint32 words each) to
+// the sidecar's secure-aggregation fold and returns the W folded words.
+// op is "xor" (XOR-shared bit vectors) or "add" (additively-shared
+// uint32 vectors, summed mod 2^32); the sidecar folds the upload in
+// device-sized chunks, so K can be millions of clients.
+func (c *Client) AggregateSubmit(op string, rows [][]uint32) ([]uint32, error) {
+	if len(rows) == 0 {
+		return nil, nil
+	}
+	w := len(rows[0])
+	body := make([]byte, 0, 4*w*len(rows))
+	for _, r := range rows {
+		if len(r) != w {
+			return nil, fmt.Errorf("dpftpu: inconsistent agg row lengths")
+		}
+		for _, v := range r {
+			body = binary.LittleEndian.AppendUint32(body, v)
+		}
+	}
+	out, err := c.post(fmt.Sprintf(
+		"/v1/agg/submit?op=%s&k=%d&words=%d", op, len(rows), w), body)
+	if err != nil {
+		return nil, err
+	}
+	if len(out) != 4*w {
+		return nil, fmt.Errorf(
+			"dpftpu: bad agg reply length %d, want %d", len(out), 4*w)
+	}
+	res := make([]uint32, w)
+	for i := range res {
+		res[i] = binary.LittleEndian.Uint32(out[4*i:])
+	}
+	return res, nil
+}
+
 // EvalFullBatch expands K shares in one round trip — the entry point that
 // amortizes the device dispatch and where the TPU speedup lives.  All keys
 // must have the same logN; the reply is the K concatenated expansions.

@@ -251,6 +251,129 @@ func TestConformancePointsPackedAndUnpacked(t *testing.T) {
 	}
 }
 
+// TestConformanceHeavyHitters drives the whole prefix-tree heavy-hitters
+// protocol round loop through the bridge — HHGen dealer, per-level key
+// slicing, two aggregators' HHEvalLevel rounds, HHCounts reconstruction,
+// thresholded HHExtend descent — and pins the FROZEN protocol output:
+// with these exact client values and threshold, the recovered heavy
+// hitters and their counts are deterministic regardless of key
+// randomness (the counts are exact, not sampled).
+func TestConformanceHeavyHitters(t *testing.T) {
+	c := conformanceClient(t)
+	const logN, threshold = 10, 3
+	// Frozen case: 613 is held by 4 clients (the one heavy hitter), 87
+	// by 2 (below threshold), the rest are singletons.
+	values := []uint64{613, 613, 613, 613, 87, 87, 100, 1001}
+	blobA, blobB, err := c.HHGen(values, logN)
+	if err != nil {
+		t.Fatal(err)
+	}
+	round := func(level uint, cands []uint64) []int {
+		rows := make([][][]byte, 2)
+		for i, blob := range [][]byte{blobA, blobB} {
+			keys, err := c.HHLevelKeys(blob, logN, level)
+			if err != nil {
+				t.Fatal(err)
+			}
+			if len(keys) != len(values) {
+				t.Fatalf("level %d: %d keys, want %d", level, len(keys), len(values))
+			}
+			rows[i], err = c.HHEvalLevel(keys, cands, logN, level)
+			if err != nil {
+				t.Fatal(err)
+			}
+		}
+		counts, err := HHCounts(rows[0], rows[1], len(cands))
+		if err != nil {
+			t.Fatal(err)
+		}
+		return counts
+	}
+	frontier := []uint64{0}
+	depth := uint(0)
+	for depth < logN {
+		r := uint(5)
+		if depth+r > logN {
+			r = logN - depth
+		}
+		cands := HHExtend(frontier, r)
+		depth += r
+		counts := round(depth-1, HHQueryValues(cands, logN, depth))
+		frontier = frontier[:0]
+		for i, n := range counts {
+			if n >= threshold {
+				frontier = append(frontier, cands[i])
+			}
+		}
+	}
+	if len(frontier) != 1 || frontier[0] != 613 {
+		t.Fatalf("recovered %v, want [613]", frontier)
+	}
+	// The leaf round's count for the survivor is the exact client count.
+	final := round(logN-1, HHQueryValues(frontier, logN, logN))
+	if final[0] != 4 {
+		t.Fatalf("heavy hitter count %d, want 4", final[0])
+	}
+}
+
+// TestConformanceAggregateGolden pins the secure-aggregation fold against
+// frozen vectors: fixed uint32 share rows whose XOR and mod-2^32 sums
+// are precomputed constants — the wire encoding, the chunked server-side
+// fold, and the reply decoding cannot drift without failing here.
+func TestConformanceAggregateGolden(t *testing.T) {
+	c := conformanceClient(t)
+	rows := [][]uint32{
+		{0x00000001, 0xFFFFFFFF},
+		{0x80000000, 0x00000001},
+		{0x00000001, 0x80000000},
+		{0xDEADBEEF, 0x12345678},
+	}
+	for _, tc := range []struct {
+		op   string
+		want []uint32
+	}{
+		{"xor", []uint32{0x5EADBEEF, 0x6DCBA986}},
+		{"add", []uint32{0x5EADBEF1, 0x92345678}},
+	} {
+		got, err := c.AggregateSubmit(tc.op, rows)
+		if err != nil {
+			t.Fatal(err)
+		}
+		if len(got) != len(tc.want) {
+			t.Fatalf("%s: reply has %d words, want %d", tc.op, len(got), len(tc.want))
+		}
+		for i := range got {
+			if got[i] != tc.want[i] {
+				t.Fatalf("%s: word %d = %#x, want %#x", tc.op, i, got[i], tc.want[i])
+			}
+		}
+	}
+	// Two aggregators' XOR folds of complementary share rows reconstruct
+	// the XOR of the clear client vectors: client i's vector v_i splits
+	// into (v_i ^ m_i, m_i) for a fixed mask m_i.
+	clear := [][]uint32{{0x01020304, 0xA5A5A5A5}, {0xCAFEBABE, 0x0BADF00D}}
+	masks := [][]uint32{{0x1111, 0x2222}, {0xFFFF0000, 0x0000FFFF}}
+	sharesA := [][]uint32{
+		{clear[0][0] ^ masks[0][0], clear[0][1] ^ masks[0][1]},
+		{clear[1][0] ^ masks[1][0], clear[1][1] ^ masks[1][1]},
+	}
+	foldA, err := c.AggregateSubmit("xor", sharesA)
+	if err != nil {
+		t.Fatal(err)
+	}
+	foldB, err := c.AggregateSubmit("xor", masks)
+	if err != nil {
+		t.Fatal(err)
+	}
+	for i := range foldA {
+		want := clear[0][i] ^ clear[1][i]
+		if foldA[i]^foldB[i] != want {
+			t.Fatalf("xor reconstruction word %d = %#x, want %#x",
+				i, foldA[i]^foldB[i], want)
+		}
+	}
+}
+
 // TestStructuredErrorParsing pins the load-survival error contract: a
 // 429 shed reply with a {code, detail} JSON body and a Retry-After
 // header must surface as *APIError with every field recovered — that is

@@ -109,6 +109,26 @@ def leaky_kernel_loop_eval(seeds, table):
     )(seeds, table)
 
 
+def leaky_hh_descend_eval(counts, xs):
+    """A heavy-hitters round that keeps descending while a SECRET count
+    clears the threshold: the trip count — and so the number of
+    candidate evaluations the device performs — leaks the count's
+    magnitude.  The production driver (apps/heavy_hitters.py) thresholds
+    on HOST over PUBLIC XOR-reconstructed counts (documented as such in
+    DESIGN §13); this is the device-side shape it must never take."""
+
+    def cond(st):
+        c, _ = st
+        return jnp.max(c) > jnp.uint32(3)
+
+    def body(st):
+        c, acc = st
+        return c >> 1, acc ^ xs
+
+    _, acc = jax.lax.while_loop(cond, body, (counts, xs))
+    return acc
+
+
 #: (function, n secret leading args, total args builder) — the tests
 #: iterate this to keep fixture and assertion lists in sync.
 LEAKY = (
@@ -120,4 +140,5 @@ LEAKY = (
     ("leaky_while_eval", leaky_while_eval, "secret-branch"),
     ("leaky_kernel_eval", leaky_kernel_eval, "secret-index"),
     ("leaky_kernel_loop_eval", leaky_kernel_loop_eval, "secret-index"),
+    ("leaky_hh_descend_eval", leaky_hh_descend_eval, "secret-branch"),
 )

@@ -51,6 +51,7 @@ _SCOPE = (
     "dpf_tpu/core/plans.py",
     "dpf_tpu/models",
     "dpf_tpu/parallel",
+    "dpf_tpu/apps",
 )
 
 _SYNC_METHODS = {"block_until_ready", "item"}

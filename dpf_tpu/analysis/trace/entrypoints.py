@@ -218,6 +218,73 @@ def _ge_full_compat():
 
 
 # ---------------------------------------------------------------------------
+# Protocol applications (apps/): heavy hitters + secure aggregation
+# ---------------------------------------------------------------------------
+
+
+def _hh_level_compat_walk():
+    """The heavy-hitters round body on the compat kernel route: 16
+    clients' level keys x 64 candidate prefixes (the shapes
+    plans.run_hh_level dispatches after bucketing; the level itself is
+    host-side query masking, so ONE certificate covers every level of a
+    descent)."""
+    from ...models import dpf
+
+    kb = _compat_batch(10, 16)  # K % _PKT(8) == 0 — the kernel route
+    masks = _compat_masks(kb)
+    xs_hi, xs_lo = _split32(16, 64)
+    args = (kb.nu, kb.log_n, *masks, xs_hi, xs_lo, 2)
+    return _trace(
+        dpf._eval_points_walk_body, args, static_argnums=(0, 1, 10),
+        secret=range(2, 8),
+    )
+
+
+def _hh_level_compat_xla():
+    from ...models import dpf
+
+    kb = _compat_batch(10, 16)
+    masks = _compat_masks(kb)
+    xs_hi, xs_lo = _split32(16, 64)
+    args = (kb.nu, kb.log_n, *masks, xs_hi, xs_lo, 2, "xla")
+    return _trace(
+        dpf._eval_points_packed_body, args, static_argnums=(0, 1, 10, 11),
+        secret=range(2, 8),
+    )
+
+
+def _hh_level_fast():
+    from ...models import dpf_chacha as dc
+
+    kb = _fast_batch(16, 16)
+    import jax.numpy as jnp
+
+    xs_lo = jnp.zeros((64, 16), jnp.uint32)  # query-major [Q, K]
+    xs_hi = jnp.zeros((1, 1), jnp.uint32)
+    args = (kb.nu, 16, *kb.device_args(), xs_hi, xs_lo, 0, None)
+    return _trace(
+        dc._eval_points_cc_packed_body, args, static_argnums=(0, 1, 9),
+        secret=range(2, 7),
+    )
+
+
+def _agg_fold(op: str):
+    """One streamed-aggregation fold chunk (apps/aggregation.py): the
+    carry and the client share rows are both secret; the fold must be
+    pure elementwise/reduction dataflow."""
+    import jax.numpy as jnp
+
+    from ...apps import aggregation as agg
+
+    carry = jnp.zeros(64, jnp.uint32)
+    rows = jnp.zeros((256, 64), jnp.uint32)
+    return _trace(
+        agg._fold_body, (op, carry, rows), static_argnums=(0,),
+        secret=(1, 2),
+    )
+
+
+# ---------------------------------------------------------------------------
 # Fast (ChaCha) profile
 # ---------------------------------------------------------------------------
 
@@ -553,6 +620,50 @@ ROUTES: tuple[Route, ...] = (
         "models.dpf_chacha.eval_full_stream chunk body", "evalfull",
         {"profile": "fast", "backend": "xla", "stream": True},
         lambda: _evalfull_fast_chunked(True),
+    ),
+    # -- protocol applications (apps/) --------------------------------------
+    _route(
+        "hh/level_eval/compat/walk",
+        "apps.heavy_hitters.eval_level_shares "
+        "(core.plans.run_hh_level -> models.dpf.eval_points_level_grouped"
+        "[levels] -> eval_points walk)",
+        "hh_level",
+        {"profile": "compat", "backend": "pallas-walk", "packed": True},
+        _hh_level_compat_walk,
+    ),
+    _route(
+        "hh/level_eval/compat/xla",
+        "apps.heavy_hitters.eval_level_shares "
+        "(core.plans.run_hh_level -> models.dpf.eval_points_level_grouped"
+        "[levels] -> eval_points xla)",
+        "hh_level",
+        {"profile": "compat", "backend": "xla", "packed": True},
+        _hh_level_compat_xla,
+    ),
+    _route(
+        "hh/level_eval/fast/xla",
+        "apps.heavy_hitters.eval_level_shares "
+        "(core.plans.run_hh_level -> models.dpf_chacha."
+        "eval_points_level_grouped[levels] -> eval_points)",
+        "hh_level",
+        {"profile": "fast", "backend": "xla", "packed": True},
+        _hh_level_fast,
+    ),
+    _route(
+        "agg/fold_xor",
+        "apps.aggregation._fold_body (core.plans.run_agg_fold; "
+        "/v1/agg/submit chunk dispatch)",
+        "agg_xor",
+        {"profile": "agg", "op": "xor"},
+        lambda: _agg_fold("xor"),
+    ),
+    _route(
+        "agg/fold_add",
+        "apps.aggregation._fold_body (core.plans.run_agg_fold; "
+        "/v1/agg/submit chunk dispatch)",
+        "agg_add",
+        {"profile": "agg", "op": "add"},
+        lambda: _agg_fold("add"),
     ),
 )
 

@@ -1,0 +1,24 @@
+"""Protocol applications on the FSS serving stack.
+
+PAPER.md names the applications DPFs exist for — PIR, distributed ORAM,
+secure aggregation; this package is the layer that turns the repo's
+primitives (batched Gen, grouped pointwise eval, packed wire words, the
+plan cache) into whole server-side protocol workloads:
+
+  heavy_hitters  prefix-tree heavy hitters: levelwise descent over a
+                 level-major batch of client DPF keys, one grouped
+                 device dispatch per round, host-side thresholding of
+                 publicly reconstructed counts.
+  aggregation    secure aggregation: streamed XOR / additive-mod-2^32
+                 folds of client share vectors in device-sized chunks.
+
+Both ride the sidecar (``/v1/hh/*``, ``/v1/agg/*`` in dpf_tpu/server.py)
+through the existing batcher / plan-cache / deadline / breaker / trace
+machinery, and both carry obliviousness certificates for their device
+bodies (docs/OBLIVIOUS.md; protocol flow and trust model: docs/DESIGN.md
+§13).
+"""
+
+from . import aggregation, heavy_hitters
+
+__all__ = ["aggregation", "heavy_hitters"]

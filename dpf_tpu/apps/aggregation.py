@@ -1,0 +1,175 @@
+"""Secure aggregation: streamed folds of client share vectors.
+
+The second protocol workload on the FSS stack (PAPER.md: "privacy
+preserving aggregation").  Every client holds a SHARE VECTOR — packed
+uint32 words, the repo's native wire format (core/bitpack.py) — and the
+aggregator's whole job is a fold over clients:
+
+  ``xor``   bitwise XOR fold.  For XOR-shared bit vectors (what the DPF
+            evaluators emit): the two aggregators' folded vectors XOR-
+            reconstruct to the XOR of all client vectors — for one-hot
+            client contributions, the odd-multiplicity presence bitmap
+            over the domain.
+  ``add``   elementwise sum mod 2^32.  For additively-shared uint32
+            vectors (classic secure-aggregation counters/histograms):
+            the aggregators' folds ADD-reconstruct to the true sum.
+
+Both folds are associative with an all-zeros identity, so the aggregator
+streams the upload in device-sized chunks (``DPF_TPU_AGG_CHUNK_BYTES``):
+each chunk is one jitted dispatch folding [rows, words] into the running
+[words] carry — a million-client sum never materializes on host, and the
+sidecar's ``/v1/agg/submit`` reads the request body the same way (one
+chunk off the socket, one dispatch, repeat).  Chunk dispatches go
+through the plan cache (``core/plans.run_agg_fold``; rows/words
+bucketed), and the fold bodies carry obliviousness certificates
+(``agg/fold_xor`` / ``agg/fold_add`` in docs/OBLIVIOUS.md): a fold is
+pure elementwise/reduction dataflow — no secret-dependent branch, index,
+or shape.
+
+``aggregate_eval_full`` closes the loop with the DPF layer: the
+aggregator holds client KEYS (not vectors) and folds their full-domain
+expansions chunk-by-chunk — the 2-server presence-bitmap protocol with
+only two [words]-sized vectors ever crossing back to the caller.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import knobs, plans
+
+__all__ = [
+    "OPS",
+    "chunk_rows",
+    "fold_rows",
+    "aggregate_chunks",
+    "aggregate_rows",
+    "aggregate_eval_full",
+    "reconstruct",
+]
+
+OPS = ("xor", "add")
+
+
+def _fold_body(op, carry, rows):
+    """One chunk of the streamed aggregation: fold uint32[R, W] rows into
+    the uint32[W] carry.  ``op`` is static ("xor" | "add"); both folds
+    are pure elementwise dataflow over the secret rows (the certified
+    property).  Zero rows are the identity for both ops, so plan-bucket
+    padding never changes the sum."""
+    if op == "xor":
+        return carry ^ jax.lax.reduce(
+            rows, np.uint32(0), jax.lax.bitwise_xor, (0,)
+        )
+    if op == "add":
+        # uint32 addition wraps: the sum is mod 2^32 by construction.
+        return carry + jnp.sum(rows, axis=0, dtype=jnp.uint32)
+    raise ValueError(f"aggregation: unknown op {op!r} (use xor|add)")
+
+
+_fold_jit = partial(jax.jit, static_argnums=(0,))(_fold_body)
+
+
+def chunk_rows(words: int, chunk_bytes: int | None = None) -> int:
+    """Rows per streamed fold dispatch: DPF_TPU_AGG_CHUNK_BYTES worth of
+    ``words``-word rows (>= 1)."""
+    if chunk_bytes is None:
+        chunk_bytes = knobs.get_int("DPF_TPU_AGG_CHUNK_BYTES")
+    return max(1, int(chunk_bytes) // max(int(words) * 4, 1))
+
+
+def fold_rows(
+    rows: np.ndarray, op: str, carry: np.ndarray | None = None
+) -> np.ndarray:
+    """Fold one chunk of share rows uint32[R, W] into ``carry`` (zeros
+    when None) -> uint32[W], through the plan cache."""
+    return plans.run_agg_fold(op, carry, rows)
+
+
+def aggregate_chunks(chunks, op: str, words: int) -> np.ndarray:
+    """Streamed aggregation driver: fold an iterable of uint32[R_i, W]
+    chunks into one uint32[W] vector.  Only the carry and one chunk are
+    ever live — the caller streams chunks straight off a socket or an
+    expansion pipeline."""
+    if op not in OPS:
+        raise ValueError(f"aggregation: unknown op {op!r} (use xor|add)")
+    carry = np.zeros(int(words), np.uint32)
+    for chunk in chunks:
+        chunk = np.asarray(chunk, dtype=np.uint32)
+        if chunk.ndim != 2 or chunk.shape[1] != words:
+            raise ValueError("aggregation: chunk shape mismatch")
+        if chunk.shape[0]:
+            carry = fold_rows(chunk, op, carry)
+    return carry
+
+
+def aggregate_rows(
+    rows: np.ndarray, op: str, rows_per_chunk: int | None = None
+) -> np.ndarray:
+    """Library convenience: chunk an in-memory uint32[K, W] share matrix
+    and stream it through :func:`aggregate_chunks` (identical result to
+    one giant fold — the differential the tests pin)."""
+    rows = np.asarray(rows, dtype=np.uint32)
+    if rows.ndim != 2:
+        raise ValueError("aggregation: rows must be [K, W]")
+    k, words = rows.shape
+    step = rows_per_chunk or chunk_rows(words)
+    return aggregate_chunks(
+        (rows[i : i + step] for i in range(0, k, step)), op, words
+    )
+
+
+def aggregate_eval_full(kb, op: str = "xor") -> np.ndarray:
+    """Fold the full-domain expansions of a client KEY batch (either
+    profile) chunk-by-chunk -> one uint32[out_bytes / 4] share vector.
+    Two aggregators running this over their halves of the client key
+    pairs hold XOR-shares of the domain's odd-multiplicity presence
+    bitmap; neither ever materializes the [K, out_bytes] expansion."""
+    from ..models.keys_chacha import KeyBatchFast
+
+    if isinstance(kb, KeyBatchFast):
+        from ..models.dpf_chacha import eval_full
+    else:
+        from ..models.dpf import eval_full
+
+    from .heavy_hitters import slice_batch
+
+    row_bytes = max((1 << kb.log_n) >> 3, 4)
+    words = max(row_bytes // 4, 1)
+    step = chunk_rows(words)
+    _, cls, _ = _hh_profile(kb)
+
+    def chunks():
+        for i in range(0, kb.k, step):
+            sub = slice_batch(kb, cls, slice(i, i + step))
+            out = eval_full(sub)  # uint8 [k_chunk, out_bytes]
+            yield np.ascontiguousarray(out[:, : words * 4]).view("<u4")
+
+    return aggregate_chunks(chunks(), op, words)
+
+
+def _hh_profile(kb):
+    from .heavy_hitters import _profile_api
+    from ..models.keys_chacha import KeyBatchFast
+
+    return _profile_api(
+        "fast" if isinstance(kb, KeyBatchFast) else "compat"
+    )
+
+
+def reconstruct(fold_a: np.ndarray, fold_b: np.ndarray, op: str) -> np.ndarray:
+    """Combine the two aggregators' folded vectors into the public
+    aggregate: XOR for ``xor`` shares, sum mod 2^32 for ``add`` shares."""
+    a = np.asarray(fold_a, dtype=np.uint32)
+    b = np.asarray(fold_b, dtype=np.uint32)
+    if a.shape != b.shape:
+        raise ValueError("aggregation: fold shapes differ")
+    if op == "xor":
+        return a ^ b
+    if op == "add":
+        return a + b  # uint32 wrap == mod 2^32
+    raise ValueError(f"aggregation: unknown op {op!r} (use xor|add)")

@@ -280,6 +280,40 @@ _declare(
     "dpf_tpu/obs/profile.py",
 )
 
+# Protocol applications: heavy hitters + secure aggregation ------------------
+_declare(
+    "DPF_TPU_HH_THRESHOLD", "int", "0",
+    "Default heavy-hitter count threshold for the prefix-tree descent "
+    "driver when the caller passes none (0 = the threshold must be "
+    "explicit; it is a PUBLIC protocol parameter, compared on host "
+    "against reconstructed counts).",
+    "dpf_tpu/apps/heavy_hitters.py",
+)
+_declare(
+    "DPF_TPU_HH_LEVELS_PER_ROUND", "int", "4",
+    "Tree levels descended per heavy-hitters round: every surviving "
+    "prefix extends to 2^R candidates before the round's one grouped "
+    "device dispatch (the driver shrinks a round's R to honor "
+    "DPF_TPU_HH_MAX_CANDIDATES).",
+    "dpf_tpu/apps/heavy_hitters.py",
+)
+_declare(
+    "DPF_TPU_HH_MAX_CANDIDATES", "int", "4096",
+    "Cap on candidate prefixes evaluated per heavy-hitters round (bounds "
+    "the [clients, candidates] device dispatch; a frontier that still "
+    "exceeds the cap at R=1 keeps only the highest-count survivors and "
+    "flags the round as truncated).",
+    "dpf_tpu/apps/heavy_hitters.py",
+)
+_declare(
+    "DPF_TPU_AGG_CHUNK_BYTES", "int", str(1 << 22),
+    "Upload bytes folded per device dispatch on the secure-aggregation "
+    "routes (/v1/agg/submit reads the body in chunks of this many bytes "
+    "and folds each into the running sum, so a million-client upload "
+    "never materializes on host).",
+    "dpf_tpu/apps/aggregation.py",
+)
+
 # Bench harness --------------------------------------------------------------
 _declare(
     "DPF_TPU_BENCH_BACKOFF", "float", "10",

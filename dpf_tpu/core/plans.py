@@ -95,6 +95,7 @@ def q_bucket(q: int) -> int:
 
 class PlanKey(NamedTuple):
     route: str  # "points" | "dcf_points" | "dcf_interval" | "evalfull"
+    #            | "hh_level" | "agg_xor" | "agg_add"
     profile: str  # "compat" | "fast"
     log_n: int
     k_bucket: int
@@ -361,6 +362,91 @@ def run_interval(ik, xs: np.ndarray) -> np.ndarray:
     )
 
 
+def run_hh_level(profile: str, kb, xs: np.ndarray, level: int) -> np.ndarray:
+    """Plan-cached heavy-hitters round evaluation: every client's
+    level-``level`` key (``kb``, K keys) at every candidate (``xs``
+    uint64[K, Q], rows identical — the tiled candidate set) -> packed
+    share words uint32[K, ceil(Q/32)].
+
+    Dispatches through ``eval_points_level_grouped(..., levels=(level,))``
+    — the level only steers HOST-side query masking, so every level of a
+    descent lands on the SAME compiled executable: one warmup per (K, Q)
+    bucket covers the whole protocol run (the zero-retrace contract
+    tests/test_apps.py asserts)."""
+    xs = np.asarray(xs, dtype=np.uint64)
+    K, Q = xs.shape
+    if K != kb.k:
+        raise ValueError("hh: xs first axis must match key batch")
+    key = plan_key("hh_level", profile, kb.log_n, K, Q, packed=True)
+    plan, first = _CACHE.get(key)
+    obs_trace.add_event(
+        "plan_lookup", hit=not first, route="hh_level",
+        k_bucket=key.k_bucket, q_bucket=key.q_bucket,
+    )
+    t0 = time.perf_counter()
+    kbp = _pad_keys(kb, key.k_bucket - K)
+    if profile == "fast":
+        from ..models.dpf_chacha import eval_points_level_grouped
+    else:
+        from ..models.dpf import eval_points_level_grouped
+    with obs_trace.child_span("compute"):
+        # The grouped levels= path returns host words (the walk bodies
+        # marshal their own packed output) — no separate d2h span here.
+        words = eval_points_level_grouped(
+            kbp, _pad_queries(xs, key.k_bucket, key.q_bucket), groups=1,
+            packed=True, levels=(int(level),),
+        )
+    if first:
+        plan.compile_s = time.perf_counter() - t0
+    plan.last_used = time.time()
+    return bitpack.mask_tail(
+        np.ascontiguousarray(words[:K, : bitpack.packed_words(Q)]), Q
+    )
+
+
+def run_agg_fold(
+    op: str, carry: np.ndarray | None, rows: np.ndarray
+) -> np.ndarray:
+    """Plan-cached aggregation fold: uint32[R, W] share rows into the
+    uint32[W] carry (zeros when None) -> uint32[W].  Rows and words are
+    bucketed like every other plan (zero rows / zero word columns are
+    the identity of both ops), so a streamed upload's fixed-size chunks
+    plus one ragged tail hit at most two executables."""
+    from ..apps import aggregation as agg
+
+    if op not in agg.OPS:
+        raise ValueError(f"agg: unknown op {op!r} (use xor|add)")
+    rows = np.asarray(rows, dtype=np.uint32)
+    if rows.ndim != 2:
+        raise ValueError("agg: rows must be [R, W]")
+    R, W = rows.shape
+    key = plan_key(f"agg_{op}", "agg", 0, R, W * 32, packed=True)
+    plan, first = _CACHE.get(key)
+    obs_trace.add_event(
+        "plan_lookup", hit=not first, route=f"agg_{op}",
+        k_bucket=key.k_bucket, q_bucket=key.q_bucket,
+    )
+    t0 = time.perf_counter()
+    wb = key.q_bucket // 32
+    rows_p = np.zeros((key.k_bucket, wb), np.uint32)
+    rows_p[:R, :W] = rows
+    carry_p = np.zeros(wb, np.uint32)
+    if carry is not None:
+        carry = np.asarray(carry, dtype=np.uint32)
+        if carry.shape != (W,):
+            raise ValueError("agg: carry must be [W]")
+        carry_p[:W] = carry
+    with obs_trace.child_span("compute"):
+        dev = agg._fold_jit(op, carry_p, rows_p)
+    with obs_trace.child_span("d2h"):
+        # host-sync: final reply marshalling (aggregation carry)
+        out = np.asarray(dev)
+    if first:
+        plan.compile_s = time.perf_counter() - t0
+    plan.last_used = time.time()
+    return np.ascontiguousarray(out[:W])
+
+
 def run_evalfull(profile: str, kb) -> np.ndarray:
     """Plan-cached full-domain expansion -> uint8[K, out_bytes]."""
     K = kb.k
@@ -397,9 +483,14 @@ def warmup(shapes: list[dict]) -> list[dict]:
     first-request compile never lands on user traffic.
 
     Each spec: ``{"route": "points"|"dcf_points"|"dcf_interval"|
-    "evalfull", "profile": "compat"|"fast", "log_n": N, "k": K,
-    "q": Q}`` (``q`` ignored for evalfull; ``profile`` ignored for the
-    DCF routes, which are fast-profile by construction).  An evalfull
+    "evalfull"|"hh_level"|"agg_xor"|"agg_add", "profile":
+    "compat"|"fast", "log_n": N, "k": K, "q": Q}`` (``q`` ignored for
+    evalfull; ``profile`` ignored for the DCF routes, which are
+    fast-profile by construction).  ``hh_level`` warms one heavy-hitters
+    round shape — K clients x Q candidates; the compiled body is
+    level-independent, so this covers EVERY level of a descent at that
+    bucket.  The agg routes warm one streamed-fold chunk shape (``q`` is
+    words * 32, the packed-bit convention; ``log_n`` ignored).  An evalfull
     spec with ``"stream": true`` ALSO drives the streaming pipeline once
     (its per-chunk finish executables are distinct compiles from the
     blocking plan's — a deployment serving streamed /v1/evalfull must
@@ -410,13 +501,34 @@ def warmup(shapes: list[dict]) -> list[dict]:
     for spec in shapes:
         route = spec.get("route", "points")
         profile = spec.get("profile", "compat")
-        log_n = int(spec["log_n"])
+        # Only the agg routes have no domain; everywhere else a missing
+        # log_n must stay a loud KeyError -> 400, not a silent log_n=0
+        # warmup of a useless plan.
+        if route in ("agg_xor", "agg_add"):
+            log_n = int(spec.get("log_n", 0))
+        else:
+            log_n = int(spec["log_n"])
         k = int(spec.get("k", 1))
         q = int(spec.get("q", 32))
         t0 = time.perf_counter()
         kb_count = k_bucket(k)
         alphas = np.zeros(kb_count, np.uint64)
-        if route == "evalfull":
+        if route in ("agg_xor", "agg_add"):
+            run_agg_fold(
+                route[4:], None,
+                np.zeros((kb_count, max(q_bucket(q) // 32, 1)), np.uint32),
+            )
+        elif route == "hh_level":
+            if profile == "fast":
+                from ..models.keys_chacha import gen_batch
+            else:
+                from ..core.keys import gen_batch
+
+            kb, _ = gen_batch(alphas, log_n, rng=rng)
+            run_hh_level(
+                profile, kb, np.zeros((kb_count, q), np.uint64), 0
+            )
+        elif route == "evalfull":
             if profile == "fast":
                 from ..models.keys_chacha import gen_batch
 

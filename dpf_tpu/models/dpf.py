@@ -796,9 +796,27 @@ _eval_points_walk_jit = partial(jax.jit, static_argnums=(0, 1, 10))(
 )
 
 
+def _masked_level_queries(
+    xs: np.ndarray, log_n: int, levels, groups: int
+) -> np.ndarray:
+    """uint64[G, Q] raw queries -> uint64[groups * len(levels) * G, Q]:
+    per selected level i, x with its low ``log_n - 1 - i`` bits zeroed
+    (the dyadic-prefix query), level-major — the host-expansion twin of
+    the device-side masking, shared by both profiles' ``levels=`` grouped
+    paths (apps/heavy_hitters.py evaluates one level block per round)."""
+    lv = np.asarray(levels, dtype=np.uint64)
+    shifts = (np.uint64(log_n) - np.uint64(1) - lv)[:, None, None]
+    qexp = ((xs[None] >> shifts) << shifts).reshape(
+        lv.shape[0] * xs.shape[0], -1
+    )
+    if groups > 1:
+        qexp = np.concatenate([qexp] * groups)
+    return qexp
+
+
 def eval_points_level_grouped(
     kb: KeyBatch, xs: np.ndarray, groups: int, reduce: bool = False,
-    backend: str | None = None, packed: bool = False,
+    backend: str | None = None, packed: bool = False, levels=None,
 ) -> np.ndarray:
     """FSS-support pointwise evaluation over level-major key groups
     (compat profile; mirror of dpf_chacha.eval_points_level_grouped).
@@ -814,12 +832,40 @@ def eval_points_level_grouped(
     -> uint8[groups * log_n * G, Q], or uint8[G, Q] with ``reduce`` (the
     level/group XOR-fold happens on device on the kernel route).
     ``packed`` returns the same rows as uint32[., ceil(Q/32)] packed words
-    (the kernel's native form — no unpack, 32x less D2H; bitpack.py)."""
+    (the kernel's native form — no unpack, 32x less D2H; bitpack.py).
+
+    ``levels`` (optional tuple of level indices in [0, log_n)) selects a
+    SUBSET of level blocks: ``kb`` then holds ``groups * len(levels) * G``
+    keys whose block ``j`` is level ``levels[j]``, and block ``j``'s
+    queries mask to that level's dyadic prefix.  The per-round eval of
+    the heavy-hitters descent (apps/heavy_hitters.py) is this call with
+    a single level: the round's candidate prefixes go in raw and the
+    masking pins them to the round's depth.  The subset path masks the
+    queries host-side and walks them through :func:`eval_points` (the
+    same certified walk bodies; the query tensor is [len(levels)*G, Q],
+    not log_n-replicated)."""
     xs = np.asarray(xs, dtype=np.uint64)
     if xs.ndim != 2:
         raise ValueError("dpf: xs must be [G, Q]")
     G, Q = xs.shape
     n = kb.log_n
+    if levels is not None:
+        lv = tuple(int(i) for i in levels)
+        if not lv or any(i < 0 or i >= n for i in lv):
+            raise ValueError("dpf: levels must be non-empty, in [0, log_n)")
+        if kb.k != groups * len(lv) * G:
+            raise ValueError("dpf: key count != groups * len(levels) * G")
+        if (xs >> np.uint64(n)).any():
+            raise ValueError("dpf: query index out of domain")
+        out = eval_points(
+            kb, _masked_level_queries(xs, n, lv, groups),
+            backend=backend, packed=packed,
+        )
+        if reduce:
+            out = np.bitwise_xor.reduce(
+                out.reshape(groups * len(lv), G, -1), axis=0
+            )
+        return out
     if kb.k != groups * n * G:
         raise ValueError("dpf: key count != groups * log_n * G")
     if (xs >> np.uint64(n)).any():

@@ -750,7 +750,7 @@ def _eval_points_cc_packed(
 
 def eval_points_level_grouped(
     kb: KeyBatchFast, xs: np.ndarray, groups: int, reduce: bool = False,
-    packed: bool = False,
+    packed: bool = False, levels=None,
 ) -> np.ndarray:
     """FSS-support pointwise evaluation over level-major key groups.
 
@@ -765,10 +765,40 @@ def eval_points_level_grouped(
     -> uint8[G, Q] (on device when the Pallas walk kernel is in use — the
     D2H transfer shrinks by groups * log_n).  ``packed`` returns the same
     rows as uint32[., ceil(Q/32)] packed words (device-side pack,
-    core/bitpack contract)."""
+    core/bitpack contract).
+
+    ``levels`` (optional tuple of level indices) selects a SUBSET of
+    level blocks — ``kb`` holds ``groups * len(levels) * G`` keys and
+    block ``j`` masks its queries to level ``levels[j]`` — the per-round
+    heavy-hitters eval (apps/heavy_hitters.py; see the compat twin,
+    models/dpf.py, for the contract).  The subset path masks host-side
+    and delegates to :func:`eval_points` (the same certified bodies)."""
     xs = np.asarray(xs, dtype=np.uint64)
     if xs.ndim != 2:
         raise ValueError("dpf-fast: xs must be [G, Q]")
+    if levels is not None:
+        from .dpf import _masked_level_queries
+
+        lv = tuple(int(i) for i in levels)
+        if not lv or any(i < 0 or i >= kb.log_n for i in lv):
+            raise ValueError(
+                "dpf-fast: levels must be non-empty, in [0, log_n)"
+            )
+        if kb.k != groups * len(lv) * xs.shape[0]:
+            raise ValueError(
+                "dpf-fast: key count != groups * len(levels) * G"
+            )
+        if (xs >> np.uint64(kb.log_n)).any():
+            raise ValueError("dpf-fast: query index out of domain")
+        out = eval_points(
+            kb, _masked_level_queries(xs, kb.log_n, lv, groups),
+            packed=packed,
+        )
+        if reduce:
+            out = np.bitwise_xor.reduce(
+                out.reshape(groups * len(lv), xs.shape[0], -1), axis=0
+            )
+        return out
     if kb.k != groups * kb.log_n * xs.shape[0]:
         raise ValueError("dpf-fast: key count != groups * log_n * G")
     if (xs >> np.uint64(kb.log_n)).any():

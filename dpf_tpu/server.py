@@ -43,9 +43,26 @@ Endpoints (all POST, binary bodies, profile/params in the query string):
         body: one party blob || indices
         -> K*Q interval-share bits (1{lo <= x <= hi} after XOR), or
            K * ceil(Q/8) packed bytes with format=packed
+  /v1/hh/gen?log_n=N&k=K[&profile=fast]       body: K uint64 client values
+        -> share blob A || share blob B (trusted-dealer helper for the
+           prefix-tree heavy-hitters protocol, apps/heavy_hitters.py;
+           each blob is K clients x log_n level keys, client-major)
+  /v1/hh/eval?log_n=N&k=K&q=Q&level=L[&profile=fast][&format=packed]
+        body: K level-L client keys (key_len bytes each) || Q uint64
+        candidate prefixes (ONE shared set, depth L+1 shifted up to n
+        bits — uploaded once, not per key)
+        -> K*Q share bits [client, candidate] (packed: K rows of
+           ceil(Q/8) bytes) — the single-aggregator round primitive;
+           two aggregators' replies XOR+popcount into public counts
+  /v1/agg/submit?op=xor|add&k=K&words=W       body: K rows x W uint32
+        -> the W folded uint32 words (secure aggregation,
+           apps/aggregation.py).  The body is read AND folded in
+           DPF_TPU_AGG_CHUNK_BYTES chunks — a million-client upload
+           never materializes on host.
   /v1/warmup                                  body: JSON
         {"shapes": [{"route": "points"|"dcf_points"|"dcf_interval"|
-        "evalfull", "profile": "compat"|"fast", "log_n": N, "k": K,
+        "evalfull"|"hh_level"|"agg_xor"|"agg_add", "profile":
+        "compat"|"fast", "log_n": N, "k": K,
         "q": Q}, ...]} — compile the dispatch plans for those shapes NOW
         (core/plans.py) so first-request compile never lands on user
         traffic.  An evalfull spec with "stream": true also warms the
@@ -142,7 +159,12 @@ from .obs import metrics as obs_metrics
 from .obs import profile as obs_profile
 from .obs import trace as obs_trace
 from .serving import Batcher, IntervalWork, KeyCache, PointsWork, faults
-from .serving.batcher import dispatch_interval, dispatch_points
+from .serving.batcher import (
+    HHWork,
+    dispatch_hh,
+    dispatch_interval,
+    dispatch_points,
+)
 from .serving.breaker import CircuitBreaker, is_transient
 from .serving.errors import DeadlineError, ServingError
 from .utils.profiling import PhaseTimer
@@ -633,6 +655,88 @@ class _Handler(BaseHTTPRequestHandler):
                 self._abort_connection()
             st.merge_timer(tm)
 
+    def _agg_submit(self, q: dict, st, trace):
+        """POST /v1/agg/submit?op=xor|add&k=K&words=W — streamed secure
+        aggregation.  Body: K client share rows of W uint32 words each
+        (little-endian), read and folded in DPF_TPU_AGG_CHUNK_BYTES
+        chunks so the [K, W] upload never materializes on host; reply:
+        the W folded words.  Rides admission (breaker), deadlines (the
+        checkpoint runs between chunks — a doomed upload stops burning
+        device slots mid-body), and per-chunk transient retries like
+        every other dispatch seam.  Any failure before the body is fully
+        consumed aborts the connection (the unread remainder would
+        misframe the next keep-alive request)."""
+        from .apps import aggregation as agg_app
+
+        clen = int(self.headers.get("Content-Length", 0))
+        consumed = 0
+        # EVERYTHING from parameter parsing on runs under the framing
+        # guard: any error that leaves body bytes unread must close the
+        # connection, or the next pipelined request parses mid-upload.
+        try:
+            op = q.get("op", "xor")
+            if op not in agg_app.OPS:
+                raise ValueError(f"unknown op {op!r} (use xor|add)")
+            k, words = int(q["k"]), int(q["words"])
+            if k <= 0 or words <= 0:
+                raise ValueError("k and words must be positive")
+            row_bytes = words * 4
+            if clen != k * row_bytes:
+                raise ValueError(
+                    f"body must be {k}*{row_bytes} bytes of uint32 rows"
+                )
+            deadline = _deadline_from(self.headers)
+            if trace is not None:
+                trace.set_attrs(op=op, words=words, rows=k)
+            with obs_trace.maybe_span(trace, "admission"):
+                st.breaker.admit()
+            step = agg_app.chunk_rows(words)
+            carry = np.zeros(words, np.uint32)
+            remaining = k
+            with obs_trace.traced_dispatch(trace) as dspan:
+                while remaining > 0:
+                    if deadline is not None and (
+                        time.perf_counter() >= deadline
+                    ):
+                        where = "queue" if consumed == 0 else "flight"
+                        st.batcher.note_expired(where)
+                        raise DeadlineError(
+                            "deadline expired mid-upload", where=where
+                        )
+                    take = min(step, remaining)
+                    # The socket read accounts to "pack" (host-side
+                    # marshalling), NOT "dispatch": a slow uploader must
+                    # never spike the device-health phase histogram.
+                    with st.phase("pack"):
+                        buf = self.rfile.read(take * row_bytes)
+                        if len(buf) != take * row_bytes:
+                            raise ValueError("upload truncated mid-chunk")
+                        consumed += len(buf)
+                        rows = np.frombuffer(buf, dtype="<u4").reshape(
+                            take, words
+                        )
+                    # The fault seam fires INSIDE the breaker call, like
+                    # every other dispatch.* site, so injected transients
+                    # get the breaker's retry/classification treatment.
+                    def fold_chunk(r=rows, c=carry):
+                        faults.fire("dispatch.agg")
+                        return plans.run_agg_fold(op, c, r)
+
+                    with st.phase("dispatch"):
+                        carry = st.breaker.call(fold_chunk)
+                    remaining -= take
+                if dspan is not None:
+                    dspan.set_attrs(coalesced=k, chunks=-(-k // step))
+        except BaseException:
+            if consumed != clen:
+                # The socket still holds unread upload bytes: a reply
+                # now would leave the next pipelined request misframed.
+                self.close_connection = True
+            raise
+        with st.phase("reply"), obs_trace.maybe_span(trace, "reply"):
+            faults.fire("reply.write")
+            self._reply(200, carry.astype("<u4").tobytes())
+
     def _profile_request(self, body: bytes):
         """POST /v1/profile: knob-gated, duration-bounded XProf capture
         (obs/profile.py).  Body: ``{"action": "start"|"stop"|"status"
@@ -671,9 +775,20 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             url = urlparse(self.path)
             q = {k: v[0] for k, v in parse_qs(url.query).items()}
-            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
             route = url.path
             st = _serving_state()
+
+            if route == "/v1/agg/submit":
+                # The aggregation upload is the one body that must NOT
+                # be read whole: it streams off the socket in
+                # DPF_TPU_AGG_CHUNK_BYTES chunks, one fold dispatch per
+                # chunk (apps/aggregation.py).
+                trace = st.tracer.begin(
+                    self.headers.get(TRACE_HEADER), route
+                )
+                self._agg_submit(q, st, trace)
+                return
+            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
 
             if route == "/v1/warmup":
                 spec = json.loads(body or b"[]")
@@ -883,6 +998,43 @@ class _Handler(BaseHTTPRequestHandler):
                 words = st.run(
                     IntervalWork(triple, xs, deadline=deadline, trace=trace),
                     dispatch_interval,
+                )
+                self._points_reply(words, nq, packed, st, trace)
+            elif route == "/v1/hh/gen":
+                from .apps import heavy_hitters as hh_app
+
+                k = int(q["k"])
+                if len(body) != k * 8:
+                    raise ValueError(f"body must be {k}*8 value bytes")
+                values = np.frombuffer(body, dtype="<u8")
+                sa, sb = hh_app.gen_shares(values, log_n, profile=profile)
+                self._reply(
+                    200,
+                    hh_app.share_to_blob(sa) + hh_app.share_to_blob(sb),
+                )
+            elif route == "/v1/hh/eval":
+                k, nq = int(q["k"]), int(q["q"])
+                level = int(q["level"])
+                if not 0 <= level < log_n:
+                    raise ValueError(
+                        f"level must be in [0, {log_n}), got {level}"
+                    )
+                kl = key_len(log_n)
+                if len(body) != k * kl + nq * 8:
+                    raise ValueError(
+                        f"body must be {k}*{kl} level-key bytes + "
+                        f"{nq}*8 candidate bytes"
+                    )
+                packed = _wire_format(q)
+                kb = cached_keys(profile, bytes(body[: k * kl]), k, kl)
+                cands = np.frombuffer(body[k * kl :], dtype="<u8")
+                words = st.run(
+                    HHWork(
+                        profile, kb,
+                        np.broadcast_to(cands[None, :], (k, nq)), level,
+                        deadline=deadline, trace=trace,
+                    ),
+                    dispatch_hh,
                 )
                 self._points_reply(words, nq, packed, st, trace)
             else:

@@ -117,6 +117,33 @@ class IntervalWork:
         return ("dcf_interval", "fast", self.ik[0].log_n)
 
 
+@dataclass
+class HHWork:
+    """One heavy-hitters round-evaluation request: K client level-keys x
+    Q candidate prefixes (the /v1/hh/eval body).  The lane includes the
+    LEVEL: concurrent rounds at the same level coalesce into one grouped
+    dispatch (the level steers host-side query masking inside
+    ``plans.run_hh_level``, so same-level batches share an executable)."""
+
+    profile: str
+    kb: object
+    xs: np.ndarray  # uint64 [K, Q] — the candidate set tiled per key row
+    level: int
+    deadline: float | None = None
+    trace: object = None
+    queue_wait: float = 0.0
+    dispatch_s: float = 0.0
+    coalesced: int = 0
+
+    @property
+    def n_keys(self) -> int:
+        return int(self.xs.shape[0])
+
+    @property
+    def lane(self) -> tuple:
+        return ("hh_level", self.profile, self.kb.log_n, self.level)
+
+
 def _concat_key_batches(batches: list):
     """Concatenate same-class struct-of-arrays key batches on the key
     axis (field order: log_n, then the arrays — true of KeyBatch,
@@ -136,6 +163,21 @@ def _concat_key_batches(batches: list):
             for n in names
         ),
     )
+
+
+def _merged_queries(items: list) -> np.ndarray:
+    """Stack the items' query tensors into one zero-padded uint64
+    [sum K, max Q] block (padded queries evaluate index 0 and are
+    re-masked off by ``_slice_rows``) — the shared merge step of every
+    lane dispatcher."""
+    qm = max(int(it.xs.shape[1]) for it in items)
+    xs = np.zeros((sum(it.n_keys for it in items), qm), np.uint64)
+    off = 0
+    for it in items:
+        k, q = it.xs.shape
+        xs[off : off + k, :q] = it.xs
+        off += k
+    return xs
 
 
 def _slice_rows(words: np.ndarray, items: list) -> list[np.ndarray]:
@@ -160,16 +202,24 @@ def dispatch_points(items: list[PointsWork]) -> list[np.ndarray]:
     if len(items) == 1:
         it = items[0]
         return [plans.run_points(it.route, it.profile, it.kb, it.xs)]
-    qm = max(int(it.xs.shape[1]) for it in items)
     merged_kb = _concat_key_batches([it.kb for it in items])
-    xs = np.zeros((sum(it.n_keys for it in items), qm), np.uint64)
-    off = 0
-    for it in items:
-        k, q = it.xs.shape
-        xs[off : off + k, :q] = it.xs
-        off += k
     words = plans.run_points(
-        items[0].route, items[0].profile, merged_kb, xs
+        items[0].route, items[0].profile, merged_kb, _merged_queries(items)
+    )
+    return _slice_rows(words, items)
+
+
+def dispatch_hh(items: list[HHWork]) -> list[np.ndarray]:
+    """Lane dispatcher for the heavy-hitters round route -> per-item
+    packed share words (one plan-cached grouped dispatch per coalesced
+    batch; same level by lane construction)."""
+    faults.fire("dispatch.hh")
+    if len(items) == 1:
+        it = items[0]
+        return [plans.run_hh_level(it.profile, it.kb, it.xs, it.level)]
+    merged_kb = _concat_key_batches([it.kb for it in items])
+    words = plans.run_hh_level(
+        items[0].profile, merged_kb, _merged_queries(items), items[0].level
     )
     return _slice_rows(words, items)
 
@@ -185,14 +235,7 @@ def dispatch_interval(items: list[IntervalWork]) -> list[np.ndarray]:
     const = np.concatenate(
         [np.asarray(it.ik[2], np.uint8) for it in items]
     )
-    qm = max(int(it.xs.shape[1]) for it in items)
-    xs = np.zeros((sum(it.n_keys for it in items), qm), np.uint64)
-    off = 0
-    for it in items:
-        k, q = it.xs.shape
-        xs[off : off + k, :q] = it.xs
-        off += k
-    words = plans.run_interval((upper, lower, const), xs)
+    words = plans.run_interval((upper, lower, const), _merged_queries(items))
     return _slice_rows(words, items)
 
 

@@ -64,6 +64,8 @@ SITES = (
     "dispatch.points",
     "dispatch.interval",
     "dispatch.evalfull",
+    "dispatch.hh",
+    "dispatch.agg",
     "stream.chunk",
     "reply.write",
 )

@@ -14,7 +14,11 @@
 #       with its certificate drift check) + docs/KNOBS.md drift + mypy
 #       typed-core and Go vet/fmt when those toolchains exist —
 #       scripts/lint_all.sh, hermetic, no TPU.
-#   ./runtests.sh --fast [pytest args]   kernel differential smoke lane:
+#   ./runtests.sh --fast [pytest args]   kernel differential smoke lane
+#       (now incl. the protocol-applications layer, tests/test_apps.py —
+#       heavy-hitters recovery + the 10^5-key plan-cached acceptance run,
+#       aggregation fold differentials, hh/agg wire identity,
+#       deadline/shed on the hh route):
 #       the Pallas kernel suites (fused + walk + expand routes, interpret
 #       mode), the S-box circuit invariants, the packed<->unpacked
 #       output differentials (every packed route vs its byte-per-bit twin
@@ -48,7 +52,7 @@ elif [ "${1:-}" = "--fast" ]; then
       tests/test_fused_expand.py tests/test_aes_bitslice.py \
       tests/test_packed.py tests/test_serving.py tests/test_obs.py \
       tests/test_serving_stress.py tests/test_analysis.py \
-      tests/test_oblivious.py \
+      tests/test_oblivious.py tests/test_apps.py \
       -q -m 'not slow' "$@"
 else
   # -m is last-wins in pytest, so a caller-supplied -m overrides ours.

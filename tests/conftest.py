@@ -14,3 +14,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from _hermetic import apply_hermetic_cpu_env
 
 apply_hermetic_cpu_env(8)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Run the protocol-applications suite LAST.
+
+    tests/test_apps.py is end-to-end heavy (a 10^5-key heavy-hitters
+    descent plus large one-time XLA compiles), where everything before
+    it is unit-sized.  Alphabetical collection would put it near the
+    front of the tier-1 run, displacing the unit suites' signal under
+    tier-1's wall-clock budget; a stable sort keeps every other file's
+    relative order and moves only the workload suite to the end."""
+    items.sort(key=lambda it: it.fspath.basename == "test_apps.py")

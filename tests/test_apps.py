@@ -1,0 +1,520 @@
+"""Protocol applications layer (dpf_tpu/apps/): prefix-tree heavy
+hitters + secure aggregation on the FSS stack.
+
+Pins the PR's acceptance contracts on CPU:
+
+  * planted-heavy-hitter recovery end-to-end from two aggregators' key
+    shares — BOTH profiles — with exact counts and zero false positives
+    above threshold;
+  * the K >= 10^5-keys acceptance run (fast profile, 6400 clients x 16
+    levels = 102,400 client DPF keys): every per-level eval goes through
+    the plan cache with ZERO retraces after warmup;
+  * aggregation XOR / additive-mod-2^32 folds differential against the
+    NumPy spec, invariant under chunking, and byte-identical over the
+    packed /v1/agg/submit wire upload;
+  * /v1/hh/eval wire identity against the in-process evaluator (packed
+    and byte-per-bit formats) and the full protocol driven through two
+    HTTP aggregators;
+  * deadline / shed behavior on the hh route (fault-injected dispatch
+    latency; the load-survival error contract).
+
+Compile budget: the compat-profile walk body is a large bitsliced-AES
+graph, so every compat test here deliberately lands on ONE jit shape —
+log_n=9 (nu=2), K bucket 32, Q bucket 32, packed — and the suite pays
+that compile once.  The fast-profile (ChaCha) graphs are cheap.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpf_tpu.apps import aggregation as agg
+from dpf_tpu.apps import heavy_hitters as hh
+from dpf_tpu.core import bitpack, plans
+
+
+def _post(url, body=b"", headers=None):
+    req = urllib.request.Request(url, data=body, method="POST")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return r.read()
+
+
+@pytest.fixture()
+def srv(monkeypatch):
+    from dpf_tpu import server as srv_mod
+
+    srv_mod.reset_serving_state()
+    s = srv_mod.serve(port=0)
+    yield f"http://127.0.0.1:{s.server_address[1]}"
+    s.shutdown()
+    srv_mod.reset_serving_state()
+
+
+def _planted_values(rng, g, log_n, plant):
+    """g client values with ``plant`` = {value: count} planted, the rest
+    uniform background."""
+    vals = rng.integers(0, 1 << log_n, size=g, dtype=np.uint64)
+    off = 0
+    for v, c in plant.items():
+        vals[off : off + c] = v
+        off += c
+    return vals
+
+
+# ---------------------------------------------------------------------------
+# Heavy hitters: protocol correctness
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "profile,g,n,thr,plant",
+    [
+        # compat stays on the shared (nu=2, K<=32, Q<=32) compile shape
+        ("compat", 24, 9, 5, {333: 8, 123: 6, 260: 5}),
+        ("fast", 192, 10, 12, {777: 40, 123: 25, 900: 13}),
+    ],
+)
+def test_hh_planted_recovery(profile, g, n, thr, plant):
+    """End-to-end descent from two share batches recovers exactly the
+    planted heavy hitters, with exact counts (XOR-reconstructed public
+    counts are exact, not sampled) and no false positives."""
+    rng = np.random.default_rng(11)
+    vals = _planted_values(rng, g, n, plant)
+    sa, sb = hh.gen_shares(vals, n, profile=profile, rng=rng)
+    res = hh.find_heavy_hitters(
+        sa, sb, threshold=thr, levels_per_round=3
+    )
+    got = {int(v): int(c) for v, c in zip(res.values, res.counts)}
+    want = {v: int((vals == v).sum()) for v in plant}
+    assert got == want
+    assert all(c >= thr for c in got.values())
+    # The final round ends at the leaves.
+    assert res.rounds[-1].depth == n
+
+
+def test_hh_single_level_round_equals_eval_points():
+    """One round's grouped dispatch (levels=(i,)) is bit-identical to a
+    plain eval_points walk of the level sub-batch at the masked
+    candidates — the levels= path adds routing, not math.  g == the K
+    bucket so the direct reference call shares the plan compile."""
+    rng = np.random.default_rng(5)
+    g, n, lvl, q = 32, 9, 4, 21  # q deliberately not a word multiple
+    vals = rng.integers(0, 1 << n, size=g, dtype=np.uint64)
+    sa, _ = hh.gen_shares(vals, n, profile="compat", rng=rng)
+    cands = rng.integers(0, 1 << n, size=q, dtype=np.uint64)
+    words = hh.eval_level_shares(sa, lvl, cands)
+    assert words.shape == (g, bitpack.packed_words(q))
+
+    from dpf_tpu.models.dpf import eval_points
+
+    kb = sa.level_keys(lvl)
+    shift = np.uint64(n - 1 - lvl)
+    masked = (cands >> shift) << shift
+    padded = np.zeros((g, 32), np.uint64)  # the plan bucket's Q shape
+    padded[:, :q] = np.broadcast_to(masked[None, :], (g, q))
+    ref = eval_points(kb, padded, packed=True)
+    np.testing.assert_array_equal(
+        words, bitpack.mask_tail(ref[:, : bitpack.packed_words(q)], q)
+    )
+
+
+def test_hh_levels_grouped_reduce_and_validation():
+    """The generalized levels= grouped eval: reduce folds the level
+    blocks, and the contract errors are loud."""
+    from dpf_tpu.models.dpf import eval_points_level_grouped
+
+    rng = np.random.default_rng(6)
+    g, n = 16, 9  # 2 levels x 16 gates -> K = 32, the shared bucket
+    vals = rng.integers(0, 1 << n, size=g, dtype=np.uint64)
+    sa, _ = hh.gen_shares(vals, n, profile="compat", rng=rng)
+    lvls = (2, 5)
+    b = sa.levels
+    from dpf_tpu.core.keys import KeyBatch
+
+    rows = np.concatenate([np.arange(lv * g, (lv + 1) * g) for lv in lvls])
+    sub = KeyBatch(
+        n, b.seeds[rows], b.ts[rows], b.scw[rows], b.tcw[rows], b.fcw[rows]
+    )
+    xs = rng.integers(0, 1 << n, size=(g, 32), dtype=np.uint64)
+    full = eval_points_level_grouped(
+        sub, xs, groups=1, levels=lvls, packed=True
+    )
+    red = eval_points_level_grouped(
+        sub, xs, groups=1, levels=lvls, reduce=True, packed=True
+    )
+    np.testing.assert_array_equal(
+        red, np.bitwise_xor.reduce(full.reshape(2, g, -1), axis=0)
+    )
+    with pytest.raises(ValueError, match="levels"):
+        eval_points_level_grouped(sub, xs, groups=1, levels=(0, n))
+    with pytest.raises(ValueError, match="key count"):
+        eval_points_level_grouped(sub, xs, groups=1, levels=(2,))
+
+
+def test_hh_share_blob_roundtrip():
+    rng = np.random.default_rng(9)
+    g, n = 6, 9
+    vals = rng.integers(0, 1 << n, size=g, dtype=np.uint64)
+    sa, _ = hh.gen_shares(vals, n, profile="compat", rng=rng)
+    data = hh.share_to_blob(sa)
+    from dpf_tpu.core.spec import key_len
+
+    kl = key_len(n)
+    assert len(data) == g * n * kl
+    back = hh.share_from_blob(data, n, g, "compat")
+    for f in ("seeds", "ts", "scw", "tcw", "fcw"):
+        np.testing.assert_array_equal(
+            getattr(back.levels, f), getattr(sa.levels, f)
+        )
+    # Client-major layout: client c's level-i key sits at a plain offset.
+    level_rows = sa.levels.to_bytes()
+    c, i = 3, 5
+    off = (c * n + i) * kl
+    assert data[off : off + kl] == level_rows[i * g + c]
+
+
+def test_hh_truncated_frontier_flags_round():
+    """A frontier past DPF_TPU_HH_MAX_CANDIDATES at R=1 drops the
+    lowest-count survivors and flags the round — approximate, but loud."""
+    rng = np.random.default_rng(14)
+    g, n = 256, 10
+    vals = rng.integers(0, 1 << n, size=g, dtype=np.uint64)
+    vals[:50] = 717
+    sa, sb = hh.gen_shares(vals, n, profile="fast", rng=rng)
+    res = hh.find_heavy_hitters(
+        sa, sb, threshold=1, levels_per_round=4, max_candidates=8
+    )
+    assert any(r.truncated for r in res.rounds)
+    assert all(r.n_candidates <= 8 for r in res.rounds)
+    # The dominant value survives even the truncated descent.
+    assert 717 in res.values.tolist()
+
+
+def test_hh_threshold_knob_and_validation(monkeypatch):
+    rng = np.random.default_rng(15)
+    vals = np.zeros(16, np.uint64)
+    sa, sb = hh.gen_shares(vals, 9, profile="fast", rng=rng)
+    with pytest.raises(ValueError, match="threshold"):
+        hh.find_heavy_hitters(sa, sb)  # no explicit, knob default 0
+    monkeypatch.setenv("DPF_TPU_HH_THRESHOLD", "8")
+    res = hh.find_heavy_hitters(sa, sb, levels_per_round=5)
+    assert res.values.tolist() == [0] and res.counts.tolist() == [16]
+    with pytest.raises(ValueError, match="out of domain"):
+        hh.gen_shares(np.array([1 << 9], np.uint64), 9)
+
+
+# ---------------------------------------------------------------------------
+# The acceptance run: K >= 10^5 client DPF keys, zero retraces
+# ---------------------------------------------------------------------------
+
+
+def test_hh_e2e_100k_keys_plan_cached():
+    """ISSUE 10 acceptance: recover every planted heavy hitter (and
+    nothing else above threshold) from two aggregators' shares of 6400
+    clients x 16 levels = 102,400 client DPF keys on CPU, every
+    per-level eval through the plan cache with zero retraces after
+    warmup."""
+    rng = np.random.default_rng(2026)
+    g, n, thr = 6400, 16, 512
+    plant = {101: 600, 9000: 600, 33333: 600, 48000: 600, 65535: 600}
+    vals = _planted_values(rng, g, n, plant)
+    sa, sb = hh.gen_shares(vals, n, profile="fast", rng=rng)
+    assert sa.levels.k == 102_400  # the K >= 10^5 contract
+
+    # Warm the two (K, Q) buckets the descent will hit (the grouped body
+    # is level-independent, so this covers all 16 levels; the candidate
+    # cap keeps every round in the q<=64 bucket).
+    plans.warmup(
+        [
+            {"route": "hh_level", "profile": "fast", "log_n": n, "k": g,
+             "q": 16},
+            {"route": "hh_level", "profile": "fast", "log_n": n, "k": g,
+             "q": 40},
+        ]
+    )
+    before = plans.trace_count()
+    res = hh.find_heavy_hitters(
+        sa, sb, threshold=thr, levels_per_round=4, max_candidates=64
+    )
+    assert plans.trace_count() == before, "descent retraced after warmup"
+
+    got = {int(v): int(c) for v, c in zip(res.values, res.counts)}
+    want = {v: int((vals == v).sum()) for v in plant}
+    assert got == want  # all planted recovered, no false positives
+    assert not any(r.truncated for r in res.rounds)
+    # Every round went through the hh_level plan route.
+    stats = plans.cache().stats()
+    hh_plans = [p for p in stats["plans"] if p["key"].startswith("hh_level")]
+    assert sum(p["hits"] for p in hh_plans) >= 2 * len(res.rounds) - 2
+
+
+# ---------------------------------------------------------------------------
+# Secure aggregation: fold differentials
+# ---------------------------------------------------------------------------
+
+
+def test_agg_folds_match_spec_and_chunking_invariant():
+    rng = np.random.default_rng(21)
+    k, w = 3000, 9
+    rows = rng.integers(0, 1 << 32, size=(k, w), dtype=np.uint64).astype(
+        np.uint32
+    )
+    ref_xor = np.bitwise_xor.reduce(rows, axis=0)
+    ref_add = rows.astype(np.uint64).sum(axis=0).astype(np.uint32)
+    for step in (k, 257, 64):
+        np.testing.assert_array_equal(
+            agg.aggregate_rows(rows, "xor", rows_per_chunk=step), ref_xor
+        )
+        np.testing.assert_array_equal(
+            agg.aggregate_rows(rows, "add", rows_per_chunk=step), ref_add
+        )
+    # Carry chaining == one-shot fold.
+    c1 = agg.fold_rows(rows[:1000], "add")
+    c2 = agg.fold_rows(rows[1000:], "add", carry=c1)
+    np.testing.assert_array_equal(c2, ref_add)
+    with pytest.raises(ValueError, match="op"):
+        agg.aggregate_rows(rows, "mul")
+
+
+def test_agg_reconstruct():
+    rng = np.random.default_rng(22)
+    clear = rng.integers(0, 1 << 32, size=(50, 6), dtype=np.uint64).astype(
+        np.uint32
+    )
+    mask = rng.integers(0, 1 << 32, size=(50, 6), dtype=np.uint64).astype(
+        np.uint32
+    )
+    # XOR sharing.
+    fa = agg.aggregate_rows(clear ^ mask, "xor")
+    fb = agg.aggregate_rows(mask, "xor")
+    np.testing.assert_array_equal(
+        agg.reconstruct(fa, fb, "xor"), np.bitwise_xor.reduce(clear, axis=0)
+    )
+    # Additive sharing mod 2^32.
+    fa = agg.aggregate_rows(clear - mask, "add")
+    fb = agg.aggregate_rows(mask, "add")
+    np.testing.assert_array_equal(
+        agg.reconstruct(fa, fb, "add"),
+        clear.astype(np.uint64).sum(axis=0).astype(np.uint32),
+    )
+
+
+def test_agg_eval_full_fold_presence_bitmap():
+    """The DPF-native aggregation: XOR-fold of both parties' key-batch
+    expansions reconstructs the odd-multiplicity presence bitmap (fast
+    profile; the fold itself is profile-agnostic and differentially
+    covered above)."""
+    from dpf_tpu.models.keys_chacha import gen_batch
+
+    rng = np.random.default_rng(23)
+    n = 10
+    pts = np.array([3, 3, 77, 500, 1023], dtype=np.uint64)  # 3 twice: even
+    ka, kb = gen_batch(pts, n, rng=rng)
+    fold = agg.reconstruct(
+        agg.aggregate_eval_full(ka, "xor"),
+        agg.aggregate_eval_full(kb, "xor"),
+        "xor",
+    )
+    bits = np.unpackbits(fold.view(np.uint8), bitorder="little")[: 1 << n]
+    assert sorted(np.flatnonzero(bits).tolist()) == [77, 500, 1023]
+
+
+# ---------------------------------------------------------------------------
+# Wire identity through the sidecar
+# ---------------------------------------------------------------------------
+
+
+def test_hh_http_wire_identity_and_protocol(srv):
+    from dpf_tpu.core.spec import key_len
+
+    rng = np.random.default_rng(31)
+    g, n, thr = 24, 9, 5
+    kl = key_len(n)
+    vals = _planted_values(rng, g, n, {300: 9, 44: 6})
+    out = _post(
+        f"{srv}/v1/hh/gen?log_n={n}&k={g}", vals.astype("<u8").tobytes()
+    )
+    half = g * n * kl
+    assert len(out) == 2 * half
+    blob_a, blob_b = out[:half], out[half:]
+    sa = hh.share_from_blob(blob_a, n, g, "compat")
+
+    lvl = 5
+    cands = rng.integers(0, 1 << n, size=13, dtype=np.uint64)
+    lib = hh.eval_level_shares(sa, lvl, cands)
+
+    def level_keys(data, level):
+        return b"".join(
+            data[(c * n + level) * kl : (c * n + level + 1) * kl]
+            for c in range(g)
+        )
+
+    body = level_keys(blob_a, lvl) + cands.astype("<u8").tobytes()
+    raw = _post(
+        f"{srv}/v1/hh/eval?log_n={n}&k={g}&q={cands.size}&level={lvl}"
+        "&format=packed",
+        body,
+    )
+    assert raw == bitpack.words_to_wire(lib, cands.size)
+    bits = _post(
+        f"{srv}/v1/hh/eval?log_n={n}&k={g}&q={cands.size}&level={lvl}"
+        "&format=bits",
+        body,
+    )
+    np.testing.assert_array_equal(
+        np.frombuffer(bits, np.uint8).reshape(g, cands.size),
+        bitpack.unpack_bits(lib, cands.size),
+    )
+
+    # Full protocol with two HTTP aggregators (what the Go helpers do).
+    def http_agg(data):
+        def ev(level, cand_values):
+            b = level_keys(data, level) + np.asarray(
+                cand_values, "<u8"
+            ).tobytes()
+            return _post(
+                f"{srv}/v1/hh/eval?log_n={n}&k={g}&q={len(cand_values)}"
+                f"&level={level}&format=packed",
+                b,
+            )
+        return ev
+
+    res = hh.find_heavy_hitters(
+        http_agg(blob_a), http_agg(blob_b), log_n=n, threshold=thr,
+        levels_per_round=3,
+    )
+    got = {int(v): int(c) for v, c in zip(res.values, res.counts)}
+    assert got == {v: int((vals == v).sum()) for v in (300, 44)}
+
+    # Malformed: wrong body length and bad level are clean 400s.
+    for path, b in (
+        (f"/v1/hh/eval?log_n={n}&k={g}&q=13&level={lvl}", body[:-1]),
+        (f"/v1/hh/eval?log_n={n}&k={g}&q=13&level={n}", body),
+    ):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv + path, b)
+        assert ei.value.code == 400
+        assert json.loads(ei.value.read())["code"] == "bad_request"
+
+
+def test_agg_http_packed_upload_identity(srv, monkeypatch):
+    """/v1/agg/submit over the packed uint32 wire == the library fold,
+    exercising the CHUNKED body read (chunk bytes pinned tiny so a small
+    upload still streams in many chunks)."""
+    monkeypatch.setenv("DPF_TPU_AGG_CHUNK_BYTES", "256")
+    rng = np.random.default_rng(41)
+    k, w = 333, 7  # 256 // 28 = 9 rows/chunk -> 37 chunks
+    rows = rng.integers(0, 1 << 32, size=(k, w), dtype=np.uint64).astype(
+        np.uint32
+    )
+    for op, ref in (
+        ("xor", np.bitwise_xor.reduce(rows, axis=0)),
+        ("add", rows.astype(np.uint64).sum(axis=0).astype(np.uint32)),
+    ):
+        rep = _post(
+            f"{srv}/v1/agg/submit?op={op}&k={k}&words={w}",
+            rows.astype("<u4").tobytes(),
+        )
+        got = np.frombuffer(rep, "<u4")
+        np.testing.assert_array_equal(got, ref)
+        np.testing.assert_array_equal(got, agg.aggregate_rows(rows, op))
+    # Validation: bad op / length mismatch are clean 400s.
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{srv}/v1/agg/submit?op=mul&k=1&words=1", b"\x00" * 4)
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{srv}/v1/agg/submit?op=xor&k=2&words=1", b"\x00" * 4)
+    assert ei.value.code == 400
+
+
+# ---------------------------------------------------------------------------
+# Load survival on the hh route
+# ---------------------------------------------------------------------------
+
+
+def _hh_request_body(rng, g, n, q):
+    from dpf_tpu.core.spec import key_len
+
+    kl = key_len(n)
+    vals = rng.integers(0, 1 << n, size=g, dtype=np.uint64)
+    sa, _ = hh.gen_shares(vals, n, profile="compat", rng=rng)
+    data = hh.share_to_blob(sa)
+    keys = b"".join(
+        data[(c * n) * kl : (c * n + 1) * kl] for c in range(g)
+    )
+    cands = rng.integers(0, 1 << n, size=q, dtype=np.uint64)
+    return keys + cands.astype("<u8").tobytes()
+
+
+def test_hh_deadline_expires_in_flight(srv):
+    """A deadline shorter than the (injected) dispatch latency on the hh
+    lane is a clean 504 {code: deadline} — doomed protocol rounds fail
+    fast instead of occupying the device."""
+    from dpf_tpu import server as srv_mod
+    from dpf_tpu.serving import faults
+
+    faults.install("dispatch.hh:latency:ms=300")
+    try:
+        rng = np.random.default_rng(51)
+        body = _hh_request_body(rng, 24, 9, 4)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(
+                f"{srv}/v1/hh/eval?log_n=9&k=24&q=4&level=0&format=packed",
+                body,
+                headers={"X-DPF-Deadline-Ms": "50"},
+            )
+        assert ei.value.code == 504
+        assert json.loads(ei.value.read())["code"] == "deadline"
+        with urllib.request.urlopen(f"{srv}/v1/stats", timeout=60) as r:
+            stats = json.loads(r.read())
+        b = stats["batcher"]
+        assert b["expired_flight"] + b["expired_queue"] >= 1
+    finally:
+        faults.clear()
+        srv_mod.reset_serving_state()
+
+
+def test_hh_shed_past_depth_watermark(srv, monkeypatch):
+    """Concurrent hh rounds past the lane's depth watermark shed with
+    429 + Retry-After while at least one request still succeeds."""
+    from dpf_tpu.serving import faults
+
+    monkeypatch.setenv("DPF_TPU_QUEUE_MAX_DEPTH", "1")
+    from dpf_tpu import server as srv_mod
+
+    srv_mod.reset_serving_state()
+    faults.install("dispatch.hh:latency:ms=250")
+    try:
+        rng = np.random.default_rng(52)
+        body = _hh_request_body(rng, 24, 9, 4)
+        url = f"{srv}/v1/hh/eval?log_n=9&k=24&q=4&level=0&format=packed"
+        codes = []
+        lock = threading.Lock()
+
+        def one():
+            try:
+                _post(url, body)
+                with lock:
+                    codes.append(200)
+            except urllib.error.HTTPError as e:
+                with lock:
+                    codes.append(e.code)
+                if e.code == 429:
+                    assert e.headers.get("Retry-After")
+
+        threads = [threading.Thread(target=one) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert 200 in codes, codes
+        assert 429 in codes, codes
+    finally:
+        faults.clear()
+        srv_mod.reset_serving_state()

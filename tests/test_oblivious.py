@@ -5,7 +5,7 @@ compile cache.
 Cheap subset in the default lane (fast-profile XLA routes, <1 s each);
 the full route matrix — every entrypoint x profile x packed x fuse,
 including the Pallas kernel traces — is marked ``slow`` (it re-traces
-~25 graphs, minutes of jax tracing) and also runs on every lint-lane
+~30 graphs, minutes of jax tracing) and also runs on every lint-lane
 invocation (``python -m dpf_tpu.analysis``).
 """
 
@@ -168,7 +168,7 @@ def test_verifier_version_stamped_in_ledger_key(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
-# Full matrix (slow: ~25 traced graphs)
+# Full matrix (slow: ~30 traced graphs)
 # ---------------------------------------------------------------------------
 
 
