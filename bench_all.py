@@ -111,6 +111,10 @@ _ROUTE_KNOBS = (
     # streamed-fold chunk size shape what the hh/agg rows measure.
     "DPF_TPU_HH_THRESHOLD", "DPF_TPU_HH_LEVELS_PER_ROUND",
     "DPF_TPU_HH_MAX_CANDIDATES", "DPF_TPU_AGG_CHUNK_BYTES",
+    # Mesh-native serving knobs: a sharded row must never collide with a
+    # single-device row on a ledger resume (cfg-serving-mesh sets these
+    # per-row, so they are also stamped into each row's route label).
+    "DPF_TPU_MESH", "DPF_TPU_MESH_DEVICES",
 )
 # DPF_TPU_BENCH_LEDGER_RETRY_ERRORS=1: sections whose recorded rows
 # contain an error row are NOT replayed (and not re-recorded) — the
@@ -1118,6 +1122,87 @@ def main():
             srv_mod.reset_serving_state()
 
     _section("cfg-serving-latency", cfg_serving)
+
+    # ---- mesh-native serving: keys/s at 1/2/4/8 shards ---------------------
+    # The serving fast path's dispatch seam (plans.run_points, the exact
+    # call every coalesced batch lands on) measured per shard count.
+    # Each row re-resolves the serving mesh (DPF_TPU_MESH /
+    # DPF_TPU_MESH_DEVICES — both in the ledger key, so sharded rows
+    # never collide with single-device rows on resume), warms its plan
+    # outside the timed loop, and commits ONLY after proving the sharded
+    # words byte-identical to the 1-shard row's.  On the CPU virtual
+    # mesh the scaling is a correctness smoke, not a speedup claim; on
+    # hardware the target is near-linear keys/s to 8 chips (ROADMAP 1).
+    def cfg_serving_mesh():
+        import jax as _jax
+
+        from dpf_tpu.core import plans as plans_mod
+        from dpf_tpu.models import keys_chacha as kc_mod
+        from dpf_tpu.parallel import serving_mesh
+
+        n_dev = len(_jax.devices())
+        max_shards = 1 << (min(n_dev, 8).bit_length() - 1)
+        log_n = 16 if not small else 10
+        K = 1024 if not small else 128
+        Q = 128 if not small else 32
+        reps = 12 if not small else 4
+        rng = np.random.default_rng(99)
+        alphas = rng.integers(0, 1 << log_n, size=K, dtype=np.uint64)
+        ka, _ = kc_mod.gen_batch(alphas, log_n, rng=rng)
+        xs = rng.integers(0, 1 << log_n, size=(K, Q), dtype=np.uint64)
+        saved = {
+            name: knobs.get_raw(name)
+            for name in ("DPF_TPU_MESH", "DPF_TPU_MESH_DEVICES")
+        }
+        want = None
+        try:
+            for shards in (1, 2, 4, 8):
+                if shards > max_shards:
+                    continue
+                if shards == 1:
+                    os.environ["DPF_TPU_MESH"] = "off"
+                    os.environ["DPF_TPU_MESH_DEVICES"] = "0"
+                else:
+                    os.environ["DPF_TPU_MESH"] = "on"
+                    os.environ["DPF_TPU_MESH_DEVICES"] = str(shards)
+                serving_mesh.reset()
+                # Warmup (the compile) + the byte-identity gate, both
+                # outside the timed loop.
+                words = plans_mod.run_points("points", "fast", ka, xs)
+                if want is None:
+                    want = words
+                elif not np.array_equal(words, want):
+                    raise RuntimeError(
+                        f"cfg-serving-mesh: {shards}-shard words drifted "
+                        "from single-device — refusing to commit a row "
+                        "for a wrong answer"
+                    )
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    plans_mod.run_points("points", "fast", ka, xs)
+                dt = (time.perf_counter() - t0) / reps
+                _emit(
+                    f"serving mesh pointwise n={log_n} {K}x{Q} "
+                    f"(fast, packed, {shards} shard"
+                    f"{'s' if shards > 1 else ''})",
+                    K / dt / 1e3, "kkeys/sec", scale=1e3,
+                    route=_route(f"mesh-{shards}shard,plan-cache,packed"),
+                    bytes_out=K * ((Q + 7) // 8),
+                    extra={
+                        "shards": shards,
+                        "key_evals_per_s": round(K * Q / dt, 1),
+                        "identical_to_single_device": True,
+                    },
+                )
+        finally:
+            for name, val in saved.items():
+                if val is None:
+                    os.environ.pop(name, None)
+                else:
+                    os.environ[name] = val
+            serving_mesh.reset()
+
+    _section("cfg-serving-mesh", cfg_serving_mesh)
 
     # ---- serving overload: goodput + shed rate at 1x/4x/16x capacity -------
     # The load-survival acceptance scenario (tests/test_load_survival.py's

@@ -100,6 +100,32 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
+        # Routes the visible topology cannot trace (the 8-shard mesh
+        # routes on a single-device invocation) carry their committed
+        # certificates forward — dropping them would desync the sidecar
+        # from the matrix.  No committed certificate either -> refuse:
+        # run under the 8-virtual-device env (runtests.sh / lint_all.sh).
+        skipped = certify.skipped_routes()
+        if skipped:
+            committed = (certify.load_committed(root) or {}).get(
+                "routes", {}
+            )
+            for r in skipped:
+                old = committed.get(r.name)
+                if old is None:
+                    print(
+                        f"route {r.name!r} needs >= {r.min_devices} "
+                        "devices to certify and has no committed "
+                        "certificate — re-run under the 8-virtual-"
+                        "device CPU mesh (lint_all.sh forces it)",
+                        file=sys.stderr,
+                    )
+                    return 1
+                certs[r.name] = old
+                print(
+                    f"carried committed certificate for {r.name} "
+                    f"(needs >= {r.min_devices} devices, have fewer)"
+                )
         for rel in certify.write(root, certs):
             print(f"wrote {rel}")
         return 0

@@ -129,6 +129,29 @@ def leaky_hh_descend_eval(counts, xs):
     return acc
 
 
+def leaky_shard_index_eval(seeds, table):
+    """Slices a 'shard subtree' by a SECRET-derived index inside a
+    shard_map body — the forbidden mesh-serving shape.  The public way
+    a shard picks its slice is ``jax.lax.axis_index`` over the mesh
+    axis (a trace-time-public coordinate, what the sharded evaluators in
+    parallel/sharding.py do); deriving it from key material makes the
+    partition layout itself key-dependent, observable as cross-chip
+    traffic skew.  Built on a 1-device mesh so the fixture fires in any
+    test environment — the leak is in the dataflow, not the topology."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from dpf_tpu.parallel.sharding import shard_map_compat
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("keys",))
+
+    def body(s, t):
+        j = (s[0] & jnp.uint32(3)).astype(jnp.int32)
+        return jax.lax.dynamic_slice_in_dim(t, j, 2, axis=0)
+
+    return shard_map_compat(body, mesh, (P(), P()), P())(seeds, table)
+
+
 #: (function, n secret leading args, total args builder) — the tests
 #: iterate this to keep fixture and assertion lists in sync.
 LEAKY = (
@@ -141,4 +164,5 @@ LEAKY = (
     ("leaky_kernel_eval", leaky_kernel_eval, "secret-index"),
     ("leaky_kernel_loop_eval", leaky_kernel_loop_eval, "secret-index"),
     ("leaky_hh_descend_eval", leaky_hh_descend_eval, "secret-branch"),
+    ("leaky_shard_index_eval", leaky_shard_index_eval, "secret-index"),
 )

@@ -44,6 +44,13 @@ class Route:
     plan_route: str  # core.plans PlanKey.route ("-" when not plan-cached)
     knobs: tuple  # (("profile", ...), ("backend", ...), ...) — hashable
     build: Callable[[], tuple]  # () -> (closed_jaxpr, secret_invar_set)
+    # Device floor: the mesh routes trace a REAL 8-shard shard_map (the
+    # per-shard shapes — and so the certificate hash — depend on the
+    # shard count, so it is pinned at 8, the virtual-CPU-mesh quantum
+    # every sanctioned entry point forces).  Routes whose floor exceeds
+    # the visible device count are SKIPPED, not failed (certify.
+    # skipped_routes) — their committed certificates stand.
+    min_devices: int = 1
 
     def knob_dict(self) -> dict:
         return dict(self.knobs)
@@ -463,13 +470,118 @@ def _evalfull_fast_chunked(single_chunk: bool):
 
 
 # ---------------------------------------------------------------------------
+# Mesh-native serving routes (DPF_TPU_MESH): the shard_map dispatch
+# bodies core.plans lands on when the serving mesh is resolved.  Each
+# traces the UNJITTED ``*_sm`` callable from parallel/sharding.py over a
+# pinned 8-shard keys-only mesh — the topology every sanctioned entry
+# point (runtests.sh, lint_all.sh, tests/conftest.py) forces on CPU —
+# so the per-shard shapes, and the certificate hashes, are
+# deterministic.  The verifier descends the shard_map sub-jaxpr like
+# any call-like primitive; the collectives (all_gather/psum in the agg
+# folds) are data movement, not control flow, and must stay untainted
+# of findings.
+# ---------------------------------------------------------------------------
+
+_MESH_SHARDS = 8
+
+
+def _serving_mesh_8():
+    from ...parallel.sharding import make_mesh
+
+    return make_mesh(_MESH_SHARDS, 1)
+
+
+def _points_sharded_compat():
+    from ...parallel import sharding
+
+    mesh = _serving_mesh_8()
+    kb = _compat_batch(9, 32)  # 4 keys per shard, XLA body
+    masks = _compat_masks(kb)
+    xs_hi, xs_lo = _split32(32, 32)
+    fn = sharding._sharded_eval_points_sm(
+        mesh, kb.nu, kb.log_n, 1, "xla", False, True
+    )
+    return _trace(fn, (*masks, xs_hi, xs_lo), secret=range(0, 6))
+
+
+def _points_sharded_fast():
+    import jax.numpy as jnp
+
+    from ...parallel import sharding
+
+    mesh = _serving_mesh_8()
+    kb = _fast_batch(10, 32)
+    xs_lo = jnp.zeros((32, 32), jnp.uint32)  # query-major [Q, K]
+    xs_hi = jnp.zeros((1, 1), jnp.uint32)
+    fn = sharding._sharded_eval_points_fast_sm(mesh, kb.nu, 10, 0, True)
+    return _trace(
+        fn, (*kb.device_args(), xs_hi, xs_lo), secret=range(0, 5)
+    )
+
+
+def _dcf_points_sharded():
+    import jax.numpy as jnp
+
+    from ...models import dcf
+    from ...parallel import sharding
+
+    mesh = _serving_mesh_8()
+    alphas = np.arange(16, dtype=np.uint64)
+    ka, _ = dcf.gen_lt_batch(alphas, 10, rng=_rng())
+    xs_lo = jnp.zeros((32, 16), jnp.uint32)
+    xs_hi = jnp.zeros((1, 1), jnp.uint32)
+    fn = sharding._sharded_dcf_points_sm(mesh, ka.nu, 10, 0, True)
+    return _trace(
+        fn, (*ka.device_args(), xs_hi, xs_lo), secret=range(0, 6)
+    )
+
+
+def _evalfull_sharded_compat():
+    from ...models import dpf
+    from ...parallel import sharding
+
+    mesh = _serving_mesh_8()
+    dk = dpf.DeviceKeys(_compat_batch(11, 32), pad_to=32 * _MESH_SHARDS)
+    fn = sharding._sharded_eval_full_sm(mesh, dk.nu, 0, "xla")
+    args = (
+        dk.seed_planes, dk.t_words, dk.scw_planes, dk.tl_words,
+        dk.tr_words, dk.fcw_planes,
+    )
+    return _trace(fn, args, secret=range(0, 6))
+
+
+def _evalfull_sharded_fast():
+    from ...parallel import sharding
+
+    mesh = _serving_mesh_8()
+    kb = _fast_batch(11, 8)  # one key per shard, XLA pipeline
+    fn = sharding._sharded_eval_full_fast_sm(mesh, kb.nu, 0, -1)
+    return _trace(fn, kb.device_args(), secret=range(0, 5))
+
+
+def _agg_fold_sharded(op: str):
+    """One mesh aggregation fold chunk: shard-local fold + ONE
+    all-reduce (XOR all-gather / psum).  Carry and rows both secret —
+    the collective moves secret data but decides nothing by it."""
+    import jax.numpy as jnp
+
+    from ...parallel import sharding
+
+    mesh = _serving_mesh_8()
+    carry = jnp.zeros(64, jnp.uint32)
+    rows = jnp.zeros((256, 64), jnp.uint32)  # 32 rows per shard
+    fn = sharding._sharded_agg_fold_sm(mesh, op)
+    return _trace(fn, (carry, rows), secret=(0, 1))
+
+
+# ---------------------------------------------------------------------------
 # The matrix
 # ---------------------------------------------------------------------------
 
 
-def _route(name, entrypoint, plan_route, knobs, build):
+def _route(name, entrypoint, plan_route, knobs, build, min_devices=1):
     return Route(name, entrypoint, plan_route, tuple(sorted(knobs.items())),
-                 build)
+                 build, min_devices)
 
 
 ROUTES: tuple[Route, ...] = (
@@ -664,6 +776,63 @@ ROUTES: tuple[Route, ...] = (
         "agg_add",
         {"profile": "agg", "op": "add"},
         lambda: _agg_fold("add"),
+    ),
+    # -- mesh-native serving (DPF_TPU_MESH; parallel/sharding.py) -----------
+    _route(
+        "points_sharded/compat/xla/packed",
+        "parallel.sharding.eval_points_sharded "
+        "(core.plans.run_points mesh dispatch)",
+        "points",
+        {"profile": "compat", "backend": "xla", "packed": True, "mesh": 8},
+        _points_sharded_compat, min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "points_sharded/fast/xla/packed",
+        "parallel.sharding.eval_points_sharded_fast "
+        "(core.plans.run_points / run_hh_level mesh dispatch)",
+        "points",
+        {"profile": "fast", "backend": "xla", "packed": True, "mesh": 8},
+        _points_sharded_fast, min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "dcf_points_sharded/xla/packed",
+        "parallel.sharding.eval_lt_points_sharded "
+        "(core.plans.run_points / run_interval mesh dispatch)",
+        "dcf_points",
+        {"profile": "fast", "backend": "xla", "packed": True, "mesh": 8},
+        _dcf_points_sharded, min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "evalfull_sharded/compat/xla",
+        "parallel.sharding.eval_full_sharded "
+        "(core.plans.run_evalfull mesh dispatch)",
+        "evalfull",
+        {"profile": "compat", "backend": "xla", "mesh": 8},
+        _evalfull_sharded_compat, min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "evalfull_sharded/fast/xla",
+        "parallel.sharding.eval_full_sharded_fast "
+        "(core.plans.run_evalfull mesh dispatch)",
+        "evalfull",
+        {"profile": "fast", "backend": "xla", "mesh": 8},
+        _evalfull_sharded_fast, min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "agg_sharded/fold_xor",
+        "parallel.sharding.fold_rows_sharded "
+        "(core.plans.run_agg_fold mesh dispatch; one all-reduce/chunk)",
+        "agg_xor",
+        {"profile": "agg", "op": "xor", "mesh": 8},
+        lambda: _agg_fold_sharded("xor"), min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "agg_sharded/fold_add",
+        "parallel.sharding.fold_rows_sharded "
+        "(core.plans.run_agg_fold mesh dispatch; one all-reduce/chunk)",
+        "agg_add",
+        {"profile": "agg", "op": "add", "mesh": 8},
+        lambda: _agg_fold_sharded("add"), min_devices=_MESH_SHARDS,
     ),
 )
 

@@ -176,6 +176,23 @@ _declare(
     "dpf_tpu/server.py",
 )
 
+# Mesh-native serving: shard serving dispatches across the chip mesh -------
+_declare(
+    "DPF_TPU_MESH", "str", "auto",
+    "Mesh-native serving fast path: shard plan-cached dispatches "
+    "(points/DCF/hh/agg/evalfull) across the chip mesh on the keys axis. "
+    "off = single-device; on = mesh whenever >= 2 devices are visible "
+    "(CPU tests use the 8-virtual-device mesh); auto = mesh on TPU only.",
+    "dpf_tpu/parallel/serving_mesh.py", values="off|auto|on",
+)
+_declare(
+    "DPF_TPU_MESH_DEVICES", "int", "0",
+    "Device budget for the serving mesh (0 = all visible devices). The "
+    "shard count is the largest power of two <= min(this, visible) so "
+    "pow2 plan K-buckets always divide evenly across shards.",
+    "dpf_tpu/parallel/serving_mesh.py",
+)
+
 # Load survival: admission control, deadlines, circuit breaker, faults ------
 _declare(
     "DPF_TPU_BATCH_TIMEOUT_S", "float", "600",

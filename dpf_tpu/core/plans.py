@@ -32,6 +32,18 @@ handed from the prefix expansion to the finish are dead afterwards, so
 XLA may reuse their buffers in place.  ``off`` / ``auto`` / ``on``;
 ``auto`` donates on TPU and stays off elsewhere (CPU XLA may decline
 the aliasing hint with a warning).
+
+Mesh-native dispatch (``DPF_TPU_MESH``): when the serving mesh is
+resolved (``parallel/serving_mesh.py``), every ``run_*`` body lands on
+the shard_map evaluators in ``parallel/sharding.py`` instead of the
+single-device routes — keys axis partitioned, replies packed shard-
+locally, one XOR/psum all-reduce per aggregation chunk and zero
+collectives anywhere else.  The shard count is part of the plan key, so
+mesh and single-device executables never collide, and the K bucket
+floors at the shard count so the pow2 pad IS the mesh pad (pad-to-mesh-
+multiple costs nothing extra).  Inside ``serving_mesh.suspended()``
+(degraded mode, breaker not closed) the same calls fall back to the
+single-device twins, byte-identically.
 """
 
 from __future__ import annotations
@@ -103,20 +115,39 @@ class PlanKey(NamedTuple):
     packed: bool
     fuse: str  # DPF_TPU_FUSE in force (expansion routes)
     sbox: str  # active S-box schedule (compat cipher routes)
+    mesh: int = 0  # serving-mesh shard count (0 = single-device)
 
 
 def plan_key(
     route: str, profile: str, log_n: int, k: int, q: int = 0,
-    packed: bool = True,
+    packed: bool = True, mesh: int = 0,
 ) -> PlanKey:
     from ..ops import sbox_circuit
 
+    # The K bucket floors at the shard count: a pow2 bucket >= shards
+    # divides evenly across a pow2 mesh, so the bucket pad doubles as
+    # the mesh pad and per-shard key counts are always whole.
     return PlanKey(
-        route, profile, int(log_n), k_bucket(k),
+        route, profile, int(log_n),
+        _pow2_bucket(k, max(k_floor(), int(mesh) or 1)),
         q_bucket(q) if q else 0, bool(packed),
         knobs.get_str("DPF_TPU_FUSE"),
         sbox_circuit.active_sbox(),
+        int(mesh),
     )
+
+
+def _dispatch_mesh():
+    """The serving mesh for THIS dispatch -> (mesh | None, shard count).
+    Resolved exactly once per ``run_*`` call so the plan key and the
+    executable can never disagree; lazy-imported so core.plans stays
+    cheap to import for harnesses that never serve."""
+    from ..parallel import serving_mesh
+
+    mesh = serving_mesh.active_mesh()
+    if mesh is None:
+        return None, 0
+    return mesh, int(mesh.shape[serving_mesh.KEYS_AXIS])
 
 
 class Plan:
@@ -264,7 +295,15 @@ def _pad_queries(xs: np.ndarray, kb_: int, qb: int) -> np.ndarray:
     return out
 
 
-def _points_eval(route: str, profile: str, kb, xs: np.ndarray):
+def _points_eval(route: str, profile: str, kb, xs: np.ndarray, mesh=None):
+    if mesh is not None:
+        from ..parallel import sharding
+
+        if route == "dcf_points":
+            return sharding.eval_lt_points_sharded(kb, xs, mesh, packed=True)
+        if profile == "fast":
+            return sharding.eval_points_sharded_fast(kb, xs, mesh, packed=True)
+        return sharding.eval_points_sharded(kb, xs, mesh, packed=True)
     if route == "dcf_points":
         from ..models import dcf
 
@@ -281,10 +320,14 @@ def _points_eval(route: str, profile: str, kb, xs: np.ndarray):
 def run_points(route: str, profile: str, kb, xs: np.ndarray) -> np.ndarray:
     """Plan-cached pointwise evaluation -> packed words
     uint32[K, ceil(Q/32)] (core/bitpack contract).  ``route`` is
-    "points" (profile selects compat/fast) or "dcf_points"."""
+    "points" (profile selects compat/fast) or "dcf_points".  With the
+    serving mesh resolved, ONE coalesced dispatch shards the key axis
+    across every chip (parallel/sharding.py) — never one dispatch per
+    shard."""
     xs = np.asarray(xs, dtype=np.uint64)
     K, Q = xs.shape
-    key = plan_key(route, profile, kb.log_n, K, Q, packed=True)
+    mesh, n_shards = _dispatch_mesh()
+    key = plan_key(route, profile, kb.log_n, K, Q, packed=True, mesh=n_shards)
     plan, first = _CACHE.get(key)
     obs_trace.add_event(
         "plan_lookup", hit=not first, route=route,
@@ -293,16 +336,22 @@ def run_points(route: str, profile: str, kb, xs: np.ndarray) -> np.ndarray:
     t0 = time.perf_counter()
     kbp = _pad_keys(kb, key.k_bucket - K)
     # "compute" is the (async) jit dispatch; the asarray below blocks on
-    # the device result, so "d2h" includes the device wait.
+    # the device result, so "d2h" includes the device wait.  The sharded
+    # evaluators marshal their own output (the gather + D2H happens
+    # inside the wrapper), so under the mesh there is no separate d2h
+    # span — emitting a zero-length one would misattribute the transfer.
     with obs_trace.child_span("compute"):
         dev = _points_eval(
             route, profile, kbp,
-            _pad_queries(xs, key.k_bucket, key.q_bucket),
+            _pad_queries(xs, key.k_bucket, key.q_bucket), mesh,
         )
-    # The packed words leave the device exactly once per dispatch, here.
-    with obs_trace.child_span("d2h"):
-        # host-sync: final reply marshalling (points route)
-        words = np.asarray(dev)
+    if mesh is not None:
+        words = dev  # already host words (sharded wrapper marshalled)
+    else:
+        # The packed words leave the device exactly once per dispatch.
+        with obs_trace.child_span("d2h"):
+            # host-sync: final reply marshalling (points route)
+            words = np.asarray(dev)
     if first:
         plan.compile_s = time.perf_counter() - t0
     plan.last_used = time.time()
@@ -319,7 +368,10 @@ def run_interval(ik, xs: np.ndarray) -> np.ndarray:
     upper, lower, const = ik[0], ik[1], ik[2]
     xs = np.asarray(xs, dtype=np.uint64)
     K, Q = xs.shape
-    key = plan_key("dcf_interval", "fast", upper.log_n, K, Q, packed=True)
+    mesh, n_shards = _dispatch_mesh()
+    key = plan_key(
+        "dcf_interval", "fast", upper.log_n, K, Q, packed=True, mesh=n_shards
+    )
     plan, first = _CACHE.get(key)
     obs_trace.add_event(
         "plan_lookup", hit=not first, route="dcf_interval",
@@ -346,14 +398,26 @@ def run_interval(ik, xs: np.ndarray) -> np.ndarray:
     else:
         up, lp, cp_ = upper, lower, const
     with obs_trace.child_span("compute"):
-        dev = dcf.eval_interval_points(
-            (up, lp, cp_),
-            _pad_queries(xs, key.k_bucket, key.q_bucket),
-            packed=True,
-        )
-    with obs_trace.child_span("d2h"):
-        # host-sync: final reply marshalling (interval route)
-        words = np.asarray(dev)
+        if mesh is not None:
+            from ..parallel.sharding import eval_interval_points_sharded
+
+            dev = eval_interval_points_sharded(
+                (up, lp, cp_),
+                _pad_queries(xs, key.k_bucket, key.q_bucket),
+                mesh, packed=True,
+            )
+        else:
+            dev = dcf.eval_interval_points(
+                (up, lp, cp_),
+                _pad_queries(xs, key.k_bucket, key.q_bucket),
+                packed=True,
+            )
+    if mesh is not None:
+        words = dev  # already host words (sharded wrapper marshalled)
+    else:
+        with obs_trace.child_span("d2h"):
+            # host-sync: final reply marshalling (interval route)
+            words = np.asarray(dev)
     if first:
         plan.compile_s = time.perf_counter() - t0
     plan.last_used = time.time()
@@ -372,12 +436,19 @@ def run_hh_level(profile: str, kb, xs: np.ndarray, level: int) -> np.ndarray:
     — the level only steers HOST-side query masking, so every level of a
     descent lands on the SAME compiled executable: one warmup per (K, Q)
     bucket covers the whole protocol run (the zero-retrace contract
-    tests/test_apps.py asserts)."""
+    tests/test_apps.py asserts).  A descent round is (clients x
+    candidates) embarrassingly parallel over the key axis, so with the
+    serving mesh resolved the masked queries walk the SHARDED pointwise
+    evaluators — the same host-side dyadic-prefix masking, the key axis
+    partitioned across chips, still one dispatch per round."""
     xs = np.asarray(xs, dtype=np.uint64)
     K, Q = xs.shape
     if K != kb.k:
         raise ValueError("hh: xs first axis must match key batch")
-    key = plan_key("hh_level", profile, kb.log_n, K, Q, packed=True)
+    mesh, n_shards = _dispatch_mesh()
+    key = plan_key(
+        "hh_level", profile, kb.log_n, K, Q, packed=True, mesh=n_shards
+    )
     plan, first = _CACHE.get(key)
     obs_trace.add_event(
         "plan_lookup", hit=not first, route="hh_level",
@@ -392,10 +463,24 @@ def run_hh_level(profile: str, kb, xs: np.ndarray, level: int) -> np.ndarray:
     with obs_trace.child_span("compute"):
         # The grouped levels= path returns host words (the walk bodies
         # marshal their own packed output) — no separate d2h span here.
-        words = eval_points_level_grouped(
-            kbp, _pad_queries(xs, key.k_bucket, key.q_bucket), groups=1,
-            packed=True, levels=(int(level),),
-        )
+        if mesh is not None:
+            from ..models.dpf import _masked_level_queries
+            from ..parallel import sharding
+
+            masked = _masked_level_queries(
+                _pad_queries(xs, key.k_bucket, key.q_bucket),
+                kb.log_n, (int(level),), 1,
+            )
+            eval_sharded = (
+                sharding.eval_points_sharded_fast if profile == "fast"
+                else sharding.eval_points_sharded
+            )
+            words = eval_sharded(kbp, masked, mesh, packed=True)
+        else:
+            words = eval_points_level_grouped(
+                kbp, _pad_queries(xs, key.k_bucket, key.q_bucket), groups=1,
+                packed=True, levels=(int(level),),
+            )
     if first:
         plan.compile_s = time.perf_counter() - t0
     plan.last_used = time.time()
@@ -411,7 +496,11 @@ def run_agg_fold(
     uint32[W] carry (zeros when None) -> uint32[W].  Rows and words are
     bucketed like every other plan (zero rows / zero word columns are
     the identity of both ops), so a streamed upload's fixed-size chunks
-    plus one ragged tail hit at most two executables."""
+    plus one ragged tail hit at most two executables.  With the serving
+    mesh resolved, the rows shard over the key axis, each chip folds its
+    rows locally, and the shard partials meet in ONE all-reduce per
+    chunk (XOR all-gather or psum; parallel/sharding.fold_rows_sharded)
+    with the dead carry donated across shards."""
     from ..apps import aggregation as agg
 
     if op not in agg.OPS:
@@ -420,7 +509,9 @@ def run_agg_fold(
     if rows.ndim != 2:
         raise ValueError("agg: rows must be [R, W]")
     R, W = rows.shape
-    key = plan_key(f"agg_{op}", "agg", 0, R, W * 32, packed=True)
+    mesh, n_shards = _dispatch_mesh()
+    key = plan_key(f"agg_{op}", "agg", 0, R, W * 32, packed=True,
+                   mesh=n_shards)
     plan, first = _CACHE.get(key)
     obs_trace.add_event(
         "plan_lookup", hit=not first, route=f"agg_{op}",
@@ -437,7 +528,14 @@ def run_agg_fold(
             raise ValueError("agg: carry must be [W]")
         carry_p[:W] = carry
     with obs_trace.child_span("compute"):
-        dev = agg._fold_jit(op, carry_p, rows_p)
+        if mesh is not None:
+            from ..parallel.sharding import fold_rows_sharded
+
+            dev = fold_rows_sharded(
+                op, carry_p, rows_p, mesh, donate=donation_enabled()
+            )
+        else:
+            dev = agg._fold_jit(op, carry_p, rows_p)
     with obs_trace.child_span("d2h"):
         # host-sync: final reply marshalling (aggregation carry)
         out = np.asarray(dev)
@@ -448,9 +546,16 @@ def run_agg_fold(
 
 
 def run_evalfull(profile: str, kb) -> np.ndarray:
-    """Plan-cached full-domain expansion -> uint8[K, out_bytes]."""
+    """Plan-cached full-domain expansion -> uint8[K, out_bytes].  With
+    the serving mesh resolved, the key batch shards over the keys axis
+    (parallel/sharding.eval_full_sharded[_fast]; keys-only mesh, zero
+    collectives); streamed EvalFull stays single-device — its chunked
+    double-buffered pipeline is a latency tool, not a throughput one."""
     K = kb.k
-    key = plan_key("evalfull", profile, kb.log_n, K, 0, packed=True)
+    mesh, n_shards = _dispatch_mesh()
+    key = plan_key(
+        "evalfull", profile, kb.log_n, K, 0, packed=True, mesh=n_shards
+    )
     plan, first = _CACHE.get(key)
     obs_trace.add_event(
         "plan_lookup", hit=not first, route="evalfull",
@@ -459,7 +564,15 @@ def run_evalfull(profile: str, kb) -> np.ndarray:
     t0 = time.perf_counter()
     kbp = _pad_keys(kb, key.k_bucket - K)
     with obs_trace.child_span("compute"):
-        if profile == "fast":
+        if mesh is not None:
+            from ..parallel import sharding
+
+            out = (
+                sharding.eval_full_sharded_fast(kbp, mesh)
+                if profile == "fast"
+                else sharding.eval_full_sharded(kbp, mesh)
+            )
+        elif profile == "fast":
             from ..models import dpf_chacha
 
             out = dpf_chacha.eval_full(kbp)
@@ -619,8 +732,16 @@ def rewarm_recent(limit: int = 4) -> int:
     """Re-drive the most recently used plans through ``warmup`` (a real
     device dispatch per plan — this IS the breaker's recovery probe: it
     fails while the device is still wedged and leaves the plan cache hot
-    when it succeeds).  Returns the number of shapes warmed."""
+    when it succeeds).  With the serving mesh resolved, the SINGLE-device
+    twins warm too: the half-open trial dispatches degraded
+    (``serving_mesh.suspended``), and recovery must not land a compile on
+    the trial request.  Returns the number of shapes warmed."""
     shapes = recent_shapes(limit)
     if shapes:
         warmup(shapes)
+        from ..parallel import serving_mesh
+
+        if serving_mesh.active_mesh() is not None:
+            with serving_mesh.suspended():
+                warmup(shapes)
     return len(shapes)

@@ -374,7 +374,9 @@ def _concat_batches(a: DcfKeyBatch, b: DcfKeyBatch) -> DcfKeyBatch:
     )
 
 
-def eval_interval_points(ik, xs: np.ndarray, packed: bool = False) -> np.ndarray:
+def eval_interval_points(
+    ik, xs: np.ndarray, packed: bool = False, lt_eval=None
+) -> np.ndarray:
     """Evaluate interval shares at xs uint64[K, Q] -> uint8[K, Q]; ``ik``
     is one party's (upper, lower, const) triple from
     :func:`gen_interval_batch`.  Both gate sets evaluate in ONE device
@@ -382,8 +384,12 @@ def eval_interval_points(ik, xs: np.ndarray, packed: bool = False) -> np.ndarray
     device-resident operands amortize across calls).  ``packed`` returns
     uint32[K, ceil(Q/32)] packed words (core/bitpack contract); the
     upper^lower fold and the public wrap constant apply directly on the
-    words."""
+    words.  ``lt_eval`` overrides the comparison evaluator (same
+    signature as :func:`eval_lt_points`) — the mesh serving path injects
+    the sharded walk here so the combine stays in one place."""
     upper, lower, const = ik[0], ik[1], ik[2]
+    if lt_eval is None:
+        lt_eval = eval_lt_points
     xs = np.asarray(xs, dtype=np.uint64)
     if xs.ndim != 2 or xs.shape[0] != upper.k:
         raise ValueError("dcf: xs must be [K, Q]")
@@ -400,10 +406,10 @@ def eval_interval_points(ik, xs: np.ndarray, packed: bool = False) -> np.ndarray
             pass
     k = upper.k
     if packed:
-        words = eval_lt_points(both, np.concatenate([xs, xs]), packed=True)
+        words = lt_eval(both, np.concatenate([xs, xs]), packed=True)
         # const in {0, 1} complements a gate's whole row; re-mask the tail
         # the complement just set.
         cmask = (np.uint32(0) - const.astype(np.uint32))[:, None]
         return bitpack.mask_tail(words[:k] ^ words[k:] ^ cmask, xs.shape[1])
-    bits = eval_lt_points(both, np.concatenate([xs, xs]))
+    bits = lt_eval(both, np.concatenate([xs, xs]), packed=False)
     return bits[:k] ^ bits[k:] ^ const[:, None]

@@ -108,24 +108,35 @@ class MetricsHub:
             }
 
 
-def device_memory_gauges() -> list[tuple[str, str, float]]:
-    """(device, stat, value) triples from ``jax.local_devices()`` memory
-    stats — present on TPU backends, absent (empty list) on CPU where
-    the runtime reports none.  Never raises: metrics exposition must not
-    depend on backend health."""
-    out: list[tuple[str, str, float]] = []
+def device_memory_gauges() -> list[tuple[str, str, str, float]]:
+    """(device, stat, mesh_coord, value) tuples from
+    ``jax.local_devices()`` memory stats — present on TPU backends,
+    absent (empty list) on CPU where the runtime reports none.
+    ``mesh_coord`` is the device's serving-mesh coordinate ("keys:3") or
+    "off" for devices outside the mesh, so a scrape can tell partitioned
+    state (per-shard operands, roughly 1/shards each) from replicated or
+    off-mesh state instead of eyeballing raw device ids.  Never raises:
+    metrics exposition must not depend on backend health."""
+    out: list[tuple[str, str, str, float]] = []
     try:
         import jax
+
+        from ..parallel import serving_mesh
 
         for d in jax.local_devices():
             ms_fn = getattr(d, "memory_stats", None)
             ms = ms_fn() if callable(ms_fn) else None
             if not ms:
                 continue
+            try:
+                coord = serving_mesh.coordinate(d) or "off"
+            except Exception:  # noqa: BLE001 — label only, never fatal
+                coord = "off"
             for stat in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
                 if stat in ms:
                     out.append(
-                        (f"{d.platform}:{d.id}", stat, float(ms[stat]))
+                        (f"{d.platform}:{d.id}", stat, coord,
+                         float(ms[stat]))
                     )
     except Exception:  # noqa: BLE001 — observability must not take traffic down
         return out
@@ -188,7 +199,8 @@ class _Writer:
 
 
 def render(stats: dict, hists: dict,
-           device_mem: list[tuple[str, str, float]] | None = None) -> str:
+           device_mem: list[tuple[str, str, str, float]] | None = None,
+           ) -> str:
     """The /v1/metrics body: ``stats`` is the /v1/stats snapshot (the
     SAME dict — counter equality between the two surfaces is structural,
     not coincidental), ``hists`` is ``MetricsHub.snapshot()``."""
@@ -304,13 +316,22 @@ def render(stats: dict, hists: dict,
                  "Traces currently held by the flight recorder.")
         w.sample(f"{ns}_trace_ring_size", None, tr["size"])
 
+    w.family(f"{ns}_mesh_shards", "gauge",
+             "Serving-mesh shard count (0 = single-device serving): how "
+             "many chips a coalesced dispatch partitions over.")
+    w.sample(f"{ns}_mesh_shards", None,
+             stats.get("mesh", {}).get("shards", 0))
+
     mem = device_memory_gauges() if device_mem is None else device_mem
     if mem:
         w.family(f"{ns}_device_memory_bytes", "gauge",
-                 "Per-device memory from jax.local_devices() stats.")
-        for device, stat, value in mem:
+                 "Per-device memory from jax.local_devices() stats, "
+                 "labeled by serving-mesh coordinate (mesh=keys:i, or "
+                 "off for devices outside the mesh).")
+        for device, stat, coord, value in mem:
             w.sample(f"{ns}_device_memory_bytes",
-                     {"device": device, "stat": stat}, value)
+                     {"device": device, "stat": stat, "mesh": coord},
+                     value)
 
     # -- histograms --------------------------------------------------------
     phase_hists = hists.get("phase_latency", {})

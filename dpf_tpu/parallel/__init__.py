@@ -2,15 +2,17 @@
 parity all-reduce collective.  Multi-HOST execution (DCN-coordinated
 meshes, per-process input placement) lives in ``multihost``."""
 
-from . import multihost
+from . import multihost, serving_mesh
 from .sharding import (
     KEYS_AXIS,
     LEAF_AXIS,
     eval_full_sharded,
     eval_full_sharded_fast,
+    eval_interval_points_sharded,
     eval_lt_points_sharded,
     eval_points_sharded,
     eval_points_sharded_fast,
+    fold_rows_sharded,
     make_mesh,
     xor_allreduce,
 )
@@ -19,11 +21,14 @@ __all__ = [
     "KEYS_AXIS",
     "LEAF_AXIS",
     "multihost",
+    "serving_mesh",
     "eval_full_sharded",
     "eval_full_sharded_fast",
+    "eval_interval_points_sharded",
     "eval_lt_points_sharded",
     "eval_points_sharded",
     "eval_points_sharded_fast",
+    "fold_rows_sharded",
     "make_mesh",
     "xor_allreduce",
 ]

@@ -1,0 +1,161 @@
+"""The serving layer's mesh: one resolved ``(keys,)`` mesh, shared by
+every plan-cached dispatch.
+
+``parallel/sharding.py`` owns the shard_map evaluators; this module owns
+the OPERATIONAL question — is the serving fast path running sharded
+right now, and over how many chips?  The answer must be consistent
+across the whole request pipeline (plan keys, key-cache identity,
+batcher quanta, metrics labels), so everything reads it from here:
+
+  * ``DPF_TPU_MESH`` (off|auto|on) gates the feature.  ``auto`` shards
+    only on TPU (multi-device CPU is a test topology, not a deployment);
+    ``on`` shards whenever >= 2 devices are visible — how the CPU test
+    suite and the bench mesh section drive the 8-virtual-device mesh.
+  * ``DPF_TPU_MESH_DEVICES`` budgets the mesh (0 = all visible).  The
+    shard count is rounded DOWN to a power of two so the plan cache's
+    pow2 K-buckets always divide evenly across shards — pad-to-mesh-
+    multiple is free, never a reshard.
+  * ``suspended()`` is the degraded-mode override: while the circuit
+    breaker is not closed the serving state wraps dispatches in it, and
+    every plan call inside falls back to the single-device executables
+    (byte-identical by the mesh test contract) without touching the env.
+
+The resolved mesh is cached (mesh identity is part of jit cache keys —
+rebuilding it per request would retrace); ``reset()`` drops the cache
+for tests/benches that flip the knobs mid-process.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from ..core import knobs
+
+KEYS_AXIS = "keys"
+
+_LOCK = threading.Lock()
+# (resolved?, mesh | None) — resolution touches jax.devices(), so it is
+# lazy and cached; None means "serving is single-device".
+_RESOLVED: list = [False, None]
+
+_TLS = threading.local()
+
+
+def _pow2_floor(n: int) -> int:
+    return 1 << (n.bit_length() - 1) if n >= 1 else 0
+
+
+def _resolve():
+    """Build (or decline to build) the serving mesh from the knobs and
+    the visible device topology.  -> Mesh | None."""
+    raw = knobs.get_raw("DPF_TPU_MESH")
+    mode = knobs.knob("DPF_TPU_MESH").default if raw is None else raw.lower()
+    if mode in ("off", "0", "false", ""):
+        return None
+    if mode in ("on", "1", "true"):
+        mode = "on"
+    elif mode != "auto":
+        raise ValueError(f"DPF_TPU_MESH={mode!r} unknown (off|auto|on)")
+    import jax
+
+    if mode == "auto" and jax.default_backend() != "tpu":
+        return None
+    devices = list(jax.devices())
+    budget = knobs.get_int("DPF_TPU_MESH_DEVICES")
+    if budget > 0:
+        devices = devices[:budget]
+    n = _pow2_floor(len(devices))
+    if n < 2:
+        return None
+    from .sharding import make_mesh
+
+    return make_mesh(n_keys=n, n_leaf=1, devices=devices[:n])
+
+
+def serving_mesh():
+    """The resolved serving mesh (None = single-device serving).  Cached;
+    ``reset()`` re-reads the knobs."""
+    with _LOCK:
+        if not _RESOLVED[0]:
+            _RESOLVED[1] = _resolve()
+            _RESOLVED[0] = True
+        return _RESOLVED[1]
+
+
+def reset() -> None:
+    """Drop the cached mesh so the next call re-reads DPF_TPU_MESH /
+    DPF_TPU_MESH_DEVICES (tests and the bench mesh section flip them
+    mid-process)."""
+    with _LOCK:
+        _RESOLVED[0] = False
+        _RESOLVED[1] = None
+
+
+def suspended():
+    """Context manager: plan dispatches inside run single-device even
+    when the mesh is on — the degraded-mode override the serving state
+    engages while the circuit breaker is not closed (a recovering device
+    must re-prove itself on the simplest executable, and a half-open
+    trial must not fan a wedged collective across every chip)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        prev = getattr(_TLS, "suspended", 0)
+        _TLS.suspended = prev + 1
+        try:
+            yield
+        finally:
+            _TLS.suspended = prev
+
+    return _cm()
+
+
+def is_suspended() -> bool:
+    return bool(getattr(_TLS, "suspended", 0))
+
+
+def active_mesh():
+    """The mesh the CURRENT dispatch should use: the resolved serving
+    mesh, unless this thread is inside ``suspended()`` (degraded mode).
+    Every ``core.plans.run_*`` body consults this exactly once per call,
+    so plan key and executable can never disagree."""
+    if is_suspended():
+        return None
+    return serving_mesh()
+
+
+def shards() -> int:
+    """Shard count of the dispatch mesh (0 = single-device).  This is
+    the ``mesh`` field of plan keys and the key-cache identity token."""
+    mesh = active_mesh()
+    if mesh is None:
+        return 0
+    return int(mesh.shape[KEYS_AXIS])
+
+
+def coordinate(device) -> str | None:
+    """Mesh coordinate label for a device ("keys:3"), or None when the
+    device is not part of the serving mesh — the metrics layer labels
+    per-device memory gauges with this so scrapes can tell partitioned
+    state (per-shard operands) from replicated or off-mesh state."""
+    mesh = serving_mesh()
+    if mesh is None:
+        return None
+    for i, d in enumerate(mesh.devices.reshape(-1)):
+        if d == device:
+            return f"{KEYS_AXIS}:{i}"
+    return None
+
+
+def stats() -> dict[str, Any]:
+    """The /v1/stats ``mesh`` block (and the dpf_mesh_shards gauge):
+    resolved shard count plus the raw knob values, so a scrape can tell
+    a deliberately-off mesh from a topology that could not support one."""
+    mesh = serving_mesh()
+    return {
+        "shards": 0 if mesh is None else int(mesh.shape[KEYS_AXIS]),
+        "mode": knobs.get_str("DPF_TPU_MESH"),
+        "device_budget": knobs.get_int("DPF_TPU_MESH_DEVICES"),
+    }

@@ -23,6 +23,7 @@ and the driver's multi-chip dry-run validate the shardings without N chips.
 
 from __future__ import annotations
 
+import threading
 from functools import cache
 
 import jax
@@ -43,6 +44,44 @@ from ..models.dpf import (
 
 KEYS_AXIS = "keys"
 LEAF_AXIS = "leaf"
+
+
+class _ShardedJits:
+    """Registry of every jitted sharded evaluator built in this module.
+
+    The mesh-native serving fast path promises zero retraces after
+    warmup, and ``core.plans.trace_count`` proves it by summing the jit
+    cache sizes of module-level jitted callables — but the sharded
+    executables live inside ``functools.cache`` closures, invisible to
+    that scan.  This object IS module-level and exposes the same
+    ``_cache_size`` duck type, summing over every sharded jit ever
+    built, so a retrace in a mesh dispatch moves the counter exactly
+    like a single-device one."""
+
+    def __init__(self):
+        self._jits: list = []
+        self._lock = threading.Lock()
+
+    def register(self, fn):
+        with self._lock:
+            self._jits.append(fn)
+        return fn
+
+    def _cache_size(self) -> int:
+        total = 0
+        with self._lock:
+            jits = list(self._jits)
+        for f in jits:
+            cs = getattr(f, "_cache_size", None)
+            if callable(cs):
+                try:
+                    total += int(cs())
+                except Exception:  # noqa: BLE001 — counting is best-effort
+                    pass
+        return total
+
+
+SHARDED_JITS = _ShardedJits()
 
 
 def shard_map_compat(body, mesh, in_specs, out_specs, check_vma=None):
@@ -140,13 +179,12 @@ def expand_subtree_local(
     return S, T
 
 
-@cache
-def _sharded_eval_full(mesh: Mesh, nu: int, subtree_levels: int, backend: str):
-    """Compile the sharded evaluator for a (mesh, domain, backend) bucket.
-
-    ``subtree_levels`` = log2(leaf-axis size); each shard replicates that
-    many top levels, then expands only its own subtree.
-    """
+def _sharded_eval_full_sm(
+    mesh: Mesh, nu: int, subtree_levels: int, backend: str
+):
+    """The UNJITTED shard_map body of :func:`_sharded_eval_full` — the
+    callable the oblivious-trace verifier certifies (tracing it adds
+    nothing to any jit cache)."""
 
     def body(seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes):
         S, T = expand_subtree_local(
@@ -156,7 +194,7 @@ def _sharded_eval_full(mesh: Mesh, nu: int, subtree_levels: int, backend: str):
         return _convert_leaves(S, T, fcw_planes, backend)
 
     keyed = P(None, None, KEYS_AXIS)  # plane tensors: lane-word axis last
-    sharded = shard_map_compat(
+    return shard_map_compat(
         body,
         mesh=mesh,
         in_specs=(
@@ -169,7 +207,18 @@ def _sharded_eval_full(mesh: Mesh, nu: int, subtree_levels: int, backend: str):
         ),
         out_specs=P(KEYS_AXIS, LEAF_AXIS, None),
     )
-    return jax.jit(sharded)
+
+
+@cache
+def _sharded_eval_full(mesh: Mesh, nu: int, subtree_levels: int, backend: str):
+    """Compile the sharded evaluator for a (mesh, domain, backend) bucket.
+
+    ``subtree_levels`` = log2(leaf-axis size); each shard replicates that
+    many top levels, then expands only its own subtree.
+    """
+    return SHARDED_JITS.register(
+        jax.jit(_sharded_eval_full_sm(mesh, nu, subtree_levels, backend))
+    )
 
 
 def eval_full_sharded(
@@ -232,8 +281,7 @@ def expand_subtree_local_cc(seeds, ts, scw, tcw, nu: int, subtree_levels: int):
     return S, T
 
 
-@cache
-def _sharded_eval_full_fast(
+def _sharded_eval_full_fast_sm(
     mesh: Mesh, nu: int, subtree_levels: int, entry: int = -1
 ):
     """Sharded fast-profile evaluator for a (mesh, domain) bucket.
@@ -262,7 +310,7 @@ def _sharded_eval_full_fast(
             nu, entry, S, T, *cw_operands(scw, tcw, fcw, entry, nu)
         )
 
-    sharded = shard_map_compat(
+    return shard_map_compat(
         body,
         mesh=mesh,
         in_specs=(
@@ -275,7 +323,15 @@ def _sharded_eval_full_fast(
         out_specs=P(KEYS_AXIS, LEAF_AXIS, None),
         check_vma=False,
     )
-    return jax.jit(sharded)
+
+
+@cache
+def _sharded_eval_full_fast(
+    mesh: Mesh, nu: int, subtree_levels: int, entry: int = -1
+):
+    return SHARDED_JITS.register(
+        jax.jit(_sharded_eval_full_fast_sm(mesh, nu, subtree_levels, entry))
+    )
 
 
 def _sharded_fast_entry_level(
@@ -373,8 +429,7 @@ def _pad_compat_batch(kb: KeyBatch, pad: int) -> KeyBatch:
 # ---------------------------------------------------------------------------
 
 
-@cache
-def _sharded_eval_points(
+def _sharded_eval_points_sm(
     mesh: Mesh, nu: int, log_n: int, qp: int, backend: str,
     use_walk_kernel: bool = False, packed: bool = False,
 ):
@@ -413,16 +468,28 @@ def _sharded_eval_points(
 
     keyed = P(None, KEYS_AXIS)
     hi_spec = P(KEYS_AXIS, None) if log_n > 32 else P(None, None)
-    return jax.jit(
-        shard_map_compat(
-            body,
-            mesh=mesh,
-            in_specs=(
-                keyed, P(KEYS_AXIS), P(None, None, KEYS_AXIS),
-                keyed, keyed, keyed, hi_spec, P(KEYS_AXIS, None),
-            ),
-            out_specs=P(KEYS_AXIS, None),
-            check_vma=False,
+    return shard_map_compat(
+        body,
+        mesh=mesh,
+        in_specs=(
+            keyed, P(KEYS_AXIS), P(None, None, KEYS_AXIS),
+            keyed, keyed, keyed, hi_spec, P(KEYS_AXIS, None),
+        ),
+        out_specs=P(KEYS_AXIS, None),
+        check_vma=False,
+    )
+
+
+@cache
+def _sharded_eval_points(
+    mesh: Mesh, nu: int, log_n: int, qp: int, backend: str,
+    use_walk_kernel: bool = False, packed: bool = False,
+):
+    return SHARDED_JITS.register(
+        jax.jit(
+            _sharded_eval_points_sm(
+                mesh, nu, log_n, qp, backend, use_walk_kernel, packed
+            )
         )
     )
 
@@ -492,8 +559,7 @@ def eval_points_sharded(
     return out[:K, :Q]
 
 
-@cache
-def _sharded_eval_points_fast(
+def _sharded_eval_points_fast_sm(
     mesh: Mesh, nu: int, log_n: int, qt: int = 0, packed: bool = False
 ):
     """Fast-profile pointwise walk sharded over the ``keys`` axis.  State is
@@ -546,18 +612,25 @@ def _sharded_eval_points_fast(
     # Kernel routes shard the hi operand with the keys even when it is the
     # never-read [1, K] dummy (the kernel's block spec is key-minor).
     hi_spec = P(None, None) if (log_n <= 32 and not qt) else P(None, KEYS_AXIS)
-    return jax.jit(
-        shard_map_compat(
-            body,
-            mesh=mesh,
-            in_specs=(
-                P(KEYS_AXIS, None), P(KEYS_AXIS), P(KEYS_AXIS, None, None),
-                P(KEYS_AXIS, None, None), P(KEYS_AXIS, None),
-                hi_spec, P(None, KEYS_AXIS),
-            ),
-            out_specs=P(KEYS_AXIS, None) if packed else P(None, KEYS_AXIS),
-            check_vma=False,
-        )
+    return shard_map_compat(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(KEYS_AXIS, None), P(KEYS_AXIS), P(KEYS_AXIS, None, None),
+            P(KEYS_AXIS, None, None), P(KEYS_AXIS, None),
+            hi_spec, P(None, KEYS_AXIS),
+        ),
+        out_specs=P(KEYS_AXIS, None) if packed else P(None, KEYS_AXIS),
+        check_vma=False,
+    )
+
+
+@cache
+def _sharded_eval_points_fast(
+    mesh: Mesh, nu: int, log_n: int, qt: int = 0, packed: bool = False
+):
+    return SHARDED_JITS.register(
+        jax.jit(_sharded_eval_points_fast_sm(mesh, nu, log_n, qt, packed))
     )
 
 
@@ -603,19 +676,26 @@ def eval_points_sharded_fast(
     return out.T[:K, :Q]
 
 
-@cache
-def _sharded_dcf_points(mesh: Mesh, nu: int, log_n: int, qt: int):
+def _sharded_dcf_points_sm(
+    mesh: Mesh, nu: int, log_n: int, qt: int, packed: bool = False
+):
     """DCF comparison walk sharded over the ``keys`` axis (one key per
     gate, models/dcf.py), via the whole-walk kernel's dcf mode per shard;
-    key-minor operands built in-graph like the DPF route above."""
+    key-minor operands built in-graph like the DPF route above.
+    ``packed`` packs each shard's bits into uint32[K_shard, Q/32] words
+    before the output gather (core/bitpack; caller pads Q to 32), so the
+    output's key axis moves FIRST."""
     from ..core import chacha_np as cc
     from ..models.dpf_chacha import _eval_points_cc_body
 
     def body(seeds, ts, scw, tcw, vcw, fvcw, xs_hi, xs_lo):
         if not qt:
-            return _eval_points_cc_body(
+            bits = _eval_points_cc_body(
                 nu, log_n, seeds, ts, scw, tcw, fvcw, xs_hi, xs_lo, 0, vcw
             )
+            if packed:
+                return bitpack.pack_bits_qmajor_jnp(bits)
+            return bits
         from ..ops import chacha_pallas as cp
 
         k = seeds.shape[0]
@@ -638,28 +718,41 @@ def _sharded_dcf_points(mesh: Mesh, nu: int, log_n: int, qt: int):
             meta, seeds.T, scw_t, tcw_t, fvcw.T, xs_lo, xs_hi,
             log_n, nu, qt, vcw_t=vcw_t, dcf=True,
         )
+        if packed:
+            return bitpack.pack_bits_qmajor_jnp(bits)  # shard-local pack
         return bits.astype(jnp.uint8)
 
     hi_spec = P(None, None) if (log_n <= 32 and not qt) else P(None, KEYS_AXIS)
-    return jax.jit(
-        shard_map_compat(
-            body,
-            mesh=mesh,
-            in_specs=(
-                P(KEYS_AXIS, None), P(KEYS_AXIS), P(KEYS_AXIS, None, None),
-                P(KEYS_AXIS, None, None), P(KEYS_AXIS, None),
-                P(KEYS_AXIS, None), hi_spec, P(None, KEYS_AXIS),
-            ),
-            out_specs=P(None, KEYS_AXIS),
-            check_vma=False,
-        )
+    return shard_map_compat(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(KEYS_AXIS, None), P(KEYS_AXIS), P(KEYS_AXIS, None, None),
+            P(KEYS_AXIS, None, None), P(KEYS_AXIS, None),
+            P(KEYS_AXIS, None), hi_spec, P(None, KEYS_AXIS),
+        ),
+        out_specs=P(KEYS_AXIS, None) if packed else P(None, KEYS_AXIS),
+        check_vma=False,
     )
 
 
-def eval_lt_points_sharded(kb, xs: np.ndarray, mesh: Mesh) -> np.ndarray:
+@cache
+def _sharded_dcf_points(
+    mesh: Mesh, nu: int, log_n: int, qt: int, packed: bool = False
+):
+    return SHARDED_JITS.register(
+        jax.jit(_sharded_dcf_points_sm(mesh, nu, log_n, qt, packed))
+    )
+
+
+def eval_lt_points_sharded(
+    kb, xs: np.ndarray, mesh: Mesh, packed: bool = False
+) -> np.ndarray:
     """Sharded DCF comparison evaluation: xs uint64[K, Q] -> uint8[K, Q]
     shares of ``1{x < alpha}``, one gate per key, key batch sharded over
-    the ``keys`` axis (zero cross-chip communication)."""
+    the ``keys`` axis (zero cross-chip communication).  ``packed``
+    returns uint32[K, ceil(Q/32)] packed words, packed SHARD-LOCALLY
+    before the output gather (core/bitpack contract)."""
     from ..models.dcf import DcfKeyBatch
     from ..models.dpf_chacha import _split_queries
     from ..ops import chacha_pallas as cp
@@ -683,7 +776,7 @@ def eval_lt_points_sharded(kb, xs: np.ndarray, mesh: Mesh) -> np.ndarray:
             padk(kb.tcw), padk(kb.vcw), padk(kb.fvcw),
         )
         xs = np.concatenate([xs, np.zeros((pad, Q), np.uint64)])
-    pad_q = (-Q) % 8 if use_kernel else 0
+    pad_q = (-Q) % 32 if packed else ((-Q) % 8 if use_kernel else 0)
     if pad_q:
         xs = np.concatenate(
             [xs, np.zeros((xs.shape[0], pad_q), np.uint64)], axis=1
@@ -692,7 +785,86 @@ def eval_lt_points_sharded(kb, xs: np.ndarray, mesh: Mesh) -> np.ndarray:
     qt = cp._qtile(xs_lo.shape[0]) if use_kernel else 0
     if use_kernel and kb.log_n <= 32:
         xs_hi = jnp.zeros((1, kb.k), jnp.uint32)  # never read
-    fn = _sharded_dcf_points(mesh, kb.nu, kb.log_n, qt)
+    fn = _sharded_dcf_points(mesh, kb.nu, kb.log_n, qt, packed)
     # host-sync: final reply marshalling (sharded DCF shares)
-    bits = np.asarray(fn(*kb.device_args(), xs_hi, xs_lo))
-    return bits.T[:K, :Q]
+    out = np.asarray(fn(*kb.device_args(), xs_hi, xs_lo))
+    if packed:
+        return bitpack.mask_tail(out[:K], Q)
+    return out.T[:K, :Q]
+
+
+def eval_interval_points_sharded(
+    ik, xs: np.ndarray, mesh: Mesh, packed: bool = False
+) -> np.ndarray:
+    """Sharded DCF interval evaluation: the host-side upper^lower^const
+    combine of ``models/dcf.eval_interval_points`` over the sharded
+    comparison walk — the fused 2K-key batch shards on the ``keys``
+    axis, so both gate sets of every interval still evaluate in ONE
+    device program (now one per shard)."""
+    from ..models import dcf
+
+    return dcf.eval_interval_points(
+        ik, xs, packed=packed,
+        lt_eval=lambda both, qs, packed: eval_lt_points_sharded(
+            both, qs, mesh, packed=packed
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Sharded aggregation fold — shard-local fold, ONE all-reduce per chunk
+# ---------------------------------------------------------------------------
+
+
+def _sharded_agg_fold_sm(mesh: Mesh, op: str):
+    """One streamed secure-aggregation fold chunk across the mesh
+    (apps/aggregation.py semantics): client share rows shard over the
+    ``keys`` axis, each shard folds its rows locally, and the shard
+    partials meet in a single all-reduce — XOR via the all-gather +
+    lane-XOR idiom (:func:`xor_allreduce`), add via ``psum`` — before
+    the replicated carry joins.  Zero rows are the identity of both
+    ops, so pad-to-mesh-multiple never changes the aggregate."""
+
+    def body(carry, rows):
+        if op == "xor":
+            local = jax.lax.reduce(
+                rows, np.uint32(0), jax.lax.bitwise_xor, (0,)
+            )
+            return carry ^ xor_allreduce(local, KEYS_AXIS)
+        local = jnp.sum(rows, axis=0, dtype=jnp.uint32)
+        # uint32 addition wraps: mod 2^32 by construction, and psum of
+        # the shard partials commutes with the wrap.
+        return carry + jax.lax.psum(local, KEYS_AXIS)
+
+    return shard_map_compat(
+        body,
+        mesh=mesh,
+        in_specs=(P(None), P(KEYS_AXIS, None)),
+        out_specs=P(None),
+        check_vma=False,
+    )
+
+
+@cache
+def _sharded_agg_fold(mesh: Mesh, op: str, donate: bool = False):
+    fn = _sharded_agg_fold_sm(mesh, op)
+    # The carry is dead after the fold (the caller rebinds it every
+    # chunk) — donating it lets XLA reuse the replicated buffer in
+    # place across a million-client upload's chunk sequence.
+    jitted = jax.jit(fn, donate_argnums=(0,)) if donate else jax.jit(fn)
+    return SHARDED_JITS.register(jitted)
+
+
+def fold_rows_sharded(
+    op: str, carry: np.ndarray, rows: np.ndarray, mesh: Mesh,
+    donate: bool = False,
+):
+    """Mesh dispatch of one aggregation fold chunk: uint32[R, W] rows +
+    uint32[W] carry -> the folded device vector (caller marshals).  R
+    must be a multiple of the ``keys`` axis (the plan layer's bucket
+    flooring guarantees it)."""
+    R = int(rows.shape[0])
+    n = int(mesh.shape[KEYS_AXIS])
+    if R % n:
+        raise ValueError(f"agg: rows {R} must tile the {n}-shard mesh")
+    return _sharded_agg_fold(mesh, op, donate)(carry, rows)

@@ -120,6 +120,15 @@ endpoints):
        + masked, so the steady state replays pre-traced executables)
     -> per-request slicing from the packed output words.
 
+With DPF_TPU_MESH resolved (parallel/serving_mesh.py) the plan cache
+dispatches land on the shard_map evaluators: one coalesced batch shards
+its key axis across the chip mesh (DESIGN §14), /v1/stats grows a
+``mesh`` block, /v1/metrics a ``dpf_mesh_shards`` gauge and mesh-
+coordinate labels on the per-device memory gauges, and while the
+circuit breaker is not closed every dispatch falls back byte-
+identically to the single-device executables.  The wire contract is
+unchanged in every mode.
+
 Format negotiation: ``format=bits`` (the byte-per-bit default, for
 back-compat) or ``format=packed``; anything else is a 400.  The server-side
 default for requests that omit the param is the ``DPF_TPU_WIRE_FORMAT``
@@ -269,11 +278,24 @@ class _ServingState:
 
     def degraded(self) -> bool:
         """True while the breaker is not closed: the batcher is bypassed
-        (a failing dispatch fans to ONE request, not a coalesced batch)
-        and streamed EvalFull falls back to buffered replies (failures
-        surface as a clean status line, never a truncated body).  Both
-        degraded paths are byte-identical to the fast path."""
+        (a failing dispatch fans to ONE request, not a coalesced batch),
+        streamed EvalFull falls back to buffered replies (failures
+        surface as a clean status line, never a truncated body), and
+        mesh dispatches fall back to single-device (a wedged chip must
+        not be re-probed through an every-chip collective;
+        ``parallel/serving_mesh.suspended``).  All degraded paths are
+        byte-identical to the fast path."""
         return self.breaker.degraded()
+
+    def _mesh_ctx(self):
+        """Single-device override for degraded dispatches: inside this
+        context every plan call ignores the serving mesh.  A no-op
+        nullcontext while the breaker is closed."""
+        if self.degraded():
+            from .parallel import serving_mesh
+
+            return serving_mesh.suspended()
+        return contextlib.nullcontext()
 
     def _note_phase(self, name: str, dt: float, n: int = 1) -> None:
         """One phase observation into BOTH surfaces — the /v1/stats sum
@@ -325,7 +347,7 @@ class _ServingState:
                     "deadline expired before dispatch", where="queue"
                 )
             t0 = time.perf_counter()
-            with obs_trace.traced_dispatch(tr) as dspan:
+            with obs_trace.traced_dispatch(tr) as dspan, self._mesh_ctx():
                 res = guarded([work])[0]
                 if dspan is not None:
                     dspan.set_attrs(coalesced=work.n_keys)
@@ -360,7 +382,7 @@ class _ServingState:
             raise DeadlineError(
                 "deadline expired before dispatch", where="queue"
             )
-        with obs_trace.traced_dispatch(trace):
+        with obs_trace.traced_dispatch(trace), self._mesh_ctx():
             out = self.breaker.call(fn)
         if deadline is not None and time.perf_counter() >= deadline:
             self.batcher.note_expired("flight")
@@ -374,6 +396,8 @@ class _ServingState:
         counters can never be torn against each other mid-update.
         /v1/metrics renders from this same snapshot, so the two surfaces
         cannot drift."""
+        from .parallel import serving_mesh
+
         with self.stats_lock:
             out = {
                 "plans": plans.cache().stats(),
@@ -384,6 +408,7 @@ class _ServingState:
                 "breaker": self.breaker.stats(),
                 "degraded": self.degraded(),
                 "trace": self.tracer.stats(),
+                "mesh": serving_mesh.stats(),
             }
         plan = faults.active()
         if plan is not None:
@@ -722,7 +747,10 @@ class _Handler(BaseHTTPRequestHandler):
                         faults.fire("dispatch.agg")
                         return plans.run_agg_fold(op, c, r)
 
-                    with st.phase("dispatch"):
+                    # _mesh_ctx per chunk: a breaker trip mid-upload
+                    # degrades the REMAINING chunks to single-device
+                    # (the fold carry is placement-agnostic numpy).
+                    with st.phase("dispatch"), st._mesh_ctx():
                         carry = st.breaker.call(fold_chunk)
                     remaining -= take
                 if dspan is not None:
@@ -828,9 +856,13 @@ class _Handler(BaseHTTPRequestHandler):
                 trace.set_attrs(profile=profile, log_n=log_n)
 
             def cached_keys(kind, blob, k, kl, cls=None):
-                """Parse ``k`` concatenated keys through the repack LRU."""
+                """Parse ``k`` concatenated keys through the repack LRU.
+                Parsing runs under the SAME mesh context the dispatch
+                will (``_mesh_ctx``), so the cache's placement-regime
+                token — and the batch's device operand memos — always
+                match the executable the batch is about to feed."""
                 cls = cls or batch_cls
-                with st.phase("pack"):
+                with st.phase("pack"), st._mesh_ctx():
                     return st.keys.get(
                         kind, log_n, blob,
                         lambda: cls.from_bytes(
@@ -989,7 +1021,7 @@ class _Handler(BaseHTTPRequestHandler):
                         ).copy(),
                     )
 
-                with st.phase("pack"):
+                with st.phase("pack"), st._mesh_ctx():
                     triple = st.keys.get(
                         "dcf_interval", log_n, bytes(body[:blob_len]),
                         build_triple,

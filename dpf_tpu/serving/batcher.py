@@ -51,6 +51,14 @@ Merged dispatches run through the plan cache (core/plans.py), always on
 the PACKED route — the packed words are the kernels' native output, XOR
 and slicing commute with the packing, and byte-per-bit responses are a
 thin host-side unpack — so mixed-format requests share one executable.
+
+Mesh-native serving (``DPF_TPU_MESH``): a coalesced lane IS the mesh
+pack.  The plan layer floors its pow2 K-buckets at the shard count, so
+the merged batch pads once to the bucket and divides evenly across the
+chip mesh — ONE sharded dispatch per coalesced batch, never one per
+shard — and ``_slice_rows`` cuts each request's reply out of the packed
+words the shards packed locally.  The batcher's key cap rounds up to a
+shard multiple at init so a full batch never strands a partial shard.
 """
 
 from __future__ import annotations
@@ -312,6 +320,13 @@ class Batcher:
             max_age_ms = knobs.get_float("DPF_TPU_QUEUE_MAX_AGE_MS")
         self.window_s = max(window_us, 0.0) / 1e6
         self.max_keys = max(max_keys, 1)
+        # Mesh-native lanes: round the key cap up to a whole number of
+        # shards (both are powers of two at their defaults, so this is
+        # usually a no-op) — a capped batch then always packs to the
+        # per-shard quantum with zero extra padding.
+        shards = self._mesh_shards()
+        if shards > 1:
+            self.max_keys = -(-self.max_keys // shards) * shards
         self.timeout_s = timeout_s
         self.max_depth = max(int(max_depth), 1)
         self.max_age_s = max(float(max_age_ms), 0.0) / 1e3
@@ -326,16 +341,30 @@ class Batcher:
         self._busy: set = set()
         self.stats = BatcherStats()
 
+    @staticmethod
+    def _mesh_shards() -> int:
+        """Resolved serving-mesh shard count (0 = single-device); best-
+        effort — the batcher must work in processes that never touch a
+        backend (unit tests construct standalone batchers)."""
+        try:
+            from ..parallel import serving_mesh
+
+            return serving_mesh.stats()["shards"]
+        except Exception:  # noqa: BLE001 — stats must not take traffic down
+            return 0
+
     def stats_dict(self) -> dict:
         """Consistent stats snapshot (taken under the batcher lock —
         leaders mutate the counters concurrently).  Includes the live
-        ``queue_depth`` gauge across lanes."""
+        ``queue_depth`` gauge across lanes and the resolved serving-mesh
+        shard count a coalesced dispatch spreads over."""
         with self._lock:
             out = self.stats.as_dict()
             out["queue_depth"] = sum(
                 len(q) for q in self._pending.values()
             )
-            return out
+        out["mesh_shards"] = self._mesh_shards()
+        return out
 
     def _retry_after_locked(self, depth: int) -> float:
         """Retry-After for a shed reply, derived from the observed

@@ -13,6 +13,15 @@ key-material H2D upload are all skipped.
 Capacity is ``DPF_TPU_KEY_CACHE_ENTRIES`` batches (default 32; 0
 disables).  Entries are whole request key-sets, not individual keys —
 the serving hot case is the same batch re-sent verbatim.
+
+Mesh-native serving: the cache key carries the serving-mesh shard count
+in force at lookup time — the sidecar parses keys under the SAME mesh
+context its dispatch will use (server.py ``cached_keys`` wraps the
+lookup in ``_mesh_ctx``), so batches parsed under the mesh keep device
+operand memos placed for the SHARDED dispatch (per-shard padding
+quanta) while the degraded single-device fallback keeps its own
+entries — a breaker trip never churns operands between placement
+regimes, and recovery finds both sets still warm.
 """
 
 from __future__ import annotations
@@ -37,13 +46,28 @@ class KeyCache:
         self.hits = 0
         self.misses = 0
 
+    @staticmethod
+    def _mesh_token() -> int:
+        """Serving-mesh shard count for THIS lookup (0 single-device,
+        honoring the degraded-mode suspension) — part of the cache key
+        so each placement regime keeps its own device operand memos."""
+        try:
+            from ..parallel import serving_mesh
+
+            return serving_mesh.shards()
+        except Exception:  # noqa: BLE001 — cache must not take traffic down
+            return 0
+
     def get(self, kind: str, log_n: int, blob: bytes, build):
         """Return the parsed batch for ``blob`` (the request's raw key
         bytes), building it via ``build()`` on a miss.  Parse failures
         propagate and are never cached."""
         if not self.entries:
             return build()
-        key = (kind, int(log_n), hashlib.sha256(blob).digest())
+        key = (
+            kind, int(log_n), self._mesh_token(),
+            hashlib.sha256(blob).digest(),
+        )
         with self._lock:
             hit = self._lru.get(key)
             if hit is not None:

@@ -40,8 +40,20 @@
 #       so it lives ONLY here and in the full tier-1 suite — CI runs
 #       this lane as its own job so a loaded fast-lane runner cannot
 #       flake it and the fast job stays fast.
+#   ./runtests.sh --mesh [pytest args]   mesh-native serving lane: the
+#       sharded serving fast path on the 8-virtual-device CPU mesh
+#       (tests/test_serving_mesh.py — byte identity of every sharded
+#       route vs its single-device twin incl. the packed wire format,
+#       one sharded dispatch per coalesced batch, zero retraces after
+#       warmup, breaker-open fallback to single-device, the mesh
+#       stats/metrics surfaces) plus the sharded-evaluator
+#       differentials (tests/test_sharding.py).
 if [ "${1:-}" = "--lint" ]; then
   exec "$(dirname "$0")/scripts/lint_all.sh"
+elif [ "${1:-}" = "--mesh" ]; then
+  shift
+  set -- tests/test_serving_mesh.py tests/test_sharding.py \
+      -q -m 'not slow' "$@"
 elif [ "${1:-}" = "--faults" ]; then
   shift
   set -- tests/test_load_survival.py tests/test_serving_stress.py \

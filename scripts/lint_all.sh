@@ -17,9 +17,16 @@
 set -e
 cd "$(dirname "$0")/.."
 
+# The 8-virtual-device CPU mesh mirrors runtests.sh / tests/conftest.py:
+# the oblivious-trace pass certifies the mesh-native serving routes
+# against a REAL 8-shard shard_map, and the certificate hashes depend on
+# the shard count — every sanctioned lint entry point must see the same
+# topology.
 run_py() {
   env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE \
-      -u PALLAS_AXON_TPU_GEN JAX_PLATFORMS=cpu python "$@"
+      -u PALLAS_AXON_TPU_GEN JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
+      python "$@"
 }
 
 status=0

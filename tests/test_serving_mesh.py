@@ -1,0 +1,465 @@
+"""Mesh-native serving fast path (``DPF_TPU_MESH``) on the
+8-virtual-device CPU mesh.
+
+The contract (DESIGN §14): every sharded serving route is byte-identical
+to its single-device twin, a coalesced batch is ONE sharded dispatch
+(never one per shard), the hit path performs zero retraces after warmup
+(``plans.trace_count`` now counts the sharded executables too, via
+``parallel.sharding.SHARDED_JITS``), the degraded (breaker-not-closed)
+path falls back to the single-device executables byte-identically, and
+the packed wire format through the sidecar is unchanged in every mode.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from dpf_tpu.core import bitpack, plans
+from dpf_tpu.parallel import serving_mesh
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+# Every compat-profile test in this file shares ONE jit shape family —
+# log_n=9, K/Q bucket 32, the same buckets tests/test_apps.py uses — so
+# under tier-1 the file adds only the MESH executables' compiles (the
+# single-device twins are the executables other suites already build).
+_LOG_N = 9
+
+
+def _post(url, body=b""):
+    req = urllib.request.Request(url, data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.read()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=60) as r:
+        return r.read()
+
+
+@pytest.fixture()
+def mesh_on(monkeypatch):
+    """The serving mesh over all 8 virtual devices, dropped afterwards
+    so the rest of the suite keeps its single-device plan behavior."""
+    monkeypatch.setenv("DPF_TPU_MESH", "on")
+    monkeypatch.setenv("DPF_TPU_MESH_DEVICES", "0")
+    serving_mesh.reset()
+    yield
+    serving_mesh.reset()
+
+
+@pytest.fixture()
+def mesh_srv(mesh_on, monkeypatch):
+    """A sidecar serving on the mesh, with a visible batching window."""
+    monkeypatch.setenv("DPF_TPU_BATCH_WINDOW_US", "20000")
+    from dpf_tpu import server as srv_mod
+
+    srv_mod.reset_serving_state()
+    s = srv_mod.serve(port=0)
+    yield f"http://127.0.0.1:{s.server_address[1]}"
+    s.shutdown()
+    srv_mod.reset_serving_state()
+
+
+def _fast_batch(k, rng):
+    from dpf_tpu.models.keys_chacha import gen_batch
+
+    alphas = rng.integers(0, 1 << _LOG_N, size=k, dtype=np.uint64)
+    return gen_batch(alphas, _LOG_N, rng=rng)[0]
+
+
+def _compat_batch(k, rng):
+    from dpf_tpu.core.keys import gen_batch
+
+    alphas = rng.integers(0, 1 << _LOG_N, size=k, dtype=np.uint64)
+    return gen_batch(alphas, _LOG_N, rng=rng)[0]
+
+
+# ---------------------------------------------------------------------------
+# Mesh resolution
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_resolution(monkeypatch):
+    monkeypatch.setenv("DPF_TPU_MESH", "on")
+    monkeypatch.setenv("DPF_TPU_MESH_DEVICES", "0")
+    serving_mesh.reset()
+    try:
+        assert serving_mesh.shards() == 8
+        with serving_mesh.suspended():  # the degraded-mode override
+            assert serving_mesh.shards() == 0
+        assert serving_mesh.shards() == 8
+        # Non-pow2 budgets floor to a power of two (pow2 K-buckets must
+        # divide evenly across shards).
+        monkeypatch.setenv("DPF_TPU_MESH_DEVICES", "3")
+        serving_mesh.reset()
+        assert serving_mesh.shards() == 2
+        monkeypatch.setenv("DPF_TPU_MESH", "off")
+        serving_mesh.reset()
+        assert serving_mesh.shards() == 0
+        # auto never shards a CPU backend (the virtual mesh is a test
+        # topology; deployments opt in with on).
+        monkeypatch.setenv("DPF_TPU_MESH", "auto")
+        serving_mesh.reset()
+        assert serving_mesh.shards() == 0
+    finally:
+        serving_mesh.reset()
+
+
+# ---------------------------------------------------------------------------
+# Byte identity: every sharded route vs its single-device twin
+# ---------------------------------------------------------------------------
+
+
+def test_points_routes_byte_identical(mesh_on):
+    rng = np.random.default_rng(2026)
+    xs = rng.integers(0, 1 << _LOG_N, size=(20, 20), dtype=np.uint64)
+
+    ka = _fast_batch(20, rng)
+    ca = _compat_batch(20, rng)
+    from dpf_tpu.models import dcf
+
+    da, _ = dcf.gen_lt_batch(
+        rng.integers(0, 1 << _LOG_N, size=20, dtype=np.uint64),
+        _LOG_N, rng=rng,
+    )
+    for route, profile, kb in (
+        ("points", "fast", ka),
+        ("points", "compat", ca),
+        ("dcf_points", "fast", da),
+    ):
+        got = plans.run_points(route, profile, kb, xs)
+        with serving_mesh.suspended():
+            want = plans.run_points(route, profile, kb, xs)
+        np.testing.assert_array_equal(got, want, err_msg=f"{route}/{profile}")
+
+
+def test_interval_route_byte_identical(mesh_on):
+    from dpf_tpu.models import dcf
+
+    rng = np.random.default_rng(7)
+    lo = rng.integers(0, 1 << (_LOG_N - 1), size=20, dtype=np.uint64)
+    hi = lo + rng.integers(0, 1 << (_LOG_N - 1), size=20, dtype=np.uint64)
+    ia, ib = dcf.gen_interval_batch(lo, hi, _LOG_N, rng=rng)
+    xs = rng.integers(0, 1 << _LOG_N, size=(20, 20), dtype=np.uint64)
+    for ik in (ia, ib):
+        got = plans.run_interval(ik, xs)
+        with serving_mesh.suspended():
+            want = plans.run_interval(ik, xs)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_hh_level_route_byte_identical(mesh_on):
+    rng = np.random.default_rng(11)
+    for profile, kb in (
+        ("fast", _fast_batch(20, rng)),
+        ("compat", _compat_batch(20, rng)),
+    ):
+        cands = rng.integers(0, 1 << _LOG_N, size=20, dtype=np.uint64)
+        xs = np.broadcast_to(cands[None, :], (20, 20))
+        for level in (0, 3, _LOG_N - 1):
+            got = plans.run_hh_level(profile, kb, xs, level)
+            with serving_mesh.suspended():
+                want = plans.run_hh_level(profile, kb, xs, level)
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"{profile} level {level}"
+            )
+
+
+def test_evalfull_routes_byte_identical(mesh_on):
+    rng = np.random.default_rng(13)
+    for profile, kb in (
+        ("fast", _fast_batch(20, rng)),
+        ("compat", _compat_batch(20, rng)),
+    ):
+        got = plans.run_evalfull(profile, kb)
+        with serving_mesh.suspended():
+            want = plans.run_evalfull(profile, kb)
+        np.testing.assert_array_equal(got, want, err_msg=profile)
+
+
+def test_agg_folds_byte_identical_and_one_allreduce(mesh_on):
+    rng = np.random.default_rng(17)
+    rows = rng.integers(
+        0, 1 << 32, size=(100, 17), dtype=np.uint64
+    ).astype(np.uint32)
+    carry = rng.integers(0, 1 << 32, size=17, dtype=np.uint64).astype(
+        np.uint32
+    )
+    for op in ("xor", "add"):
+        got = plans.run_agg_fold(op, carry, rows)
+        with serving_mesh.suspended():
+            want = plans.run_agg_fold(op, carry, rows)
+        np.testing.assert_array_equal(got, want, err_msg=op)
+    # The numpy ground truth, to first principles:
+    np.testing.assert_array_equal(
+        plans.run_agg_fold("xor", carry, rows),
+        np.bitwise_xor.reduce(rows, axis=0) ^ carry,
+    )
+    np.testing.assert_array_equal(
+        plans.run_agg_fold("add", carry, rows),
+        rows.sum(axis=0, dtype=np.uint32) + carry,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Plan discipline: mesh plan keys, zero retrace, one dispatch per batch
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_plan_keys_and_zero_retrace_after_warmup(mesh_on):
+    rng = np.random.default_rng(23)
+    plans.warmup(
+        [
+            {"route": "points", "profile": "fast", "log_n": _LOG_N,
+             "k": 8, "q": 32},
+            {"route": "agg_xor", "k": 64, "q": 512},
+        ]
+    )
+    # Warmup under the mesh compiled MESH plans (shard count in the key).
+    with plans.cache()._lock:
+        keys = list(plans.cache()._plans)
+    assert any(k.route == "points" and k.mesh == 8 for k in keys)
+    assert any(k.route == "agg_xor" and k.mesh == 8 for k in keys)
+
+    tc0 = plans.trace_count()
+    kb = _fast_batch(5, rng)
+    xs = rng.integers(0, 1 << _LOG_N, size=(5, 20), dtype=np.uint64)
+    plans.run_points("points", "fast", kb, xs)
+    plans.run_agg_fold(
+        "xor", None,
+        rng.integers(0, 1 << 32, size=(40, 16), dtype=np.uint64).astype(
+            np.uint32
+        ),
+    )
+    assert plans.trace_count() == tc0, "mesh hit path retraced"
+
+
+def test_batcher_coalesces_to_one_sharded_dispatch(mesh_on):
+    """Concurrent requests on one lane -> ONE sharded device dispatch
+    (not one per request, and not one per shard), with per-request rows
+    byte-identical to solo dispatches."""
+    from dpf_tpu.serving.batcher import Batcher, PointsWork, dispatch_points
+
+    rng = np.random.default_rng(31)
+    n_req = 4
+    works = []
+    for _ in range(n_req):
+        kb = _fast_batch(1, rng)
+        xs = rng.integers(0, 1 << _LOG_N, size=(1, 16), dtype=np.uint64)
+        works.append((kb, xs))
+    want = [
+        plans.run_points("points", "fast", kb, xs) for kb, xs in works
+    ]
+
+    b = Batcher(window_us=50_000, max_keys=1024)
+    assert b.stats_dict()["mesh_shards"] == 8
+    d0 = plans.cache().stats()
+    results = [None] * n_req
+    errs = []
+    gate = threading.Barrier(n_req)
+
+    def client(i):
+        try:
+            gate.wait(30)
+            kb, xs = works[i]
+            results[i] = b.submit(
+                PointsWork("points", "fast", kb, xs), dispatch_points
+            )
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(n_req)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errs, errs
+    for got, w in zip(results, want):
+        np.testing.assert_array_equal(got, w)
+    with b._lock:
+        dispatches = b.stats.dispatches
+        requests = b.stats.requests
+    assert requests == n_req
+    # Coalescing-by-backpressure: strictly fewer dispatches than
+    # requests, and each dispatch was exactly ONE plan-cache visit —
+    # the sharded dispatch is one program across all 8 chips.
+    d1 = plans.cache().stats()
+    plan_visits = (d1["hits"] + d1["misses"]) - (d0["hits"] + d0["misses"])
+    assert dispatches < requests
+    assert plan_visits == dispatches
+
+
+# ---------------------------------------------------------------------------
+# Degraded mode: breaker-not-closed falls back to single-device
+# ---------------------------------------------------------------------------
+
+
+def test_degraded_breaker_falls_back_to_single_device(mesh_on, monkeypatch):
+    monkeypatch.setenv("DPF_TPU_BREAKER_COOLDOWN_MS", "60000")
+    monkeypatch.setenv("DPF_TPU_BREAKER_PROBE", "off")
+    from dpf_tpu import server as srv_mod
+    from dpf_tpu.serving.batcher import PointsWork, dispatch_points
+
+    srv_mod.reset_serving_state()
+    st = srv_mod._serving_state()
+    rng = np.random.default_rng(37)
+    kb = _fast_batch(3, rng)
+    xs = rng.integers(0, 1 << _LOG_N, size=(3, 24), dtype=np.uint64)
+    healthy = st.run(
+        PointsWork("points", "fast", kb, xs), dispatch_points
+    )
+    mesh_keys = {
+        k.mesh for k in plans.cache()._plans if k.route == "points"
+    }
+    assert 8 in mesh_keys
+
+    # Force the half-open state (the e2e trip path is pinned by
+    # tests/test_load_survival; here only the state matters): dispatches
+    # must bypass the batcher AND the mesh.
+    with st.stats_lock:
+        st.breaker._state = "half_open"
+    assert st.degraded()
+    degraded = st.run(
+        PointsWork("points", "fast", kb, xs), dispatch_points
+    )
+    np.testing.assert_array_equal(degraded, healthy)
+    single_keys = {
+        k.mesh for k in plans.cache()._plans if k.route == "points"
+    }
+    assert 0 in single_keys, "degraded dispatch did not fall back"
+    # The successful trial closed the breaker; the next dispatch is
+    # mesh-native again.
+    assert not st.degraded()
+    srv_mod.reset_serving_state()
+
+
+def test_keycache_keeps_per_regime_entries(mesh_on):
+    from dpf_tpu.serving.keycache import KeyCache
+
+    kc = KeyCache(entries=8)
+    built = []
+
+    def build():
+        built.append(1)
+        return object()
+
+    a = kc.get("points", _LOG_N, b"same-bytes", build)
+    with serving_mesh.suspended():
+        b = kc.get("points", _LOG_N, b"same-bytes", build)
+    assert len(built) == 2 and a is not b  # one entry per placement regime
+    assert kc.get("points", _LOG_N, b"same-bytes", build) is a  # hit
+    assert len(built) == 2 and kc.hits == 1
+
+
+# ---------------------------------------------------------------------------
+# The sidecar: wire identity, stats/metrics surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_http_wire_identity_and_mesh_surfaces(mesh_srv):
+    from dpf_tpu.core import chacha_np as cc
+    from dpf_tpu.models.keys_chacha import KeyBatchFast
+    from dpf_tpu.obs import promtext
+
+    rng = np.random.default_rng(41)
+    q = 40
+    k = 3
+    _post(
+        f"{mesh_srv}/v1/warmup",
+        json.dumps(
+            {"shapes": [{"route": "points", "profile": "fast",
+                         "log_n": _LOG_N, "k": k, "q": q}]}
+        ).encode(),
+    )
+    kl = cc.key_len(_LOG_N)
+    keys = b""
+    for _ in range(k):
+        alpha = int(rng.integers(0, 1 << _LOG_N))
+        keys += _post(
+            f"{mesh_srv}/v1/gen?log_n={_LOG_N}&alpha={alpha}&profile=fast"
+        )[:kl]
+    xs = rng.integers(0, 1 << _LOG_N, size=(k, q), dtype=np.uint64)
+
+    # Ground truth: the SAME key bytes through the single-device plans.
+    kb = KeyBatchFast.from_bytes(
+        [keys[i * kl: (i + 1) * kl] for i in range(k)], _LOG_N
+    )
+    with serving_mesh.suspended():
+        want_words = plans.run_points("points", "fast", kb, xs)
+
+    body = keys + xs.tobytes()
+    packed = _post(
+        f"{mesh_srv}/v1/eval_points_batch?log_n={_LOG_N}&k={k}&q={q}"
+        "&profile=fast&format=packed",
+        body,
+    )
+    assert packed == bitpack.words_to_wire(want_words, q)
+    bits = _post(
+        f"{mesh_srv}/v1/eval_points_batch?log_n={_LOG_N}&k={k}&q={q}"
+        "&profile=fast&format=bits",
+        body,
+    )
+    assert bits == np.ascontiguousarray(
+        bitpack.unpack_bits(want_words, q)
+    ).tobytes()
+
+    # /v1/agg/submit: shard-local folds + one all-reduce per chunk,
+    # exact against numpy.
+    rows = rng.integers(0, 1 << 32, size=(24, 6), dtype=np.uint64).astype(
+        np.uint32
+    )
+    reply = _post(
+        f"{mesh_srv}/v1/agg/submit?op=add&k=24&words=6",
+        rows.astype("<u4").tobytes(),
+    )
+    np.testing.assert_array_equal(
+        np.frombuffer(reply, dtype="<u4"),
+        rows.sum(axis=0, dtype=np.uint32),
+    )
+
+    stats = json.loads(_get(f"{mesh_srv}/v1/stats"))
+    assert stats["mesh"]["shards"] == 8
+    assert stats["batcher"]["mesh_shards"] == 8
+    scrape = promtext.parse(_get(f"{mesh_srv}/v1/metrics").decode())
+    assert scrape.value("dpf_mesh_shards") == 8.0
+
+
+def test_hh_eval_through_sidecar_matches_single_device(mesh_srv):
+    from dpf_tpu.core import chacha_np as cc
+    from dpf_tpu.models.keys_chacha import KeyBatchFast
+
+    rng = np.random.default_rng(43)
+    k, q, level = 5, 12, 4
+    kl = cc.key_len(_LOG_N)
+    keys = b""
+    for _ in range(k):
+        alpha = int(rng.integers(0, 1 << _LOG_N))
+        keys += _post(
+            f"{mesh_srv}/v1/gen?log_n={_LOG_N}&alpha={alpha}&profile=fast"
+        )[:kl]
+    cands = rng.integers(0, 1 << _LOG_N, size=q, dtype=np.uint64)
+    got = _post(
+        f"{mesh_srv}/v1/hh/eval?log_n={_LOG_N}&k={k}&q={q}"
+        f"&level={level}&profile=fast&format=packed",
+        keys + cands.tobytes(),
+    )
+    kb = KeyBatchFast.from_bytes(
+        [keys[i * kl: (i + 1) * kl] for i in range(k)], _LOG_N
+    )
+    with serving_mesh.suspended():
+        want = plans.run_hh_level(
+            "fast", kb, np.broadcast_to(cands[None, :], (k, q)), level
+        )
+    assert got == bitpack.words_to_wire(want, q)
