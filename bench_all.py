@@ -115,6 +115,9 @@ _ROUTE_KNOBS = (
     # single-device row on a ledger resume (cfg-serving-mesh sets these
     # per-row, so they are also stamped into each row's route label).
     "DPF_TPU_MESH", "DPF_TPU_MESH_DEVICES",
+    # Served-PIR knobs (cfg-pir): the matmul chunk granularity and the
+    # streamed-scan threshold select distinct executables and schedules.
+    "DPF_TPU_PIR_CHUNK_ROWS", "DPF_TPU_PIR_DB_CHUNK_BYTES",
 )
 # DPF_TPU_BENCH_LEDGER_RETRY_ERRORS=1: sections whose recorded rows
 # contain an error row are NOT replayed (and not re-recorded) — the
@@ -1524,30 +1527,119 @@ def main():
 
     _section("cfg-apps", cfg_apps)
 
-    # ---- config 4: 2-server PIR, 2^24 x 32 B, 1k queries --------------------
-    def cfg4():
+    # ---- config 4 rework: served-scale 2-server PIR (ROADMAP 3) ------------
+    # DB-GB/s scanned and queries/s against the single-core native
+    # baseline, swept over 1/2/4/8 row shards (rows resident in mesh
+    # HBM, one parity all-reduce per query batch), plus a streamed-scan
+    # row over a DB strictly larger than DPF_TPU_PIR_DB_CHUNK_BYTES and
+    # a served row through plans.run_pir (the exact dispatch every
+    # /v1/pir/query batch lands on).  Every row is gated on byte
+    # identity: reconstruct == db[idx] AND sharded/streamed answers ==
+    # the 1-shard one-shot answer.
+    def cfg_pir():
+        from dpf_tpu.apps import pir_store
+        from dpf_tpu.core import plans as plans_mod
+        from dpf_tpu.models import pir as pir_mod
+        from dpf_tpu.parallel import make_mesh
+
         nrows, rb, nq = (1 << 24, 32, 1024) if not small else (1 << 12, 32, 16)
         db = rng.integers(0, 256, size=(nrows, rb), dtype=np.uint8)
         idx = rng.integers(0, nrows, size=nq, dtype=np.uint64)
         qa, qb = pir_query(idx, nrows, rng=rng, profile="fast")
-        srv = PirServer(db, profile="fast")
-        base4 = _native_pir_rate(db, srv.log_n)
-        ans_a = []  # capture the last timed answer — a full 512 MB-DB pass
-        dt = _timed_host_call(lambda: ans_a.append(srv.answer(qa)))
-        rows = pir_reconstruct(ans_a[-1], srv.answer(qb))
-        np.testing.assert_array_equal(rows, db[idx.astype(np.int64)])
+        log_n, dom = pir_mod.row_domain(nrows, "fast")
+        base4 = _native_pir_rate(db, log_n)
+        db_gb = nrows * rb / 2**30
+        n_dev = len(jax.devices())
+        max_shards = 1 << (min(n_dev, 8).bit_length() - 1)
+        reps = 3 if not small else 2
+        want = None  # the 1-shard answer — every later row must match it
+
+        def gated_rows(srv, label, extra):
+            nonlocal want
+            ans_a = srv.answer(qa)  # warm + the identity evidence
+            if want is None:
+                want = ans_a
+            elif not np.array_equal(ans_a, want):
+                raise RuntimeError(
+                    f"cfg-pir: {label} answer drifted from the 1-shard "
+                    "one-shot answer — refusing to commit a wrong-answer "
+                    "row"
+                )
+            rows = pir_reconstruct(ans_a, srv.answer(qb))
+            np.testing.assert_array_equal(rows, db[idx.astype(np.int64)])
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                srv.answer(qa)
+            dt = (time.perf_counter() - t0) / reps
+            extra = dict(extra, identical_to_single_shard=True,
+                         stream_chunks=srv.stream_chunks)
+            _emit(
+                f"2-server PIR {nrows}x{rb}B, {nq} queries ({label})",
+                nq / dt, "queries/sec",
+                baseline=base4, scale=1, bytes_out=nq * rb, extra=extra,
+                route=_route("expand+parity-matmul"),
+            )
+            _emit(
+                f"2-server PIR scan {nrows}x{rb}B, {nq} queries ({label})",
+                db_gb / dt, "DB-GB/sec", scale=1,
+                extra=extra, route=_route("expand+parity-matmul"),
+            )
+
+        nu = max(log_n - 9, 0)
+        for shards in (1, 2, 4, 8):
+            if shards > max_shards or (1 << nu) < shards:
+                continue
+            mesh = (
+                None if shards == 1
+                else make_mesh(1, shards, devices=jax.devices()[:shards])
+            )
+            srv = PirServer(db, mesh=mesh, profile="fast")
+            gated_rows(
+                srv, f"fast, {shards} shard{'s' if shards > 1 else ''}",
+                {"shards": shards},
+            )
+
+        # Streamed chunk scan: force a DB strictly larger than the chunk
+        # threshold (quartered resident bytes) and prove the multi-
+        # dispatch pipeline answers byte-identically.
+        srv_s = PirServer(
+            db, profile="fast", db_chunk_bytes=dom * rb // 4
+        )
+        if srv_s.stream_chunks < 2:
+            raise RuntimeError("cfg-pir: streamed row did not stream")
+        gated_rows(srv_s, "fast, 1 shard, streamed",
+                   {"shards": 1, "db_chunk_bytes": dom * rb // 4})
+
+        # Served row: the registry + plan-cache dispatch every
+        # /v1/pir/query batch rides (zero-retrace steady state after the
+        # first call), gated on identity with the library answer.
+        entry = pir_store.PirDB("bench", db, profile="fast")
+        served = plans_mod.run_pir(entry, qa)
+        if not np.array_equal(served, want):
+            raise RuntimeError(
+                "cfg-pir: served answer drifted from the library path"
+            )
+        tc0 = plans_mod.trace_count()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            plans_mod.run_pir(entry, qa)
+        dt = (time.perf_counter() - t0) / reps
+        if plans_mod.trace_count() != tc0:
+            raise RuntimeError("cfg-pir: served steady state retraced")
         _emit(
-            f"2-server PIR {nrows}x{rb}B, {nq} queries (fast, incl. dispatch)",
+            f"2-server PIR {nrows}x{rb}B, {nq} queries "
+            "(fast, served, plan-cached)",
             nq / dt, "queries/sec",
             baseline=base4, scale=1, bytes_out=nq * rb,
-            route=_route("expand+parity-matmul"),
+            route=_route("run_pir,plan-cache"),
+            extra={"db_gb_per_s": round(db_gb / dt, 3),
+                   "zero_retrace": True},
         )
 
         # Device row: chain R expand->parity-matmul pipelines, the answer
         # words feeding the next round's seeds — exactly the computation
         # inside PirServer.answer, transfers and dispatch cancelled.
-        from dpf_tpu.models import pir as pir_mod
-
+        srv = PirServer(db, profile="fast")
         entry4 = pir_mod._pir_fast_entry_level(srv.nu, qa.k)
         n_chunks4 = srv.dom // (srv.n_leaf * srv.chunk_rows)
 
@@ -1572,7 +1664,7 @@ def main():
               baseline=base4, scale=1, bytes_out=nq * rb,
               route=_route("expand+parity-matmul"))
 
-    _section("cfg4-pir", cfg4)
+    _section("cfg-pir", cfg_pir)
 
     # ---- config 5: FSS comparison gates, n=32, 4096 gates -------------------
     def cfg5_fast():

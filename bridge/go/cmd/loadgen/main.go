@@ -21,6 +21,9 @@
 //	loadgen -url http://127.0.0.1:8990 -rps 200 -duration 10s \
 //	        -logn 10 -q 64 -profile fast -deadline-ms 500
 //
+//	loadgen -mode pir -pir-rows 65536 -pir-row-bytes 32 -rps 100 \
+//	        -duration 10s      # register a DB once, drive /v1/pir/query
+//
 // Output: one JSON object on stdout (bench-ledger-shaped).
 package main
 
@@ -106,6 +109,11 @@ func main() {
 	logN := flag.Uint("logn", 10, "domain log2 size")
 	q := flag.Int("q", 64, "queries per request")
 	profile := flag.String("profile", "fast", "evaluation profile")
+	mode := flag.String("mode", "points",
+		"load shape: points (pointwise eval) or pir (register a database "+
+			"once, then drive /v1/pir/query; -pir-rows/-pir-row-bytes size it)")
+	pirRows := flag.Int("pir-rows", 4096, "pir mode: database rows")
+	pirRowBytes := flag.Int("pir-row-bytes", 32, "pir mode: bytes per row")
 	deadlineMs := flag.Int("deadline-ms", 0, "per-request deadline header (0 = none)")
 	maxInflight := flag.Int("max-inflight", 512, "in-flight cap; arrivals past it count as client_dropped")
 	seed := flag.Int64("seed", 2026, "query RNG seed")
@@ -124,19 +132,56 @@ func main() {
 	c.Profile = *profile
 	c.DeadlineMs = *deadlineMs
 
-	// One key pair + a fixed query row: the load is the serving stack's
-	// dispatch path, not Gen.
-	ka, _, err := c.Gen(uint64(rand.New(rand.NewSource(*seed)).Int63n(int64(1)<<*logN)), *logN)
-	if err != nil {
-		fmt.Fprintf(os.Stderr, "loadgen: gen: %v\n", err)
+	// One request payload prepared up front: the load is the serving
+	// stack's dispatch path, not Gen (or the one-time DB upload).
+	var fire func() error
+	rng := rand.New(rand.NewSource(*seed + 1))
+	switch *mode {
+	case "points":
+		ka, _, err := c.Gen(uint64(rand.New(rand.NewSource(*seed)).Int63n(int64(1)<<*logN)), *logN)
+		if err != nil {
+			fmt.Fprintf(os.Stderr, "loadgen: gen: %v\n", err)
+			os.Exit(1)
+		}
+		xs := [][]uint64{make([]uint64, *q)}
+		for j := range xs[0] {
+			xs[0][j] = uint64(rng.Int63n(int64(1) << *logN))
+		}
+		keys := []dpftpu.DPFkey{ka}
+		fire = func() error {
+			_, err := c.EvalPointsBatchPacked(keys, xs, *logN)
+			return err
+		}
+	case "pir":
+		// Register the database once (seeded rows), then every arrival
+		// is one /v1/pir/query against the resident rows — the scan is
+		// the dispatch cost, so this measures coalescing across the
+		// whole-database MXU pass.
+		rows := make([][]byte, *pirRows)
+		for i := range rows {
+			rows[i] = make([]byte, *pirRowBytes)
+			rng.Read(rows[i])
+		}
+		info, err := c.PirRegisterDB("loadgen", rows)
+		if err != nil {
+			fmt.Fprintf(os.Stderr, "loadgen: pir db: %v\n", err)
+			os.Exit(1)
+		}
+		ka, _, err := c.Gen(uint64(rng.Int63n(int64(*pirRows))), info.LogN)
+		if err != nil {
+			fmt.Fprintf(os.Stderr, "loadgen: gen: %v\n", err)
+			os.Exit(1)
+		}
+		keys := []dpftpu.DPFkey{ka}
+		rb := info.RowBytes
+		fire = func() error {
+			_, err := c.PirQuery("loadgen", keys, rb)
+			return err
+		}
+	default:
+		fmt.Fprintf(os.Stderr, "loadgen: unknown -mode %q (points|pir)\n", *mode)
 		os.Exit(1)
 	}
-	rng := rand.New(rand.NewSource(*seed + 1))
-	xs := [][]uint64{make([]uint64, *q)}
-	for j := range xs[0] {
-		xs[0][j] = uint64(rng.Int63n(int64(1) << *logN))
-	}
-	keys := []dpftpu.DPFkey{ka}
 
 	var sent, ok, shed, deadline, errCount, dropped, inflight int64
 	var mu sync.Mutex
@@ -167,7 +212,7 @@ loop:
 				defer wg.Done()
 				defer atomic.AddInt64(&inflight, -1)
 				t0 := time.Now()
-				_, err := c.EvalPointsBatchPacked(keys, xs, *logN)
+				err := fire()
 				dt := time.Since(t0).Seconds()
 				if err == nil {
 					atomic.AddInt64(&ok, 1)

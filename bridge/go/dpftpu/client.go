@@ -646,6 +646,87 @@ func (c *Client) AggregateSubmit(op string, rows [][]uint32) ([]uint32, error) {
 	return res, nil
 }
 
+// PirDBInfo is the sidecar's reply to a database registration: the
+// registered shape plus how the rows were placed (shards > 0 means the
+// rows live sharded over the chip mesh's HBM; stream_chunks > 1 means
+// queries answer through the streamed chunk scan).
+type PirDBInfo struct {
+	Name         string `json:"name"`
+	Rows         int    `json:"rows"`
+	RowBytes     int    `json:"row_bytes"`
+	LogN         uint   `json:"log_n"`
+	Profile      string `json:"profile"`
+	DBBytes      int64  `json:"db_bytes"`
+	Shards       int    `json:"shards"`
+	StreamChunks int    `json:"stream_chunks"`
+}
+
+// PirRegisterDB uploads a named 2-server PIR database to the sidecar
+// (POST /v1/pir/db): rows[i] is row i's bytes, all rows the same length
+// (a multiple of 4).  The sidecar reads the body in
+// DPF_TPU_PIR_DB_CHUNK_BYTES chunks and keeps the packed rows resident
+// in device HBM — sharded over the chip mesh when one is resolved —
+// until replaced.  The database is PUBLIC protocol data (both PIR
+// servers hold identical copies); the query key is the secret.
+func (c *Client) PirRegisterDB(name string, rows [][]byte) (*PirDBInfo, error) {
+	if len(rows) == 0 {
+		return nil, fmt.Errorf("dpftpu: pir db needs >= 1 row")
+	}
+	rb := len(rows[0])
+	body := make([]byte, 0, rb*len(rows))
+	for _, r := range rows {
+		if len(r) != rb {
+			return nil, fmt.Errorf("dpftpu: inconsistent pir row lengths")
+		}
+		body = append(body, r...)
+	}
+	out, err := c.post(fmt.Sprintf(
+		"/v1/pir/db?name=%s&rows=%d&row_bytes=%d", name, len(rows), rb), body)
+	if err != nil {
+		return nil, err
+	}
+	info := &PirDBInfo{}
+	if err := json.Unmarshal(out, info); err != nil {
+		return nil, fmt.Errorf("dpftpu: bad pir db reply: %w", err)
+	}
+	return info, nil
+}
+
+// PirQuery answers K PIR queries against a registered database
+// (POST /v1/pir/query): each key is one query's DPF share (generated at
+// the database's profile and log_n — see PirDBInfo.LogN from
+// PirRegisterDB).  The reply is one rowBytes-byte row per key: that
+// server's XOR of the selected database rows.  XOR the two servers'
+// replies to reconstruct the queried rows.
+func (c *Client) PirQuery(dbName string, keys []DPFkey, rowBytes int) ([][]byte, error) {
+	if len(keys) == 0 {
+		return nil, nil
+	}
+	kl := len(keys[0])
+	body := make([]byte, 0, kl*len(keys))
+	for _, k := range keys {
+		if len(k) != kl {
+			return nil, fmt.Errorf("dpftpu: inconsistent key lengths")
+		}
+		body = append(body, k...)
+	}
+	out, err := c.post(fmt.Sprintf(
+		"/v1/pir/query?db=%s&k=%d", dbName, len(keys)), body)
+	if err != nil {
+		return nil, err
+	}
+	if len(out) != len(keys)*rowBytes {
+		return nil, fmt.Errorf(
+			"dpftpu: bad pir reply length %d, want %d*%d",
+			len(out), len(keys), rowBytes)
+	}
+	res := make([][]byte, len(keys))
+	for i := range keys {
+		res[i] = out[i*rowBytes : (i+1)*rowBytes]
+	}
+	return res, nil
+}
+
 // EvalFullBatch expands K shares in one round trip — the entry point that
 // amortizes the device dispatch and where the TPU speedup lives.  All keys
 // must have the same logN; the reply is the K concatenated expansions.

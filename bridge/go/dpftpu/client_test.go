@@ -374,6 +374,104 @@ func TestConformanceAggregateGolden(t *testing.T) {
 	}
 }
 
+// TestConformancePir runs the served 2-server PIR protocol end to end
+// through the bridge: register a frozen deterministic database, generate
+// both aggregators' query keys, query each through /v1/pir/query, and
+// XOR-reconstruct (pir_reconstruct) the rows.  The database bytes come
+// from a fixed xorshift stream, so the expected rows are a frozen vector
+// computed locally — a drift anywhere in the upload chunking, resident
+// placement, MXU parity scan, or reply framing breaks the equality.
+func TestConformancePir(t *testing.T) {
+	c := conformanceClient(t)
+	const (
+		nRows    = 300
+		rowBytes = 8
+		logN     = 9 // row_domain(300, compat) — compat leaf floor 2^7
+	)
+	// Frozen DB: xorshift64(seed 0x2026) bytes, row-major.
+	rows := make([][]byte, nRows)
+	s := uint64(0x2026)
+	next := func() uint64 {
+		s ^= s << 13
+		s ^= s >> 7
+		s ^= s << 17
+		return s
+	}
+	for i := range rows {
+		rows[i] = make([]byte, rowBytes)
+		v := next()
+		for j := 0; j < rowBytes; j++ {
+			rows[i][j] = byte(v >> (8 * j))
+		}
+	}
+	info, err := c.PirRegisterDB("go-conformance", rows)
+	if err != nil {
+		t.Fatal(err)
+	}
+	if info.Rows != nRows || info.RowBytes != rowBytes || info.LogN != logN {
+		t.Fatalf("db info %+v, want rows=%d row_bytes=%d log_n=%d",
+			info, nRows, rowBytes, logN)
+	}
+	for _, alpha := range []uint64{0, 7, 131, nRows - 1} {
+		ka, kb, err := c.Gen(alpha, logN)
+		if err != nil {
+			t.Fatal(err)
+		}
+		ansA, err := c.PirQuery("go-conformance", []DPFkey{ka}, rowBytes)
+		if err != nil {
+			t.Fatal(err)
+		}
+		ansB, err := c.PirQuery("go-conformance", []DPFkey{kb}, rowBytes)
+		if err != nil {
+			t.Fatal(err)
+		}
+		got := make([]byte, rowBytes)
+		for j := range got {
+			got[j] = ansA[0][j] ^ ansB[0][j]
+		}
+		if !bytes.Equal(got, rows[alpha]) {
+			t.Fatalf("pir row %d = %x, want %x", alpha, got, rows[alpha])
+		}
+	}
+	// Batched queries: one request, K rows back, same reconstruction.
+	alphas := []uint64{3, 299, 42}
+	keysA := make([]DPFkey, len(alphas))
+	keysB := make([]DPFkey, len(alphas))
+	for i, a := range alphas {
+		ka, kb, err := c.Gen(a, logN)
+		if err != nil {
+			t.Fatal(err)
+		}
+		keysA[i], keysB[i] = ka, kb
+	}
+	ansA, err := c.PirQuery("go-conformance", keysA, rowBytes)
+	if err != nil {
+		t.Fatal(err)
+	}
+	ansB, err := c.PirQuery("go-conformance", keysB, rowBytes)
+	if err != nil {
+		t.Fatal(err)
+	}
+	for i, a := range alphas {
+		got := make([]byte, rowBytes)
+		for j := range got {
+			got[j] = ansA[i][j] ^ ansB[i][j]
+		}
+		if !bytes.Equal(got, rows[a]) {
+			t.Fatalf("pir batch row %d = %x, want %x", a, got, rows[a])
+		}
+	}
+	// Unknown database -> structured 400, never a crash.
+	if _, err := c.PirQuery("no-such-db", keysA, rowBytes); err == nil {
+		t.Fatal("query against unknown db succeeded")
+	} else {
+		var apiErr *APIError
+		if !errors.As(err, &apiErr) || apiErr.Status != 400 {
+			t.Fatalf("unknown db error = %v, want 400 *APIError", err)
+		}
+	}
+}
+
 // TestStructuredErrorParsing pins the load-survival error contract: a
 // 429 shed reply with a {code, detail} JSON body and a Retry-After
 // header must surface as *APIError with every field recovered — that is

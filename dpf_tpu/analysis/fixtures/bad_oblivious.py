@@ -152,6 +152,18 @@ def leaky_shard_index_eval(seeds, table):
     return shard_map_compat(body, mesh, (P(), P()), P())(seeds, table)
 
 
+def leaky_pir_chunk_eval(seeds, db):
+    """Streams a PIR database scan from a SECRET-derived chunk index —
+    the forbidden served-PIR shape.  The production streamed scan
+    (models/pir.py ``_pir_stream_chunk_body``) selects the database slab
+    by the PUBLIC chunk counter; deriving the slab index from key
+    material makes the HBM access order — which chunk a scan touches
+    when — a function of the query, observable as memory-traffic skew."""
+    j = (seeds[0] & jnp.uint32(3)).astype(jnp.int32)
+    chunk = jax.lax.dynamic_slice_in_dim(db, j * 2, 2, axis=0)
+    return jnp.bitwise_xor.reduce(chunk, axis=0)
+
+
 #: (function, n secret leading args, total args builder) — the tests
 #: iterate this to keep fixture and assertion lists in sync.
 LEAKY = (
@@ -165,4 +177,5 @@ LEAKY = (
     ("leaky_kernel_loop_eval", leaky_kernel_loop_eval, "secret-index"),
     ("leaky_hh_descend_eval", leaky_hh_descend_eval, "secret-branch"),
     ("leaky_shard_index_eval", leaky_shard_index_eval, "secret-index"),
+    ("leaky_pir_chunk_eval", leaky_pir_chunk_eval, "secret-index"),
 )

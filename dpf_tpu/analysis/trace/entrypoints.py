@@ -575,6 +575,139 @@ def _agg_fold_sharded(op: str):
 
 
 # ---------------------------------------------------------------------------
+# Served 2-server PIR (models/pir.py; core.plans.run_pir, /v1/pir/query).
+# Trust model (DESIGN §15): the DATABASE words are PUBLIC — both PIR
+# servers hold identical copies by protocol — so the db operand is
+# untainted; the QUERY is the secret (key material and everything
+# derived from it, including the selection words and the carried
+# accumulator).  The chunk index of the streamed scan is the public
+# host loop counter.  The sharded routes trace a pinned (2 keys x 4
+# leaf) 8-device mesh — rows shard over ``leaf``, the one collective is
+# the final parity all-reduce.
+# ---------------------------------------------------------------------------
+
+
+def _pir_mesh_8():
+    from ...parallel.sharding import make_mesh
+
+    return make_mesh(2, 4)
+
+
+def _pir_db_words(rows: int):
+    import jax.numpy as jnp
+
+    return jnp.zeros((rows, 2), jnp.uint32)  # 8-byte rows
+
+
+def _pir_scan_compat():
+    from ...models import dpf, pir
+
+    dk = dpf.DeviceKeys(_compat_batch(9, 32))  # nu=2, dom=512
+    fn = pir._pir_single_body(dk.nu, 128, 4, "xla")
+    args = (
+        dk.seed_planes, dk.t_words, dk.scw_planes, dk.tl_words,
+        dk.tr_words, dk.fcw_planes, _pir_db_words(512),
+    )
+    return _trace(fn, args, secret=range(0, 6))
+
+
+def _pir_scan_fast():
+    from ...models import pir
+
+    kb = _fast_batch(9, 8)  # nu=0, dom=512
+    fn = pir._pir_single_fast_body(kb.nu, 128, 4, -1)
+    return _trace(
+        fn, (*kb.device_args(), _pir_db_words(512)), secret=range(0, 5)
+    )
+
+
+def _pir_scan_sharded_compat():
+    from ...models import dpf, pir
+
+    mesh = _pir_mesh_8()
+    dk = dpf.DeviceKeys(_compat_batch(9, 32), pad_to=64)  # 2 key shards
+    fn = pir._pir_sharded_sm(mesh, dk.nu, 2, 128, 1, "xla")
+    args = (
+        dk.seed_planes, dk.t_words, dk.scw_planes, dk.tl_words,
+        dk.tr_words, dk.fcw_planes, _pir_db_words(512),
+    )
+    return _trace(fn, args, secret=range(0, 6))
+
+
+def _pir_scan_sharded_fast():
+    from ...models import pir
+
+    mesh = _pir_mesh_8()
+    kb = _fast_batch(12, 32)  # nu=3; leaf 4 -> subtree_levels=2
+    fn = pir._pir_sharded_fast_sm(mesh, kb.nu, 2, 128, 8, -1)
+    return _trace(
+        fn, (*kb.device_args(), _pir_db_words(4096)), secret=range(0, 5)
+    )
+
+
+def _pir_stream_expand_compat(sharded: bool):
+    from ...models import dpf, pir
+
+    if sharded:
+        mesh = _pir_mesh_8()
+        dk = dpf.DeviceKeys(_compat_batch(9, 32), pad_to=64)
+        fn = pir._pir_expand_sharded_sm(mesh, dk.nu, 2, "xla")
+    else:
+        dk = dpf.DeviceKeys(_compat_batch(9, 32))
+        fn = pir._pir_expand_body(dk.nu, "xla")
+    args = (
+        dk.seed_planes, dk.t_words, dk.scw_planes, dk.tl_words,
+        dk.tr_words, dk.fcw_planes,
+    )
+    return _trace(fn, args, secret=range(0, 6))
+
+
+def _pir_stream_expand_fast(sharded: bool):
+    from ...models import pir
+
+    if sharded:
+        mesh = _pir_mesh_8()
+        kb = _fast_batch(12, 32)
+        fn = pir._pir_expand_fast_sharded_sm(mesh, kb.nu, 2, -1)
+    else:
+        kb = _fast_batch(12, 32)
+        fn = pir._pir_expand_fast_body(kb.nu, -1)
+    return _trace(fn, kb.device_args(), secret=range(0, 5))
+
+
+def _pir_stream_chunk(sharded: bool):
+    """One streamed-scan chunk dispatch: selection words + carried
+    accumulator secret; database slab and the chunk index ``j`` public
+    (the host loop counter — the leaky twin derives it from a seed,
+    ``bad_oblivious.leaky_pir_chunk_eval``)."""
+    import jax.numpy as jnp
+
+    from ...models import pir
+
+    j = jnp.int32(0)
+    if sharded:
+        mesh = _pir_mesh_8()
+        sel = jnp.zeros((32, 16), jnp.uint32)  # [K, dom/32], dom=512
+        acc = jnp.zeros((4, 32, 2), jnp.uint32)  # leaf-major carry
+        fn = pir._pir_stream_chunk_sharded_sm(mesh, 128, 1, 128)
+    else:
+        sel = jnp.zeros((32, 16), jnp.uint32)
+        acc = jnp.zeros((32, 2), jnp.uint32)
+        fn = pir._pir_stream_chunk_body(128, 1, 128)
+    return _trace(fn, (sel, _pir_db_words(512), acc, j), secret=(0, 2))
+
+
+def _pir_stream_combine():
+    import jax.numpy as jnp
+
+    from ...models import pir
+
+    acc = jnp.zeros((4, 32, 2), jnp.uint32)
+    fn = pir._pir_stream_combine_sm(_pir_mesh_8())
+    return _trace(fn, (acc,), secret=(0,))
+
+
+# ---------------------------------------------------------------------------
 # The matrix
 # ---------------------------------------------------------------------------
 
@@ -833,6 +966,98 @@ ROUTES: tuple[Route, ...] = (
         "agg_add",
         {"profile": "agg", "op": "add", "mesh": 8},
         lambda: _agg_fold_sharded("add"), min_devices=_MESH_SHARDS,
+    ),
+    # -- served 2-server PIR (models/pir.py; /v1/pir/query) ------------------
+    _route(
+        "pir/scan/compat/xla",
+        "models.pir.PirServer.answer one-shot pipeline "
+        "(core.plans.run_pir -> _pir_single)",
+        "pir",
+        {"profile": "compat", "backend": "xla", "fuse": "off"},
+        _pir_scan_compat,
+    ),
+    _route(
+        "pir/scan/fast/xla",
+        "models.pir.PirServer.answer one-shot pipeline "
+        "(core.plans.run_pir -> _pir_single_fast)",
+        "pir",
+        {"profile": "fast", "backend": "xla"},
+        _pir_scan_fast,
+    ),
+    _route(
+        "pir/scan_sharded/compat/xla",
+        "models.pir.PirServer.answer sharded pipeline "
+        "(core.plans.run_pir -> _pir_sharded; rows over leaf, one "
+        "parity all-reduce)",
+        "pir",
+        {"profile": "compat", "backend": "xla", "mesh": "2x4"},
+        _pir_scan_sharded_compat, min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "pir/scan_sharded/fast/xla",
+        "models.pir.PirServer.answer sharded pipeline "
+        "(core.plans.run_pir -> _pir_sharded_fast)",
+        "pir",
+        {"profile": "fast", "backend": "xla", "mesh": "2x4"},
+        _pir_scan_sharded_fast, min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "pir/stream_expand/compat/xla",
+        "models.pir streamed scan expansion dispatch (_pir_expand)",
+        "pir",
+        {"profile": "compat", "backend": "xla", "stream": True},
+        lambda: _pir_stream_expand_compat(False),
+    ),
+    _route(
+        "pir/stream_expand/fast/xla",
+        "models.pir streamed scan expansion dispatch (_pir_expand_fast)",
+        "pir",
+        {"profile": "fast", "backend": "xla", "stream": True},
+        lambda: _pir_stream_expand_fast(False),
+    ),
+    _route(
+        "pir/stream_expand_sharded/compat/xla",
+        "models.pir streamed scan expansion dispatch "
+        "(_pir_expand_sharded; selection words stay sharded keys x leaf)",
+        "pir",
+        {"profile": "compat", "backend": "xla", "stream": True,
+         "mesh": "2x4"},
+        lambda: _pir_stream_expand_compat(True),
+        min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "pir/stream_expand_sharded/fast/xla",
+        "models.pir streamed scan expansion dispatch "
+        "(_pir_expand_fast_sharded)",
+        "pir",
+        {"profile": "fast", "backend": "xla", "stream": True,
+         "mesh": "2x4"},
+        lambda: _pir_stream_expand_fast(True),
+        min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "pir/stream_chunk",
+        "models.pir streamed scan chunk dispatch (_pir_stream_chunk; "
+        "public chunk index, donated accumulator)",
+        "pir",
+        {"stream": True},
+        lambda: _pir_stream_chunk(False),
+    ),
+    _route(
+        "pir/stream_chunk_sharded",
+        "models.pir streamed scan chunk dispatch "
+        "(_pir_stream_chunk_sharded; zero collectives per chunk)",
+        "pir",
+        {"stream": True, "mesh": "2x4"},
+        lambda: _pir_stream_chunk(True), min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "pir/stream_combine_sharded",
+        "models.pir streamed scan combine dispatch (_pir_stream_combine; "
+        "the ONE parity all-reduce per query batch)",
+        "pir",
+        {"stream": True, "mesh": "2x4"},
+        _pir_stream_combine, min_devices=_MESH_SHARDS,
     ),
 )
 

@@ -1,0 +1,262 @@
+"""Device-resident PIR database registry — 2-server PIR as a served
+production workload.
+
+``models/pir.py`` owns the math (MXU parity matmuls over a packed
+database, one-shot or streamed); this module owns the OPERATIONAL
+lifecycle a serving deployment needs:
+
+  * named databases loaded once (``POST /v1/pir/db`` streams the body
+    off the socket in ``DPF_TPU_PIR_DB_CHUNK_BYTES`` chunks straight
+    into the packed host buffer — no giant intermediate bytes object)
+    and resident in device HBM from then on: with the serving mesh
+    resolved (``DPF_TPU_MESH``, parallel/serving_mesh.py) the rows shard
+    over a ``(keys=1, leaf=shards)`` mesh built on the SAME devices, so
+    a multi-GB corpus splits 1/shards per chip and every query batch
+    costs exactly one parity all-reduce;
+  * per-placement ``PirServer`` views built lazily from one public host
+    copy: the sharded view is the production path, the single-device
+    view is the degraded fallback the plan layer dispatches inside
+    ``serving_mesh.suspended()`` (breaker-not-closed) — byte-identical
+    by the PIR answer contract (the DB is public data, so keeping the
+    packed host words for re-placement leaks nothing);
+  * scan accounting for ``/v1/stats`` / ``/v1/metrics``: databases
+    resident, queries answered, database bytes scanned, and the
+    streamed-chunks-per-scan histogram.
+
+Trust model (DESIGN §15): the DATABASE is public — both PIR servers hold
+identical copies by protocol construction, so names, shapes, and scan
+counters are exportable metadata.  The QUERY is the secret: it exists
+only as DPF key material, and the scan routes carry obliviousness
+certificates (``pir/scan*`` in docs/OBLIVIOUS.md) that no secret ever
+steers a branch, index, or shape — the seeded-leaky twin
+(``bad_oblivious.leaky_pir_chunk_eval``, a secret-dependent DB chunk
+index) is what the verifier must refuse.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+
+import numpy as np
+
+from ..core import knobs
+from ..models.pir import _LEAF_LOG, PirServer, row_domain
+
+__all__ = ["PirDB", "PirRegistry", "registry", "reset", "validate_name"]
+
+_NAME_RE = re.compile(r"^[A-Za-z0-9_.-]{1,64}$")
+
+
+def validate_name(name: str) -> str:
+    """Raise ValueError unless ``name`` is a legal database name.  The
+    sidecar runs this BEFORE reading an upload body — a bad name must
+    cost zero bytes of socket work, not a full-database read."""
+    if not _NAME_RE.match(name or ""):
+        raise ValueError(
+            "pir: db name must be 1-64 chars of [A-Za-z0-9_.-]"
+        )
+    return name
+
+# Streamed-chunks-per-scan histogram bounds (1 = one-shot scan).
+CHUNK_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+
+class PirDB:
+    """One named, device-resident database and its scan counters.
+
+    The packed public host words are kept (the one copy serving both
+    placement regimes); ``server(shards)`` returns — building lazily —
+    the ``PirServer`` for a placement (0 = single-device)."""
+
+    def __init__(self, name: str, db: np.ndarray, profile: str = "compat"):
+        validate_name(name)
+        db = np.ascontiguousarray(np.asarray(db, dtype=np.uint8))
+        if db.ndim != 2:
+            raise ValueError("pir: db must be [n_rows, row_bytes]")
+        self.name = name
+        self.profile = profile
+        self.n_rows, self.row_bytes = db.shape
+        self.log_n, self.dom = row_domain(self.n_rows, profile)
+        self.nu = max(self.log_n - _LEAF_LOG[profile], 0)
+        self._db = db
+        self._servers: dict[int, PirServer] = {}
+        self._lock = threading.Lock()
+        # Scan accounting (read by stats()/metrics under the registry).
+        self.queries = 0
+        self.scans = 0
+        self.bytes_scanned = 0
+        self.chunk_hist = [0] * (len(CHUNK_BOUNDS) + 1)
+        self.chunk_sum = 0  # total streamed chunks across scans
+
+    @property
+    def db_bytes(self) -> int:
+        """Padded resident bytes — what one full scan reads."""
+        return self.dom * self.row_bytes
+
+    def server(self, shards: int = 0) -> PirServer:
+        """The ``PirServer`` view for a placement regime (``shards`` = 0
+        for single-device; otherwise rows shard over a (1, shards) leaf
+        mesh on the serving mesh's devices).  Built once per regime; the
+        database words are placed into (mesh) HBM at build."""
+        shards = int(shards)
+        with self._lock:
+            srv = self._servers.get(shards)
+        if srv is not None:
+            return srv
+        # Build OUTSIDE the lock: placement copies the whole database to
+        # (mesh) HBM, and holding _lock across it would stall note_scan
+        # on every concurrent query and registry().stats() behind it —
+        # freezing /v1/stats exactly when a degraded first-build happens.
+        mesh = None
+        if shards > 1:
+            from ..parallel import serving_mesh
+            from ..parallel.sharding import make_mesh
+
+            smesh = serving_mesh.serving_mesh()
+            devices = (
+                list(smesh.devices.reshape(-1)[:shards])
+                if smesh is not None
+                else None
+            )
+            mesh = make_mesh(n_keys=1, n_leaf=shards, devices=devices)
+        built = PirServer(self._db, mesh=mesh, profile=self.profile)
+        with self._lock:
+            # Keep-first on a racing build: both are views of the same
+            # public rows, but plans' jit caches key on the mesh object,
+            # so every caller must converge on ONE server per regime.
+            srv = self._servers.setdefault(shards, built)
+        return srv
+
+    def dispatch_shards(self) -> int:
+        """Shard count for the CURRENT dispatch: the serving mesh's, but
+        never more leaf shards than the domain has subtrees (tiny DBs
+        stay single-device), and 0 inside ``serving_mesh.suspended()``
+        — the degraded fallback the breaker engages."""
+        from ..parallel import serving_mesh
+
+        shards = serving_mesh.shards()
+        while shards > 1 and (1 << self.nu) < shards:
+            shards //= 2
+        return 0 if shards < 2 else shards
+
+    def note_scan(self, k: int, stream_chunks: int) -> None:
+        """One answered query-batch dispatch: ``k`` queries rode one
+        full-database scan of ``stream_chunks`` streamed dispatches."""
+        import bisect
+
+        with self._lock:
+            self.queries += int(k)
+            self.scans += 1
+            self.bytes_scanned += self.db_bytes
+            self.chunk_sum += int(stream_chunks)
+            self.chunk_hist[
+                bisect.bisect_left(CHUNK_BOUNDS, int(stream_chunks))
+            ] += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name,
+                "profile": self.profile,
+                "log_n": self.log_n,
+                "rows": self.n_rows,
+                "row_bytes": self.row_bytes,
+                "db_bytes": self.db_bytes,
+                "placements": sorted(self._servers),
+                "queries": self.queries,
+                "scans": self.scans,
+                "bytes_scanned": self.bytes_scanned,
+            }
+
+
+class PirRegistry:
+    """Process-wide name -> :class:`PirDB` map plus the aggregate scan
+    counters the stats/metrics surfaces export."""
+
+    def __init__(self):
+        self._dbs: dict[str, PirDB] = {}
+        self._lock = threading.Lock()
+
+    def load(self, name: str, db: np.ndarray,
+             profile: str = "compat") -> PirDB:
+        """Register (or replace) a named database.  Placement happens on
+        the entry's first ``server()`` call — warm it with
+        ``plans.warmup([{"route": "pir", "db": name, ...}])`` so the
+        compile never lands on query traffic."""
+        entry = PirDB(name, db, profile=profile)
+        with self._lock:
+            self._dbs[name] = entry
+        return entry
+
+    def get(self, name: str) -> PirDB:
+        with self._lock:
+            entry = self._dbs.get(name)
+        if entry is None:
+            raise KeyError(f"pir: unknown db {name!r} (load it first: "
+                           "POST /v1/pir/db)")
+        return entry
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._dbs)
+
+    def drop(self, name: str) -> bool:
+        with self._lock:
+            return self._dbs.pop(name, None) is not None
+
+    def stats(self) -> dict:
+        """The /v1/stats ``pir`` block (and the metrics families): DBs
+        resident, bytes scanned, and the streamed-chunk histogram —
+        names and shapes are public metadata (the DB is public data)."""
+        with self._lock:
+            dbs = list(self._dbs.values())
+        per_db = [d.stats() for d in dbs]
+        hist = [0] * (len(CHUNK_BOUNDS) + 1)
+        chunk_sum = 0
+        for d in dbs:
+            with d._lock:
+                chunk_sum += d.chunk_sum
+                for i, c in enumerate(d.chunk_hist):
+                    hist[i] += c
+        return {
+            "dbs_resident": len(per_db),
+            "db_bytes_resident": sum(d["db_bytes"] for d in per_db),
+            "queries": sum(d["queries"] for d in per_db),
+            "scans": sum(d["scans"] for d in per_db),
+            "bytes_scanned": sum(d["bytes_scanned"] for d in per_db),
+            # Histogram of streamed chunks per scan, promtext-shaped
+            # (non-cumulative counts; last bucket = overflow).
+            "scan_chunks": {
+                "bounds": list(CHUNK_BOUNDS),
+                "counts": hist,
+                "sum": float(chunk_sum),
+                "count": sum(hist),
+            },
+            "resident": per_db,
+        }
+
+
+_REGISTRY = PirRegistry()
+_REGISTRY_LOCK = threading.Lock()
+
+
+def registry() -> PirRegistry:
+    return _REGISTRY
+
+
+def reset() -> None:
+    """Drop every registered database (tests/benches; frees the host and
+    device copies once nothing else references the servers)."""
+    global _REGISTRY
+    with _REGISTRY_LOCK:
+        _REGISTRY = PirRegistry()
+
+
+def upload_chunk_rows(row_bytes: int) -> int:
+    """Rows per socket read of the /v1/pir/db upload: one
+    DPF_TPU_PIR_DB_CHUNK_BYTES chunk's worth (>= 1)."""
+    chunk = knobs.get_int("DPF_TPU_PIR_DB_CHUNK_BYTES")
+    if chunk <= 0:
+        chunk = 1 << 22
+    return max(1, chunk // max(int(row_bytes), 1))

@@ -330,6 +330,23 @@ _declare(
     "never materializes on host).",
     "dpf_tpu/apps/aggregation.py",
 )
+_declare(
+    "DPF_TPU_PIR_CHUNK_ROWS", "int", str(1 << 16),
+    "Database rows per parity-matmul chunk inside a PIR scan dispatch "
+    "(the int8 unpack granularity of the MXU matmul).  Auto-rounded down "
+    "to the nearest power of two dividing the per-shard domain.",
+    "dpf_tpu/models/pir.py",
+)
+_declare(
+    "DPF_TPU_PIR_DB_CHUNK_BYTES", "int", str(1 << 28),
+    "Per-shard resident database bytes above which a PIR scan streams as "
+    "per-chunk dispatches (selection expanded once, chunk j+1 dispatched "
+    "under chunk j's compute, donated device accumulator, ONE parity "
+    "all-reduce per query batch) instead of one monolithic program; also "
+    "the socket read-chunk size of the POST /v1/pir/db upload.  "
+    "0 disables streaming.",
+    "dpf_tpu/models/pir.py",
+)
 
 # Bench harness --------------------------------------------------------------
 _declare(

@@ -107,7 +107,7 @@ def q_bucket(q: int) -> int:
 
 class PlanKey(NamedTuple):
     route: str  # "points" | "dcf_points" | "dcf_interval" | "evalfull"
-    #            | "hh_level" | "agg_xor" | "agg_add"
+    #            | "hh_level" | "agg_xor" | "agg_add" | "pir"
     profile: str  # "compat" | "fast"
     log_n: int
     k_bucket: int
@@ -545,6 +545,59 @@ def run_agg_fold(
     return np.ascontiguousarray(out[:W])
 
 
+def run_pir(db, kb) -> np.ndarray:
+    """Plan-cached 2-server PIR answer: ``db`` is a registered
+    :class:`~dpf_tpu.apps.pir_store.PirDB`, ``kb`` a query key batch in
+    the database's profile -> uint8[K, row_bytes] (the per-query XOR of
+    selected rows; XOR two servers' replies to reconstruct).
+
+    Keyed on the DB's shape bucket — ``(log_n, row-bits)`` — not its
+    name: the database words are a traced operand, so two same-shaped
+    databases share one compiled scan.  With the serving mesh resolved
+    the rows live sharded over a leaf mesh on the same chips and the
+    scan ends in ONE parity all-reduce; inside
+    ``serving_mesh.suspended()`` (degraded) the same call lands on the
+    single-device resident copy, byte-identically.  Databases past
+    ``DPF_TPU_PIR_DB_CHUNK_BYTES`` answer through the streamed chunk
+    scan (models/pir.py) — still one plan, one warmup."""
+    K = kb.k
+    if kb.log_n != db.log_n:
+        raise ValueError(
+            f"pir: query domain 2^{kb.log_n} != db domain 2^{db.log_n}"
+        )
+    n_shards = db.dispatch_shards()
+    # Exact row-bits in the q slot (the DB is fixed — bucketing it would
+    # let two different executables share one plan entry).
+    key = PlanKey(
+        "pir", db.profile, int(db.log_n),
+        _pow2_bucket(K, k_floor()), int(db.row_bytes) * 8, True,
+        knobs.get_str("DPF_TPU_FUSE"), _active_sbox(), int(n_shards),
+    )
+    plan, first = _CACHE.get(key)
+    obs_trace.add_event(
+        "plan_lookup", hit=not first, route="pir",
+        k_bucket=key.k_bucket, q_bucket=key.q_bucket,
+    )
+    t0 = time.perf_counter()
+    kbp = _pad_keys(kb, key.k_bucket - K)
+    srv = db.server(n_shards)
+    with obs_trace.child_span("compute"):
+        # PirServer.answer marshals its own output (the answer rows are
+        # the one D2H) — no separate d2h span, like the sharded routes.
+        rows = srv.answer(kbp)
+    if first:
+        plan.compile_s = time.perf_counter() - t0
+    plan.last_used = time.time()
+    db.note_scan(K, srv.stream_chunks)
+    return np.ascontiguousarray(rows[:K])
+
+
+def _active_sbox() -> str:
+    from ..ops import sbox_circuit
+
+    return sbox_circuit.active_sbox()
+
+
 def run_evalfull(profile: str, kb) -> np.ndarray:
     """Plan-cached full-domain expansion -> uint8[K, out_bytes].  With
     the serving mesh resolved, the key batch shards over the keys axis
@@ -596,10 +649,14 @@ def warmup(shapes: list[dict]) -> list[dict]:
     first-request compile never lands on user traffic.
 
     Each spec: ``{"route": "points"|"dcf_points"|"dcf_interval"|
-    "evalfull"|"hh_level"|"agg_xor"|"agg_add", "profile":
+    "evalfull"|"hh_level"|"agg_xor"|"agg_add"|"pir", "profile":
     "compat"|"fast", "log_n": N, "k": K, "q": Q}`` (``q`` ignored for
     evalfull; ``profile`` ignored for the DCF routes, which are
-    fast-profile by construction).  ``hh_level`` warms one heavy-hitters
+    fast-profile by construction).  A ``pir`` spec instead names a
+    REGISTERED database — ``{"route": "pir", "db": name, "k": K}`` —
+    and warms its expansion + parity-matmul executables for the current
+    mesh regime (log_n and profile come from the registry entry;
+    apps/pir_store.py).  ``hh_level`` warms one heavy-hitters
     round shape — K clients x Q candidates; the compiled body is
     level-independent, so this covers EVERY level of a descent at that
     bucket.  The agg routes warm one streamed-fold chunk shape (``q`` is
@@ -614,16 +671,49 @@ def warmup(shapes: list[dict]) -> list[dict]:
     for spec in shapes:
         route = spec.get("route", "points")
         profile = spec.get("profile", "compat")
-        # Only the agg routes have no domain; everywhere else a missing
+        # Only the agg routes (no domain) and pir (domain comes from the
+        # registered database) may omit log_n; everywhere else a missing
         # log_n must stay a loud KeyError -> 400, not a silent log_n=0
         # warmup of a useless plan.
-        if route in ("agg_xor", "agg_add"):
+        if route in ("agg_xor", "agg_add", "pir"):
             log_n = int(spec.get("log_n", 0))
         else:
             log_n = int(spec["log_n"])
         k = int(spec.get("k", 1))
         q = int(spec.get("q", 32))
         t0 = time.perf_counter()
+        if route == "pir":
+            # One registered-database scan shape ({"route": "pir", "db":
+            # name[, "k": K]}): compiles the expansion + parity-matmul
+            # executables for the CURRENT placement regime AND places the
+            # database words.  log_n/profile come from the registry
+            # entry; an unknown name is a loud KeyError -> 400.
+            from ..apps import pir_store
+
+            db = pir_store.registry().get(str(spec["db"]))
+            k = int(spec.get("k", 1))
+            kb_count = k_bucket(k)
+            if db.profile == "fast":
+                from ..models.keys_chacha import gen_batch
+            else:
+                from ..core.keys import gen_batch
+
+            kb, _ = gen_batch(
+                np.zeros(kb_count, np.uint64), db.log_n, rng=rng
+            )
+            run_pir(db, kb)
+            out.append(
+                {
+                    "route": "pir",
+                    "profile": db.profile,
+                    "db": db.name,
+                    "log_n": db.log_n,
+                    "k_bucket": kb_count,
+                    "q_bucket": db.row_bytes * 8,
+                    "seconds": round(time.perf_counter() - t0, 3),
+                }
+            )
+            continue
         kb_count = k_bucket(k)
         alphas = np.zeros(kb_count, np.uint64)
         if route in ("agg_xor", "agg_add"):
@@ -716,6 +806,13 @@ def recent_shapes(limit: int = 4) -> list[dict]:
     out = []
     for p in recent:
         key = p.key
+        if key.route == "pir":
+            # A pir plan is keyed on the DB's shape, not its name — the
+            # probe cannot reconstruct which registered database to scan,
+            # so re-warm happens on the first post-recovery query instead
+            # (the resident placement survives the breaker trip; only the
+            # degraded single-device twin may pay a compile).
+            continue
         spec = {
             "route": key.route,
             "profile": key.profile,

@@ -18,6 +18,19 @@ expands only the GGM subtree covering its own rows (zero-communication
 domain parallelism) — and the K queries shard over the ``keys`` axis.  The
 only collective is one parity all-reduce of the [K, row_bytes] partial
 answers over ICI (parallel/sharding.xor_allreduce).
+
+Production database sizes: a multi-GB database is bigger than a
+comfortable single dispatch, so above ``DPF_TPU_PIR_DB_CHUNK_BYTES`` of
+per-shard resident bytes the scan runs as a **streamed chunk scan**: the
+selection vectors are expanded ONCE (one dispatch), then the parity
+matmul is split into per-chunk dispatches over the HBM-resident database
+— chunk j+1's dispatch is issued while chunk j computes (the async-
+dispatch twin of core/stream.py's double buffering; nothing crosses back
+to host mid-scan), each chunk XORs into a device-carried accumulator
+whose buffer is donated (``DPF_TPU_DONATE``), and under a mesh the
+per-shard partials meet in exactly ONE parity all-reduce per query
+batch, after the last chunk.  The answer bytes are identical to the
+one-shot scan's — pinned by tests/test_pir_serving.py.
 """
 
 from __future__ import annotations
@@ -29,10 +42,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..core import knobs
 from ..core.keys import KeyBatch, gen_batch
 from ..parallel.sharding import (
     KEYS_AXIS,
     LEAF_AXIS,
+    _ShardedJits,
     expand_subtree_local,
     leaf_axis_levels,
     shard_map_compat,
@@ -53,6 +68,17 @@ from .dpf import (
 # Leaf width (log2 bits) per profile: compat = one AES block (reference
 # dpf/dpf.go:251), fast = one ChaCha block (core/chacha_np.LEAF_LOG).
 _LEAF_LOG = {"compat": 7, "fast": 9}
+
+# Every jitted PIR executable registers here so core.plans.trace_count —
+# the zero-retrace-after-warmup detector — counts them like any other
+# module-level jit (the executables themselves live inside functools
+# caches, invisible to the module scan; same duck type as
+# parallel.sharding.SHARDED_JITS).
+PIR_JITS = _ShardedJits()
+
+
+def _pow2_floor(n: int) -> int:
+    return 1 << (int(n).bit_length() - 1) if n >= 1 else 0
 
 
 def row_domain(n_rows: int, profile: str = "compat") -> tuple[int, int]:
@@ -102,16 +128,25 @@ class PirServer:
     """One server's database, packed on device.
 
     ``db``: uint8[N, row_bytes]; both servers hold identical copies.
-    ``mesh``: optional (keys, leaf) mesh; rows shard over ``leaf``.
-    ``chunk_rows``: rows per parity-matmul chunk (int8 unpack granularity).
+    ``mesh``: optional (keys, leaf) mesh; rows shard over ``leaf`` (the
+    database words are placed once, sharded, into mesh HBM).
+    ``chunk_rows``: rows per parity-matmul chunk (int8 unpack
+    granularity; default ``DPF_TPU_PIR_CHUNK_ROWS``).  Any value is
+    auto-rounded down to the nearest power of two that divides the
+    per-shard domain — chunking changes only the schedule, never the
+    answer, so a non-divisor is a tuning input, not an error.
+    ``db_chunk_bytes``: per-shard resident bytes above which the scan
+    streams as per-chunk dispatches (default
+    ``DPF_TPU_PIR_DB_CHUNK_BYTES``; 0 disables streaming).
     """
 
     def __init__(
         self,
         db: np.ndarray,
         mesh: Mesh | None = None,
-        chunk_rows: int = 1 << 16,
+        chunk_rows: int | None = None,
         profile: str = "compat",
+        db_chunk_bytes: int | None = None,
     ):
         if profile not in _LEAF_LOG:
             raise ValueError(f"pir: unknown profile {profile!r}")
@@ -133,14 +168,41 @@ class PirServer:
         # Pad the row count to a full leaf domain so selection words line up
         # 1:1 with expansion output words (and to whole shards/chunks).
         self.dom = dom
-        self.chunk_rows = min(chunk_rows, max(dom // self.n_leaf, 128))
-        if dom % (self.n_leaf * self.chunk_rows):
-            raise ValueError("chunk_rows must divide the per-shard domain")
+        local_dom = dom // self.n_leaf  # pow2, >= 2^_LEAF_LOG >= 128
+        if chunk_rows is None:
+            chunk_rows = knobs.get_int("DPF_TPU_PIR_CHUNK_ROWS")
+        # Auto-round: pow2-floor (>= 128 — one packed uint32[4] leaf word
+        # group) clamped to the per-shard domain; every such value
+        # divides the pow2 per-shard domain, so the old hard
+        # "must divide" ValueError cannot fire.
+        self.chunk_rows = min(_pow2_floor(max(int(chunk_rows), 128)),
+                              local_dom)
+        # Streamed chunk scan: when a shard holds more resident DB bytes
+        # than one comfortable dispatch, the scan splits into
+        # ``stream_chunks`` dispatches of ``stream_rows`` rows each.
+        if db_chunk_bytes is None:
+            db_chunk_bytes = knobs.get_int("DPF_TPU_PIR_DB_CHUNK_BYTES")
+        if db_chunk_bytes > 0 and local_dom * self.row_bytes > db_chunk_bytes:
+            rows_per = _pow2_floor(max(db_chunk_bytes // self.row_bytes, 1))
+            self.stream_rows = min(max(rows_per, 128), local_dom)
+        else:
+            self.stream_rows = local_dom
+        self.stream_chunks = local_dom // self.stream_rows
+        # The matmul chunk can never exceed one streamed slab.
+        self.chunk_rows = min(self.chunk_rows, self.stream_rows)
         padded = np.zeros((dom, self.row_bytes), np.uint8)
         padded[: self.n_rows] = db
-        self.db_words = jnp.asarray(
-            np.ascontiguousarray(padded).view("<u4")
-        )  # [dom, row_bytes/4]
+        words = np.ascontiguousarray(padded).view("<u4")  # [dom, rb/4]
+        if mesh is not None:
+            # Resident placement: rows sharded over the leaf axis ONCE at
+            # load, so no dispatch ever re-lays the database out.
+            from jax.sharding import NamedSharding
+
+            self.db_words = jax.device_put(
+                words, NamedSharding(mesh, P(LEAF_AXIS, None))
+            )
+        else:
+            self.db_words = jnp.asarray(words)
 
     def answer(self, queries) -> np.ndarray:
         """-> uint8[K, row_bytes]: per-query XOR of selected rows.
@@ -172,6 +234,13 @@ class PirServer:
             dk.seed_planes, dk.t_words, dk.scw_planes,
             dk.tl_words, dk.tr_words, dk.fcw_planes, self.db_words,
         )
+        if self.stream_chunks > 1:
+            words = self._stream_compat(dk, backend, args[:-1])
+            return (
+                np.ascontiguousarray(words[: queries.k])
+                .view("<u1")
+                .reshape(queries.k, -1)
+            )
         words = None
         if self.mesh is None:
             # Single-chip expansion follows the production fused routing
@@ -231,27 +300,100 @@ class PirServer:
             padk(queries.scw), padk(queries.tcw), padk(queries.fcw),
         )
         if self.mesh is None:
-            fn = _pir_single_fast(
-                self.nu, self.chunk_rows, n_chunks,
-                _pir_fast_entry_level(self.nu, padded.k),
-            )
+            entry = _pir_fast_entry_level(self.nu, padded.k)
+            if self.stream_chunks > 1:
+                sel = _pir_expand_fast(self.nu, entry)(*padded.device_args())
+                words = self._stream_scan(sel)
+            else:
+                fn = _pir_single_fast(
+                    self.nu, self.chunk_rows, n_chunks, entry
+                )
+                # host-sync: final reply marshalling (PIR answer rows)
+                words = np.asarray(fn(*padded.device_args(), self.db_words))
         else:
             from ..parallel.sharding import _sharded_fast_entry_level
 
-            fn = _pir_sharded_fast(
-                self.mesh, self.nu, self.subtree_levels, self.chunk_rows,
-                n_chunks,
-                _sharded_fast_entry_level(
-                    self.nu, self.subtree_levels, padded.k // k_shards
-                ),
+            entry = _sharded_fast_entry_level(
+                self.nu, self.subtree_levels, padded.k // k_shards
             )
-        # host-sync: final reply marshalling (PIR answer rows)
-        words = np.asarray(fn(*padded.device_args(), self.db_words))
+            if self.stream_chunks > 1:
+                sel = _pir_expand_fast_sharded(
+                    self.mesh, self.nu, self.subtree_levels, entry
+                )(*padded.device_args())
+                words = self._stream_scan(sel)
+            else:
+                fn = _pir_sharded_fast(
+                    self.mesh, self.nu, self.subtree_levels,
+                    self.chunk_rows, n_chunks, entry,
+                )
+                # host-sync: final reply marshalling (PIR answer rows)
+                words = np.asarray(fn(*padded.device_args(), self.db_words))
         return (
             np.ascontiguousarray(words[: queries.k])
             .view("<u1")
             .reshape(queries.k, -1)
         )
+
+    # -- streamed chunk scan (DBs past DPF_TPU_PIR_DB_CHUNK_BYTES) ---------
+
+    def _stream_compat(self, dk, backend, key_args) -> np.ndarray:
+        """Compat-profile streamed answer: expand the selection words in
+        ONE dispatch (fused routing like the one-shot path), then stream
+        the parity matmul over the resident database."""
+        if self.mesh is not None:
+            sel = _pir_expand_sharded(
+                self.mesh, dk.nu, self.subtree_levels, backend
+            )(*key_args)
+            return self._stream_scan(sel)
+        sel = None
+        sched = _fuse_plan(dk.nu, backend, None)
+        if sched is not None:
+            from . import dpf as _mdpf
+
+            try:
+                sel = _pir_expand(dk.nu, backend, sched)(*key_args)
+            except Exception as e:  # noqa: BLE001
+                _mdpf._fuse_degraded(e)
+        if sel is None:
+            sel = _pir_expand(dk.nu, backend)(*key_args)
+        return self._stream_scan(sel)
+
+    def _stream_scan(self, sel) -> np.ndarray:
+        """Stream the parity matmul over the device-resident database:
+        one dispatch per ``stream_rows`` chunk, each XORing into a
+        donated device accumulator.  Dispatch is async, so chunk j+1 is
+        issued while chunk j computes (double buffering without a host
+        round trip); nothing leaves the device until the final carry.
+        Under a mesh the per-(key-shard, row-shard) partials meet in ONE
+        parity all-reduce after the last chunk.  -> host uint32[Kpad, R]."""
+        from ..core.plans import donation_enabled
+
+        donate = donation_enabled()
+        K = int(sel.shape[0])
+        R = int(self.db_words.shape[1])
+        inner = self.stream_rows // self.chunk_rows
+        if self.mesh is None:
+            acc = jnp.zeros((K, R), jnp.uint32)
+            step = _pir_stream_chunk(
+                self.chunk_rows, inner, self.stream_rows, donate
+            )
+            for j in range(self.stream_chunks):
+                acc = step(sel, self.db_words, acc, np.int32(j))
+            # host-sync: final reply marshalling (PIR answer rows)
+            return np.asarray(acc)
+        from jax.sharding import NamedSharding
+
+        acc = jax.device_put(
+            np.zeros((self.n_leaf, K, R), np.uint32),
+            NamedSharding(self.mesh, P(LEAF_AXIS, KEYS_AXIS, None)),
+        )
+        step = _pir_stream_chunk_sharded(
+            self.mesh, self.chunk_rows, inner, self.stream_rows, donate
+        )
+        for j in range(self.stream_chunks):
+            acc = step(sel, self.db_words, acc, np.int32(j))
+        # host-sync: final reply marshalling (PIR answer rows)
+        return np.asarray(_pir_stream_combine(self.mesh)(acc))
 
 
 # ---------------------------------------------------------------------------
@@ -309,39 +451,265 @@ def _leaves_to_sel_words(words: jax.Array) -> jax.Array:
     return words.reshape(words.shape[0], -1)
 
 
+def _expand_sel_planes(
+    nu, backend, fuse_sched, seed_planes, t_words, scw_planes, tl_w, tr_w,
+    fcw_planes,
+):
+    """Traceable compat-profile expansion -> selection words
+    uint32[K, dom/32] in ascending row order.  ``fuse_sched``
+    (models/dpf._fuse_plan output) routes the deep levels through the
+    level-fused VMEM kernels — same bytes, ~G x less HBM traffic."""
+    if backend in _BM_BACKENDS:
+        seed_planes, scw_planes = _to_bm(seed_planes, scw_planes)
+    S, T = seed_planes, t_words
+    if fuse_sched is not None:
+        first, groups = fuse_sched
+        for i in range(first):
+            S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i], backend)
+        Sf, Tf = _fused_groups(S, T, scw_planes, tl_w, tr_w, first, groups)
+        leaves = _convert_leaves_fused(Sf, Tf, fcw_planes, backend)
+    else:
+        for i in range(nu):
+            S, T = _level_step(S, T, scw_planes[i], tl_w[i], tr_w[i], backend)
+        leaves = _convert_leaves(S, T, fcw_planes, backend)
+    return _leaves_to_sel_words(leaves)
+
+
+def _pir_single_body(
+    nu: int, chunk_rows: int, n_chunks: int, backend: str = "xla",
+    fuse_sched=None,
+):
+    """The UNJITTED one-shot compat pipeline body (what the oblivious-
+    trace verifier certifies as ``pir/scan/compat``)."""
+
+    def body(seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes,
+             db_words):
+        sel = _expand_sel_planes(
+            nu, backend, fuse_sched, seed_planes, t_words, scw_planes,
+            tl_w, tr_w, fcw_planes,
+        )
+        return _parity_matmul(sel, db_words, chunk_rows, n_chunks)
+
+    return body
+
+
 @cache
 def _pir_single(
     nu: int, chunk_rows: int, n_chunks: int, backend: str = "xla",
     fuse_sched=None,
 ):
-    """Single-chip PIR pipeline.  ``fuse_sched`` (models/dpf._fuse_plan
-    output) routes the deep levels through the level-fused VMEM kernels —
-    the selection words then come off the fused-layout leaf convert, same
-    bytes, ~G x less HBM traffic on the expansion that feeds the parity
-    matmul."""
+    """Single-chip PIR pipeline: expansion feeding the chunked parity
+    matmul in one program."""
+    return PIR_JITS.register(
+        jax.jit(_pir_single_body(nu, chunk_rows, n_chunks, backend,
+                                 fuse_sched))
+    )
 
-    def body(seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes, db_words):
-        if backend in _BM_BACKENDS:
-            seed_planes, scw_planes = _to_bm(seed_planes, scw_planes)
-        S, T = seed_planes, t_words
-        if fuse_sched is not None:
-            first, groups = fuse_sched
-            for i in range(first):
-                S, T = _level_step(
-                    S, T, scw_planes[i], tl_w[i], tr_w[i], backend
-                )
-            Sf, Tf = _fused_groups(S, T, scw_planes, tl_w, tr_w, first, groups)
-            leaves = _convert_leaves_fused(Sf, Tf, fcw_planes, backend)
+
+def _pir_expand_body(nu: int, backend: str = "xla", fuse_sched=None):
+    """UNJITTED compat expansion-only body (``pir/stream_expand/compat``):
+    the streamed scan's first dispatch — selection words stay on device."""
+
+    def body(seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes):
+        return _expand_sel_planes(
+            nu, backend, fuse_sched, seed_planes, t_words, scw_planes,
+            tl_w, tr_w, fcw_planes,
+        )
+
+    return body
+
+
+@cache
+def _pir_expand(nu: int, backend: str = "xla", fuse_sched=None):
+    return PIR_JITS.register(
+        jax.jit(_pir_expand_body(nu, backend, fuse_sched))
+    )
+
+
+def _pir_expand_sharded_sm(
+    mesh: Mesh, nu: int, subtree_levels: int, backend: str = "xla"
+):
+    """UNJITTED sharded compat expansion (``pir/stream_expand`` sharded):
+    each shard expands only its own subtree; the selection words come out
+    sharded (keys x leaf) and FEED the streamed chunk scan in place —
+    zero collectives, nothing replicated."""
+
+    def body(seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes):
+        S, T = expand_subtree_local(
+            seed_planes, t_words, scw_planes, tl_w, tr_w, nu,
+            subtree_levels, backend,
+        )
+        return _leaves_to_sel_words(_convert_leaves(S, T, fcw_planes,
+                                                    backend))
+
+    keyed = P(None, None, KEYS_AXIS)
+    return shard_map_compat(
+        body,
+        mesh=mesh,
+        in_specs=(
+            keyed, P(None, KEYS_AXIS), keyed, P(None, KEYS_AXIS),
+            P(None, KEYS_AXIS), keyed,
+        ),
+        out_specs=P(KEYS_AXIS, LEAF_AXIS),
+        check_vma=False,
+    )
+
+
+@cache
+def _pir_expand_sharded(
+    mesh: Mesh, nu: int, subtree_levels: int, backend: str = "xla"
+):
+    return PIR_JITS.register(
+        jax.jit(_pir_expand_sharded_sm(mesh, nu, subtree_levels, backend))
+    )
+
+
+def _pir_expand_fast_body(nu: int, entry: int = -1):
+    """UNJITTED fast-profile expansion-only body
+    (``pir/stream_expand/fast``)."""
+
+    def body(seeds, ts, scw, tcw, fcw):
+        return _fast_expand_sel(nu, entry, seeds, ts, scw, tcw, fcw)
+
+    return body
+
+
+@cache
+def _pir_expand_fast(nu: int, entry: int = -1):
+    return PIR_JITS.register(jax.jit(_pir_expand_fast_body(nu, entry)))
+
+
+def _pir_expand_fast_sharded_sm(
+    mesh: Mesh, nu: int, subtree_levels: int, entry: int = -1
+):
+    from ..parallel.sharding import expand_subtree_local_cc
+    from .dpf_chacha import _convert_leaves_cc, _finish_pk
+
+    def body(seeds, ts, scw, tcw, fcw):
+        if entry < 0:
+            S, T = expand_subtree_local_cc(
+                seeds, ts, scw, tcw, nu, subtree_levels
+            )
+            leaves = _convert_leaves_cc(S, T, [fcw[:, j] for j in range(16)])
         else:
-            for i in range(nu):
-                S, T = _level_step(
-                    S, T, scw_planes[i], tl_w[i], tr_w[i], backend
-                )
-            leaves = _convert_leaves(S, T, fcw_planes, backend)
-        sel = _leaves_to_sel_words(leaves)
-        return _parity_matmul(sel, db_words, chunk_rows, n_chunks)
+            from ..ops.chacha_pallas import cw_operands
 
-    return jax.jit(body)
+            S, T = expand_subtree_local_cc(
+                seeds, ts, scw, tcw, entry, subtree_levels
+            )
+            leaves = _finish_pk(
+                nu, entry, S, T, *cw_operands(scw, tcw, fcw, entry, nu)
+            )
+        return leaves.reshape(leaves.shape[0], -1)
+
+    return shard_map_compat(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(KEYS_AXIS, None), P(KEYS_AXIS), P(KEYS_AXIS, None, None),
+            P(KEYS_AXIS, None, None), P(KEYS_AXIS, None),
+        ),
+        out_specs=P(KEYS_AXIS, LEAF_AXIS),
+        check_vma=False,
+    )
+
+
+@cache
+def _pir_expand_fast_sharded(
+    mesh: Mesh, nu: int, subtree_levels: int, entry: int = -1
+):
+    return PIR_JITS.register(
+        jax.jit(_pir_expand_fast_sharded_sm(mesh, nu, subtree_levels, entry))
+    )
+
+
+def _pir_stream_chunk_body(chunk_rows: int, n_inner: int, stream_rows: int):
+    """UNJITTED streamed-scan chunk body (``pir/stream_chunk``): one
+    ``stream_rows``-row slab of the resident database XORed into the
+    carried accumulator.  ``j`` is the PUBLIC chunk index — a traced
+    scalar so every chunk of a scan lands on one executable."""
+
+    def body(sel, db_words, acc, j):
+        sw = stream_rows // 32
+        sel_j = jax.lax.dynamic_slice_in_dim(sel, j * sw, sw, axis=1)
+        db_j = jax.lax.dynamic_slice_in_dim(
+            db_words, j * stream_rows, stream_rows, axis=0
+        )
+        return acc ^ _parity_matmul(sel_j, db_j, chunk_rows, n_inner)
+
+    return body
+
+
+@cache
+def _pir_stream_chunk(
+    chunk_rows: int, n_inner: int, stream_rows: int, donate: bool = False
+):
+    body = _pir_stream_chunk_body(chunk_rows, n_inner, stream_rows)
+    # The accumulator is dead after each chunk (the loop rebinds it), so
+    # donating its buffer lets XLA XOR in place across the whole scan.
+    jitted = jax.jit(body, donate_argnums=(2,)) if donate else jax.jit(body)
+    return PIR_JITS.register(jitted)
+
+
+def _pir_stream_chunk_sharded_sm(
+    mesh: Mesh, chunk_rows: int, n_inner: int, stream_rows: int
+):
+    """UNJITTED sharded streamed-scan chunk body: every (key-shard,
+    row-shard) device scans its own ``stream_rows`` local rows against
+    its own selection-word block — zero collectives; the accumulator
+    stays per-device (leaf-major) until the final combine."""
+
+    def body(sel_l, db_l, acc_l, j):
+        sw = stream_rows // 32
+        sel_j = jax.lax.dynamic_slice_in_dim(sel_l, j * sw, sw, axis=1)
+        db_j = jax.lax.dynamic_slice_in_dim(
+            db_l, j * stream_rows, stream_rows, axis=0
+        )
+        part = _parity_matmul(sel_j, db_j, chunk_rows, n_inner)
+        return acc_l ^ part[None]
+
+    return shard_map_compat(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(KEYS_AXIS, LEAF_AXIS), P(LEAF_AXIS, None),
+            P(LEAF_AXIS, KEYS_AXIS, None), P(),
+        ),
+        out_specs=P(LEAF_AXIS, KEYS_AXIS, None),
+        check_vma=False,
+    )
+
+
+@cache
+def _pir_stream_chunk_sharded(
+    mesh: Mesh, chunk_rows: int, n_inner: int, stream_rows: int,
+    donate: bool = False,
+):
+    body = _pir_stream_chunk_sharded_sm(mesh, chunk_rows, n_inner,
+                                        stream_rows)
+    jitted = jax.jit(body, donate_argnums=(2,)) if donate else jax.jit(body)
+    return PIR_JITS.register(jitted)
+
+
+def _pir_stream_combine_sm(mesh: Mesh):
+    """UNJITTED streamed-scan combine: the ONE parity all-reduce of a
+    sharded query batch, folding the per-row-shard partial answers."""
+
+    def body(acc_l):
+        return xor_allreduce(acc_l[0], LEAF_AXIS)
+
+    return shard_map_compat(
+        body,
+        mesh=mesh,
+        in_specs=(P(LEAF_AXIS, KEYS_AXIS, None),),
+        out_specs=P(KEYS_AXIS, None),
+        check_vma=False,
+    )
+
+
+@cache
+def _pir_stream_combine(mesh: Mesh):
+    return PIR_JITS.register(jax.jit(_pir_stream_combine_sm(mesh)))
 
 
 def _fast_expand_sel(nu, entry, seeds, ts, scw, tcw, fcw):
@@ -378,17 +746,26 @@ def _pir_fast_entry_level(nu: int, k: int) -> int:
     return cp.entry_level(nu)
 
 
-@cache
-def _pir_single_fast(nu: int, chunk_rows: int, n_chunks: int, entry: int = -1):
+def _pir_single_fast_body(
+    nu: int, chunk_rows: int, n_chunks: int, entry: int = -1
+):
+    """The UNJITTED one-shot fast pipeline body (``pir/scan/fast``)."""
+
     def body(seeds, ts, scw, tcw, fcw, db_words):
         sel = _fast_expand_sel(nu, entry, seeds, ts, scw, tcw, fcw)
         return _parity_matmul(sel, db_words, chunk_rows, n_chunks)
 
-    return jax.jit(body)
+    return body
 
 
 @cache
-def _pir_sharded_fast(
+def _pir_single_fast(nu: int, chunk_rows: int, n_chunks: int, entry: int = -1):
+    return PIR_JITS.register(
+        jax.jit(_pir_single_fast_body(nu, chunk_rows, n_chunks, entry))
+    )
+
+
+def _pir_sharded_fast_sm(
     mesh: Mesh, nu: int, subtree_levels: int, chunk_rows: int, n_chunks: int,
     entry: int = -1,
 ):
@@ -414,26 +791,38 @@ def _pir_sharded_fast(
         part = _parity_matmul(sel, db_words, chunk_rows, n_chunks)
         return xor_allreduce(part, LEAF_AXIS)
 
-    return jax.jit(
-        shard_map_compat(
-            body,
-            mesh=mesh,
-            in_specs=(
-                P(KEYS_AXIS, None), P(KEYS_AXIS), P(KEYS_AXIS, None, None),
-                P(KEYS_AXIS, None, None), P(KEYS_AXIS, None), P(LEAF_AXIS, None),
-            ),
-            out_specs=P(KEYS_AXIS, None),
-            check_vma=False,
-        )
+    return shard_map_compat(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(KEYS_AXIS, None), P(KEYS_AXIS), P(KEYS_AXIS, None, None),
+            P(KEYS_AXIS, None, None), P(KEYS_AXIS, None), P(LEAF_AXIS, None),
+        ),
+        out_specs=P(KEYS_AXIS, None),
+        check_vma=False,
     )
 
 
 @cache
-def _pir_sharded(
+def _pir_sharded_fast(
+    mesh: Mesh, nu: int, subtree_levels: int, chunk_rows: int, n_chunks: int,
+    entry: int = -1,
+):
+    return PIR_JITS.register(
+        jax.jit(
+            _pir_sharded_fast_sm(
+                mesh, nu, subtree_levels, chunk_rows, n_chunks, entry
+            )
+        )
+    )
+
+
+def _pir_sharded_sm(
     mesh: Mesh, nu: int, subtree_levels: int, chunk_rows: int, n_chunks: int,
     backend: str = "xla",
 ):
-    def body(seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes, db_words):
+    def body(seed_planes, t_words, scw_planes, tl_w, tr_w, fcw_planes,
+             db_words):
         S, T = expand_subtree_local(
             seed_planes, t_words, scw_planes, tl_w, tr_w, nu, subtree_levels,
             backend,
@@ -443,15 +832,27 @@ def _pir_sharded(
         return xor_allreduce(part, LEAF_AXIS)
 
     keyed = P(None, None, KEYS_AXIS)
-    return jax.jit(
-        shard_map_compat(
-            body,
-            mesh=mesh,
-            in_specs=(
-                keyed, P(None, KEYS_AXIS), keyed, P(None, KEYS_AXIS),
-                P(None, KEYS_AXIS), keyed, P(LEAF_AXIS, None),
-            ),
-            out_specs=P(KEYS_AXIS, None),
-            check_vma=False,
+    return shard_map_compat(
+        body,
+        mesh=mesh,
+        in_specs=(
+            keyed, P(None, KEYS_AXIS), keyed, P(None, KEYS_AXIS),
+            P(None, KEYS_AXIS), keyed, P(LEAF_AXIS, None),
+        ),
+        out_specs=P(KEYS_AXIS, None),
+        check_vma=False,
+    )
+
+
+@cache
+def _pir_sharded(
+    mesh: Mesh, nu: int, subtree_levels: int, chunk_rows: int, n_chunks: int,
+    backend: str = "xla",
+):
+    return PIR_JITS.register(
+        jax.jit(
+            _pir_sharded_sm(
+                mesh, nu, subtree_levels, chunk_rows, n_chunks, backend
+            )
         )
     )

@@ -273,6 +273,21 @@ def render(stats: dict, hists: dict,
              "Host-repack LRU misses.")
     w.sample(f"{ns}_keycache_misses_total", None, kc["misses"])
 
+    pir = stats.get("pir")
+    if pir is not None:
+        w.family(f"{ns}_pir_queries_total", "counter",
+                 "PIR queries answered across registered databases.")
+        w.sample(f"{ns}_pir_queries_total", None, pir["queries"])
+        w.family(f"{ns}_pir_scans_total", "counter",
+                 "Full-database PIR scan dispatches (coalesced query "
+                 "batches count once).")
+        w.sample(f"{ns}_pir_scans_total", None, pir["scans"])
+        w.family(f"{ns}_pir_bytes_scanned_total", "counter",
+                 "Database bytes read by PIR scans (padded resident "
+                 "bytes per scan).")
+        w.sample(f"{ns}_pir_bytes_scanned_total", None,
+                 pir["bytes_scanned"])
+
     phases = stats.get("phases", {})
     w.family(f"{ns}_phase_seconds_total", "counter",
              "Cumulative wall seconds per request phase.")
@@ -322,6 +337,15 @@ def render(stats: dict, hists: dict,
     w.sample(f"{ns}_mesh_shards", None,
              stats.get("mesh", {}).get("shards", 0))
 
+    if pir is not None:
+        w.family(f"{ns}_pir_dbs_resident", "gauge",
+                 "PIR databases resident in device HBM.")
+        w.sample(f"{ns}_pir_dbs_resident", None, pir["dbs_resident"])
+        w.family(f"{ns}_pir_db_bytes_resident", "gauge",
+                 "Padded database bytes resident across PIR databases.")
+        w.sample(f"{ns}_pir_db_bytes_resident", None,
+                 pir["db_bytes_resident"])
+
     mem = device_memory_gauges() if device_mem is None else device_mem
     if mem:
         w.family(f"{ns}_device_memory_bytes", "gauge",
@@ -345,5 +369,10 @@ def render(stats: dict, hists: dict,
     w.family(f"{ns}_coalesce_size", "histogram",
              "Key-rows coalesced per device dispatch.")
     w.histogram(f"{ns}_coalesce_size", None, hists["coalesce_size"])
+    if pir is not None:
+        w.family(f"{ns}_pir_scan_chunks", "histogram",
+                 "Streamed chunk dispatches per PIR scan (1 = one-shot "
+                 "scan; more = database past DPF_TPU_PIR_DB_CHUNK_BYTES).")
+        w.histogram(f"{ns}_pir_scan_chunks", None, pir["scan_chunks"])
 
     return w.text()

@@ -59,14 +59,36 @@ Endpoints (all POST, binary bodies, profile/params in the query string):
            apps/aggregation.py).  The body is read AND folded in
            DPF_TPU_AGG_CHUNK_BYTES chunks — a million-client upload
            never materializes on host.
+  /v1/pir/db?name=X&rows=N&row_bytes=B[&profile=fast]
+        body: N rows x B bytes — register (or replace) a named PIR
+        database (apps/pir_store.py).  The body is read off the socket
+        in DPF_TPU_PIR_DB_CHUNK_BYTES chunks straight into the packed
+        host buffer; the rows then live device-resident — sharded over
+        the chip mesh's HBM when DPF_TPU_MESH resolves — until replaced.
+        Replies JSON {name, rows, row_bytes, log_n, db_bytes, shards,
+        stream_chunks}.  The DB is PUBLIC protocol data (both PIR
+        servers hold identical copies); the query is the secret.
+  /v1/pir/query?db=X&k=K                      body: K concatenated DPF
+        keys (the database's profile) -> K rows x row_bytes answer
+        bytes: each query's XOR of the selected database rows, computed
+        as chunked int8/int32 MXU matmuls over the resident DB
+        (models/pir.py).  XOR the two servers' replies to reconstruct
+        the rows.  Concurrent queries coalesce into ONE
+        selection-matrix matmul (the scan cost is the database pass,
+        so batch-mates ride it as extra MXU rows); databases past
+        DPF_TPU_PIR_DB_CHUNK_BYTES answer through the streamed chunk
+        scan, byte-identically.
   /v1/warmup                                  body: JSON
         {"shapes": [{"route": "points"|"dcf_points"|"dcf_interval"|
-        "evalfull"|"hh_level"|"agg_xor"|"agg_add", "profile":
+        "evalfull"|"hh_level"|"agg_xor"|"agg_add"|"pir", "profile":
         "compat"|"fast", "log_n": N, "k": K,
         "q": Q}, ...]} — compile the dispatch plans for those shapes NOW
         (core/plans.py) so first-request compile never lands on user
         traffic.  An evalfull spec with "stream": true also warms the
-        streaming pipeline's per-chunk executables (distinct compiles).
+        streaming pipeline's per-chunk executables (distinct compiles);
+        a pir spec names a REGISTERED database ({"route": "pir", "db":
+        name, "k": K} — log_n/profile come from the registry) and warms
+        its scan executables for the current mesh regime.
         Replies JSON with per-shape compile seconds.
   /healthz                                    -> "ok" (liveness ONLY:
         200 while the process serves, regardless of breaker/warmup)
@@ -170,8 +192,10 @@ from .obs import trace as obs_trace
 from .serving import Batcher, IntervalWork, KeyCache, PointsWork, faults
 from .serving.batcher import (
     HHWork,
+    PirWork,
     dispatch_hh,
     dispatch_interval,
+    dispatch_pir,
     dispatch_points,
 )
 from .serving.breaker import CircuitBreaker, is_transient
@@ -396,6 +420,7 @@ class _ServingState:
         counters can never be torn against each other mid-update.
         /v1/metrics renders from this same snapshot, so the two surfaces
         cannot drift."""
+        from .apps import pir_store
         from .parallel import serving_mesh
 
         with self.stats_lock:
@@ -409,6 +434,7 @@ class _ServingState:
                 "degraded": self.degraded(),
                 "trace": self.tracer.stats(),
                 "mesh": serving_mesh.stats(),
+                "pir": pir_store.registry().stats(),
             }
         plan = faults.active()
         if plan is not None:
@@ -765,6 +791,130 @@ class _Handler(BaseHTTPRequestHandler):
             faults.fire("reply.write")
             self._reply(200, carry.astype("<u4").tobytes())
 
+    def _pir_db_load(self, q: dict, st, trace):
+        """POST /v1/pir/db?name=X&rows=N&row_bytes=B[&profile=] —
+        register a named device-resident PIR database
+        (apps/pir_store.py).  The body is read off the socket in
+        DPF_TPU_PIR_DB_CHUNK_BYTES chunks straight into the packed host
+        buffer (one copy, no giant intermediate bytes object), with
+        deadline checkpoints between chunks; the same framing guard as
+        /v1/agg/submit closes the connection when an error leaves body
+        bytes unread.  On success the database is placed resident for
+        the CURRENT mesh regime, so query traffic never pays the
+        device transfer."""
+        from .apps import pir_store
+
+        clen = int(self.headers.get("Content-Length", 0))
+        consumed = 0
+        try:
+            name = q.get("name", "")
+            pir_store.validate_name(name)  # BEFORE reading a byte
+            profile = q.get("profile", "compat")
+            if profile not in ("compat", "fast"):
+                raise ValueError(f"unknown profile {profile!r}")
+            rows, row_bytes = int(q["rows"]), int(q["row_bytes"])
+            if rows <= 0 or row_bytes <= 0:
+                raise ValueError("rows and row_bytes must be positive")
+            if row_bytes % 4:
+                raise ValueError("row_bytes must be a multiple of 4")
+            if clen != rows * row_bytes:
+                raise ValueError(
+                    f"body must be {rows}*{row_bytes} bytes of row data"
+                )
+            deadline = _deadline_from(self.headers)
+            if trace is not None:
+                trace.set_attrs(db=name, rows=rows, row_bytes=row_bytes)
+            # Breaker admission before the buffer and the read loop: a
+            # wedged/recovering device must shed a multi-GB upload (and
+            # its residency placement) exactly like any other dispatch.
+            with obs_trace.maybe_span(trace, "admission"):
+                st.breaker.admit()
+            db = np.empty((rows, row_bytes), np.uint8)
+            step = pir_store.upload_chunk_rows(row_bytes)
+            done = 0
+            while done < rows:
+                if deadline is not None and (
+                    time.perf_counter() >= deadline
+                ):
+                    where = "queue" if consumed == 0 else "flight"
+                    st.batcher.note_expired(where)
+                    raise DeadlineError(
+                        "deadline expired mid-upload", where=where
+                    )
+                take = min(step, rows - done)
+                # The socket read accounts to "pack" (host marshalling),
+                # like the agg upload — a slow uploader must never spike
+                # the device-health phases.
+                with st.phase("pack"):
+                    faults.fire("pir.db_load")
+                    buf = self.rfile.read(take * row_bytes)
+                    if len(buf) != take * row_bytes:
+                        raise ValueError("upload truncated mid-chunk")
+                    consumed += len(buf)
+                    db[done : done + take] = np.frombuffer(
+                        buf, np.uint8
+                    ).reshape(take, row_bytes)
+                done += take
+            entry = pir_store.registry().load(name, db, profile=profile)
+        except BaseException:
+            if consumed != clen:
+                # Unread upload bytes would misframe the next pipelined
+                # request: close instead of replying over them.
+                self.close_connection = True
+            raise
+        # Place residency NOW (sharded over the mesh when resolved), so
+        # the first query pays neither transfer nor layout.
+        shards = entry.dispatch_shards()
+        srv = entry.server(shards)
+        info = {
+            "name": entry.name,
+            "rows": entry.n_rows,
+            "row_bytes": entry.row_bytes,
+            "log_n": entry.log_n,
+            "profile": entry.profile,
+            "db_bytes": entry.db_bytes,
+            "shards": shards,
+            "stream_chunks": srv.stream_chunks,
+        }
+        with st.phase("reply"), obs_trace.maybe_span(trace, "reply"):
+            faults.fire("reply.write")
+            self._reply(200, json.dumps(info).encode(), "application/json")
+
+    def _pir_query(self, q: dict, body: bytes, st, trace):
+        """POST /v1/pir/query?db=X&k=K — answer K PIR queries against a
+        registered database through the batcher lane (concurrent
+        queries coalesce into one selection-matrix matmul over the
+        resident rows)."""
+        from .apps import pir_store
+
+        name = q["db"]  # KeyError -> 400 missing parameter
+        try:
+            db = pir_store.registry().get(name)
+        except KeyError as e:
+            raise ValueError(str(e.args[0])) from None
+        k = int(q["k"])
+        _, key_len, batch_cls = _profile_api(db.profile)
+        kl = key_len(db.log_n)
+        if len(body) != k * kl:
+            raise ValueError(f"body must be {k}*{kl} key bytes")
+        deadline = _deadline_from(self.headers)
+        if trace is not None:
+            trace.set_attrs(profile=db.profile, log_n=db.log_n, db=db.name)
+        with st.phase("pack"), st._mesh_ctx():
+            kb = st.keys.get(
+                db.profile, db.log_n, bytes(body),
+                lambda: batch_cls.from_bytes(
+                    [bytes(body[i * kl : (i + 1) * kl]) for i in range(k)],
+                    db.log_n,
+                ),
+            )
+        rows = st.run(
+            PirWork(db, kb, deadline=deadline, trace=trace), dispatch_pir
+        )
+        with st.phase("reply"), obs_trace.maybe_span(trace, "reply"):
+            faults.fire("reply.write")
+            self._reply(200, np.ascontiguousarray(rows).tobytes())
+
     def _profile_request(self, body: bytes):
         """POST /v1/profile: knob-gated, duration-bounded XProf capture
         (obs/profile.py).  Body: ``{"action": "start"|"stop"|"status"
@@ -816,6 +966,15 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 self._agg_submit(q, st, trace)
                 return
+            if route == "/v1/pir/db":
+                # The other streamed upload: database rows read in
+                # DPF_TPU_PIR_DB_CHUNK_BYTES chunks into the packed
+                # host buffer (apps/pir_store.py).
+                trace = st.tracer.begin(
+                    self.headers.get(TRACE_HEADER), route
+                )
+                self._pir_db_load(q, st, trace)
+                return
             body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
 
             if route == "/v1/warmup":
@@ -847,6 +1006,13 @@ class _Handler(BaseHTTPRequestHandler):
             # DPF_TPU_TRACE=off): id from the client's X-DPF-Trace
             # header, or generated here at ingress.
             trace = st.tracer.begin(self.headers.get(TRACE_HEADER), route)
+
+            if route == "/v1/pir/query":
+                # Profile and domain come from the registered database,
+                # not the query string — handled before the generic
+                # profile/log_n parsing below.
+                self._pir_query(q, body, st, trace)
+                return
 
             profile = q.get("profile", "compat")
             api, key_len, batch_cls = _profile_api(profile)

@@ -9,7 +9,7 @@ deterministically testable on CPU.  All of it sits BETWEEN
 dpf_tpu/server.py and the plan cache (core/plans.py); the evaluators
 themselves are untouched."""
 
-from .batcher import Batcher, HHWork, IntervalWork, PointsWork
+from .batcher import Batcher, HHWork, IntervalWork, PirWork, PointsWork
 from .breaker import CircuitBreaker
 from .errors import (
     DeadlineError, OverloadedError, ServingError, ShedError,
@@ -17,7 +17,7 @@ from .errors import (
 from .keycache import KeyCache
 
 __all__ = [
-    "Batcher", "PointsWork", "IntervalWork", "HHWork", "KeyCache",
-    "CircuitBreaker", "ServingError", "ShedError", "OverloadedError",
-    "DeadlineError",
+    "Batcher", "PointsWork", "IntervalWork", "HHWork", "PirWork",
+    "KeyCache", "CircuitBreaker", "ServingError", "ShedError",
+    "OverloadedError", "DeadlineError",
 ]

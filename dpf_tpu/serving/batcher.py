@@ -152,6 +152,39 @@ class HHWork:
         return ("hh_level", self.profile, self.kb.log_n, self.level)
 
 
+@dataclass
+class PirWork:
+    """One PIR query request: K query keys against one registered
+    database (the /v1/pir/query body).  The lane keys on the DB OBJECT
+    (``id``), not just its name: concurrent queries against the same
+    database generation coalesce into ONE selection-matrix matmul — the
+    whole-database scan is the dispatch cost, so coalesced queries ride
+    it for free (extra MXU rows) — while a re-registered database (same
+    name, new rows) never coalesces with queries still holding the old
+    generation (``dispatch_pir`` answers a batch from one entry; mixing
+    generations would answer some queries from the wrong rows)."""
+
+    db: object  # apps.pir_store.PirDB
+    kb: object
+    deadline: float | None = None
+    trace: object = None
+    queue_wait: float = 0.0
+    dispatch_s: float = 0.0
+    coalesced: int = 0
+
+    @property
+    def n_keys(self) -> int:
+        return int(self.kb.k)
+
+    @property
+    def lane(self) -> tuple:
+        # id() is safe as the generation token: every queued work holds
+        # a reference to ITS entry, so two live generations can never
+        # share an address.
+        return ("pir", self.db.name, id(self.db), self.db.profile,
+                self.db.log_n)
+
+
 def _concat_key_batches(batches: list):
     """Concatenate same-class struct-of-arrays key batches on the key
     axis (field order: log_n, then the arrays — true of KeyBatch,
@@ -230,6 +263,23 @@ def dispatch_hh(items: list[HHWork]) -> list[np.ndarray]:
         items[0].profile, merged_kb, _merged_queries(items), items[0].level
     )
     return _slice_rows(words, items)
+
+
+def dispatch_pir(items: list[PirWork]) -> list[np.ndarray]:
+    """Lane dispatcher for the PIR query route -> per-item answer rows
+    uint8[K_i, row_bytes].  One coalesced batch is ONE plan-cached scan
+    of the resident database (same DB by lane construction)."""
+    faults.fire("dispatch.pir")
+    if len(items) == 1:
+        it = items[0]
+        return [plans.run_pir(it.db, it.kb)]
+    merged_kb = _concat_key_batches([it.kb for it in items])
+    rows = plans.run_pir(items[0].db, merged_kb)
+    out, off = [], 0
+    for it in items:
+        out.append(np.ascontiguousarray(rows[off : off + it.n_keys]))
+        off += it.n_keys
+    return out
 
 
 def dispatch_interval(items: list[IntervalWork]) -> list[np.ndarray]:

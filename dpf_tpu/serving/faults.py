@@ -16,6 +16,12 @@ with no active plan is one ``is None`` check):
                      cache runs
   dispatch.interval  the DCF interval lane dispatcher
   dispatch.evalfull  the blocking /v1/evalfull[_batch] dispatch
+  dispatch.hh        the heavy-hitters round lane dispatcher
+  dispatch.agg       each streamed /v1/agg/submit fold-chunk dispatch
+  dispatch.pir       the PIR query lane dispatcher (serving/batcher.
+                     dispatch_pir), before the plan-cached scan
+  pir.db_load        once per socket-read chunk of a /v1/pir/db upload,
+                     before the chunk lands in the packed host buffer
   stream.chunk       once per chunk of a streamed /v1/evalfull, before
                      the chunk's bytes go onto the socket
   reply.write        the points reply marshalling (slow-client stand-in)
@@ -66,6 +72,8 @@ SITES = (
     "dispatch.evalfull",
     "dispatch.hh",
     "dispatch.agg",
+    "dispatch.pir",
+    "pir.db_load",
     "stream.chunk",
     "reply.write",
 )

@@ -18,7 +18,10 @@
 #       (now incl. the protocol-applications layer, tests/test_apps.py —
 #       heavy-hitters recovery + the 10^5-key plan-cached acceptance run,
 #       aggregation fold differentials, hh/agg wire identity,
-#       deadline/shed on the hh route):
+#       deadline/shed on the hh route — and the served-PIR suite,
+#       tests/test_pir_serving.py — registry/run_pir/native byte
+#       identity, the streamed chunk scan, mesh dispatch + degraded
+#       fallback, the /v1/pir/* wire):
 #       the Pallas kernel suites (fused + walk + expand routes, interpret
 #       mode), the S-box circuit invariants, the packed<->unpacked
 #       output differentials (every packed route vs its byte-per-bit twin
@@ -65,6 +68,7 @@ elif [ "${1:-}" = "--fast" ]; then
       tests/test_packed.py tests/test_serving.py tests/test_obs.py \
       tests/test_serving_stress.py tests/test_analysis.py \
       tests/test_oblivious.py tests/test_apps.py \
+      tests/test_pir_serving.py \
       -q -m 'not slow' "$@"
 else
   # -m is last-wins in pytest, so a caller-supplied -m overrides ours.

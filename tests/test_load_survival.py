@@ -847,3 +847,88 @@ def test_queue_wait_peak_resets_per_window():
     b.reset_peak()
     st = b.stats_dict()
     assert st["queue_wait_max_ms"] == 0.0 and st["requests"] == 7
+
+
+# ---------------------------------------------------------------------------
+# Served PIR under faults: the dispatch.pir + pir.db_load seams
+# ---------------------------------------------------------------------------
+
+
+def _pir_fixture_db(base, name, seed=9, n_rows=300, row_bytes=8):
+    """Register a PIR database and return (db, query-key bytes)."""
+    from dpf_tpu.models.pir import pir_query
+
+    rng = np.random.default_rng(seed)
+    db = rng.integers(0, 256, size=(n_rows, row_bytes), dtype=np.uint8)
+    info = json.loads(
+        _post(
+            f"{base}/v1/pir/db?name={name}&rows={n_rows}"
+            f"&row_bytes={row_bytes}&profile=fast",
+            db.tobytes(),
+        )
+    )
+    assert info["name"] == name
+    qa, _ = pir_query(
+        rng.integers(0, n_rows, size=2, dtype=np.uint64),
+        n_rows, rng=rng, profile="fast",
+    )
+    return db, b"".join(qa.to_bytes())
+
+
+def test_pir_dispatch_faults_surface_structured(server_factory):
+    """An injected failure at the dispatch.pir seam surfaces exactly
+    like any other dispatch failure: non-transient -> 400, transient
+    UNAVAILABLE -> breaker-classified 503 with Retry-After — and a
+    cleared fault leaves the route byte-identically healthy."""
+    from dpf_tpu.apps import pir_store
+
+    pir_store.reset()
+    try:
+        base = server_factory()
+        _, keys = _pir_fixture_db(base, "flt")
+        path = f"{base}/v1/pir/query?db=flt&k=2"
+        healthy = _post(path, keys)
+        with faults.injected("dispatch.pir:error:times=1"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(path, keys)
+            assert ei.value.code == 400
+            assert json.loads(ei.value.read())["code"] == "bad_request"
+        with faults.injected("dispatch.pir:unavailable"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(path, keys)
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After") is not None
+        assert _post(path, keys) == healthy
+    finally:
+        pir_store.reset()
+
+
+def test_pir_db_load_fault_fails_upload_cleanly(server_factory):
+    """A failure mid-upload at pir.db_load must refuse the registration
+    (no half-loaded database can ever answer) and leave the sidecar
+    healthy for the retry."""
+    from dpf_tpu.apps import pir_store
+
+    pir_store.reset()
+    try:
+        # 1024-byte read chunks -> the 2400-byte body takes 3 chunks;
+        # after=1 fires the fault on the second.
+        base = server_factory(DPF_TPU_PIR_DB_CHUNK_BYTES="1024")
+        rng = np.random.default_rng(11)
+        db = rng.integers(0, 256, size=(300, 8), dtype=np.uint8)
+        url = f"{base}/v1/pir/db?name=up&rows=300&row_bytes=8&profile=fast"
+        with faults.injected("pir.db_load:error:after=1"):
+            with pytest.raises(
+                (urllib.error.HTTPError, urllib.error.URLError,
+                 ConnectionError)
+            ):
+                _post(url, db.tobytes())
+        # The failed upload never registered.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(f"{base}/v1/pir/query?db=up&k=1", b"")
+        assert ei.value.code == 400
+        # A clean retry succeeds end to end.
+        info = json.loads(_post(url, db.tobytes()))
+        assert info["rows"] == 300
+    finally:
+        pir_store.reset()

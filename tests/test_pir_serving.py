@@ -1,0 +1,304 @@
+"""Served 2-server PIR (DESIGN §15): registry residency, the run_pir
+plan route, the streamed chunk scan, and the /v1/pir/* wire.
+
+The contract: served answers == the library ``PirServer.answer`` == the
+spec-level native baseline (per-key expansion + host XOR of selected
+rows), byte for byte, in both profiles, single-device AND on the
+8-virtual-device mesh; the steady state performs zero retraces after
+warmup (``plans.trace_count`` counts the PIR executables through
+``models.pir.PIR_JITS``); and a database strictly larger than
+``DPF_TPU_PIR_DB_CHUNK_BYTES`` answers correctly — and identically —
+through the streamed chunk scan.
+
+Every compat-profile test here shares the log_n=9 K/Q-bucket-32 jit
+shape family with tests/test_apps.py and tests/test_serving_mesh.py, so
+under tier-1 this file adds only the PIR executables' compiles.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpf_tpu.apps import pir_store
+from dpf_tpu.core import plans
+from dpf_tpu.models.pir import PirServer, pir_query, pir_reconstruct
+from dpf_tpu.parallel import serving_mesh
+
+_LOG_N = 9  # compat: 300 rows pads to dom 512; fast: same domain
+
+
+def _native_rows(db: np.ndarray, kb, profile: str) -> np.ndarray:
+    """Spec-level one-server baseline: per-key full-domain expansion
+    (core/spec or core/chacha_np — the line-verified references) + host
+    XOR of the rows whose selection bit is set."""
+    if profile == "fast":
+        from dpf_tpu.core import chacha_np as ref
+    else:
+        from dpf_tpu.core import spec as ref
+
+    out = np.zeros((kb.k, db.shape[1]), np.uint8)
+    for i, key in enumerate(kb.to_bytes()):
+        shares = np.frombuffer(ref.eval_full(key, kb.log_n), np.uint8)
+        bits = np.unpackbits(shares, bitorder="little")[: db.shape[0]]
+        for r in np.nonzero(bits)[0]:
+            out[i] ^= db[r]
+    return out
+
+
+def _db_and_queries(profile: str, seed: int, n_rows=300, row_bytes=8, k=4):
+    rng = np.random.default_rng(seed)
+    db = rng.integers(0, 256, size=(n_rows, row_bytes), dtype=np.uint8)
+    idx = rng.integers(0, n_rows, size=k, dtype=np.uint64)
+    qa, qb = pir_query(idx, n_rows, rng=rng, profile=profile)
+    return db, idx, qa, qb
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    pir_store.reset()
+    yield
+    pir_store.reset()
+
+
+# ---------------------------------------------------------------------------
+# Library / plan-route identity against the native baseline
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("profile", ["compat", "fast"])
+def test_run_pir_matches_library_and_native(profile):
+    db, idx, qa, qb = _db_and_queries(profile, seed=31)
+    entry = pir_store.registry().load("t", db, profile=profile)
+    served_a = plans.run_pir(entry, qa)
+    served_b = plans.run_pir(entry, qb)
+    lib = PirServer(db, profile=profile)
+    np.testing.assert_array_equal(served_a, lib.answer(qa))
+    np.testing.assert_array_equal(served_a, _native_rows(db, qa, profile))
+    np.testing.assert_array_equal(
+        pir_reconstruct(served_a, served_b), db[idx.astype(np.int64)]
+    )
+    stats = pir_store.registry().stats()
+    assert stats["dbs_resident"] == 1
+    assert stats["queries"] == 2 * qa.k
+    assert stats["bytes_scanned"] == 2 * entry.db_bytes
+
+
+def test_run_pir_zero_retrace_after_warmup():
+    db, _, qa, _ = _db_and_queries("fast", seed=37)
+    pir_store.registry().load("warm", db, profile="fast")
+    entry = pir_store.registry().get("warm")
+    plans.warmup([{"route": "pir", "db": "warm", "k": qa.k}])
+    tc0 = plans.trace_count()
+    for _ in range(3):
+        plans.run_pir(entry, qa)
+    assert plans.trace_count() == tc0, "pir hit path retraced"
+
+
+def test_run_pir_domain_mismatch_and_unknown_db():
+    db, _, qa, _ = _db_and_queries("fast", seed=41)
+    entry = pir_store.registry().load("d", db, profile="fast")
+    big_qa, _ = pir_query([1], 4096, profile="fast")
+    with pytest.raises(ValueError, match="domain"):
+        plans.run_pir(entry, big_qa)
+    with pytest.raises(KeyError, match="unknown db"):
+        pir_store.registry().get("nope")
+
+
+# ---------------------------------------------------------------------------
+# Streamed chunk scan: DB strictly larger than DPF_TPU_PIR_DB_CHUNK_BYTES
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("profile", ["compat", "fast"])
+def test_streamed_scan_byte_identical(profile, monkeypatch):
+    # dom 512 x 8 B = 4096 resident bytes; a 1024-byte ceiling forces a
+    # 4-chunk streamed scan (128-row slabs).
+    monkeypatch.setenv("DPF_TPU_PIR_DB_CHUNK_BYTES", "1024")
+    db, idx, qa, qb = _db_and_queries(profile, seed=43)
+    streamed = PirServer(db, profile=profile)
+    assert streamed.stream_chunks == 4
+    one_shot = PirServer(db, profile=profile, db_chunk_bytes=0)
+    assert one_shot.stream_chunks == 1
+    ans = streamed.answer(qa)
+    np.testing.assert_array_equal(ans, one_shot.answer(qa))
+    np.testing.assert_array_equal(
+        pir_reconstruct(ans, streamed.answer(qb)), db[idx.astype(np.int64)]
+    )
+
+
+def test_chunk_rows_auto_rounds():
+    # 300 is not a divisor of any pow2 domain: the old hard ValueError is
+    # now an auto-round down to 256 — same answer, different schedule.
+    db, idx, qa, qb = _db_and_queries("fast", seed=47)
+    srv = PirServer(db, profile="fast", chunk_rows=300)
+    assert srv.chunk_rows == 256
+    np.testing.assert_array_equal(
+        pir_reconstruct(srv.answer(qa), srv.answer(qb)),
+        db[idx.astype(np.int64)],
+    )
+    tiny = PirServer(db, profile="fast", chunk_rows=1)
+    assert tiny.chunk_rows == 128  # floor: one packed leaf word group
+    np.testing.assert_array_equal(tiny.answer(qa), srv.answer(qa))
+
+
+# ---------------------------------------------------------------------------
+# Mesh: sharded residency, degraded fallback (needs the 8-device mesh)
+# ---------------------------------------------------------------------------
+
+
+needs_mesh = pytest.mark.skipif(
+    __import__("jax").device_count() < 8, reason="needs 8 (virtual) devices"
+)
+
+
+@needs_mesh
+def test_streamed_scan_sharded_byte_identical(monkeypatch):
+    # fast log_n=12 (nu=3): (2 keys x 4 leaf) mesh, 8-chunk streamed scan
+    # per shard — sharded+streamed must equal single-device one-shot.
+    from dpf_tpu.parallel import make_mesh
+
+    monkeypatch.setenv("DPF_TPU_PIR_DB_CHUNK_BYTES", "1024")
+    db, idx, qa, qb = _db_and_queries("fast", seed=53, n_rows=3000, k=3)
+    mesh = make_mesh(2, 4)
+    sharded = PirServer(db, mesh=mesh, profile="fast")
+    assert sharded.stream_chunks > 1
+    one_shot = PirServer(db, profile="fast", db_chunk_bytes=0)
+    ans = sharded.answer(qa)
+    np.testing.assert_array_equal(ans, one_shot.answer(qa))
+    np.testing.assert_array_equal(
+        pir_reconstruct(ans, sharded.answer(qb)), db[idx.astype(np.int64)]
+    )
+
+
+@needs_mesh
+def test_mesh_dispatch_and_degraded_fallback(monkeypatch):
+    """With the serving mesh on, run_pir shards the database rows over a
+    leaf mesh on the same chips (plan key mesh > 0); inside
+    ``serving_mesh.suspended()`` (the breaker's degraded override) the
+    same call answers byte-identically on a single device (mesh 0)."""
+    monkeypatch.setenv("DPF_TPU_MESH", "on")
+    monkeypatch.setenv("DPF_TPU_MESH_DEVICES", "0")
+    serving_mesh.reset()
+    try:
+        # fast log_n=12 -> nu=3 -> 8 leaf shards fit (2^3).
+        db, idx, qa, qb = _db_and_queries("fast", seed=59, n_rows=3000, k=3)
+        entry = pir_store.registry().load("m", db, profile="fast")
+        assert entry.dispatch_shards() == 8
+        sharded = plans.run_pir(entry, qa)
+        with serving_mesh.suspended():
+            assert entry.dispatch_shards() == 0
+            single = plans.run_pir(entry, qa)
+        np.testing.assert_array_equal(sharded, single)
+        np.testing.assert_array_equal(
+            pir_reconstruct(sharded, plans.run_pir(entry, qb)),
+            db[idx.astype(np.int64)],
+        )
+        mesh_keys = {k.mesh for k in plans.cache()._plans if k.route == "pir"}
+        assert {0, 8} <= mesh_keys
+        # Tiny domains floor the shard count to what the subtrees allow:
+        # log_n=9 fast has nu=0 — no leaf axis, single-device dispatch.
+        db2, _, qa2, _ = _db_and_queries("fast", seed=61)
+        entry2 = pir_store.registry().load("tiny", db2, profile="fast")
+        assert entry2.dispatch_shards() == 0
+        plans.run_pir(entry2, qa2)
+    finally:
+        serving_mesh.reset()
+
+
+# ---------------------------------------------------------------------------
+# The sidecar: /v1/pir/db chunked upload + /v1/pir/query wire identity
+# ---------------------------------------------------------------------------
+
+
+def _post(url, body=b""):
+    req = urllib.request.Request(url, data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.read()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=60) as r:
+        return r.read()
+
+
+@pytest.fixture()
+def pir_srv(monkeypatch):
+    # A small upload chunk so the /v1/pir/db body crosses the socket in
+    # multiple reads (the streamed-upload path), and a small scan chunk
+    # ceiling so served queries ride the streamed chunk scan.
+    monkeypatch.setenv("DPF_TPU_PIR_DB_CHUNK_BYTES", "1024")
+    from dpf_tpu import server as srv_mod
+
+    srv_mod.reset_serving_state()
+    s = srv_mod.serve(port=0)
+    yield f"http://127.0.0.1:{s.server_address[1]}"
+    s.shutdown()
+    srv_mod.reset_serving_state()
+
+
+def test_http_pir_wire_identity(pir_srv):
+    db, idx, qa, qb = _db_and_queries("fast", seed=67)
+    info = json.loads(
+        _post(
+            f"{pir_srv}/v1/pir/db?name=wire&rows={db.shape[0]}"
+            f"&row_bytes={db.shape[1]}&profile=fast",
+            db.tobytes(),
+        )
+    )
+    assert info["rows"] == db.shape[0] and info["log_n"] == _LOG_N
+    assert info["stream_chunks"] == 4  # 4096 resident bytes / 1024
+    _post(
+        f"{pir_srv}/v1/warmup",
+        json.dumps({"shapes": [{"route": "pir", "db": "wire",
+                                "k": qa.k}]}).encode(),
+    )
+    ans = {}
+    for party, kb in (("a", qa), ("b", qb)):
+        reply = _post(
+            f"{pir_srv}/v1/pir/query?db=wire&k={kb.k}",
+            b"".join(kb.to_bytes()),
+        )
+        ans[party] = np.frombuffer(reply, np.uint8).reshape(kb.k, -1)
+    # Served == library == reconstructs the exact rows.
+    lib = PirServer(db, profile="fast", db_chunk_bytes=0)
+    np.testing.assert_array_equal(ans["a"], lib.answer(qa))
+    np.testing.assert_array_equal(
+        pir_reconstruct(ans["a"], ans["b"]), db[idx.astype(np.int64)]
+    )
+    # Observability: the pir block reaches /v1/stats and /v1/metrics.
+    stats = json.loads(_get(f"{pir_srv}/v1/stats"))
+    assert stats["pir"]["dbs_resident"] == 1
+    assert stats["pir"]["scans"] >= 2
+    from dpf_tpu.obs import promtext
+
+    scrape = promtext.parse(_get(f"{pir_srv}/v1/metrics").decode())
+    assert scrape.value("dpf_pir_dbs_resident") == 1.0
+    assert scrape.value("dpf_pir_queries_total") >= 2 * qa.k
+
+
+def test_http_pir_validation_errors(pir_srv):
+    db, _, qa, _ = _db_and_queries("fast", seed=71)
+    # Unknown db -> 400 with a structured body.
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{pir_srv}/v1/pir/query?db=ghost&k=1",
+              b"".join(qa.to_bytes())[:1])
+    assert ei.value.code == 400
+    assert json.loads(ei.value.read())["code"] == "bad_request"
+    # Bad body length on the upload -> 400.
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{pir_srv}/v1/pir/db?name=x&rows=10&row_bytes=8", b"short")
+    assert ei.value.code == 400
+    # row_bytes not a multiple of 4 -> 400.
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{pir_srv}/v1/pir/db?name=x&rows=1&row_bytes=6", b"6bytes")
+    assert ei.value.code == 400
+    # Bad db name -> 400.
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(f"{pir_srv}/v1/pir/db?name=bad%20name&rows=1&row_bytes=4",
+              b"4byt")
+    assert ei.value.code == 400
