@@ -56,6 +56,7 @@ import time
 import numpy as np
 
 from dpf_tpu.analysis import LINT_SUITE_VERSION
+from dpf_tpu.analysis.perf import PERF_CONTRACT_VERSION
 from dpf_tpu.analysis.trace import OBLIVIOUS_VERIFIER_VERSION
 from dpf_tpu.core import knobs
 from dpf_tpu.serving.breaker import TRANSIENT_SIGNATURES
@@ -146,6 +147,7 @@ def _ledger_key(scale: str) -> dict:
             "knobs": knobs.snapshot(_ROUTE_KNOBS),
             "lint": LINT_SUITE_VERSION,
             "oblivious": OBLIVIOUS_VERIFIER_VERSION,
+            "perf": PERF_CONTRACT_VERSION,
         }
     try:
         rp = subprocess.run(
@@ -174,6 +176,10 @@ def _ledger_key(scale: str) -> dict:
         # ...and which obliviousness discipline (docs/OBLIVIOUS.md)
         # certified the routes the measured dispatches ran on.
         "oblivious": OBLIVIOUS_VERIFIER_VERSION,
+        # ...and which performance-contract discipline
+        # (docs/PERF_CONTRACTS.md) pinned their collective/donation/
+        # dispatch budgets — a budget change re-measures.
+        "perf": PERF_CONTRACT_VERSION,
     }
 
 

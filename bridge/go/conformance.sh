@@ -27,12 +27,23 @@ go vet ./...
 
 # staticcheck is a stronger linter than vet (unused results, API misuse,
 # simplifications); like the -race lane it is part of the discipline
-# when the toolchain has it, and a loud skip when it does not.
+# when the toolchain has it, and a loud skip when it does not.  The
+# version is PINNED (CI installs exactly this one): an unpinned
+# staticcheck makes the lane's verdict drift with whatever version a
+# machine happens to have — new checks appear, old ones retire, and the
+# same tree flips red/green across machines.
+STATICCHECK_PIN="2023.1.7"
 if command -v staticcheck >/dev/null 2>&1; then
+  if ! staticcheck -version 2>/dev/null | grep -q "$STATICCHECK_PIN"; then
+    echo "conformance.sh: staticcheck version is not the pinned" \
+         "$STATICCHECK_PIN ($(staticcheck -version 2>/dev/null)) —" \
+         "verdicts may differ from CI (go install" \
+         "honnef.co/go/tools/cmd/staticcheck@$STATICCHECK_PIN)" >&2
+  fi
   staticcheck ./...
 else
   echo "conformance.sh: staticcheck not installed; skipping" \
-       "(go install honnef.co/go/tools/cmd/staticcheck@latest)" >&2
+       "(go install honnef.co/go/tools/cmd/staticcheck@$STATICCHECK_PIN)" >&2
 fi
 
 PYTHONPATH="$(cd ../.. && pwd)" python -m dpf_tpu.server --port "$PORT" &

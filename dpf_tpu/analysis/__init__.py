@@ -1,14 +1,17 @@
 """Repo-native static analysis: the discipline the ROADMAP's production
 north star needs, checked on every commit for free.
 
-Four AST-based passes plus one jaxpr-level pass over the whole tree
+Five AST-based passes plus two jaxpr-level passes over the whole tree
 (one entrypoint: ``python -m dpf_tpu.analysis`` / ``scripts/lint_all.sh``;
 exits nonzero on any finding):
 
   knob-registry   every DPF_TPU_* env knob is declared once in
                   dpf_tpu/core/knobs.py and read only through it —
                   direct env reads and undeclared (typo'd) knob names
-                  are findings.
+                  are findings; on whole-tree scans, declared knobs no
+                  non-fixture module reads are findings too (dead knobs
+                  rot into documentation lies — ``# knob-unused-ok`` on
+                  the declaration is the reviewed escape hatch).
   secret-hygiene  key bytes / PRG seeds / correction words must never
                   flow into logging, f-strings in raised exceptions,
                   /v1/stats payloads, or bench ledgers (name-based
@@ -23,6 +26,13 @@ exits nonzero on any finding):
                   module's declared VMEM budget, and every jax.jit's
                   static/donate argnum specs are hashable literals
                   (no list/dict retrace hazards).
+  test-discipline the test surface stays wired: every test file named
+                  in a runtests.sh lane exists, the tier-1 ``tests/``
+                  glob lane is still present (so every on-disk test is
+                  reachable), every ``pytest.mark.*`` used under tests/
+                  is declared in pytest.ini (an undeclared marker makes
+                  ``-m`` selections silently skip nothing), and the
+                  collection-order hook's file references resolve.
   oblivious-trace the jaxpr-level oblivious-dataflow verifier
                   (``analysis/trace/``): every production route traced
                   to a ClosedJaxpr, the interprocedural taint lattice
@@ -32,6 +42,17 @@ exits nonzero on any finding):
                   vs the ops budget), and the resulting obliviousness
                   certificates (docs/OBLIVIOUS.md + docs/oblivious.json)
                   checked for drift against the committed tree.
+  perf-contract   the jaxpr-level performance-contract verifier
+                  (``analysis/perf/``): the SAME route traces (shared
+                  trace cache — each route traces once per lint run)
+                  checked against per-route declared resource budgets:
+                  collective census (one all-reduce per agg chunk / PIR
+                  query batch, zero elsewhere), donation surviving into
+                  the lowering with no live output copies, zero
+                  unsanctioned host callbacks, chunk indices as traced
+                  operands (no retrace bombs), plus a static FLOPs/HBM
+                  cost model — certificates in docs/PERF_CONTRACTS.md +
+                  docs/perf_contracts.json with the same drift policy.
 
 Each pass ships fixture files with seeded violations
 (``dpf_tpu/analysis/fixtures/``, excluded from real scans) and a test
@@ -48,17 +69,23 @@ from __future__ import annotations
 
 # Bump when a pass is added or materially tightened (bench ledgers keyed
 # on it re-measure).  "2": the oblivious-trace jaxpr verifier joined the
-# suite and host-sync grew the models/ + parallel/ scope.
-LINT_SUITE_VERSION = "2"
+# suite and host-sync grew the models/ + parallel/ scope.  "3": the
+# perf-contract verifier and the test-discipline pass joined, and
+# knob-registry grew unused-knob detection.
+LINT_SUITE_VERSION = "3"
 
 # name -> (module, callable); imported lazily so `import dpf_tpu.analysis`
-# stays cheap for the bench harness's version stamp.
+# stays cheap for the bench harness's version stamp.  Passes run in
+# sorted-name order, which puts oblivious-trace BEFORE perf-contract —
+# the first populates the shared trace cache the second reads.
 PASSES = {
     "knob-registry": ("dpf_tpu.analysis.knob_registry_pass", "run"),
     "secret-hygiene": ("dpf_tpu.analysis.secret_hygiene_pass", "run"),
     "host-sync": ("dpf_tpu.analysis.host_sync_pass", "run"),
     "pallas-jit": ("dpf_tpu.analysis.pallas_discipline_pass", "run"),
+    "test-discipline": ("dpf_tpu.analysis.test_discipline_pass", "run"),
     "oblivious-trace": ("dpf_tpu.analysis.trace_pass", "run"),
+    "perf-contract": ("dpf_tpu.analysis.perf_pass", "run"),
 }
 
 

@@ -1,0 +1,201 @@
+"""Seeded BUDGET-BUSTING toy dispatch bodies for the perf-contract
+verifier.
+
+Each entry is a miniature serving dispatch with exactly one
+performance-contract violation the resource model must catch — the
+regressions the budgets exist to stop:
+
+  * an EXTRA per-chunk all-reduce on a fold that budgets one (the
+    "someone added a second psum and halved agg throughput" regression),
+  * a collective moved INSIDE the chunk scan (one all-reduce per
+    iteration instead of per dispatch),
+  * a dropped donation (the jit lost its ``donate_argnums`` — steady
+    state silently re-allocates every carry),
+  * a donated carry returned as a live output (the caller's handle is
+    dead by the donation contract),
+  * a host callback inside a dispatch body,
+  * the chunk-index-as-Python-int retrace bomb (every chunk index
+    compiles its own executable — the zero-retrace-after-warmup
+    contract dies quietly).
+
+``PERF_FIXTURES`` is consumed by tests/test_analysis.py: each entry is
+``(name, build, expected_finding_kind)`` where ``build()`` returns
+``(closed_jaxpr, PerfContract)`` for ``perf.certify.check_route``.  The
+donation-site fixtures live in ``DONATION_FIXTURES``:
+``(name, site, expected_kind)`` for ``perf.certify.check_donation_site``.
+
+This file lives in ``dpf_tpu/analysis/fixtures/`` so it is EXCLUDED
+from the AST passes' default scans and never imported by production
+code — only the tests trace it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..perf.contracts import DonationSite, PerfContract
+
+
+def _carry_rows():
+    return (
+        jnp.zeros(64, jnp.uint32), jnp.zeros((8 * 32, 64), jnp.uint32),
+    )
+
+
+def _mesh8():
+    from ...parallel.sharding import make_mesh
+
+    return make_mesh(8, 1)
+
+
+def extra_allreduce_fold():
+    """A sharded XOR fold that all-reduces TWICE per chunk — the second
+    all_gather is pure waste the one-all-reduce budget must catch."""
+    from ...parallel.sharding import KEYS_AXIS, shard_map_compat, xor_allreduce
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mesh8()
+
+    def body(carry, rows):
+        local = jax.lax.reduce(rows, np.uint32(0), jax.lax.bitwise_xor, (0,))
+        once = xor_allreduce(local, KEYS_AXIS)
+        twice = xor_allreduce(once, KEYS_AXIS)  # the seeded extra reduce
+        return carry ^ twice
+
+    fn = shard_map_compat(
+        body, mesh=mesh, in_specs=(P(None), P(KEYS_AXIS, None)),
+        out_specs=P(None), check_vma=False,
+    )
+    closed = jax.make_jaxpr(fn)(*_carry_rows())
+    return closed, PerfContract(collectives={"all_gather": 1})
+
+
+def loop_allreduce_fold():
+    """The all-reduce moved INSIDE the chunk scan: one collective per
+    iteration per dispatch — the budget says one per DISPATCH."""
+    from ...parallel.sharding import KEYS_AXIS, shard_map_compat, xor_allreduce
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mesh8()
+
+    def body(carry, rows):
+        chunks = rows.reshape(4, -1, rows.shape[-1])
+
+        def step(c, chunk):
+            local = jax.lax.reduce(
+                chunk, np.uint32(0), jax.lax.bitwise_xor, (0,)
+            )
+            return c ^ xor_allreduce(local, KEYS_AXIS), None
+
+        out, _ = jax.lax.scan(step, carry, chunks)
+        return out
+
+    fn = shard_map_compat(
+        body, mesh=mesh, in_specs=(P(None), P(KEYS_AXIS, None)),
+        out_specs=P(None), check_vma=False,
+    )
+    closed = jax.make_jaxpr(fn)(*_carry_rows())
+    return closed, PerfContract(collectives={"all_gather": 4})
+
+
+def callback_in_dispatch():
+    """A host callback (debug_print) in the dispatch body: a host round
+    trip per dispatch that the sanctioned count (0) must catch."""
+
+    def body(carry, rows):
+        folded = carry ^ jax.lax.reduce(
+            rows, np.uint32(0), jax.lax.bitwise_xor, (0,)
+        )
+        jax.debug.print("folded[0]={x}", x=folded[0])  # the host crossing
+        return folded
+
+    closed = jax.make_jaxpr(body)(*_carry_rows())
+    return closed, PerfContract()
+
+
+def live_copy_donation():
+    """The donated carry handed straight back as a second output: the
+    caller's handle is dead by the donation contract."""
+
+    def body(carry, rows):
+        folded = carry ^ jax.lax.reduce(
+            rows, np.uint32(0), jax.lax.bitwise_xor, (0,)
+        )
+        return folded, carry  # the seeded live copy
+
+    closed = jax.make_jaxpr(body)(*_carry_rows())
+    return closed, PerfContract(donated=(0,))
+
+
+def retrace_bomb_chunk():
+    """The chunk index baked in as a Python int: the traced signature
+    loses the operand, so every chunk index is its own XLA compile —
+    the contract's declared chunk invar must not resolve."""
+    j = 0  # Python int closure — THE bomb (jnp.int32 would be traced)
+
+    def body(sel, db):
+        sw = 4
+        sel_j = jax.lax.dynamic_slice_in_dim(sel, j * sw, sw, axis=1)
+        db_j = jax.lax.dynamic_slice_in_dim(db, j * 128, 128, axis=0)
+        return (sel_j[:, :1] & db_j[:1, :1]).sum()
+
+    closed = jax.make_jaxpr(body)(
+        jnp.zeros((32, 16), jnp.uint32), jnp.zeros((512, 2), jnp.uint32)
+    )
+    return closed, PerfContract(chunk_invar=2)
+
+
+PERF_FIXTURES = (
+    ("extra_allreduce_fold", extra_allreduce_fold, "collective-budget"),
+    ("loop_allreduce_fold", loop_allreduce_fold, "loop-collective"),
+    ("callback_in_dispatch", callback_in_dispatch, "host-crossing"),
+    ("live_copy_donation", live_copy_donation, "donation-live-copy"),
+    ("retrace_bomb_chunk", retrace_bomb_chunk, "chunk-index-static"),
+)
+
+
+# ---------------------------------------------------------------------------
+# Donation-site fixtures (for check_donation_site)
+# ---------------------------------------------------------------------------
+
+
+def _dropped_donation_site() -> DonationSite:
+    """A 'donated twin' whose jit silently lost its donate_argnums —
+    the declared donation never reaches the lowering."""
+
+    def body(carry, rows):
+        return carry ^ jax.lax.reduce(
+            rows, np.uint32(0), jax.lax.bitwise_xor, (0,)
+        )
+
+    def build():
+        return jax.jit(body), body, _carry_rows()  # no donate_argnums!
+
+    return DonationSite(
+        "fixtures.dropped_donation", (), (), (0,), build,
+    )
+
+
+def _honored_donation_site() -> DonationSite:
+    """The negative space: the same twin donating properly must verify
+    clean (the fixture fires on the drop, not on the pattern)."""
+
+    def body(carry, rows):
+        return carry ^ jax.lax.reduce(
+            rows, np.uint32(0), jax.lax.bitwise_xor, (0,)
+        )
+
+    def build():
+        return jax.jit(body, donate_argnums=(0,)), body, _carry_rows()
+
+    return DonationSite(
+        "fixtures.honored_donation", (), (), (0,), build,
+    )
+
+
+DONATION_FIXTURES = (
+    ("dropped_donation", _dropped_donation_site, "donation-dropped"),
+    ("honored_donation", _honored_donation_site, None),
+)

@@ -21,6 +21,15 @@ holds structurally:
       catcher (``DPF_TPU_BATCH_WINDOW_MS`` can no longer fail silent
       anywhere: not in code, not in tests, not in A/B scripts).
 
+  R4  (whole-tree scans only) every knob DECLARED in the registry is
+      READ somewhere: a declared ``DPF_TPU_*`` name that no non-fixture
+      file in the tree mentions outside its declaration is a finding —
+      dead knobs accumulate as the registry grows past 45 entries, and
+      a knob nobody reads is a documentation lie (docs/KNOBS.md keeps
+      advertising it).  ``# knob-unused-ok`` on (or above) the
+      ``_declare(...)`` line in core/knobs.py is the reviewed escape
+      hatch for knobs that are intentionally declaration-only.
+
 ``# knob-ok`` on the line suppresses R2/R3 (the lint suite's own tests
 must spell typo'd names on purpose).
 
@@ -71,6 +80,10 @@ def _knob_literal(node: ast.AST) -> str | None:
 
 def check_file(root: str, rel: str) -> list[Finding]:
     tree, lines = parse_file(root, rel)
+    return _check_tree(rel, tree, lines)
+
+
+def _check_tree(rel: str, tree: ast.Module, lines: list[str]) -> list[Finding]:
     rel_fwd = rel.replace("\\", "/")
     if rel_fwd == _REGISTRY_FILE:
         return []
@@ -169,12 +182,97 @@ def check_file(root: str, rel: str) -> list[Finding]:
     ))
 
 
+def _declaration_lines(root: str) -> dict[str, tuple[int, list[str]]]:
+    """knob name -> (declaration line in core/knobs.py, source lines) for
+    every ``_declare("DPF_TPU_...", ...)`` call — where R4's findings
+    anchor and where its ``# knob-unused-ok`` pragma is looked up."""
+    try:
+        tree, lines = parse_file(root, _REGISTRY_FILE)
+    except (OSError, SyntaxError):
+        return {}
+    out: dict[str, tuple[int, list[str]]] = {}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "_declare"
+            and node.args
+        ):
+            name = _knob_literal(node.args[0])
+            if name:
+                out[name] = (node.lineno, lines)
+    return out
+
+
+def _knob_mentions(tree: ast.Module) -> set[str]:
+    """Every DPF_TPU_* string literal in one parsed file (comments do
+    not count — a knob mentioned only in prose is still dead)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        name = _knob_literal(node)
+        if name:
+            out.add(name)
+    return out
+
+
+def unused_knobs(
+    root: str, files: list[str], seen: set[str] | None = None
+) -> list[Finding]:
+    """R4: knobs the SCANNED TREE declares (parsed from its own
+    core/knobs.py ``_declare`` calls — never the imported process
+    registry, so a foreign --root is judged against its own
+    declarations) that no scanned file reads.  A knob counts as used
+    when ANY non-fixture file other than the registry itself mentions
+    its name as a string literal (typed-accessor reads, ledger snapshot
+    lists, A/B env writes — all legitimate liveness).  Trees without a
+    core/knobs.py produce no R4 findings.  ``seen`` lets run() feed the
+    mention set it already collected on its single parse of the tree."""
+    decls = _declaration_lines(root)
+    if not decls:
+        return []
+    if seen is None:
+        seen = set()
+        for rel in files:
+            if rel.replace("\\", "/") == _REGISTRY_FILE:
+                continue
+            try:
+                tree, _lines = parse_file(root, rel)
+            except (OSError, SyntaxError):
+                continue
+            seen |= _knob_mentions(tree)
+    out: list[Finding] = []
+    for name in sorted(set(decls) - seen):
+        lineno, lines = decls[name]
+        if pragma(lines, lineno, "knob-unused-ok") is not None:
+            continue
+        out.append(Finding(
+            _REGISTRY_FILE, lineno, PASS,
+            f"{name} is declared but no non-fixture module reads it — "
+            "delete the dead knob, or mark the declaration "
+            "'# knob-unused-ok' with a reason",
+        ))
+    return out
+
+
 def run(root: str, files=None) -> list[Finding]:
+    whole_tree = files is None
     files = list(files) if files is not None else list(iter_py_files(root))
     out: list[Finding] = []
+    seen: set[str] = set()
     for rel in files:
         try:
-            out.extend(check_file(root, rel))
+            tree, lines = parse_file(root, rel)
         except SyntaxError as e:
             out.append(Finding(rel, e.lineno or 0, PASS, f"syntax error: {e}"))
+            continue
+        out.extend(_check_tree(rel, tree, lines))
+        if whole_tree and rel.replace("\\", "/") != _REGISTRY_FILE:
+            # R4's mention set comes off the SAME parse as R1-R3 — one
+            # whole-tree AST walk total, not one per rule family.
+            seen |= _knob_mentions(tree)
+    if whole_tree:
+        # R4 is a registry-vs-tree property: it only means something when
+        # the scan saw the whole tree (a fixture-subset scan would flag
+        # every knob).
+        out.extend(unused_knobs(root, files, seen=seen))
     return out

@@ -1,0 +1,64 @@
+"""Jaxpr-level performance-contract verifier (the ``perf-contract``
+analysis pass).
+
+The serving stack's headline performance claims — "one all-reduce per
+aggregation chunk", "one parity all-reduce per PIR query batch",
+"donated carries so steady-state serving allocates nothing", "zero host
+syncs inside dispatch bodies", "one compiled executable across every
+chunk index of a streamed scan" — are structural properties of the
+traced graphs the routes dispatch.  The oblivious-dataflow verifier
+(``analysis/trace/``) already traces every production route to a
+ClosedJaxpr to prove *secrecy* properties; this package runs the same
+traces (one shared trace cache — lint traces each route once, not once
+per pass) through a *resource* model and verifies each route against a
+declared :class:`~dpf_tpu.analysis.perf.contracts.PerfContract`:
+
+  collectives   census of cross-device collective primitives (psum /
+                all_gather / ppermute / reduce_scatter / all_to_all),
+                including inside scan/cond/while/pjit/shard_map
+                sub-jaxprs, against per-route declared maxima — and any
+                budgeted collective inside a loop body is a finding on
+                its own (a per-iteration collective is exactly the
+                "extra all-reduce per chunk" regression the budgets
+                exist to stop).
+  donation      every donated twin in the production modules (the
+                chunk-finish carries, the sharded agg fold carry, the
+                streamed PIR accumulator) must still *declare* its
+                donation to XLA — the lowering must mark the buffers
+                donated (``tf.aliasing_output`` / ``jax.buffer_donor``)
+                or name them in the declined-donation warning (CPU XLA
+                declines hints it cannot alias; TPU honors them) — and
+                a donated invar must never be returned as a live output.
+  host-crossing host callbacks (``pure_callback`` / ``io_callback`` /
+                ``debug_callback`` / ``debug_print``) in a dispatch
+                body beyond the route's sanctioned count (default 0).
+  dispatch      streamed/chunked routes must take their chunk index as
+                a TRACED scalar operand so every chunk of a scan lands
+                on one compiled executable (a chunk index baked in as a
+                Python int is a retrace bomb: one XLA compile per
+                chunk), cross-checked against core/plans.PLAN_ROUTES
+                route registration.
+  cost          a static FLOPs / HBM-bytes model per route emitted
+                alongside the certificate (reviewable magnitude facts,
+                not a gate).
+
+Clean routes emit versioned contract certificates to
+``docs/PERF_CONTRACTS.md`` + ``docs/perf_contracts.json`` with the same
+drift-detection / re-certification workflow as the obliviousness
+certificates (``python -m dpf_tpu.analysis --write-perf-contracts``),
+and the certificate hash is pinned to the committed obliviousness hash
+for the same route — the two ledgers can never attest different graphs.
+
+Modules: ``model.py`` (the jaxpr resource walk), ``contracts.py`` (the
+declared per-route budgets + the donation-site registry),
+``certify.py`` (certificates, drift, artifacts).  Contract semantics
+and what a certificate does NOT attest: docs/DESIGN.md §16.
+"""
+
+from __future__ import annotations
+
+# Bump when the resource model, the contract schema, or the budgets
+# change (committed certificates re-generate; bench ledgers keyed on it
+# re-measure — bench_all stamps this next to LINT_SUITE_VERSION and
+# OBLIVIOUS_VERIFIER_VERSION).
+PERF_CONTRACT_VERSION = "1"

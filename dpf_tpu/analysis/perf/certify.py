@@ -1,0 +1,445 @@
+"""Performance-contract certificates: verify every route and donation
+site, emit the ledger, detect drift.
+
+A certificate attests: "route R's traced jaxpr, hashed H (the SAME hash
+the obliviousness certificate for R pins — one trace, two ledgers, zero
+possibility of attesting different graphs), stays inside its declared
+PerfContract: collective census within budget, no budgeted collective
+inside a loop body, host crossings within the sanctioned count, donated
+operands never returned live, chunk indices traced operands — and here
+is its static FLOPs / HBM-bytes model."  It does NOT attest wall-clock,
+overlap, or anything the XLA scheduler decides — docs/DESIGN.md §16
+draws the line.
+
+Artifacts (regenerate with ``python -m dpf_tpu.analysis
+--write-perf-contracts`` after any intentional budget/route change):
+
+  docs/PERF_CONTRACTS.md     the human-readable contract table
+  docs/perf_contracts.json   the machine-readable sidecar the drift
+                             check and tests compare against
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from . import PERF_CONTRACT_VERSION
+from .contracts import (
+    CONTRACTS, donation_sites, orphan_override_problems,
+    plan_route_problems,
+)
+from .model import (
+    COLLECTIVE_PRIMS, cost_model, chunk_invar_problem,
+    donated_invar_indices, live_copy_donations, lowered_donation_evidence,
+    resource_census,
+)
+from ..trace import certify as oblivious_certify
+from ..trace.entrypoints import ROUTES, trace_route_cached
+from ..trace.taint import jaxpr_hash
+
+PERF_MD = os.path.join("docs", "PERF_CONTRACTS.md")
+PERF_JSON = os.path.join("docs", "perf_contracts.json")
+
+
+class PerfFinding:
+    """(route-or-site, kind, message) — the perf pass's finding unit."""
+
+    __slots__ = ("where", "kind", "message")
+
+    def __init__(self, where: str, kind: str, message: str):
+        self.where = where
+        self.kind = kind
+        self.message = message
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return f"PerfFinding({self.where!r}, {self.kind!r}, {self.message!r})"
+
+
+def check_route(
+    closed: Any, contract: Any, name: str, census: Any = None
+) -> list[PerfFinding]:
+    """One route's traced jaxpr against its declared contract — shared
+    by the real matrix and the seeded bad_perf fixtures.  ``census``
+    lets verify_routes reuse the walk it needs for the certificate
+    anyway (one traversal per route, not two)."""
+    out: list[PerfFinding] = []
+    if census is None:
+        census = resource_census(closed)
+
+    for prim, n in sorted(census.collectives.items()):
+        budget = contract.collectives.get(prim, 0)
+        if n > budget:
+            out.append(PerfFinding(
+                name, "collective-budget",
+                f"{n}x {prim} traced but the contract budgets {budget} — "
+                "an extra cross-device reduce per dispatch",
+            ))
+    for prim in sorted(contract.collectives):
+        if prim not in COLLECTIVE_PRIMS:
+            out.append(PerfFinding(
+                name, "collective-budget",
+                f"contract budgets unknown collective {prim!r} "
+                "(not in model.COLLECTIVE_PRIMS)",
+            ))
+    for prim, n in sorted(census.loop_collectives.items()):
+        out.append(PerfFinding(
+            name, "loop-collective",
+            f"{n}x {prim} inside a scan/while body — that is one "
+            "collective per ITERATION per dispatch, not the budgeted "
+            "per-dispatch count",
+        ))
+    if census.callbacks > contract.callbacks:
+        out.append(PerfFinding(
+            name, "host-crossing",
+            f"{census.callbacks} host callback(s) traced but the "
+            f"contract sanctions {contract.callbacks} — a host round "
+            "trip inside a dispatch body",
+        ))
+    for i in live_copy_donations(closed, contract.donated):
+        out.append(PerfFinding(
+            name, "donation-live-copy",
+            f"donated invar {i} is returned as a live output — the "
+            "caller's handle is dead by the donation contract, so "
+            "either the donation or the output is a lie",
+        ))
+    if contract.chunk_invar is not None:
+        problem = chunk_invar_problem(closed, contract.chunk_invar)
+        if problem is not None:
+            out.append(PerfFinding(name, "chunk-index-static", problem))
+    return out
+
+
+def check_donation_site(site: Any) -> tuple[dict, list[PerfFinding]]:
+    """-> (evidence dict for the sidecar, findings).  Lowers the REAL
+    production twin and demands every declared donated leaf is either
+    aliased/donor-marked or named in the backend's declined-donation
+    warning; plus the jaxpr-level live-copy check on the body."""
+    import jax
+
+    out: list[PerfFinding] = []
+    jitted, body, args = site.build()
+    donated = donated_invar_indices(args, site.static, site.donate)
+    evidence: dict[str, Any] = {
+        "routes": sorted(site.routes),
+        "donate_argnums": sorted(site.donate),
+        "donated_leaves": len(donated),
+    }
+    closed = jax.make_jaxpr(body, static_argnums=site.static)(*args)
+    for i in live_copy_donations(closed, donated):
+        out.append(PerfFinding(
+            site.name, "donation-live-copy",
+            f"donated invar {i} is returned as a live output",
+        ))
+    if site.lowerable:
+        ev = lowered_donation_evidence(jitted, args)
+        evidence.update(ev)
+        if ev["aliased"] + ev["declined"] < len(donated):
+            out.append(PerfFinding(
+                site.name, "donation-dropped",
+                f"{len(donated)} donated leaves declared but the "
+                f"lowering shows only {ev['aliased']} aliased + "
+                f"{ev['declined']} declined — the jit lost its "
+                "donate_argnums",
+            ))
+    else:
+        evidence["lowered"] = False  # Mosaic body: TPU-only lowering
+    return evidence, out
+
+
+def skipped_routes(routes: Any = None) -> list:
+    """Same device-floor policy as the obliviousness certifier (the mesh
+    routes need the 8-virtual-device topology every sanctioned lint
+    entry point forces)."""
+    return oblivious_certify.skipped_routes(routes)
+
+
+def skipped_donation_sites() -> list:
+    """Donation sites whose device floor exceeds the visible topology
+    (the sharded fold/chunk factories build a real 8-device mesh).
+    Same carry-forward policy as skipped routes: their committed ledger
+    entries stand, and --write-perf-contracts refuses to write a ledger
+    that silently drops them."""
+    import jax
+
+    n = jax.device_count()
+    return [s for s in donation_sites() if s.min_devices > n]
+
+
+def verify_routes(routes: Any = None) -> tuple[dict[str, dict], list]:
+    """Trace (through the shared cache) + contract-verify every route
+    the visible topology supports, then verify the donation sites.
+    -> (certificates, findings)."""
+    certs: dict[str, dict] = {}
+    findings: list[PerfFinding] = []
+    matrix = list(routes if routes is not None else ROUTES)
+    skipped = {r.name for r in skipped_routes(matrix)}
+    for msg in plan_route_problems():
+        findings.append(PerfFinding("contracts", "plan-route", msg))
+    for msg in orphan_override_problems():
+        findings.append(PerfFinding("contracts", "orphan-override", msg))
+    for route in matrix:
+        contract = CONTRACTS.get(route.name)
+        if contract is None:
+            findings.append(PerfFinding(
+                route.name, "no-contract",
+                "route has no declared PerfContract — declare its "
+                "budget in analysis/perf/contracts.py",
+            ))
+            continue
+        if route.name in skipped:
+            continue
+        closed, _secret = trace_route_cached(route)
+        census = resource_census(closed)
+        route_findings = check_route(closed, contract, route.name, census)
+        findings.extend(route_findings)
+        if route_findings:
+            continue
+        certs[route.name] = {
+            "plan_route": route.plan_route,
+            "knobs": route.knob_dict(),
+            "jaxpr_sha256": jaxpr_hash(closed),
+            "contract": {
+                "collectives": dict(sorted(contract.collectives.items())),
+                "callbacks": contract.callbacks,
+                "donated": sorted(contract.donated),
+                "chunk_invar": contract.chunk_invar,
+                "note": contract.note,
+            },
+            "observed": {
+                "collectives": dict(sorted(census.collectives.items())),
+                "callbacks": census.callbacks,
+            },
+            "cost": cost_model(closed),
+        }
+    # The hash bind: a perf certificate must attest the SAME trace the
+    # committed obliviousness certificate pins (shared cache makes this
+    # structural; the check catches a desynced re-certification).
+    from ..common import repo_root
+
+    committed_obl = (
+        oblivious_certify.load_committed(repo_root()) or {}
+    ).get("routes", {})
+    for name, cert in certs.items():
+        old = committed_obl.get(name)
+        if old is not None and old.get("jaxpr_sha256") != cert["jaxpr_sha256"]:
+            findings.append(PerfFinding(
+                name, "hash-mismatch",
+                "perf-contract trace hash differs from the committed "
+                "obliviousness certificate — re-certify BOTH ledgers in "
+                "the same change (--write-oblivious then "
+                "--write-perf-contracts)",
+            ))
+    donation: dict[str, dict] = {}
+    import jax
+
+    n_dev = jax.device_count()
+    for site in donation_sites():
+        if site.min_devices > n_dev:
+            continue
+        try:
+            evidence, site_findings = check_donation_site(site)
+        except Exception as e:  # noqa: BLE001 — a site that cannot even
+            # build/lower is a finding, not a crash of the whole pass
+            findings.append(PerfFinding(
+                site.name, "donation-dropped",
+                f"donation site failed to build/lower: {type(e).__name__}: "
+                f"{e}",
+            ))
+            continue
+        donation[site.name] = evidence
+        findings.extend(site_findings)
+    if donation:
+        certs["__donation__"] = donation
+    return certs, findings
+
+
+# ---------------------------------------------------------------------------
+# Artifacts + drift
+# ---------------------------------------------------------------------------
+
+
+def sidecar(certs: dict[str, dict]) -> dict:
+    import jax
+
+    donation = certs.get("__donation__", {})
+    routes = {k: v for k, v in certs.items() if k != "__donation__"}
+    return {
+        "perf_contract_version": PERF_CONTRACT_VERSION,
+        "jax": jax.__version__,
+        "routes": {k: routes[k] for k in sorted(routes)},
+        "donation_sites": {k: donation[k] for k in sorted(donation)},
+    }
+
+
+def _fmt_collectives(d: dict, sep: str = "<=") -> str:
+    return ", ".join(f"{k}{sep}{v}" for k, v in sorted(d.items())) or "none"
+
+
+def render_markdown(side: dict) -> str:
+    lines = [
+        "# Performance contracts",
+        "",
+        "Auto-generated by `python -m dpf_tpu.analysis "
+        "--write-perf-contracts` — do not edit by hand.",
+        "",
+        f"Contract version {side['perf_contract_version']}, traced under "
+        f"`JAX_PLATFORMS=cpu`, jax {side['jax']}.  Each row attests that "
+        "the route's traced jaxpr stays inside its declared budget: "
+        "**collective census within the stated maxima (and none inside "
+        "a loop body), zero unsanctioned host callbacks, donated "
+        "operands never returned live, chunk indices traced operands** "
+        "— plus a static FLOPs / HBM-bytes model.  The jaxpr hash is "
+        "pinned to the obliviousness certificate's "
+        "([`OBLIVIOUS.md`](OBLIVIOUS.md)): one trace, two ledgers.  "
+        "Contract semantics and the re-certification workflow: "
+        "`docs/DESIGN.md` §16.  Machine-readable sidecar: "
+        "[`perf_contracts.json`](perf_contracts.json).",
+        "",
+        "| route | plan | collective budget | observed | donated | chunk "
+        "op | MFLOPs | HBM KiB |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for name in sorted(side["routes"]):
+        c = side["routes"][name]
+        con, obs = c["contract"], c["observed"]
+        donated = (
+            ",".join(str(i) for i in con["donated"]) if con["donated"]
+            else "-"
+        )
+        chunk = (
+            str(con["chunk_invar"]) if con["chunk_invar"] is not None
+            else "-"
+        )
+        lines.append(
+            f"| `{name}` | {c['plan_route']} | "
+            f"{_fmt_collectives(con['collectives'])} | "
+            f"{_fmt_collectives(obs['collectives'], '=')} | {donated} | "
+            f"{chunk} | {c['cost']['flops'] / 1e6:.2f} | "
+            f"{c['cost']['hbm_bytes'] / 1024:.1f} |"
+        )
+    lines += [
+        "",
+        "## Donation sites",
+        "",
+        "Every production donated twin, lowered with donation forced on: "
+        "`aliased` buffers the lowering marked donated "
+        "(`tf.aliasing_output` / `jax.buffer_donor`), `declined` buffers "
+        "this backend's lowering named in the declined-donation warning "
+        "(CPU XLA cannot alias the chunk-finish carries; TPU honors "
+        "them).  `aliased + declined` must cover every declared leaf or "
+        "the jit lost its `donate_argnums`.",
+        "",
+        "| site | routes | donate_argnums | leaves | aliased | declined |",
+        "|---|---|---|---|---|---|",
+    ]
+    for name in sorted(side["donation_sites"]):
+        d = side["donation_sites"][name]
+        lines.append(
+            f"| `{name}` | {', '.join(d['routes'])} | "
+            f"{d['donate_argnums']} | {d['donated_leaves']} | "
+            f"{d.get('aliased', '-')} | {d.get('declined', '-')} |"
+        )
+    lines += [
+        "",
+        "To re-certify after an intentional budget or route change: run "
+        "`python -m dpf_tpu.analysis --write-perf-contracts`, review the "
+        "diff, commit both files.",
+        "",
+    ]
+    return "\n".join(lines)
+
+
+def write(root: str, certs: dict[str, dict]) -> list[str]:
+    side = sidecar(certs)
+    md = os.path.join(root, PERF_MD)
+    js = os.path.join(root, PERF_JSON)
+    os.makedirs(os.path.dirname(md), exist_ok=True)
+    with open(md, "w", encoding="utf-8") as f:
+        f.write(render_markdown(side))
+    with open(js, "w", encoding="utf-8") as f:
+        json.dump(side, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return [PERF_MD, PERF_JSON]
+
+
+def load_committed(root: str) -> dict | None:
+    try:
+        with open(os.path.join(root, PERF_JSON), encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def drift(root: str, certs: dict[str, dict], matrix_names: Any = None) -> list[str]:
+    """Compare freshly verified certificates against the committed
+    sidecar (same policy as the obliviousness drift check: skipped
+    routes keep their committed rows; a certified route missing from
+    ``certs`` already produced findings)."""
+    if matrix_names is None:
+        matrix_names = {r.name for r in ROUTES}
+    committed = load_committed(root)
+    out: list[str] = []
+    if committed is None:
+        return [
+            f"{PERF_JSON} missing or unreadable — generate it with "
+            "'python -m dpf_tpu.analysis --write-perf-contracts'"
+        ]
+    if committed.get("perf_contract_version") != PERF_CONTRACT_VERSION:
+        return [
+            f"certificates were issued by perf-contract "
+            f"v{committed.get('perf_contract_version')} but "
+            f"v{PERF_CONTRACT_VERSION} is in force — re-certify"
+        ]
+    routes = committed.get("routes", {})
+    fresh = {k: v for k, v in certs.items() if k != "__donation__"}
+    for name, cert in fresh.items():
+        old = routes.get(name)
+        if old is None:
+            out.append(
+                f"route {name!r} has no committed perf certificate — "
+                "re-certify"
+            )
+        elif old != cert:
+            what = "contract/budget" if old.get("jaxpr_sha256") == cert[
+                "jaxpr_sha256"
+            ] else "traced jaxpr"
+            out.append(
+                f"route {name!r}: {what} changed without re-certification "
+                "— re-run --write-perf-contracts and review the diff"
+            )
+    for name in routes:
+        if name not in fresh and name not in matrix_names:
+            out.append(
+                f"committed perf certificate {name!r} has no matching "
+                "route in the matrix (removed or renamed?) — re-certify"
+            )
+    # The donation ledger drifts like the route ledger: evidence for a
+    # verifiable site must match its committed entry, and a committed
+    # site absent from BOTH this run and the registry is stale.  Sites
+    # this topology cannot build (skipped_donation_sites) keep their
+    # committed entries without complaint.
+    fresh_don = certs.get("__donation__", {})
+    committed_don = committed.get("donation_sites", {})
+    for name, ev in fresh_don.items():
+        old = committed_don.get(name)
+        if old is None:
+            out.append(
+                f"donation site {name!r} has no committed entry — "
+                "re-certify"
+            )
+        elif old != ev:
+            out.append(
+                f"donation site {name!r}: donation evidence changed "
+                "without re-certification — re-run "
+                "--write-perf-contracts and review the diff"
+            )
+    registry_names = {s.name for s in donation_sites()}
+    for name in committed_don:
+        if name not in fresh_don and name not in registry_names:
+            out.append(
+                f"committed donation site {name!r} is no longer in the "
+                "registry (removed or renamed?) — re-certify"
+            )
+    return out

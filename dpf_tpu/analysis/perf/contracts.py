@@ -1,0 +1,360 @@
+"""Declared performance contracts: per-route resource budgets and the
+production donation-site registry.
+
+A :class:`PerfContract` is a *commitment*, not an observation: the route
+may use at most the declared collectives (zero for everything that is
+not an explicit cross-shard reduce), at most the sanctioned host
+crossings (zero everywhere — the sidecar owns the host boundary), must
+keep its declared donated operands dead-on-return, and — for the
+streamed routes — must take the chunk index as a traced operand so one
+executable covers every chunk.  The verifier (``certify.py``) re-traces
+every route through the shared trace cache and fails the lint lane when
+an observation exceeds its budget; loosening a budget is a reviewed
+edit HERE, next to the claim it weakens.
+
+The big structural claims these budgets pin:
+
+  * ``agg_sharded/fold_*``: exactly ONE all-reduce per streamed
+    aggregation chunk (XOR all-gather / psum) — PR 9's headline.
+  * ``pir/stream_chunk*``: ZERO collectives per streamed DB chunk, and
+    ``pir/stream_combine_sharded``: exactly ONE parity all-reduce per
+    query batch — PR 12's headline.
+  * every non-mesh route: zero collectives, full stop.
+  * every route: zero host callbacks inside the dispatch body.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+from ..trace.entrypoints import ROUTES
+
+__all__ = [
+    "PerfContract", "CONTRACTS", "DonationSite", "DONATION_SITES",
+    "orphan_override_problems", "plan_route_problems",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class PerfContract:
+    """One route's declared resource budget.
+
+    ``collectives``  budgeted collective primitive -> maximum static
+                     occurrences in the traced graph; any budgeted
+                     primitive not listed has budget 0.
+    ``callbacks``    sanctioned host-crossing primitives (default 0).
+    ``donated``      traced invar indices the production dispatch
+                     donates (``core/plans.donation_enabled`` gating the
+                     donated twins) — each must never be a live output.
+    ``chunk_invar``  for streamed/chunked routes: the invar index of the
+                     public chunk counter, which must be a traced scalar
+                     operand (one executable across all chunk indices).
+    """
+
+    collectives: dict[str, int] = dataclasses.field(default_factory=dict)
+    callbacks: int = 0
+    donated: tuple[int, ...] = ()
+    chunk_invar: int | None = None
+    note: str = ""
+
+
+_ONE_ALLGATHER = {"all_gather": 1}
+
+# Routes that are NOT the all-zero default.  Keys must be route names in
+# the entrypoints matrix (certify cross-checks both directions).
+_OVERRIDES: dict[str, PerfContract] = {
+    # -- chunk-finish donation (the serving fast path's carries) ---------
+    "evalfull_chunked/compat": PerfContract(
+        donated=(0, 1),
+        note="prefix level-state carries (S, T) donated into the finish",
+    ),
+    "evalfull_stream/compat": PerfContract(
+        donated=(0, 1),
+        note="per-chunk level-state slices donated into the stream finish",
+    ),
+    "evalfull_chunked/fast": PerfContract(
+        donated=(0, 1, 2, 3, 4),
+        note="prefix level-state carries (s0..s3, T) donated",
+    ),
+    "evalfull_stream/fast": PerfContract(
+        donated=(0, 1, 2, 3, 4),
+        note="per-chunk level-state slices (s0..s3, T) donated",
+    ),
+    # -- mesh aggregation: ONE all-reduce per streamed chunk -------------
+    "agg_sharded/fold_xor": PerfContract(
+        collectives=dict(_ONE_ALLGATHER), donated=(0,),
+        note="one XOR all-reduce (all-gather + lane XOR) per fold chunk; "
+        "dead carry donated across shards",
+    ),
+    "agg_sharded/fold_add": PerfContract(
+        collectives={"psum": 1}, donated=(0,),
+        note="one psum per fold chunk; dead carry donated across shards",
+    ),
+    # -- served PIR: one parity all-reduce per query batch ---------------
+    "pir/scan_sharded/compat/xla": PerfContract(
+        collectives=dict(_ONE_ALLGATHER),
+        note="the ONE parity all-reduce of a sharded one-shot scan",
+    ),
+    "pir/scan_sharded/fast/xla": PerfContract(
+        collectives=dict(_ONE_ALLGATHER),
+        note="the ONE parity all-reduce of a sharded one-shot scan",
+    ),
+    "pir/stream_chunk": PerfContract(
+        donated=(2,), chunk_invar=3,
+        note="streamed DB chunk: donated accumulator, public traced "
+        "chunk index, zero collectives",
+    ),
+    "pir/stream_chunk_sharded": PerfContract(
+        donated=(2,), chunk_invar=3,
+        note="streamed DB chunk: zero collectives per chunk (partials "
+        "stay shard-local until the combine)",
+    ),
+    "pir/stream_combine_sharded": PerfContract(
+        collectives=dict(_ONE_ALLGATHER),
+        note="the ONE parity all-reduce per streamed query batch",
+    ),
+}
+
+# Every route in the matrix carries a contract: the all-zero default
+# (zero collectives, zero callbacks, no donation obligations) unless
+# overridden above.  certify flags a matrix/contract set mismatch in
+# both directions — a new route cannot ship without (at least
+# explicitly defaulting) its budget, and a RENAMED route cannot
+# silently demote its override to the permissive default
+# (:func:`orphan_override_problems`).
+CONTRACTS: dict[str, PerfContract] = {
+    r.name: _OVERRIDES.get(r.name, PerfContract()) for r in ROUTES
+}
+
+
+def orphan_override_problems() -> list[str]:
+    """Overrides whose route name no longer exists in the matrix: a
+    route rename would otherwise silently swap its declared budget for
+    the all-zero default — the donation/chunk-invar obligations it
+    carried would simply stop being checked."""
+    names = {r.name for r in ROUTES}
+    return [
+        f"contract override {k!r} matches no route in the matrix — the "
+        "route was renamed or removed without moving its declared budget"
+        for k in sorted(_OVERRIDES)
+        if k not in names
+    ]
+
+
+def plan_route_problems() -> list[str]:
+    """Cross-check the matrix against core/plans route registration:
+    every route's ``plan_route`` must be a registered plan route (or the
+    explicit "-" for library-only entrypoints) — the dispatch-count
+    claim ("after warmup, serving never retraces") only covers shapes
+    the plan layer buckets, so a route pointing at an unregistered plan
+    route name is attesting a dispatch path that does not exist."""
+    from ...core.plans import PLAN_ROUTES
+
+    out = []
+    for r in ROUTES:
+        if r.plan_route != "-" and r.plan_route not in PLAN_ROUTES:
+            out.append(
+                f"route {r.name!r} names plan route {r.plan_route!r}, "
+                f"which core/plans.PLAN_ROUTES does not register"
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Donation sites: the production donated twins
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DonationSite:
+    """One production donated executable.  ``build`` returns the REAL
+    jitted object (module-level twin or the production factory with
+    donation forced on), the unjitted body, and call args shaped like
+    the deployed dispatch.  ``static``/``donate`` are per-argument
+    positions mirroring the jit declaration (the models' DONATED_TWINS
+    tables are the shared source the verifier cross-checks by
+    lowering)."""
+
+    name: str
+    routes: tuple[str, ...]  # certificate routes this donation underlies
+    static: tuple[int, ...]
+    donate: tuple[int, ...]
+    build: Callable[[], tuple[Any, Any, tuple]]
+    # False for twins whose body is a Mosaic kernel: CPU cannot lower
+    # them, so only the jaxpr-level live-copy check runs off-TPU.
+    lowerable: bool = True
+    min_devices: int = 1
+
+
+def _dpf_finish_args(single_chunk: bool) -> tuple:
+    import jax.numpy as jnp
+
+    from ...models import dpf
+    from ..trace import entrypoints as ep
+
+    dk = dpf.DeviceKeys(ep._compat_batch(9, 32))
+    c = 1
+    kp = dk.k_padded // 32
+    S = jnp.zeros((128, 1 << c, kp), jnp.uint32)
+    T = jnp.zeros((1 << c, kp), jnp.uint32)
+    if single_chunk:
+        S, T = S[:, :1, :], T[:1]
+    return (
+        dk.nu - c, c, S, T, dk.scw_planes, dk.tl_words, dk.tr_words,
+        dk.fcw_planes, "xla",
+    )
+
+
+def _cc_finish_args(single_chunk: bool) -> tuple:
+    import jax.numpy as jnp
+
+    from ..trace import entrypoints as ep
+
+    kb = ep._fast_batch(11, 8)
+    seeds, ts, scw, tcw, fcw = kb.device_args()
+    c = 1
+    S = [jnp.zeros((kb.k, 1 << c), jnp.uint32) for _ in range(4)]
+    T = jnp.zeros((kb.k, 1 << c), jnp.uint32)
+    if single_chunk:
+        return (
+            kb.nu - c, c, [s[:, :1] for s in S], T[:, :1], scw, tcw, fcw
+        )
+    return (kb.nu - c, c, *S, T, scw, tcw, fcw)
+
+
+def _pk_finish_args() -> tuple:
+    import jax.numpy as jnp
+
+    from ...models import dpf_chacha as dc
+    from ...ops import chacha_pallas as cp
+    from ..trace import entrypoints as ep
+
+    kb = ep._fast_batch(16, 8)  # nu=7; K % _EKT == 0 (the kernel route)
+    s = kb.nu - cp._EXP_LEVELS
+    seeds, ts, scw, tcw, _ = kb.device_args()
+    S, T = dc._expand_prefix_cc_jit(s, seeds, ts, scw, tcw)
+    n_chunks = 2
+    wc = (1 << s) // n_chunks
+    return (kb.nu, s, n_chunks, wc, *S, T, *cp.expand_operands(kb, s))
+
+
+def _dpf_site(name: str, single: bool) -> DonationSite:
+    from ...models import dpf
+
+    static, donate = dpf.DONATED_TWINS[name]
+    return DonationSite(
+        f"models.dpf.{name}",
+        ("evalfull_stream/compat",) if single
+        else ("evalfull_chunked/compat",),
+        static, donate,
+        lambda: (
+            getattr(dpf, name), dpf._finish_chunk_body if single
+            else dpf._finish_chunks_scan_body, _dpf_finish_args(single),
+        ),
+    )
+
+
+def _cc_site(name: str, routes: tuple[str, ...]) -> DonationSite:
+    from ...models import dpf_chacha as dc
+
+    static, donate = dc.DONATED_TWINS[name]
+    bodies = {
+        "_finish_chunks_cc_scan_donated_jit": (
+            dc._finish_chunks_cc_scan_body, lambda: _cc_finish_args(False),
+            True,
+        ),
+        "_finish_chunk_cc_donated_jit": (
+            dc._finish_chunk_cc_body, lambda: _cc_finish_args(True), True,
+        ),
+        "_finish_pk_chunks_donated_jit": (
+            dc._finish_pk_chunks_body, _pk_finish_args, False,
+        ),
+    }
+    body, args, lowerable = bodies[name]
+    return DonationSite(
+        f"models.dpf_chacha.{name}", routes, static, donate,
+        lambda: (getattr(dc, name), body, args()), lowerable=lowerable,
+    )
+
+
+def _agg_site(op: str) -> DonationSite:
+    def build() -> tuple[Any, Any, tuple]:
+        import jax.numpy as jnp
+
+        from ...parallel import sharding
+
+        mesh = sharding.make_mesh(8, 1)
+        body = sharding._sharded_agg_fold_sm(mesh, op)
+        jitted = sharding._sharded_agg_fold(mesh, op, donate=True)
+        args = (
+            jnp.zeros(64, jnp.uint32), jnp.zeros((256, 64), jnp.uint32)
+        )
+        return jitted, body, args
+
+    from ...parallel.sharding import AGG_FOLD_DONATE_ARGNUMS
+
+    return DonationSite(
+        f"parallel.sharding._sharded_agg_fold[{op}]",
+        (f"agg_sharded/fold_{op}",), (), AGG_FOLD_DONATE_ARGNUMS, build,
+        min_devices=8,
+    )
+
+
+def _pir_site(sharded: bool) -> DonationSite:
+    def build() -> tuple[Any, Any, tuple]:
+        import jax.numpy as jnp
+
+        from ...models import pir
+
+        j = jnp.int32(0)
+        sel = jnp.zeros((32, 16), jnp.uint32)
+        db = jnp.zeros((512, 2), jnp.uint32)
+        if sharded:
+            from ...parallel.sharding import make_mesh
+
+            mesh = make_mesh(2, 4)
+            body = pir._pir_stream_chunk_sharded_sm(mesh, 128, 1, 128)
+            jitted = pir._pir_stream_chunk_sharded(
+                mesh, 128, 1, 128, donate=True
+            )
+            acc = jnp.zeros((4, 32, 2), jnp.uint32)
+        else:
+            body = pir._pir_stream_chunk_body(128, 1, 128)
+            jitted = pir._pir_stream_chunk(128, 1, 128, donate=True)
+            acc = jnp.zeros((32, 2), jnp.uint32)
+        return jitted, body, (sel, db, acc, j)
+
+    from ...models.pir import STREAM_CHUNK_DONATE_ARGNUMS
+
+    return DonationSite(
+        "models.pir._pir_stream_chunk"
+        + ("_sharded" if sharded else ""),
+        ("pir/stream_chunk_sharded",) if sharded else ("pir/stream_chunk",),
+        (), STREAM_CHUNK_DONATE_ARGNUMS, build,
+        min_devices=8 if sharded else 1,
+    )
+
+
+def donation_sites() -> tuple[DonationSite, ...]:
+    """The production donation surface (built lazily — the models import
+    jax).  Every donated executable the serving stack can dispatch is
+    listed; certify verifies each against its declared argnums."""
+    return (
+        _dpf_site("_finish_chunks_scan_donated_jit", single=False),
+        _dpf_site("_finish_chunk_donated_jit", single=True),
+        _cc_site(
+            "_finish_chunks_cc_scan_donated_jit", ("evalfull_chunked/fast",)
+        ),
+        _cc_site("_finish_chunk_cc_donated_jit", ("evalfull_stream/fast",)),
+        _cc_site("_finish_pk_chunks_donated_jit", ("evalfull/fast/pallas",)),
+        _agg_site("xor"),
+        _agg_site("add"),
+        _pir_site(sharded=False),
+        _pir_site(sharded=True),
+    )
+
+
+# Kept for importers that expect a module-level name; resolved lazily in
+# certify so `import dpf_tpu.analysis.perf.contracts` stays jax-free.
+DONATION_SITES = donation_sites

@@ -1,0 +1,294 @@
+"""The jaxpr resource walk: collective census, host-crossing census,
+donation evidence, dispatch-shape checks, and the static cost model.
+
+Everything here is a pure function of a ClosedJaxpr (plus, for the
+donation evidence, one ``jit.lower()`` of the production donated twin —
+tracing + StableHLO emission, never an XLA compile), so the numbers in a
+certificate are deterministic under a pinned jax version — the same
+property the obliviousness hashes rely on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+from collections import Counter
+from typing import Any, Iterator
+
+import numpy as np
+
+# Cross-device collective primitives whose count a PerfContract budgets.
+# ``pbroadcast`` and ``axis_index`` are shard_map bookkeeping (replication
+# markers / mesh coordinates), not data movement — they stay unbudgeted.
+COLLECTIVE_PRIMS = frozenset(
+    {
+        "psum", "psum2", "all_gather", "all_to_all", "ppermute",
+        "pmax", "pmin", "reduce_scatter", "pgather",
+    }
+)
+
+# Host round trips inside a dispatch body (same set the taint lattice
+# flags unconditionally — the perf contract re-counts them against the
+# route's sanctioned budget, default zero).
+CALLBACK_PRIMS = frozenset(
+    {"pure_callback", "io_callback", "debug_callback", "debug_print"}
+)
+
+# Loop-like primitives: a budgeted collective inside one of these runs
+# once per ITERATION per dispatch, not once per dispatch.
+_LOOP_PRIMS = frozenset({"scan", "while"})
+
+
+@dataclasses.dataclass
+class ResourceCensus:
+    """Static occurrence counts over a route's whole nested jaxpr."""
+
+    collectives: Counter  # budgeted collective prim -> static count
+    loop_collectives: Counter  # subset that sits inside scan/while bodies
+    callbacks: int  # host-crossing primitive count
+    n_eqns: int
+
+
+def _sub_jaxprs(value: Any) -> Iterator[Any]:
+    """Every open Jaxpr reachable inside one eqn params value (the
+    ClosedJaxpr unwrap must come first — it forwards ``.eqns``)."""
+    if hasattr(value, "jaxpr") and hasattr(value.jaxpr, "eqns"):
+        yield value.jaxpr
+    elif hasattr(value, "eqns"):
+        yield value
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            yield from _sub_jaxprs(v)
+
+
+def resource_census(closed_jaxpr: Any) -> ResourceCensus:
+    out = ResourceCensus(Counter(), Counter(), 0, 0)
+
+    def walk(jaxpr: Any, in_loop: bool) -> None:
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            out.n_eqns += 1
+            if prim in COLLECTIVE_PRIMS:
+                out.collectives[prim] += 1
+                if in_loop:
+                    out.loop_collectives[prim] += 1
+            if prim in CALLBACK_PRIMS:
+                out.callbacks += 1
+            child_in_loop = in_loop or prim in _LOOP_PRIMS
+            for value in eqn.params.values():
+                for sub in _sub_jaxprs(value):
+                    walk(sub, child_in_loop)
+
+    walk(closed_jaxpr.jaxpr, False)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Static cost model
+# ---------------------------------------------------------------------------
+
+
+def _size(aval: Any) -> int:
+    n = 1
+    for d in getattr(aval, "shape", ()):
+        if isinstance(d, (int, np.integer)):
+            n *= int(d)
+    return n
+
+
+def _nbytes(aval: Any) -> int:
+    try:
+        item = int(np.dtype(aval.dtype).itemsize)
+    except (TypeError, AttributeError):
+        item = 4
+    return _size(aval) * item
+
+
+def _eqn_flops(eqn: Any) -> int:
+    """One equation's op-count model: 2*M*N*K for ``dot_general``, one op
+    per element visited for everything else (max of operand/result
+    element counts — the reductions and elementwise ops this tree is
+    made of)."""
+    if eqn.primitive.name == "dot_general":
+        (lc, _rc), _batch = eqn.params["dimension_numbers"]
+        lhs = eqn.invars[0].aval
+        k = 1
+        for d in lc:
+            k *= int(lhs.shape[d])
+        return 2 * _size(eqn.outvars[0].aval) * k
+    sizes = [0]
+    for v in list(eqn.invars) + list(eqn.outvars):
+        aval = getattr(v, "aval", None)
+        if aval is not None:
+            sizes.append(_size(aval))
+    return max(sizes)
+
+
+def cost_model(closed_jaxpr: Any) -> dict[str, int]:
+    """Static per-dispatch cost facts emitted alongside a certificate:
+
+    ``flops``      modeled integer-op count: every equation contributes
+                   per-element work (``dot_general`` contributes
+                   2*M*N*K), scan bodies multiply by the trip count,
+                   pallas_call kernels multiply by the grid size.
+                   While-loop bodies count one iteration (the trip
+                   count is data-dependent by construction and every
+                   production while is a fixed small constant).
+    ``hbm_bytes``  the dispatch's HBM I/O floor: bytes of the top-level
+                   invars plus outvars (what must cross HBM even under
+                   perfect fusion — intermediates are a compiler
+                   decision the model stays agnostic about).
+    """
+
+    def walk(jaxpr: Any, mult: int) -> int:
+        flops = 0
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            child_mult = mult
+            if prim == "scan":
+                child_mult = mult * int(eqn.params.get("length", 1) or 1)
+            elif prim == "pallas_call":
+                grid = ()
+                gm = eqn.params.get("grid_mapping")
+                if gm is not None:
+                    grid = getattr(gm, "grid", ()) or ()
+                g = 1
+                for d in grid:
+                    if isinstance(d, (int, np.integer)):
+                        g *= int(d)
+                child_mult = mult * g
+            subs = [
+                s for v in eqn.params.values() for s in _sub_jaxprs(v)
+            ]
+            if subs:
+                for sub in subs:
+                    flops += walk(sub, child_mult)
+            else:
+                flops += mult * _eqn_flops(eqn)
+        return flops
+
+    jaxpr = closed_jaxpr.jaxpr
+    io_bytes = sum(
+        _nbytes(v.aval)
+        for v in list(jaxpr.invars) + list(jaxpr.outvars)
+        if hasattr(v, "aval")
+    )
+    return {"flops": walk(jaxpr, 1), "hbm_bytes": io_bytes}
+
+
+# ---------------------------------------------------------------------------
+# Donation
+# ---------------------------------------------------------------------------
+
+
+def donated_invar_indices(
+    args: tuple, static_argnums: tuple[int, ...],
+    donate_argnums: tuple[int, ...],
+) -> tuple[int, ...]:
+    """Map per-ARGUMENT donate positions onto traced per-INVAR indices,
+    with the same pytree flattening the tracer applies (a donated list
+    argument flattens to several donated invars) — the donation twin of
+    ``entrypoints._trace``'s secrecy-flag expansion."""
+    import jax
+
+    static = set(static_argnums)
+    donate = set(donate_argnums)
+    out: list[int] = []
+    pos = 0
+    for i, a in enumerate(args):
+        if i in static:
+            continue
+        n = len(jax.tree_util.tree_leaves(a))
+        if i in donate:
+            out.extend(range(pos, pos + n))
+        pos += n
+    return tuple(out)
+
+
+def live_copy_donations(
+    closed_jaxpr: Any, donated_invars: tuple[int, ...]
+) -> list[int]:
+    """Donated invar indices that the jaxpr ALSO returns as outputs.  A
+    donated buffer handed straight back is a live output copy: the
+    caller's handle is dead by the donation contract, so either the
+    donation is a lie or the output is — both are findings."""
+    jaxpr = closed_jaxpr.jaxpr
+    out_ids = {id(v) for v in jaxpr.outvars}
+    return [
+        i for i in donated_invars
+        if i < len(jaxpr.invars) and id(jaxpr.invars[i]) in out_ids
+    ]
+
+
+def lowered_donation_evidence(jitted: Any, args: tuple) -> dict[str, int]:
+    """Lower the production donated twin (StableHLO emission only — no
+    XLA compile, and ``PjitFunction._cache_size`` stays untouched, so
+    the zero-retrace accounting the serving tests rely on cannot be
+    polluted) and count the donation markers:
+
+      ``aliased``   parameters the lowering marked ``tf.aliasing_output``
+                    or ``jax.buffer_donor`` — donation fully honored.
+      ``declined``  buffers named by jax's "donated buffers were not
+                    usable" warning — the hint reached the lowering but
+                    this backend cannot alias them (CPU declines the
+                    chunk-finish carries; TPU honors them).
+
+    ``aliased + declined == 0`` means the jit lost its donate_argnums —
+    the dropped-donation regression this check exists to catch."""
+    declined = 0
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        lowered = jitted.lower(*args)
+        for w in caught:
+            msg = str(w.message)
+            if "donated buffers were not usable" in msg:
+                declined += msg.count("ShapedArray")
+    text = lowered.as_text()
+    aliased = text.count("tf.aliasing_output") + text.count(
+        "jax.buffer_donor"
+    )
+    return {"aliased": aliased, "declined": declined}
+
+
+# ---------------------------------------------------------------------------
+# Dispatch-shape discipline
+# ---------------------------------------------------------------------------
+
+
+def chunk_invar_problem(closed_jaxpr: Any, index: int) -> str | None:
+    """Verify the declared chunk-index operand of a streamed/chunked
+    route: it must exist as a traced invar (a chunk index baked in as a
+    Python int disappears from the signature — the retrace bomb), be a
+    scalar integer, and actually steer the graph (an ignored index means
+    every chunk computes the same thing).  -> a problem description, or
+    None when the discipline holds."""
+    jaxpr = closed_jaxpr.jaxpr
+    if index >= len(jaxpr.invars):
+        return (
+            f"declared chunk-index invar {index} does not exist (only "
+            f"{len(jaxpr.invars)} invars traced) — the chunk index was "
+            "baked in as a Python constant, so every chunk index "
+            "compiles its own executable"
+        )
+    v = jaxpr.invars[index]
+    aval = v.aval
+    if getattr(aval, "shape", None) != ():
+        return (
+            f"chunk-index invar {index} is not a scalar "
+            f"(shape {getattr(aval, 'shape', '?')})"
+        )
+    if not np.issubdtype(np.dtype(aval.dtype), np.integer):
+        return f"chunk-index invar {index} is not an integer ({aval.dtype})"
+
+    # Top-level scan only: sub-jaxprs bind FRESH Vars for their invars,
+    # so an outer invar can never appear inside one by identity — the
+    # equation that feeds it downward is itself the use we scan for.
+    used = any(
+        any(iv is v for iv in eqn.invars) for eqn in jaxpr.eqns
+    )
+    if not used:
+        return (
+            f"chunk-index invar {index} is never read — the chunk "
+            "dispatch cannot depend on it"
+        )
+    return None

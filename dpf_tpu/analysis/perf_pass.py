@@ -1,0 +1,51 @@
+"""Pass 6: jaxpr-level performance-contract verification (the
+``perf-contract`` pass).
+
+Re-uses the oblivious-trace pass's route traces through the shared
+trace cache (``trace/entrypoints.trace_route_cached`` — one lint run
+traces each route once, not once per pass), runs the resource model
+(``perf/model.py``) against every route's declared
+:class:`~dpf_tpu.analysis.perf.contracts.PerfContract`, lowers the
+production donated twins, and fails on
+
+  * any budget violation (collective census, loop collectives, host
+    crossings, donation live-copies, dropped donation, chunk-index
+    retrace hazards), and
+  * certificate drift: a route whose certificate no longer matches the
+    committed ``docs/perf_contracts.json`` (re-certify with
+    ``python -m dpf_tpu.analysis --write-perf-contracts``).
+
+Same foreign-root policy as the oblivious-trace pass: the traced routes
+are always the imported checkout's, so a foreign ``--root`` gets one
+explanatory finding instead of a misleading verdict.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .common import Finding, repo_root
+
+PASS = "perf-contract"
+
+
+def run(root: str, files=None) -> list[Finding]:
+    if os.path.realpath(root) != os.path.realpath(repo_root()):
+        return [
+            Finding(
+                "dpf_tpu/analysis/perf", 0, PASS,
+                "the perf-contract verifier only certifies the checkout "
+                "it is imported from; run it from the target tree",
+            )
+        ]
+    from .perf import certify
+
+    certs, perf_findings = certify.verify_routes()
+    out: list[Finding] = []
+    for f in perf_findings:
+        out.append(
+            Finding(f"perf://{f.where}", 0, PASS, f"[{f.kind}] {f.message}")
+        )
+    for msg in certify.drift(root, certs):
+        out.append(Finding(certify.PERF_JSON, 0, PASS, msg))
+    return out

@@ -1,0 +1,169 @@
+"""Pass: test-suite wiring discipline (``test-discipline``).
+
+The test surface is wired together by convention — runtests.sh lane
+file lists, pytest.ini marker declarations, and the conftest
+collection-order hook all reference test files and marker names as bare
+strings — and every one of those references fails SILENTLY when it goes
+stale: a renamed file just drops out of its lane, an undeclared marker
+makes ``-m 'not slow'`` select nothing extra (pytest only warns), and
+the PR 8 collection-order hook quietly stops reordering.  Four rules:
+
+  T1  every ``tests/test_*.py`` named in a runtests.sh lane exists on
+      disk (a stale lane reference means that lane silently stopped
+      running the file — or errors on every invocation).
+  T2  runtests.sh keeps a bare ``tests/`` tier-1 lane (the default
+      ``set -- tests/ ...``): with it, every on-disk test file is
+      reachable from at least one lane; without it, any file missing
+      from the named lanes would silently never run.
+  T3  every ``pytest.mark.<name>`` used under tests/ is either a pytest
+      builtin or declared in pytest.ini's ``markers`` section — an
+      undeclared marker is exactly how a "slow" test ends up inside the
+      tier-1 wall-clock budget (the ``-m`` filter doesn't know it).
+  T4  every ``test_*.py`` file name referenced in tests/conftest.py
+      (the collection-order hook) exists — renaming the workload suite
+      must not silently turn the hook into a no-op.
+
+Scopes to the scanned root, so tests exercise it on synthetic trees; a
+root without runtests.sh (a foreign --root) produces no findings (the
+conventions under test are this repo's).
+"""
+
+from __future__ import annotations
+
+import ast
+import configparser
+import os
+import re
+
+from .common import Finding, parse_file
+
+PASS = "test-discipline"
+
+_TEST_REF = re.compile(r"tests/test_[A-Za-z0-9_]+\.py")
+_TIER1_GLOB = re.compile(r"set\s+--\s+tests/\s")
+# Marks pytest owns (plus plugin marks the tree may legitimately use
+# without declaring) — everything else must be declared in pytest.ini.
+_BUILTIN_MARKS = frozenset(
+    {
+        "parametrize", "skip", "skipif", "xfail", "usefixtures",
+        "filterwarnings", "tryfirst", "trylast",
+    }
+)
+
+
+def _declared_markers(root: str) -> set[str] | None:
+    """Marker names declared in pytest.ini (None when unreadable)."""
+    path = os.path.join(root, "pytest.ini")
+    cp = configparser.ConfigParser()
+    try:
+        with open(path, encoding="utf-8") as f:
+            cp.read_file(f)
+        raw = cp.get("pytest", "markers")
+    except (OSError, configparser.Error):
+        return None
+    out = set()
+    for line in raw.splitlines():
+        line = line.strip()
+        if line:
+            out.add(line.split(":", 1)[0].split("(", 1)[0].strip())
+    return out
+
+
+def _mark_uses(tree: ast.Module) -> list[tuple[str, int]]:
+    """(marker name, line) for every ``pytest.mark.<name>`` attribute
+    chain (covers decorators, ``pytestmark = ...`` lists, and inline
+    ``pytest.mark.slow`` applications)."""
+    out = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr == "mark"
+            and isinstance(node.value.value, ast.Name)
+            and node.value.value.id == "pytest"
+        ):
+            out.append((node.attr, node.lineno))
+    return out
+
+
+def run(root: str, files=None) -> list[Finding]:
+    runtests = os.path.join(root, "runtests.sh")
+    if not os.path.isfile(runtests):
+        return []  # foreign root: these are THIS repo's conventions
+    with open(runtests, encoding="utf-8") as f:
+        lanes_src = f.read()
+    out: list[Finding] = []
+
+    # T1: lane references resolve.
+    lane_refs = sorted(set(_TEST_REF.findall(lanes_src)))
+    for rel in lane_refs:
+        if not os.path.isfile(os.path.join(root, rel)):
+            line = next(
+                i for i, ln in enumerate(lanes_src.splitlines(), 1)
+                if rel in ln
+            )
+            out.append(Finding(
+                "runtests.sh", line, PASS,
+                f"lane references {rel}, which does not exist — the lane "
+                "silently dropped it (renamed or deleted without "
+                "re-wiring)",
+            ))
+
+    # T2: the tier-1 tests/ glob lane still exists; with it every
+    # on-disk file is reachable, without it unlisted files never run.
+    disk = sorted(
+        f"tests/{fn}" for fn in os.listdir(os.path.join(root, "tests"))
+        if fn.startswith("test_") and fn.endswith(".py")
+    ) if os.path.isdir(os.path.join(root, "tests")) else []
+    if not _TIER1_GLOB.search(lanes_src):
+        out.append(Finding(
+            "runtests.sh", 0, PASS,
+            "the tier-1 'set -- tests/' glob lane is gone — every test "
+            "file not named in a specific lane now silently never runs",
+        ))
+        for rel in disk:
+            if rel not in lane_refs:
+                out.append(Finding(
+                    rel, 0, PASS,
+                    "not registered in any runtests.sh lane (and the "
+                    "tier-1 tests/ glob is gone)",
+                ))
+
+    # T3: marker discipline.
+    declared = _declared_markers(root)
+    if declared is None:
+        out.append(Finding(
+            "pytest.ini", 0, PASS,
+            "missing or unreadable markers section — every custom "
+            "pytest.mark becomes an undeclared (silently ignored by "
+            "-m) marker",
+        ))
+        declared = set()
+    for rel in disk:
+        try:
+            tree, _ = parse_file(root, rel)
+        except (OSError, SyntaxError):
+            continue
+        for name, line in _mark_uses(tree):
+            if name not in _BUILTIN_MARKS and name not in declared:
+                out.append(Finding(
+                    rel, line, PASS,
+                    f"pytest.mark.{name} is not declared in pytest.ini — "
+                    "-m lane filters silently ignore it, so the marked "
+                    "tests land in whatever lane collects them",
+                ))
+
+    # T4: conftest file references resolve (the collection-order hook).
+    conftest = os.path.join(root, "tests", "conftest.py")
+    if os.path.isfile(conftest):
+        with open(conftest, encoding="utf-8") as f:
+            src = f.read()
+        for i, line in enumerate(src.splitlines(), 1):
+            for ref in re.findall(r"test_[A-Za-z0-9_]+\.py", line):
+                if not os.path.isfile(os.path.join(root, "tests", ref)):
+                    out.append(Finding(
+                        "tests/conftest.py", i, PASS,
+                        f"references {ref}, which does not exist — the "
+                        "collection-order hook is a silent no-op for it",
+                    ))
+    return out

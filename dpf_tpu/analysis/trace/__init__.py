@@ -44,7 +44,11 @@ Three modules:
                   without re-certification
                   (``python -m dpf_tpu.analysis --write-oblivious``).
 
-Run as the fifth analysis pass (``oblivious-trace``) under
+The perf-contract pass (``analysis/perf/``) consumes the same route
+traces through ``entrypoints.trace_route_cached`` — one lint run traces
+each route once, and the two certificate ledgers pin the same hash.
+
+Run as the ``oblivious-trace`` analysis pass under
 ``python -m dpf_tpu.analysis`` / ``scripts/lint_all.sh`` /
 ``runtests.sh --lint``.
 """

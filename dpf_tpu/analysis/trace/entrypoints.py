@@ -34,7 +34,9 @@ from typing import Any, Callable
 
 import numpy as np
 
-__all__ = ["Route", "ROUTES", "trace_route", "vmem_budgets"]
+__all__ = [
+    "Route", "ROUTES", "trace_route", "trace_route_cached", "vmem_budgets",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1081,3 +1083,22 @@ def vmem_budgets() -> dict[str, int]:
 def trace_route(route: Route):
     """-> (ClosedJaxpr, secret invar set).  Separated for tests."""
     return route.build()
+
+
+# One trace per route per process: the oblivious-trace pass (taint
+# lattice + certificate drift) and the perf-contract pass (collective /
+# donation / dispatch budgets + cost model) both consume the same
+# ClosedJaxpr, so a lint run (`python -m dpf_tpu.analysis`) traces each
+# route once, not once per pass — tracing is the dominant cost of both.
+_TRACE_CACHE: dict[str, tuple] = {}
+
+
+def trace_route_cached(route: Route):
+    """Memoized :func:`trace_route` keyed on the route name.  Safe to
+    share across passes: routes trace UNWRAPPED bodies with
+    deterministic shapes, so the (jaxpr, secret-invar) pair is a pure
+    function of the route and the jax version."""
+    got = _TRACE_CACHE.get(route.name)
+    if got is None:
+        got = _TRACE_CACHE[route.name] = route.build()
+    return got

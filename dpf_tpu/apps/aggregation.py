@@ -24,7 +24,11 @@ through the plan cache (``core/plans.run_agg_fold``; rows/words
 bucketed), and the fold bodies carry obliviousness certificates
 (``agg/fold_xor`` / ``agg/fold_add`` in docs/OBLIVIOUS.md): a fold is
 pure elementwise/reduction dataflow — no secret-dependent branch, index,
-or shape.
+or shape.  The fold bodies ALSO carry performance contracts
+(docs/PERF_CONTRACTS.md, DESIGN §16): zero collectives single-device,
+exactly ONE all-reduce per chunk on the mesh with the dead carry
+donated across shards — the "one all-reduce per chunk" headline is a
+lint failure to regress, not a docstring.
 
 ``aggregate_eval_full`` closes the loop with the DPF layer: the
 aggregator holds client KEYS (not vectors) and folds their full-domain

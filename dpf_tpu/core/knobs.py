@@ -16,8 +16,11 @@ module is the single source of truth:
     time instead of silently returning a default;
   * the static-analysis suite (``python -m dpf_tpu.analysis``) rejects
     any direct ``os.environ`` / ``os.getenv`` read of a ``DPF_TPU_*``
-    name outside this file, and any ``DPF_TPU_*`` string literal in the
-    tree that is not declared here;
+    name outside this file, any ``DPF_TPU_*`` string literal in the
+    tree that is not declared here, AND any knob declared here that no
+    non-fixture module reads (dead knobs rot into documentation lies as
+    the registry passes 45+ entries; ``# knob-unused-ok`` on a
+    ``_declare`` line is the reviewed escape hatch);
   * ``audit_environ()`` reports ``DPF_TPU_*`` vars present in the
     process environment but not declared — the sidecar warns on boot
     (a deployment's typo'd knob used to fail silent);

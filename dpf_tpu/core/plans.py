@@ -105,9 +105,24 @@ def q_bucket(q: int) -> int:
 # ---------------------------------------------------------------------------
 
 
+# The closed set of plan-cacheable dispatch routes.  Every PlanKey's
+# ``route`` field must come from here: the zero-retrace-after-warmup
+# contract ("after warmup, serving traffic never traces") is only
+# provable for routes the plan layer buckets, and the perf-contract
+# analysis pass cross-checks every certified entrypoint's declared
+# plan route against this registry — an entrypoint claiming an
+# unregistered plan route is attesting a dispatch path that does not
+# exist.
+PLAN_ROUTES = frozenset(
+    {
+        "points", "dcf_points", "dcf_interval", "evalfull", "hh_level",
+        "agg_xor", "agg_add", "pir",
+    }
+)
+
+
 class PlanKey(NamedTuple):
-    route: str  # "points" | "dcf_points" | "dcf_interval" | "evalfull"
-    #            | "hh_level" | "agg_xor" | "agg_add" | "pir"
+    route: str  # one of PLAN_ROUTES
     profile: str  # "compat" | "fast"
     log_n: int
     k_bucket: int
@@ -124,6 +139,11 @@ def plan_key(
 ) -> PlanKey:
     from ..ops import sbox_circuit
 
+    if route not in PLAN_ROUTES:
+        raise ValueError(
+            f"plans: unknown route {route!r} (registered: "
+            f"{'/'.join(sorted(PLAN_ROUTES))})"
+        )
     # The K bucket floors at the shard count: a pow2 bucket >= shards
     # divides evenly across a pow2 mesh, so the bucket pad doubles as
     # the mesh pad and per-shard key counts are always whole.

@@ -42,7 +42,11 @@ tR(1) | VCW(1)) | FVCW(64)  ->  81 + 19 * nu bytes; one key per gate vs
 Evaluation is a batched root-to-leaf walk with the same structure as
 models/dpf_chacha._eval_points_cc_body plus the accumulator, and routes
 through the Pallas whole-walk kernel on TPU (ops/chacha_pallas.py, dcf
-mode).  The compat (AES) profile has no DCF: its 2-call fixed-key MMO PRG
+mode).  The dcf_points/dcf_interval routes carry both certificate
+kinds: obliviousness (docs/OBLIVIOUS.md) and a zero-collective /
+zero-callback performance contract (docs/PERF_CONTRACTS.md) — a
+comparison walk that grew a cross-device reduce or a host round trip
+fails lint before it reaches a bench.  The compat (AES) profile has no DCF: its 2-call fixed-key MMO PRG
 has no spare output word, and reference key compatibility pins its wire
 format — comparison on compat keys stays the per-level construction in
 models/fss.py.

@@ -394,6 +394,16 @@ def _finish_chunks_scan_body(
 _finish_chunks_scan_jit = partial(jax.jit, static_argnums=(0, 1, 8))(
     _finish_chunks_scan_body
 )
+# The donation surface of this module: twin name -> (static_argnums,
+# donate_argnums), mirroring the jit declarations below.  The
+# perf-contract analysis pass (dpf_tpu/analysis/perf/) lowers each twin
+# and verifies the declared buffers actually reach XLA donated and are
+# never returned live — so this table and the literals below cannot
+# drift apart silently.
+DONATED_TWINS = {
+    "_finish_chunks_scan_donated_jit": ((0, 1, 8), (2, 3)),
+    "_finish_chunk_donated_jit": ((0, 1, 8), (2, 3)),
+}
 # Donated twin (the serving fast path, core/plans.donation_enabled): the
 # prefix level-state carries (S, T) are dead once the finish consumes
 # them, so XLA may reuse their buffers in place — steady-state chunked

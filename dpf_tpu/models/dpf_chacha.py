@@ -183,6 +183,14 @@ def _finish_chunks_cc_scan_body(
 _finish_chunks_cc_scan_jit = partial(jax.jit, static_argnums=(0, 1))(
     _finish_chunks_cc_scan_body
 )
+# Donation surface (see models/dpf.DONATED_TWINS): twin name ->
+# (static_argnums, donate_argnums), verified against the actual
+# lowerings by the perf-contract analysis pass.
+DONATED_TWINS = {
+    "_finish_chunks_cc_scan_donated_jit": ((0, 1), (2, 3, 4, 5, 6)),
+    "_finish_chunk_cc_donated_jit": ((0, 1), (2, 3)),
+    "_finish_pk_chunks_donated_jit": ((0, 1, 2, 3), (4, 5, 6, 7, 8)),
+}
 # Donated twin (core/plans.donation_enabled): the prefix level-state
 # carries are dead once the finish consumes them — see the compat
 # mirror models/dpf._finish_chunks_scan_donated_jit.

@@ -30,7 +30,12 @@ to host mid-scan), each chunk XORs into a device-carried accumulator
 whose buffer is donated (``DPF_TPU_DONATE``), and under a mesh the
 per-shard partials meet in exactly ONE parity all-reduce per query
 batch, after the last chunk.  The answer bytes are identical to the
-one-shot scan's — pinned by tests/test_pir_serving.py.
+one-shot scan's — pinned by tests/test_pir_serving.py.  The schedule
+claims are performance contracts (docs/PERF_CONTRACTS.md, DESIGN §16):
+zero collectives per streamed chunk, one all-reduce per query batch,
+the accumulator donation surviving into the lowering, and the chunk
+index a traced operand (one executable for every chunk) are verified
+statically by the perf-contract lint pass, not just by these tests.
 """
 
 from __future__ import annotations
@@ -621,6 +626,13 @@ def _pir_expand_fast_sharded(
     return PIR_JITS.register(
         jax.jit(_pir_expand_fast_sharded_sm(mesh, nu, subtree_levels, entry))
     )
+
+
+# The donated accumulator position of BOTH streamed-chunk jits below
+# (single-device and sharded share the (sel, db, acc, j) signature).
+# The perf-contract analysis pass lowers the donate=True factories and
+# verifies the accumulator actually reaches XLA donated.
+STREAM_CHUNK_DONATE_ARGNUMS = (2,)
 
 
 def _pir_stream_chunk_body(chunk_rows: int, n_inner: int, stream_rows: int):

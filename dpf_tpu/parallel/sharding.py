@@ -845,6 +845,13 @@ def _sharded_agg_fold_sm(mesh: Mesh, op: str):
     )
 
 
+# The donated carry position of the sharded fold jit below (the
+# perf-contract analysis pass lowers the donate=True factory and
+# verifies the carry actually reaches XLA donated — this constant is
+# its declared expectation, kept next to the jit it describes).
+AGG_FOLD_DONATE_ARGNUMS = (0,)
+
+
 @cache
 def _sharded_agg_fold(mesh: Mesh, op: str, donate: bool = False):
     fn = _sharded_agg_fold_sm(mesh, op)

@@ -8,11 +8,14 @@
 #       excluded by the default -m; append your own -m to override, e.g.
 #       `./runtests.sh -m slow` for the fused acceptance sweep, or
 #       `./runtests.sh -m ''` for absolutely everything)
-#   ./runtests.sh --lint                 static-analysis lane: the five
-#       repo-native passes (knob registry, secret hygiene, host-sync,
-#       pallas/jit discipline, and the oblivious-trace jaxpr verifier
-#       with its certificate drift check) + docs/KNOBS.md drift + mypy
-#       typed-core and Go vet/fmt when those toolchains exist —
+#   ./runtests.sh --lint                 static-analysis lane: the seven
+#       repo-native passes (knob registry incl. unused-knob detection,
+#       secret hygiene, host-sync, pallas/jit discipline, test-suite
+#       wiring discipline, the oblivious-trace jaxpr verifier with its
+#       certificate drift check, and the perf-contract verifier with its
+#       collective/donation/dispatch budgets — one shared trace cache, so
+#       each route traces once) + docs/KNOBS.md drift + mypy typed-core
+#       and Go vet/fmt when those toolchains exist —
 #       scripts/lint_all.sh, hermetic, no TPU.
 #   ./runtests.sh --fast [pytest args]   kernel differential smoke lane
 #       (now incl. the protocol-applications layer, tests/test_apps.py —
@@ -67,8 +70,8 @@ elif [ "${1:-}" = "--fast" ]; then
       tests/test_fused_expand.py tests/test_aes_bitslice.py \
       tests/test_packed.py tests/test_serving.py tests/test_obs.py \
       tests/test_serving_stress.py tests/test_analysis.py \
-      tests/test_oblivious.py tests/test_apps.py \
-      tests/test_pir_serving.py \
+      tests/test_oblivious.py tests/test_perf_contracts.py \
+      tests/test_apps.py tests/test_pir_serving.py \
       -q -m 'not slow' "$@"
 else
   # -m is last-wins in pytest, so a caller-supplied -m overrides ours.

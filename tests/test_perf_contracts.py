@@ -1,0 +1,227 @@
+"""The performance-contract verifier: committed certificates pin the
+headline structural claims, cheap routes re-verify live, donation sites
+lower with their buffers actually donated, and the chunk dispatch
+discipline holds behaviorally (one executable across chunk indices).
+
+Cheap subset in the default lane; the full 48-route matrix runs in the
+lint lane (``python -m dpf_tpu.analysis``) and in the slow-marked full
+check here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from dpf_tpu.analysis.common import repo_root
+from dpf_tpu.analysis.perf import PERF_CONTRACT_VERSION, certify
+from dpf_tpu.analysis.perf.contracts import CONTRACTS, plan_route_problems
+from dpf_tpu.analysis.trace.entrypoints import ROUTES, trace_route_cached
+
+ROOT = repo_root()
+
+_CHEAP = (
+    "points/fast/xla/packed",
+    "evalfull_stream/fast",
+    "pir/stream_chunk",
+    "agg/fold_xor",
+)
+
+
+def _committed():
+    with open(os.path.join(ROOT, "docs", "perf_contracts.json")) as f:
+        return json.load(f)
+
+
+def _route(name):
+    (r,) = [r for r in ROUTES if r.name == name]
+    return r
+
+
+# ---------------------------------------------------------------------------
+# Committed-artifact facts (no tracing — these pin the acceptance bar)
+# ---------------------------------------------------------------------------
+
+
+def test_every_route_carries_a_contract_and_certificate():
+    names = sorted(r.name for r in ROUTES)
+    assert sorted(CONTRACTS) == names
+    committed = _committed()
+    assert committed["perf_contract_version"] == PERF_CONTRACT_VERSION
+    assert sorted(committed["routes"]) == names, (
+        "docs/perf_contracts.json route set drifted from the matrix — "
+        "re-certify with 'python -m dpf_tpu.analysis "
+        "--write-perf-contracts'"
+    )
+    for name, cert in committed["routes"].items():
+        for field in ("plan_route", "jaxpr_sha256", "contract", "observed",
+                      "cost"):
+            assert field in cert, (name, field)
+        assert cert["cost"]["flops"] > 0, name
+        assert cert["cost"]["hbm_bytes"] > 0, name
+        assert cert["observed"]["callbacks"] <= cert["contract"]["callbacks"]
+
+
+def test_hash_bind_to_oblivious_certificates():
+    """One trace, two ledgers: every perf certificate's jaxpr hash MUST
+    equal the obliviousness certificate's for the same route — the two
+    artifacts can never attest different graphs."""
+    with open(os.path.join(ROOT, "docs", "oblivious.json")) as f:
+        oblivious = json.load(f)["routes"]
+    for name, cert in _committed()["routes"].items():
+        assert cert["jaxpr_sha256"] == oblivious[name]["jaxpr_sha256"], name
+
+
+def test_one_allreduce_per_chunk_pinned():
+    """The headline claims, as committed facts: exactly ONE all-reduce
+    per sharded aggregation chunk, ZERO collectives per streamed PIR DB
+    chunk, exactly ONE parity all-reduce per PIR query batch, and zero
+    collectives on every non-mesh route."""
+    routes = _committed()["routes"]
+    assert routes["agg_sharded/fold_xor"]["observed"]["collectives"] == {
+        "all_gather": 1
+    }
+    assert routes["agg_sharded/fold_add"]["observed"]["collectives"] == {
+        "psum": 1
+    }
+    assert routes["pir/stream_chunk_sharded"]["observed"]["collectives"] == {}
+    assert routes["pir/stream_combine_sharded"]["observed"][
+        "collectives"
+    ] == {"all_gather": 1}
+    for name in ("pir/scan_sharded/compat/xla", "pir/scan_sharded/fast/xla"):
+        assert routes[name]["observed"]["collectives"] == {"all_gather": 1}
+    for name, cert in routes.items():
+        if "sharded" not in name:
+            assert cert["observed"]["collectives"] == {}, name
+
+
+def test_donation_sites_committed():
+    """Every production donated twin is in the committed ledger, with
+    its declared leaves covered by aliased + declined evidence (the
+    Mosaic twin is jaxpr-checked only — CPU cannot lower it)."""
+    sites = _committed()["donation_sites"]
+    assert len(sites) >= 9
+    for name, d in sites.items():
+        if d.get("lowered") is False:
+            continue
+        assert d["aliased"] + d["declined"] >= d["donated_leaves"], name
+    # The serving carries specifically:
+    assert sites["models.pir._pir_stream_chunk"]["aliased"] == 1
+    assert sites["parallel.sharding._sharded_agg_fold[xor]"]["aliased"] == 1
+
+
+def test_perf_md_in_sync_with_sidecar():
+    committed = _committed()
+    with open(os.path.join(ROOT, "docs", "PERF_CONTRACTS.md")) as f:
+        md = f.read()
+    assert md == certify.render_markdown(committed), (
+        "docs/PERF_CONTRACTS.md is stale vs docs/perf_contracts.json — "
+        "re-certify with 'python -m dpf_tpu.analysis "
+        "--write-perf-contracts'"
+    )
+
+
+def test_plan_route_registration_cross_check():
+    from dpf_tpu.core import plans
+
+    assert plan_route_problems() == []
+    with pytest.raises(ValueError, match="unknown route"):
+        plans.plan_key("definitely_not_a_route", "fast", 10, 1)
+
+
+# ---------------------------------------------------------------------------
+# Live cheap-route verification (the default-lane drift check)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", _CHEAP)
+def test_cheap_route_contract_clean_and_cert_pinned(name):
+    route = _route(name)
+    closed, _secret = trace_route_cached(route)
+    findings = certify.check_route(closed, CONTRACTS[name], name)
+    assert findings == [], [(f.kind, f.message) for f in findings]
+    committed = _committed()["routes"][name]
+    from dpf_tpu.analysis.trace.taint import jaxpr_hash
+
+    assert jaxpr_hash(closed) == committed["jaxpr_sha256"], (
+        f"{name}: traced jaxpr drifted from the committed perf "
+        "certificate — re-certify"
+    )
+    assert certify.cost_model(closed) == committed["cost"]
+
+
+def test_shared_trace_cache_is_shared():
+    """oblivious-trace and perf-contract consume ONE trace per route:
+    the cache returns the identical ClosedJaxpr object on re-query."""
+    route = _route("points/fast/xla/packed")
+    a, sa = trace_route_cached(route)
+    b, sb = trace_route_cached(route)
+    assert a is b and sa == sb
+
+
+def test_donation_site_live_cheap():
+    """The single-device streamed-PIR accumulator, verified live: the
+    production factory's jit still declares the donation and the
+    lowering aliases it."""
+    from dpf_tpu.analysis.perf.contracts import donation_sites
+
+    (site,) = [
+        s for s in donation_sites()
+        if s.name == "models.pir._pir_stream_chunk"
+    ]
+    evidence, findings = certify.check_donation_site(site)
+    assert findings == []
+    assert evidence["aliased"] == 1
+
+
+def test_chunk_dispatch_one_executable():
+    """The behavioral twin of the chunk-index-static check: dispatching
+    the streamed-PIR chunk body at two different chunk indices grows
+    plans.trace_count by at most the FIRST compile — chunk j is a traced
+    operand, so chunk 1 reuses chunk 0's executable."""
+    import jax.numpy as jnp
+
+    from dpf_tpu.core import plans
+    from dpf_tpu.models import pir
+
+    jitted = pir._pir_stream_chunk(64, 1, 64)
+    sel = jnp.zeros((8, 4), jnp.uint32)
+    db = jnp.zeros((128, 2), jnp.uint32)
+    acc = jnp.zeros((8, 2), jnp.uint32)
+    jitted(sel, db, acc, jnp.int32(0)).block_until_ready()
+    before = plans.trace_count()
+    jitted(sel, db, acc, jnp.int32(1)).block_until_ready()
+    assert plans.trace_count() == before
+
+
+def test_verifier_version_stamped_in_ledger_key(monkeypatch):
+    import sys
+
+    monkeypatch.setenv("DPF_TPU_BENCH_LEDGER_KEY", "pinned")
+    sys.path.insert(0, ROOT)
+    try:
+        import bench_all
+
+        key = bench_all._ledger_key("small")
+    finally:
+        sys.path.remove(ROOT)
+    assert key["perf"] == PERF_CONTRACT_VERSION
+
+
+# ---------------------------------------------------------------------------
+# Full matrix (slow: traces all 48 routes + lowers every donation site)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_full_matrix_clean_and_no_drift():
+    certs, findings = certify.verify_routes()
+    assert findings == [], [
+        (f.where, f.kind, f.message) for f in findings
+    ]
+    assert sorted(k for k in certs if k != "__donation__") == sorted(
+        r.name for r in ROUTES
+    )
+    assert certify.drift(ROOT, certs) == []

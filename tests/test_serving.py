@@ -102,6 +102,46 @@ def test_plan_cache_zero_retrace_after_warmup():
     assert plans.cache().stats()["hits"] >= hits0 + len(reqs)
 
 
+def test_recent_shapes_excludes_pir_rewarms_the_rest():
+    """The breaker's half-open re-warm contract: pir plans are EXCLUDED
+    from recent_shapes (a pir plan is keyed on the DB's shape, not its
+    name — the probe cannot reconstruct which registered database to
+    scan), while points/hh/agg plans re-warm.  Pinned here so a future
+    route addition that breaks the exclusion (or accidentally extends
+    it) fails loudly instead of wedging the half-open trial."""
+    from dpf_tpu.core.plans import PlanKey
+
+    cache = plans.cache()
+    seeded = [
+        plans.plan_key("points", "fast", 10, 4, 32),
+        plans.plan_key("hh_level", "fast", 12, 8, 64),
+        plans.plan_key("agg_xor", "agg", 0, 32, 64 * 32),
+        PlanKey("pir", "fast", 12, 8, 64, True, "off", "bp113", 0),
+    ]
+    import time as _time
+
+    try:
+        for i, key in enumerate(seeded):
+            plan, _ = cache.get(key)
+            # Strictly newer than anything earlier tests dispatched, so
+            # these four ARE the recent set regardless of test order.
+            plan.last_used = _time.time() + 1e6 + i
+        shapes = plans.recent_shapes(limit=len(seeded))
+        routes = [s["route"] for s in shapes]
+        assert "pir" not in routes, shapes
+        assert {"points", "hh_level", "agg_xor"} <= set(routes), shapes
+        # The warmup-spec shape survives the round trip (q only when
+        # the plan has a q bucket).
+        for s in shapes:
+            assert set(s) <= {"route", "profile", "log_n", "k", "q"}
+            if s["route"] in ("points", "hh_level", "agg_xor"):
+                assert s["q"] >= 32
+    finally:
+        with cache._lock:
+            for key in seeded:
+                cache._plans.pop(key, None)
+
+
 def test_plan_repeat_key_batch_reuses_padding():
     """The pad memo keeps a re-used batch on the same padded object so
     device-side operand caches survive across requests."""
