@@ -119,6 +119,11 @@ _ROUTE_KNOBS = (
     # Served-PIR knobs (cfg-pir): the matmul chunk granularity and the
     # streamed-scan threshold select distinct executables and schedules.
     "DPF_TPU_PIR_CHUNK_ROWS", "DPF_TPU_PIR_DB_CHUNK_BYTES",
+    # wire2 knobs (cfg-wire): which fronts are up and how the binary
+    # front buffers/admits shape the transport-comparison rows — a
+    # wire2 row must never collide with an HTTP-only row on resume.
+    "DPF_TPU_WIRE2", "DPF_TPU_WIRE2_PORT", "DPF_TPU_WIRE2_MAX_STREAMS",
+    "DPF_TPU_WIRE2_RECV_BUF_BYTES", "DPF_TPU_WIRE2_MAX_BODY_BYTES",
 )
 # DPF_TPU_BENCH_LEDGER_RETRY_ERRORS=1: sections whose recorded rows
 # contain an error row are NOT replayed (and not re-recorded) — the
@@ -1532,6 +1537,309 @@ def main():
             )
 
     _section("cfg-apps", cfg_apps)
+
+    # ---- wire transports: HTTP/1.1 vs wire2 at matched concurrency ---------
+    # The ISSUE-14 acceptance rows: agg fold shares/s and HH round
+    # key-evals/s through BOTH serving fronts at 64-way client
+    # concurrency, every compared reply byte-identical (a wrong answer
+    # raises — never a throughput row), plus the marshalling-overhead
+    # row from the per-front allocation probe (/v1/stats "wire"): bytes
+    # COPIED per request between socket buffer and dispatch operand —
+    # clen on HTTP/1.1, ZERO on wire2 (enforced: a nonzero wire2 count
+    # fails the section).
+    #
+    # Regime: the section runs with DPF_TPU_BATCH=off (stamped in the
+    # route) so the rows isolate the TRANSPORT: with the micro-batcher
+    # on, concurrent same-lane requests coalesce into one dispatch and
+    # the wire cost disappears into the amortization on both fronts —
+    # correct serving behavior, useless as a marshalling measurement.
+    # The HTTP leg uses per-thread keep-alive connections (http.client
+    # — the Go bridge's pooled-Transport shape); the wire2 leg ONE
+    # multiplexed connection shared by all threads.  Legs alternate and
+    # commit best-of-3 walls (this harness shares its core with the
+    # measurement process, so worst-case walls measure the scheduler,
+    # not the front).
+    def cfg_wire():
+        import http.client as hc
+        import threading as _th
+        import urllib.request
+
+        from dpf_tpu import server as srv_mod
+        from dpf_tpu.serving.wire2 import Wire2Client
+
+        conc = 64
+        knob_env = {
+            "DPF_TPU_WIRE2": "on",
+            "DPF_TPU_WIRE2_PORT": "0",
+            "DPF_TPU_BATCH": "off",
+        }
+        saved = {k: os.environ.get(k) for k in knob_env}
+        os.environ.update(knob_env)
+        srv_mod.reset_serving_state()
+        s = srv_mod.serve(port=0)
+        try:
+            hhost, hport = "127.0.0.1", s.server_address[1]
+            whost, wport = s.wire2.address[0], s.wire2.address[1]
+            base = f"http://{hhost}:{hport}"
+
+            def post(path, body=b""):
+                req = urllib.request.Request(
+                    base + path, data=body, method="POST"
+                )
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    return r.read()
+
+            def run_http(path, body, want, n_reqs):
+                """n_reqs POSTs over conc keep-alive connections; every
+                reply must equal ``want``.  Returns wall seconds."""
+                errs = []
+                lock = _th.Lock()
+                counter = [0]
+
+                def worker():
+                    conn = hc.HTTPConnection(hhost, hport, timeout=120)
+                    try:
+                        while True:
+                            with lock:
+                                if counter[0] >= n_reqs:
+                                    return
+                                counter[0] += 1
+                            conn.request("POST", path, body)
+                            out = conn.getresponse().read()
+                            if out != want:
+                                raise RuntimeError(
+                                    "cfg-wire: http reply drifted"
+                                )
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(e)
+                    finally:
+                        conn.close()
+
+                threads = [
+                    _th.Thread(target=worker) for _ in range(conc)
+                ]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(600)
+                wall = time.perf_counter() - t0
+                if errs:
+                    raise errs[0]
+                if any(t.is_alive() for t in threads):
+                    raise RuntimeError("cfg-wire: http leg wedged")
+                return wall
+
+            def run_wire2(route, params, body, want, n_reqs):
+                """n_reqs streams over ONE multiplexed connection, conc
+                worker threads; every reply must equal ``want``."""
+                errs = []
+                lock = _th.Lock()
+                counter = [0]
+                with Wire2Client(whost, wport) as w2:
+
+                    def worker():
+                        try:
+                            while True:
+                                with lock:
+                                    if counter[0] >= n_reqs:
+                                        return
+                                    counter[0] += 1
+                                out = w2.request(route, params, body)
+                                if out != want:
+                                    raise RuntimeError(
+                                        "cfg-wire: wire2 reply drifted "
+                                        "from http/1.1"
+                                    )
+                        except Exception as e:  # noqa: BLE001
+                            errs.append(e)
+
+                    threads = [
+                        _th.Thread(target=worker) for _ in range(conc)
+                    ]
+                    t0 = time.perf_counter()
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join(600)
+                    wall = time.perf_counter() - t0
+                    if any(t.is_alive() for t in threads):
+                        # Same guard as the http leg: a hung stream must
+                        # become an error row, never a ~600 s wall
+                        # committed as a throughput number.
+                        raise RuntimeError("cfg-wire: wire2 leg wedged")
+                if errs:
+                    raise errs[0]
+                return wall
+
+            def best_walls(path, qs, route, body, want, n_reqs, reps=3):
+                """Alternate the legs reps times; return (best http wall,
+                best wire2 wall, all walls) — one front's scheduler-noise
+                outlier must not decide the committed ratio."""
+                hw, ww = [], []
+                for _ in range(reps):
+                    hw.append(run_http(path, body, want, n_reqs))
+                    ww.append(run_wire2(route, qs, body, want, n_reqs))
+                return min(hw), min(ww), {
+                    "http_walls_s": [round(w, 3) for w in hw],
+                    "wire2_walls_s": [round(w, 3) for w in ww],
+                }
+
+            # ---- agg fold shares/s -------------------------------------
+            k_req, words = (512, 64) if not small else (128, 32)
+            n_reqs = 384 if not small else 192
+            rows_agg = rng.integers(
+                0, 1 << 32, size=(k_req, words), dtype=np.uint64
+            ).astype(np.uint32)
+            agg_body = rows_agg.tobytes()
+            agg_path = f"/v1/agg/submit?op=xor&k={k_req}&words={words}"
+            agg_qs = f"op=xor&k={k_req}&words={words}"
+            # Warm the fold executables + pin byte identity across
+            # fronts BEFORE the timed legs.
+            want_agg = post(agg_path, agg_body)
+            np.testing.assert_array_equal(
+                np.frombuffer(want_agg, "<u4"),
+                np.bitwise_xor.reduce(rows_agg, axis=0),
+            )
+            run_http(agg_path, agg_body, want_agg, 2 * conc)
+            run_wire2("/v1/agg/submit", agg_qs, agg_body, want_agg,
+                      2 * conc)
+            wall_h, wall_w, walls = best_walls(
+                agg_path, agg_qs, "/v1/agg/submit", agg_body, want_agg,
+                n_reqs,
+            )
+            _emit(
+                f"wire agg xor fold {k_req}x{words}w http/1.1 conc={conc}",
+                n_reqs * k_req / wall_h / 1e6, "Mshares/sec",
+                route=_route("wire,http1,keepalive,agg-fold,batch-off"),
+                bytes_out=words * 4,
+                extra={"requests": n_reqs, "concurrency": conc},
+            )
+            _emit(
+                f"wire agg xor fold {k_req}x{words}w wire2 conc={conc}",
+                n_reqs * k_req / wall_w / 1e6, "Mshares/sec",
+                route=_route("wire,wire2,agg-fold,zero-copy,batch-off"),
+                bytes_out=words * 4,
+                extra=dict(
+                    requests=n_reqs, concurrency=conc,
+                    identical_to_http=True,
+                    speedup_vs_http1=round(wall_h / wall_w, 2),
+                    **walls,
+                ),
+            )
+
+            # ---- hh descent round key-evals/s --------------------------
+            n_hh, k_hh, q_hh, level = (12, 16, 128, 7) if not small else (
+                10, 8, 64, 5
+            )
+            n_reqs_hh = 256 if not small else 160
+            rng_hh = np.random.default_rng(31)
+            vals = rng_hh.integers(
+                0, 1 << n_hh, size=k_hh, dtype=np.uint64
+            )
+            blob = post(
+                f"/v1/hh/gen?log_n={n_hh}&k={k_hh}&profile=fast",
+                vals.tobytes(),
+            )
+            from dpf_tpu.core.chacha_np import key_len as cc_key_len
+
+            kl = cc_key_len(n_hh)
+            per = n_hh * kl
+            level_keys = b"".join(
+                blob[i * per + level * kl : i * per + (level + 1) * kl]
+                for i in range(k_hh)
+            )
+            cands = (
+                rng_hh.integers(0, 1 << (level + 1), size=q_hh,
+                                dtype=np.uint64)
+                << (n_hh - level - 1)
+            ).astype("<u8")
+            hh_body = level_keys + cands.tobytes()
+            hh_path = (
+                f"/v1/hh/eval?log_n={n_hh}&k={k_hh}&q={q_hh}"
+                f"&level={level}&profile=fast&format=packed"
+            )
+            hh_qs = (
+                f"log_n={n_hh}&k={k_hh}&q={q_hh}&level={level}"
+                "&profile=fast&format=packed"
+            )
+            want_hh = post(hh_path, hh_body)
+            run_http(hh_path, hh_body, want_hh, conc)
+            run_wire2("/v1/hh/eval", hh_qs, hh_body, want_hh, conc)
+            evals_per_req = k_hh * q_hh
+            wall_h, wall_w, walls = best_walls(
+                hh_path, hh_qs, "/v1/hh/eval", hh_body, want_hh,
+                n_reqs_hh,
+            )
+            _emit(
+                f"wire hh round {k_hh}x{q_hh} n={n_hh} http/1.1 "
+                f"conc={conc} (fast, packed)",
+                n_reqs_hh * evals_per_req / wall_h / 1e6,
+                "Mkeyevals/sec",
+                route=_route(
+                    "wire,http1,keepalive,hh-descent,packed,batch-off"
+                ),
+                bytes_out=k_hh * ((q_hh + 7) // 8),
+                extra={"requests": n_reqs_hh, "concurrency": conc},
+            )
+            _emit(
+                f"wire hh round {k_hh}x{q_hh} n={n_hh} wire2 "
+                f"conc={conc} (fast, packed)",
+                n_reqs_hh * evals_per_req / wall_w / 1e6,
+                "Mkeyevals/sec",
+                route=_route(
+                    "wire,wire2,hh-descent,packed,zero-copy,batch-off"
+                ),
+                bytes_out=k_hh * ((q_hh + 7) // 8),
+                extra=dict(
+                    requests=n_reqs_hh, concurrency=conc,
+                    identical_to_http=True,
+                    speedup_vs_http1=round(wall_h / wall_w, 2),
+                    # The hh dispatch itself (~1 ms of jax-on-CPU per
+                    # request with the batcher off) bounds this ratio
+                    # on small/CPU runs; the transport win is the
+                    # http1-vs-wire2 OVERHEAD delta, committed above in
+                    # the agg rows where the dispatch is light.
+                    **walls,
+                ),
+            )
+
+            # ---- marshalling overhead: the allocation probe ------------
+            with urllib.request.urlopen(
+                base + "/v1/stats", timeout=30
+            ) as r:
+                wire = json.loads(r.read())["wire"]
+            http_per_req = wire["http"]["body_bytes_copied"] / max(
+                wire["http"]["requests"], 1
+            )
+            w2_copied = wire["wire2"]["body_bytes_copied"]
+            if w2_copied != 0:
+                raise RuntimeError(
+                    f"cfg-wire: wire2 front copied {w2_copied} body "
+                    "bytes — the zero-copy contract is broken"
+                )
+            _emit(
+                "wire marshalling overhead (bytes copied per request, "
+                "allocation probe)",
+                w2_copied, "bytes/req",
+                route=_route("wire,allocation-probe"),
+                extra={
+                    "http1_copied_per_req": round(http_per_req, 1),
+                    "wire2_copied_total": w2_copied,
+                    "wire2_requests": wire["wire2"]["requests"],
+                    "wire2_body_bytes": wire["wire2"]["body_bytes"],
+                },
+            )
+        finally:
+            for name, val in saved.items():
+                if val is None:
+                    os.environ.pop(name, None)
+                else:
+                    os.environ[name] = val
+            s.shutdown()
+            srv_mod.reset_serving_state()
+
+    _section("cfg-wire", cfg_wire)
 
     # ---- config 4 rework: served-scale 2-server PIR (ROADMAP 3) ------------
     # DB-GB/s scanned and queries/s against the single-core native

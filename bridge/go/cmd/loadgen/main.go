@@ -24,6 +24,20 @@
 //	loadgen -mode pir -pir-rows 65536 -pir-row-bytes 32 -rps 100 \
 //	        -duration 10s      # register a DB once, drive /v1/pir/query
 //
+//	loadgen -mode agg-epoch -wire2-addr 127.0.0.1:8991 \
+//	        -agg-clients 1048576 -agg-words 64 -agg-batch 4096 \
+//	        -concurrency 64    # replay a 2^20-client aggregation epoch
+//	                           # end-to-end over ONE multiplexed wire2
+//	                           # connection (omit -wire2-addr to drive
+//	                           # the same epoch through the HTTP front
+//	                           # for an apples-to-apples comparison)
+//
+// agg-epoch is a CAMPAIGN replay, not an overload probe: it is
+// closed-loop (-concurrency in-flight request batches), measures fold
+// shares/s for a fixed epoch, and cross-checks the reconstructed epoch
+// fold against a locally computed reference before reporting — a wrong
+// answer is exit 2, never a throughput row.
+//
 // Output: one JSON object on stdout (bench-ledger-shaped).
 package main
 
@@ -91,6 +105,141 @@ type result struct {
 	RetryAfterP50 float64 `json:"retry_after_p50_s"`
 }
 
+type aggEpochResult struct {
+	Mode        string  `json:"mode"`
+	Transport   string  `json:"transport"`
+	Clients     int     `json:"clients"`
+	Words       int     `json:"words"`
+	Batch       int     `json:"batch"`
+	Concurrency int     `json:"concurrency"`
+	Requests    int64   `json:"requests"`
+	Errors      int64   `json:"errors"`
+	DurationS   float64 `json:"duration_s"`
+	SharesPerS  float64 `json:"shares_per_s"`
+	WireMBPerS  float64 `json:"wire_mb_per_s"`
+	FoldChecked bool    `json:"fold_checked"`
+}
+
+// runAggEpoch replays an aggregation epoch end-to-end: `clients` share
+// rows of `words` uint32 each, submitted in `batch`-row requests by
+// `conc` concurrent workers — every request a stream on ONE wire2
+// connection (or a pooled HTTP request when wire2Addr is empty).  One
+// batch body is packed up front and reused, so the wire carries the
+// full epoch volume without epoch-sized client memory, and every
+// reply must equal the locally computed batch fold — a wrong fold is
+// exit 2, never a throughput number.
+func runAggEpoch(base, wire2Addr, op string, clients, words, batch,
+	conc int, seed int64) {
+	rng := rand.New(rand.NewSource(seed))
+	body := make([]byte, batch*words*4)
+	rng.Read(body)
+	// Local reference fold of the one batch (uint32 wrap for "add" is
+	// the protocol's own semantics): every server reply must equal it.
+	want := make([]uint32, words)
+	for r := 0; r < batch; r++ {
+		for wI := 0; wI < words; wI++ {
+			v := uint32(body[(r*words+wI)*4]) |
+				uint32(body[(r*words+wI)*4+1])<<8 |
+				uint32(body[(r*words+wI)*4+2])<<16 |
+				uint32(body[(r*words+wI)*4+3])<<24
+			if op == "add" {
+				want[wI] += v
+			} else {
+				want[wI] ^= v
+			}
+		}
+	}
+	nReq := clients / batch
+	if nReq == 0 {
+		nReq = 1
+	}
+
+	transport := "http"
+	var submit func() ([]uint32, error)
+	if wire2Addr != "" {
+		transport = "wire2"
+		w2, err := dpftpu.DialWire2(wire2Addr)
+		if err != nil {
+			fmt.Fprintf(os.Stderr, "loadgen: %v\n", err)
+			os.Exit(1)
+		}
+		defer w2.Close()
+		submit = func() ([]uint32, error) {
+			return w2.AggregateSubmitRaw(op, batch, words, body)
+		}
+	} else {
+		c := dpftpu.New(base)
+		submit = func() ([]uint32, error) {
+			return c.AggregateSubmitRaw(op, batch, words, body)
+		}
+	}
+
+	check := func(got []uint32) error {
+		for i := range want {
+			if got[i] != want[i] {
+				return fmt.Errorf("epoch fold word %d drifted", i)
+			}
+		}
+		return nil
+	}
+
+	// One untimed submit warms the fold executables (plan-cache
+	// compile must not land inside the throughput window).
+	if got, err := submit(); err != nil {
+		fmt.Fprintf(os.Stderr, "loadgen: agg warmup: %v\n", err)
+		os.Exit(1)
+	} else if err := check(got); err != nil {
+		fmt.Fprintf(os.Stderr, "loadgen: %v\n", err)
+		os.Exit(2)
+	}
+
+	var next, errCount int64
+	var wg sync.WaitGroup
+	start := time.Now()
+	for w := 0; w < conc; w++ {
+		wg.Add(1)
+		go func() {
+			defer wg.Done()
+			for {
+				if atomic.AddInt64(&next, 1) > int64(nReq) {
+					return
+				}
+				got, err := submit()
+				if err == nil {
+					err = check(got)
+				}
+				if err != nil {
+					atomic.AddInt64(&errCount, 1)
+					fmt.Fprintf(os.Stderr, "loadgen: %v\n", err)
+					return
+				}
+			}
+		}()
+	}
+	wg.Wait()
+	elapsed := time.Since(start).Seconds()
+	done := nReq - int(errCount)
+	res := aggEpochResult{
+		Mode:        "agg-epoch",
+		Transport:   transport,
+		Clients:     nReq * batch,
+		Words:       words,
+		Batch:       batch,
+		Concurrency: conc,
+		Requests:    int64(nReq),
+		Errors:      errCount,
+		DurationS:   elapsed,
+		SharesPerS:  float64(done*batch) / elapsed,
+		WireMBPerS:  float64(done*batch*words*4) / elapsed / (1 << 20),
+		FoldChecked: errCount == 0,
+	}
+	out, _ := json.Marshal(res)
+	fmt.Println(string(out))
+	if errCount > 0 {
+		os.Exit(2)
+	}
+}
+
 func percentile(sorted []float64, p float64) float64 {
 	if len(sorted) == 0 {
 		return 0
@@ -110,10 +259,26 @@ func main() {
 	q := flag.Int("q", 64, "queries per request")
 	profile := flag.String("profile", "fast", "evaluation profile")
 	mode := flag.String("mode", "points",
-		"load shape: points (pointwise eval) or pir (register a database "+
-			"once, then drive /v1/pir/query; -pir-rows/-pir-row-bytes size it)")
+		"load shape: points (pointwise eval), pir (register a database "+
+			"once, then drive /v1/pir/query; -pir-rows/-pir-row-bytes size "+
+			"it), or agg-epoch (closed-loop aggregation-campaign replay; "+
+			"-agg-clients/-agg-words/-agg-batch/-concurrency shape it, "+
+			"-wire2-addr selects the wire2 front)")
 	pirRows := flag.Int("pir-rows", 4096, "pir mode: database rows")
 	pirRowBytes := flag.Int("pir-row-bytes", 32, "pir mode: bytes per row")
+	wire2Addr := flag.String("wire2-addr", "",
+		"agg-epoch mode: wire2 front host:port; empty = replay the epoch "+
+			"through the HTTP front instead")
+	aggClients := flag.Int("agg-clients", 1<<20,
+		"agg-epoch mode: total client share rows in the epoch")
+	aggWords := flag.Int("agg-words", 64,
+		"agg-epoch mode: uint32 words per client share row")
+	aggBatch := flag.Int("agg-batch", 4096,
+		"agg-epoch mode: client rows per /v1/agg/submit request")
+	aggOp := flag.String("agg-op", "xor", "agg-epoch mode: fold op (xor|add)")
+	concurrency := flag.Int("concurrency", 64,
+		"agg-epoch mode: concurrent in-flight requests (streams on the "+
+			"one wire2 connection, pooled keep-alive conns on HTTP)")
 	deadlineMs := flag.Int("deadline-ms", 0, "per-request deadline header (0 = none)")
 	maxInflight := flag.Int("max-inflight", 512, "in-flight cap; arrivals past it count as client_dropped")
 	seed := flag.Int64("seed", 2026, "query RNG seed")
@@ -121,11 +286,17 @@ func main() {
 		"poll GET /readyz for up to this long before opening load (0 = skip)")
 	flag.Parse()
 
-	if *waitReadyBudget > 0 {
+	if *waitReadyBudget > 0 && *mode != "agg-epoch" {
 		if err := waitReady(*url, *waitReadyBudget); err != nil {
 			fmt.Fprintf(os.Stderr, "loadgen: %v\n", err)
 			os.Exit(1)
 		}
+	}
+
+	if *mode == "agg-epoch" {
+		runAggEpoch(*url, *wire2Addr, *aggOp, *aggClients, *aggWords,
+			*aggBatch, *concurrency, *seed)
+		return
 	}
 
 	c := dpftpu.New(*url)

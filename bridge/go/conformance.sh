@@ -7,12 +7,20 @@
 # importable (run from anywhere; paths are script-relative).
 #
 #   ./conformance.sh            # ephemeral sidecar on port 8993
+#   ./conformance.sh --wire2    # ALSO start the wire2 binary front
+#                               # (DPF_TPU_WIRE2=on, port PORT+1) and run
+#                               # the wire2 transport-equivalence tests
+#                               # (wire2_test.go) against both fronts
 #   PORT=9000 ./conformance.sh  # pick the port
 #   DPFTPU_URL=http://host:8990 go test ./dpftpu -run Conformance -v
 #                               # against an already-running sidecar
 set -e
 cd "$(dirname "$0")"
 PORT="${PORT:-8993}"
+WIRE2=""
+if [ "${1:-}" = "--wire2" ]; then
+  WIRE2=1
+fi
 
 # Static hygiene first (no sidecar needed): formatting and vet are part
 # of the repo's lint discipline (scripts/lint_all.sh runs them too when
@@ -46,7 +54,16 @@ else
        "(go install honnef.co/go/tools/cmd/staticcheck@$STATICCHECK_PIN)" >&2
 fi
 
-PYTHONPATH="$(cd ../.. && pwd)" python -m dpf_tpu.server --port "$PORT" &
+# With --wire2 the sidecar also opens the binary front on PORT+1; the
+# Go suite picks it up through DPFTPU_WIRE2_ADDR (wire2_test.go skips
+# without it, so the plain run is unchanged).
+WIRE2_PORT=$((PORT + 1))
+if [ -n "$WIRE2" ]; then
+  DPF_TPU_WIRE2=on DPF_TPU_WIRE2_PORT="$WIRE2_PORT" \
+    PYTHONPATH="$(cd ../.. && pwd)" python -m dpf_tpu.server --port "$PORT" &
+else
+  PYTHONPATH="$(cd ../.. && pwd)" python -m dpf_tpu.server --port "$PORT" &
+fi
 SIDECAR=$!
 trap 'kill "$SIDECAR" 2>/dev/null || true' EXIT INT TERM
 
@@ -68,4 +85,12 @@ curl -sf "http://127.0.0.1:$PORT/healthz" >/dev/null 2>&1 || {
 # The whole suite under the race detector: the conformance tests against
 # the live sidecar AND the sidecar-free concurrency tests (pooled
 # Transport shared across 16 goroutines — TestConcurrentClientRace).
-DPFTPU_URL="http://127.0.0.1:$PORT" go test -race ./dpftpu -v
+# With --wire2, the wire2 transport-equivalence tests join the same run
+# (16 goroutines multiplexed on ONE connection — TestWire2Multiplexed —
+# is exactly what the race detector is for).
+if [ -n "$WIRE2" ]; then
+  DPFTPU_URL="http://127.0.0.1:$PORT" \
+    DPFTPU_WIRE2_ADDR="127.0.0.1:$WIRE2_PORT" go test -race ./dpftpu -v
+else
+  DPFTPU_URL="http://127.0.0.1:$PORT" go test -race ./dpftpu -v
+fi

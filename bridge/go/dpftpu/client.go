@@ -630,8 +630,15 @@ func (c *Client) AggregateSubmit(op string, rows [][]uint32) ([]uint32, error) {
 			body = binary.LittleEndian.AppendUint32(body, v)
 		}
 	}
+	return c.AggregateSubmitRaw(op, len(rows), w, body)
+}
+
+// AggregateSubmitRaw is AggregateSubmit over a pre-packed body (K rows x
+// W little-endian uint32 words) — callers replaying a campaign pack the
+// body once and reuse it across requests (cmd/loadgen -mode agg-epoch).
+func (c *Client) AggregateSubmitRaw(op string, k, w int, body []byte) ([]uint32, error) {
 	out, err := c.post(fmt.Sprintf(
-		"/v1/agg/submit?op=%s&k=%d&words=%d", op, len(rows), w), body)
+		"/v1/agg/submit?op=%s&k=%d&words=%d", op, k, w), body)
 	if err != nil {
 		return nil, err
 	}

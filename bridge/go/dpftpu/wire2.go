@@ -1,0 +1,568 @@
+// wire2: client for the sidecar's zero-copy multiplexed binary front
+// (dpf_tpu/serving/wire2.py; enable it server-side with DPF_TPU_WIRE2=on).
+//
+// One Wire2Client owns ONE persistent connection carrying many concurrent
+// streams — HTTP/2-style multiplexing without the HTTP: a whole
+// heavy-hitter descent or aggregation campaign rides a single conn, so
+// the per-request cost is a 12-byte frame header instead of a TCP
+// handshake plus request-line/header parsing.  Replies are byte-identical
+// to the HTTP front's (the transport-equivalence suite pins this), and
+// non-200 replies carry the same structured {code, detail} JSON mapped
+// onto the same *APIError type, so retry/backoff code is front-agnostic.
+//
+// Frame format (little-endian; docs/DESIGN.md §17):
+//
+//	preface        8 B: "DPF2" || version 1 || 3 zero bytes
+//	frame header  12 B: length:u32 | type:u8 | flags:u8 | route:u16 | stream:u32
+//	HEADERS  (1)  body_len:u64 || param string (the HTTP query string)
+//	DATA     (2)  body bytes; flag bit 0 marks the last frame
+//	RESP     (3)  status:u16 | reserved:u16 | retry_after:f64 | body_len:u64
+//	RESP_DATA(4)  reply bytes; flag bit 0 ends the stream
+//	GOAWAY   (5)  fatal: every in-flight stream fails loudly
+//	PING/PONG(6/7) liveness echo
+package dpftpu
+
+import (
+	"encoding/binary"
+	"encoding/json"
+	"fmt"
+	"io"
+	"math"
+	"net"
+	"net/url"
+	"strconv"
+	"sync"
+	"time"
+)
+
+// Route ids — mirrors dpf_tpu/serving/handlers.ROUTE_IDS (the
+// transport-equivalence suite compares replies against the HTTP paths,
+// so the two tables cannot silently diverge).
+const (
+	wire2RouteGen             = 1
+	wire2RouteEval            = 2
+	wire2RouteEvalFull        = 3
+	wire2RouteEvalFullBatch   = 4
+	wire2RouteEvalPointsBatch = 5
+	wire2RouteDcfGen          = 6
+	wire2RouteDcfEvalPoints   = 7
+	wire2RouteDcfIntervalGen  = 8
+	wire2RouteDcfIntervalEval = 9
+	wire2RouteHHGen           = 10
+	wire2RouteHHEval          = 11
+	wire2RouteAggSubmit       = 12
+	wire2RoutePirDB           = 13
+	wire2RoutePirQuery        = 14
+	wire2RouteWarmup          = 15
+)
+
+const (
+	wire2THeaders  = 1
+	wire2TData     = 2
+	wire2TResp     = 3
+	wire2TRespData = 4
+	wire2TGoaway   = 5
+	wire2TPing     = 6
+	wire2TPong     = 7
+
+	wire2FEndStream = 1
+
+	wire2HdrLen    = 12
+	wire2DataChunk = 1 << 20
+	wire2RespHead  = 20
+)
+
+var wire2Magic = []byte{'D', 'P', 'F', '2', 1, 0, 0, 0}
+
+type wire2Pending struct {
+	done       chan struct{}
+	status     int
+	retryAfter float64
+	body       []byte
+	got        int
+	err        error
+}
+
+// Wire2Client drives the sidecar's wire2 front over one multiplexed
+// connection.  All methods are safe for concurrent goroutines — each
+// call is an independent stream.  Profile/DeadlineMs/Trace mirror the
+// HTTP Client's fields and are applied per request.
+type Wire2Client struct {
+	Profile    string
+	DeadlineMs int
+	Trace      bool
+	Timeout    time.Duration
+
+	conn    net.Conn
+	wmu     sync.Mutex // write side: one request's frames go out atomically
+	smu     sync.Mutex // stream table
+	streams map[uint32]*wire2Pending
+	nextSID uint32
+	dead    error
+}
+
+// DialWire2 connects to a wire2 front at addr ("host:port") and sends
+// the connection preface.  Close the client to release the connection.
+func DialWire2(addr string) (*Wire2Client, error) {
+	conn, err := net.DialTimeout("tcp", addr, 10*time.Second)
+	if err != nil {
+		return nil, fmt.Errorf("dpftpu: wire2 dial: %w", err)
+	}
+	if tc, ok := conn.(*net.TCPConn); ok {
+		tc.SetNoDelay(true)
+	}
+	if _, err := conn.Write(wire2Magic); err != nil {
+		conn.Close()
+		return nil, fmt.Errorf("dpftpu: wire2 preface: %w", err)
+	}
+	c := &Wire2Client{
+		Profile: "compat",
+		Trace:   true,
+		Timeout: 120 * time.Second,
+		conn:    conn,
+		streams: make(map[uint32]*wire2Pending),
+		nextSID: 1,
+	}
+	go c.readLoop()
+	return c, nil
+}
+
+// Close tears the connection down; every in-flight stream fails.
+func (c *Wire2Client) Close() error {
+	err := c.conn.Close()
+	c.failAll(fmt.Errorf("dpftpu: wire2 client closed"))
+	return err
+}
+
+func (c *Wire2Client) failAll(err error) {
+	c.smu.Lock()
+	if c.dead == nil {
+		c.dead = err
+	}
+	pending := make([]*wire2Pending, 0, len(c.streams))
+	for sid, p := range c.streams {
+		pending = append(pending, p)
+		delete(c.streams, sid)
+	}
+	c.smu.Unlock()
+	for _, p := range pending {
+		p.err = err
+		close(p.done)
+	}
+}
+
+func (c *Wire2Client) readLoop() {
+	hdr := make([]byte, wire2HdrLen)
+	for {
+		if _, err := io.ReadFull(c.conn, hdr); err != nil {
+			c.failAll(fmt.Errorf("dpftpu: wire2 read: %w", err))
+			return
+		}
+		length := binary.LittleEndian.Uint32(hdr[0:4])
+		ftype := hdr[4]
+		flags := hdr[5]
+		sid := binary.LittleEndian.Uint32(hdr[8:12])
+		switch ftype {
+		case wire2TResp:
+			payload := make([]byte, length)
+			if _, err := io.ReadFull(c.conn, payload); err != nil {
+				c.failAll(fmt.Errorf("dpftpu: wire2 read: %w", err))
+				return
+			}
+			if len(payload) < wire2RespHead {
+				c.failAll(fmt.Errorf("dpftpu: wire2 short RESP payload"))
+				return
+			}
+			c.smu.Lock()
+			p := c.streams[sid]
+			c.smu.Unlock()
+			if p == nil {
+				continue
+			}
+			p.status = int(binary.LittleEndian.Uint16(payload[0:2]))
+			p.retryAfter = math.Float64frombits(
+				binary.LittleEndian.Uint64(payload[4:12]))
+			p.body = make([]byte, binary.LittleEndian.Uint64(payload[12:20]))
+		case wire2TRespData:
+			c.smu.Lock()
+			p := c.streams[sid]
+			c.smu.Unlock()
+			if p == nil || p.body == nil && length > 0 {
+				// Reply bytes for a stream we gave up on (or a protocol
+				// hiccup): drain to keep the framing.
+				if _, err := io.CopyN(io.Discard, c.conn, int64(length)); err != nil {
+					c.failAll(fmt.Errorf("dpftpu: wire2 read: %w", err))
+					return
+				}
+				continue
+			}
+			if p.got+int(length) > len(p.body) {
+				c.failAll(fmt.Errorf("dpftpu: wire2 reply overflow"))
+				return
+			}
+			if _, err := io.ReadFull(c.conn, p.body[p.got:p.got+int(length)]); err != nil {
+				c.failAll(fmt.Errorf("dpftpu: wire2 read: %w", err))
+				return
+			}
+			p.got += int(length)
+			if flags&wire2FEndStream != 0 {
+				if p.got != len(p.body) {
+					p.err = fmt.Errorf(
+						"dpftpu: wire2 reply truncated (%d of %d bytes)",
+						p.got, len(p.body))
+				}
+				// Only the goroutine that removes the entry may close
+				// p.done — a concurrent Close()/failAll may have
+				// already claimed (and closed) it, and closing twice
+				// panics the process.
+				c.smu.Lock()
+				_, owned := c.streams[sid]
+				delete(c.streams, sid)
+				c.smu.Unlock()
+				if owned {
+					close(p.done)
+				}
+			}
+		case wire2TPong:
+			if _, err := io.CopyN(io.Discard, c.conn, int64(length)); err != nil {
+				c.failAll(fmt.Errorf("dpftpu: wire2 read: %w", err))
+				return
+			}
+		case wire2TGoaway:
+			// The server's loud-truncation signal (the RST twin): every
+			// in-flight reply is now unreliable.
+			c.failAll(fmt.Errorf("dpftpu: wire2 server sent GOAWAY"))
+			return
+		default:
+			c.failAll(fmt.Errorf("dpftpu: wire2 unknown frame type %d", ftype))
+			return
+		}
+	}
+}
+
+// Do sends one request on its own stream and blocks for the reply body.
+// route is a wire2Route* id; params the same query params the HTTP front
+// takes (profile/deadline/trace are appended from the client fields).
+// Non-200 replies surface as *APIError, exactly like the HTTP client.
+func (c *Wire2Client) Do(route uint16, params url.Values, body []byte) ([]byte, error) {
+	// Copy before injecting the client fields: callers reuse one
+	// url.Values across concurrent Do calls (the campaign shape), and
+	// mutating it here would be a concurrent map write.
+	q := make(url.Values, len(params)+3)
+	for k, v := range params {
+		q[k] = v
+	}
+	q.Set("profile", c.Profile)
+	if c.DeadlineMs > 0 {
+		q.Set("_deadline_ms", strconv.Itoa(c.DeadlineMs))
+	}
+	if c.Trace {
+		if id := newTraceID(); id != "" {
+			q.Set("_trace", id)
+		}
+	}
+	qs := []byte(q.Encode())
+
+	p := &wire2Pending{done: make(chan struct{})}
+	c.smu.Lock()
+	if c.dead != nil {
+		err := c.dead
+		c.smu.Unlock()
+		return nil, err
+	}
+	sid := c.nextSID
+	c.nextSID++
+	c.streams[sid] = p
+	c.smu.Unlock()
+
+	// One request's frames as a single buffered write: HEADERS
+	// (body_len + params), then DATA frames split at 1 MiB.
+	var headFlags byte
+	if len(body) == 0 {
+		headFlags = wire2FEndStream
+	}
+	msg := make([]byte, 0, wire2HdrLen+8+len(qs)+wire2HdrLen+len(body))
+	msg = appendWire2Hdr(msg, uint32(8+len(qs)), wire2THeaders, headFlags,
+		route, sid)
+	msg = binary.LittleEndian.AppendUint64(msg, uint64(len(body)))
+	msg = append(msg, qs...)
+	for off := 0; off < len(body); {
+		take := len(body) - off
+		if take > wire2DataChunk {
+			take = wire2DataChunk
+		}
+		var flags byte
+		if off+take >= len(body) {
+			flags = wire2FEndStream
+		}
+		msg = appendWire2Hdr(msg, uint32(take), wire2TData, flags, 0, sid)
+		msg = append(msg, body[off:off+take]...)
+		off += take
+	}
+	c.wmu.Lock()
+	_, err := c.conn.Write(msg)
+	c.wmu.Unlock()
+	if err != nil {
+		c.smu.Lock()
+		delete(c.streams, sid)
+		c.smu.Unlock()
+		return nil, fmt.Errorf("dpftpu: wire2 write: %w", err)
+	}
+
+	timeout := c.Timeout
+	if timeout <= 0 {
+		timeout = 120 * time.Second
+	}
+	select {
+	case <-p.done:
+	case <-time.After(timeout):
+		c.smu.Lock()
+		delete(c.streams, sid)
+		c.smu.Unlock()
+		return nil, fmt.Errorf("dpftpu: wire2 stream %d timed out", sid)
+	}
+	if p.err != nil {
+		return nil, p.err
+	}
+	if p.status != 200 {
+		e := &APIError{Status: p.status, Detail: string(p.body)}
+		var parsed struct {
+			Code   string `json:"code"`
+			Detail string `json:"detail"`
+		}
+		if json.Unmarshal(p.body, &parsed) == nil && parsed.Code != "" {
+			e.Code, e.Detail = parsed.Code, parsed.Detail
+		}
+		e.RetryAfter = p.retryAfter
+		return nil, e
+	}
+	return p.body, nil
+}
+
+func appendWire2Hdr(b []byte, length uint32, ftype, flags byte,
+	route uint16, sid uint32) []byte {
+	b = binary.LittleEndian.AppendUint32(b, length)
+	b = append(b, ftype, flags)
+	b = binary.LittleEndian.AppendUint16(b, route)
+	b = binary.LittleEndian.AppendUint32(b, sid)
+	return b
+}
+
+// Ping round-trips a liveness echo (PONG is drained by the reader).
+func (c *Wire2Client) Ping() error {
+	c.wmu.Lock()
+	defer c.wmu.Unlock()
+	msg := appendWire2Hdr(nil, 5, wire2TPing, 0, 0, 0)
+	msg = append(msg, []byte("wire2")...)
+	_, err := c.conn.Write(msg)
+	return err
+}
+
+// ---------------------------------------------------------------------------
+// Typed wrappers mirroring the HTTP client's surface — same bodies,
+// same reply validation, different wire.
+// ---------------------------------------------------------------------------
+
+// Gen generates a key pair server-side, like Client.Gen.
+func (c *Wire2Client) Gen(alpha uint64, logN uint) (DPFkey, DPFkey, error) {
+	out, err := c.Do(wire2RouteGen, url.Values{
+		"log_n": {strconv.Itoa(int(logN))},
+		"alpha": {strconv.FormatUint(alpha, 10)},
+	}, nil)
+	if err != nil {
+		return nil, nil, err
+	}
+	if len(out)%2 != 0 || len(out) == 0 {
+		return nil, nil, fmt.Errorf("dpftpu: bad gen reply length %d", len(out))
+	}
+	h := len(out) / 2
+	return DPFkey(out[:h]), DPFkey(out[h:]), nil
+}
+
+// EvalFull expands one share over the whole domain, like Client.EvalFull.
+func (c *Wire2Client) EvalFull(k DPFkey, logN uint) ([]byte, error) {
+	out, err := c.Do(wire2RouteEvalFull, url.Values{
+		"log_n": {strconv.Itoa(int(logN))},
+	}, k)
+	if err != nil {
+		return nil, err
+	}
+	if want := expansionBytes(logN, c.Profile); len(out) != want {
+		return nil, fmt.Errorf(
+			"dpftpu: evalfull reply is %d bytes, want %d (truncated or corrupt)",
+			len(out), want)
+	}
+	return out, nil
+}
+
+// EvalPointsBatchPacked evaluates K shares at Q points each over the
+// bit-packed wire format, like Client.EvalPointsBatchPacked.
+func (c *Wire2Client) EvalPointsBatchPacked(keys []DPFkey, xs [][]uint64, logN uint) ([][]byte, error) {
+	if len(keys) == 0 {
+		return nil, nil
+	}
+	body, nq, err := pointsBody(keys, xs)
+	if err != nil {
+		return nil, err
+	}
+	out, err := c.Do(wire2RouteEvalPointsBatch, url.Values{
+		"log_n":  {strconv.Itoa(int(logN))},
+		"k":      {strconv.Itoa(len(keys))},
+		"q":      {strconv.Itoa(nq)},
+		"format": {"packed"},
+	}, body)
+	if err != nil {
+		return nil, err
+	}
+	row := (nq + 7) / 8
+	if len(out) != len(keys)*row {
+		return nil, fmt.Errorf("dpftpu: bad packed reply length %d", len(out))
+	}
+	res := make([][]byte, len(keys))
+	for i := range keys {
+		res[i] = out[i*row : (i+1)*row]
+	}
+	return res, nil
+}
+
+// HHEvalLevel runs one heavy-hitters round, like Client.HHEvalLevel —
+// the descent primitive a single multiplexed connection is built for.
+func (c *Wire2Client) HHEvalLevel(levelKeys []DPFkey, candidates []uint64, logN, level uint) ([][]byte, error) {
+	if len(levelKeys) == 0 || len(candidates) == 0 {
+		return nil, nil
+	}
+	kl := len(levelKeys[0])
+	body := make([]byte, 0, kl*len(levelKeys)+8*len(candidates))
+	for _, k := range levelKeys {
+		if len(k) != kl {
+			return nil, fmt.Errorf("dpftpu: inconsistent key lengths")
+		}
+		body = append(body, k...)
+	}
+	for _, x := range candidates {
+		body = binary.LittleEndian.AppendUint64(body, x)
+	}
+	out, err := c.Do(wire2RouteHHEval, url.Values{
+		"log_n":  {strconv.Itoa(int(logN))},
+		"k":      {strconv.Itoa(len(levelKeys))},
+		"q":      {strconv.Itoa(len(candidates))},
+		"level":  {strconv.Itoa(int(level))},
+		"format": {"packed"},
+	}, body)
+	if err != nil {
+		return nil, err
+	}
+	row := (len(candidates) + 7) / 8
+	if len(out) != len(levelKeys)*row {
+		return nil, fmt.Errorf("dpftpu: bad hh eval reply length %d", len(out))
+	}
+	res := make([][]byte, len(levelKeys))
+	for i := range res {
+		res[i] = out[i*row : (i+1)*row]
+	}
+	return res, nil
+}
+
+// AggregateSubmit streams K client share rows to the aggregation fold,
+// like Client.AggregateSubmit.  rows[i] must all have the same width.
+func (c *Wire2Client) AggregateSubmit(op string, rows [][]uint32) ([]uint32, error) {
+	if len(rows) == 0 {
+		return nil, nil
+	}
+	w := len(rows[0])
+	body := make([]byte, 0, 4*w*len(rows))
+	for _, r := range rows {
+		if len(r) != w {
+			return nil, fmt.Errorf("dpftpu: inconsistent agg row lengths")
+		}
+		for _, v := range r {
+			body = binary.LittleEndian.AppendUint32(body, v)
+		}
+	}
+	return c.AggregateSubmitRaw(op, len(rows), w, body)
+}
+
+// AggregateSubmitRaw is AggregateSubmit over a pre-packed body (K rows x
+// W little-endian uint32 words) — the loadgen epoch replay packs once
+// and reuses the buffer across requests.
+func (c *Wire2Client) AggregateSubmitRaw(op string, k, w int, body []byte) ([]uint32, error) {
+	out, err := c.Do(wire2RouteAggSubmit, url.Values{
+		"op":    {op},
+		"k":     {strconv.Itoa(k)},
+		"words": {strconv.Itoa(w)},
+	}, body)
+	if err != nil {
+		return nil, err
+	}
+	if len(out) != 4*w {
+		return nil, fmt.Errorf(
+			"dpftpu: bad agg reply length %d, want %d", len(out), 4*w)
+	}
+	res := make([]uint32, w)
+	for i := range res {
+		res[i] = binary.LittleEndian.Uint32(out[4*i:])
+	}
+	return res, nil
+}
+
+// PirQuery answers K PIR queries against a registered database, like
+// Client.PirQuery (register the database over the HTTP front or with
+// Wire2Client.Do on wire2RoutePirDB).
+func (c *Wire2Client) PirQuery(dbName string, keys []DPFkey, rowBytes int) ([][]byte, error) {
+	if len(keys) == 0 {
+		return nil, nil
+	}
+	kl := len(keys[0])
+	body := make([]byte, 0, kl*len(keys))
+	for _, k := range keys {
+		if len(k) != kl {
+			return nil, fmt.Errorf("dpftpu: inconsistent key lengths")
+		}
+		body = append(body, k...)
+	}
+	out, err := c.Do(wire2RoutePirQuery, url.Values{
+		"db": {dbName},
+		"k":  {strconv.Itoa(len(keys))},
+	}, body)
+	if err != nil {
+		return nil, err
+	}
+	if len(out) != len(keys)*rowBytes {
+		return nil, fmt.Errorf(
+			"dpftpu: bad pir reply length %d, want %d*%d",
+			len(out), len(keys), rowBytes)
+	}
+	res := make([][]byte, len(keys))
+	for i := range keys {
+		res[i] = out[i*rowBytes : (i+1)*rowBytes]
+	}
+	return res, nil
+}
+
+// DcfEvalPoints evaluates K comparison shares at Q points each, like
+// Client.DcfEvalPoints (byte-per-bit format).
+func (c *Wire2Client) DcfEvalPoints(keys []DPFkey, xs [][]uint64, logN uint) ([][]byte, error) {
+	if len(keys) == 0 {
+		return nil, nil
+	}
+	body, nq, err := pointsBody(keys, xs)
+	if err != nil {
+		return nil, err
+	}
+	out, err := c.Do(wire2RouteDcfEvalPoints, url.Values{
+		"log_n": {strconv.Itoa(int(logN))},
+		"k":     {strconv.Itoa(len(keys))},
+		"q":     {strconv.Itoa(nq)},
+	}, body)
+	if err != nil {
+		return nil, err
+	}
+	if len(out) != len(keys)*nq {
+		return nil, fmt.Errorf("dpftpu: bad dcf points reply length %d", len(out))
+	}
+	res := make([][]byte, len(keys))
+	for i := range keys {
+		res[i] = out[i*nq : (i+1)*nq]
+	}
+	return res, nil
+}

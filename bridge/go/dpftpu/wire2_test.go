@@ -1,0 +1,234 @@
+// wire2 conformance: the binary multiplexed front must answer
+// byte-identically to the HTTP/1.1 front on every compared route, carry
+// the same structured errors, and survive concurrent streams on one
+// connection under the race detector.
+//
+// Needs BOTH fronts reachable: the sidecar at DPFTPU_URL (default
+// http://127.0.0.1:8990) started with DPF_TPU_WIRE2=on, and the wire2
+// address in DPFTPU_WIRE2_ADDR (default 127.0.0.1:8991); otherwise the
+// tests skip.  ../conformance.sh --wire2 is the one-command run.
+package dpftpu
+
+import (
+	"bytes"
+	"errors"
+	"math/rand"
+	"net"
+	"net/url"
+	"os"
+	"sync"
+	"testing"
+	"time"
+)
+
+func wire2Clients(t *testing.T) (*Client, *Wire2Client) {
+	t.Helper()
+	httpC := conformanceClient(t)
+	addr := os.Getenv("DPFTPU_WIRE2_ADDR")
+	if addr == "" {
+		addr = "127.0.0.1:8991"
+	}
+	probe, err := net.DialTimeout("tcp", addr, 2*time.Second)
+	if err != nil {
+		t.Skipf("wire2 front not reachable at %s (start the sidecar with "+
+			"DPF_TPU_WIRE2=on or set DPFTPU_WIRE2_ADDR): %v", addr, err)
+	}
+	probe.Close()
+	w2, err := DialWire2(addr)
+	if err != nil {
+		t.Fatalf("wire2 dial: %v", err)
+	}
+	t.Cleanup(func() { w2.Close() })
+	return httpC, w2
+}
+
+// TestWire2ConformancePoints pins byte identity of the packed pointwise
+// route across fronts — the dominant serving-traffic reply.
+func TestWire2ConformancePoints(t *testing.T) {
+	httpC, w2 := wire2Clients(t)
+	const logN, q = 10, 33 // q % 8 != 0: the tail-masked packed shape
+	rng := rand.New(rand.NewSource(7))
+	var keys []DPFkey
+	var xs [][]uint64
+	for i := 0; i < 3; i++ {
+		ka, _, err := httpC.Gen(uint64(rng.Int63n(1<<logN)), logN)
+		if err != nil {
+			t.Fatalf("gen: %v", err)
+		}
+		keys = append(keys, ka)
+		row := make([]uint64, q)
+		for j := range row {
+			row[j] = uint64(rng.Int63n(1 << logN))
+		}
+		xs = append(xs, row)
+	}
+	viaHTTP, err := httpC.EvalPointsBatchPacked(keys, xs, logN)
+	if err != nil {
+		t.Fatalf("http points: %v", err)
+	}
+	viaWire2, err := w2.EvalPointsBatchPacked(keys, xs, logN)
+	if err != nil {
+		t.Fatalf("wire2 points: %v", err)
+	}
+	for i := range viaHTTP {
+		if !bytes.Equal(viaHTTP[i], viaWire2[i]) {
+			t.Fatalf("row %d differs across fronts", i)
+		}
+	}
+}
+
+// TestWire2ConformanceEvalFull pins the full-domain expansion, the
+// largest buffered reply (and the route the server may stream).
+func TestWire2ConformanceEvalFull(t *testing.T) {
+	httpC, w2 := wire2Clients(t)
+	const logN = 10
+	ka, kb, err := httpC.Gen(619, logN)
+	if err != nil {
+		t.Fatalf("gen: %v", err)
+	}
+	for _, k := range []DPFkey{ka, kb} {
+		viaHTTP, err := httpC.EvalFull(k, logN)
+		if err != nil {
+			t.Fatalf("http evalfull: %v", err)
+		}
+		viaWire2, err := w2.EvalFull(k, logN)
+		if err != nil {
+			t.Fatalf("wire2 evalfull: %v", err)
+		}
+		if !bytes.Equal(viaHTTP, viaWire2) {
+			t.Fatal("evalfull differs across fronts")
+		}
+	}
+}
+
+// TestWire2ConformanceAgg pins the streamed-upload route: the body
+// flows through the server's chunked fold on both fronts.
+func TestWire2ConformanceAgg(t *testing.T) {
+	httpC, w2 := wire2Clients(t)
+	rng := rand.New(rand.NewSource(11))
+	rows := make([][]uint32, 257)
+	for i := range rows {
+		rows[i] = make([]uint32, 16)
+		for j := range rows[i] {
+			rows[i][j] = rng.Uint32()
+		}
+	}
+	for _, op := range []string{"xor", "add"} {
+		viaHTTP, err := httpC.AggregateSubmit(op, rows)
+		if err != nil {
+			t.Fatalf("http agg %s: %v", op, err)
+		}
+		viaWire2, err := w2.AggregateSubmit(op, rows)
+		if err != nil {
+			t.Fatalf("wire2 agg %s: %v", op, err)
+		}
+		for j := range viaHTTP {
+			if viaHTTP[j] != viaWire2[j] {
+				t.Fatalf("agg %s word %d differs across fronts", op, j)
+			}
+		}
+	}
+}
+
+// TestWire2ConformanceHH pins one heavy-hitters round across fronts —
+// the descent primitive the multiplexed connection is built for.
+func TestWire2ConformanceHH(t *testing.T) {
+	httpC, w2 := wire2Clients(t)
+	const logN, nClients = 8, 5
+	values := make([]uint64, nClients)
+	for i := range values {
+		values[i] = uint64(i * 37 % (1 << logN))
+	}
+	blobA, _, err := httpC.HHGen(values, logN)
+	if err != nil {
+		t.Fatalf("hh gen: %v", err)
+	}
+	level := uint(3)
+	keys, err := httpC.HHLevelKeys(blobA, logN, level)
+	if err != nil {
+		t.Fatalf("hh level keys: %v", err)
+	}
+	cands := HHQueryValues(HHExtend([]uint64{0, 1, 2, 3}, 2), logN, level+1)
+	viaHTTP, err := httpC.HHEvalLevel(keys, cands, logN, level)
+	if err != nil {
+		t.Fatalf("http hh eval: %v", err)
+	}
+	viaWire2, err := w2.HHEvalLevel(keys, cands, logN, level)
+	if err != nil {
+		t.Fatalf("wire2 hh eval: %v", err)
+	}
+	for i := range viaHTTP {
+		if !bytes.Equal(viaHTTP[i], viaWire2[i]) {
+			t.Fatalf("hh row %d differs across fronts", i)
+		}
+	}
+}
+
+// TestWire2StructuredError: a validation failure surfaces as the same
+// *APIError shape the HTTP front produces.
+func TestWire2StructuredError(t *testing.T) {
+	_, w2 := wire2Clients(t)
+	_, err := w2.Do(wire2RouteEvalFull,
+		url.Values{"log_n": {"9"}}, []byte{0, 1, 2})
+	var apiErr *APIError
+	if !errors.As(err, &apiErr) {
+		t.Fatalf("want *APIError, got %v", err)
+	}
+	if apiErr.Status != 400 || apiErr.Code != "bad_request" {
+		t.Fatalf("want 400 bad_request, got %d %q", apiErr.Status, apiErr.Code)
+	}
+}
+
+// TestWire2Multiplexed: N goroutines share ONE connection; every stream
+// must come back correct and uncrossed (run under -race, the whole
+// point of the conformance lane).
+func TestWire2Multiplexed(t *testing.T) {
+	httpC, w2 := wire2Clients(t)
+	const logN, q, workers, reps = 9, 16, 16, 4
+	rng := rand.New(rand.NewSource(3))
+	keys := make([]DPFkey, workers)
+	xs := make([][][]uint64, workers)
+	want := make([][][]byte, workers)
+	for i := 0; i < workers; i++ {
+		ka, _, err := httpC.Gen(uint64(rng.Int63n(1<<logN)), logN)
+		if err != nil {
+			t.Fatalf("gen: %v", err)
+		}
+		keys[i] = ka
+		row := make([]uint64, q)
+		for j := range row {
+			row[j] = uint64(rng.Int63n(1 << logN))
+		}
+		xs[i] = [][]uint64{row}
+		want[i], err = httpC.EvalPointsBatchPacked(
+			[]DPFkey{ka}, xs[i], logN)
+		if err != nil {
+			t.Fatalf("http points: %v", err)
+		}
+	}
+	var wg sync.WaitGroup
+	errs := make(chan error, workers*reps)
+	for i := 0; i < workers; i++ {
+		wg.Add(1)
+		go func(i int) {
+			defer wg.Done()
+			for r := 0; r < reps; r++ {
+				got, err := w2.EvalPointsBatchPacked(
+					[]DPFkey{keys[i]}, xs[i], logN)
+				if err != nil {
+					errs <- err
+					return
+				}
+				if !bytes.Equal(got[0], want[i][0]) {
+					errs <- errors.New("stream reply crossed")
+					return
+				}
+			}
+		}(i)
+	}
+	wg.Wait()
+	close(errs)
+	for err := range errs {
+		t.Fatal(err)
+	}
+}

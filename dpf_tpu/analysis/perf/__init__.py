@@ -42,6 +42,12 @@ declared :class:`~dpf_tpu.analysis.perf.contracts.PerfContract`:
                 alongside the certificate (reviewable magnitude facts,
                 not a gate).
 
+The pass additionally enforces the AST-level **wire-path budget**
+(``perf_pass.wire_path_findings``): zero ``bytes()`` materializations
+of request-body buffers in the wire2 transport and the shared handler
+core — the zero-copy socket-buffer-to-device-operand claim is a lint
+failure to regress, like every other budget here (DESIGN §17).
+
 Clean routes emit versioned contract certificates to
 ``docs/PERF_CONTRACTS.md`` + ``docs/perf_contracts.json`` with the same
 drift-detection / re-certification workflow as the obliviousness
@@ -61,4 +67,4 @@ from __future__ import annotations
 # change (committed certificates re-generate; bench ledgers keyed on it
 # re-measure — bench_all stamps this next to LINT_SUITE_VERSION and
 # OBLIVIOUS_VERIFIER_VERSION).
-PERF_CONTRACT_VERSION = "1"
+PERF_CONTRACT_VERSION = "2"

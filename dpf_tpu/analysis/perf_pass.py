@@ -15,33 +15,125 @@ production donated twins, and fails on
     committed ``docs/perf_contracts.json`` (re-certify with
     ``python -m dpf_tpu.analysis --write-perf-contracts``).
 
-Same foreign-root policy as the oblivious-trace pass: the traced routes
-are always the imported checkout's, so a foreign ``--root`` gets one
-explanatory finding instead of a misleading verdict.
+The pass also owns the **wire-path budget** (the wire2 transport's
+structural claim): the serving hot path must make ZERO ``bytes()`` /
+``bytearray()`` / ``.tobytes()`` materializations of request-body
+buffers — the whole point of the binary front is that bodies flow as
+``memoryview`` slices from the socket's receive buffer straight into
+``np.frombuffer``/``device_put``, and one stray ``bytes(body)`` quietly
+restores the copy the transport exists to delete.  This budget is
+AST-level (no tracing): it scans ``serving/wire2.py`` and
+``serving/handlers.py`` for copy calls over body-buffer names, with
+``# wire-copy-ok: <why>`` as the reviewed in-place escape hatch (the
+warmup/profile JSON bodies, the client-side reply materialization).
+Unlike the jaxpr budgets it runs on ANY --root, so the fixture tests
+exercise it on synthetic trees.
+
+Same foreign-root policy as the oblivious-trace pass for the jaxpr
+budgets: the traced routes are always the imported checkout's, so a
+foreign ``--root`` gets one explanatory finding instead of a misleading
+verdict.
 """
 
 from __future__ import annotations
 
+import ast
 import os
 
-from .common import Finding, repo_root
+from .common import Finding, parse_file, pragma, repo_root
 
 PASS = "perf-contract"
 
+# The wire-path budget's scope: the transport and the shared handler
+# core — the two modules request bodies flow through between socket
+# buffer and dispatch operand.
+WIRE_PATH_FILES = (
+    "dpf_tpu/serving/wire2.py",
+    "dpf_tpu/serving/handlers.py",
+)
+
+# Identifier / attribute names that carry request-body buffers in those
+# modules (the same name-based auditability bargain as the secret-
+# hygiene pass: pin the names the code actually uses).
+_BODY_NAMES = frozenset(
+    {"body", "view", "mv", "buf", "payload", "chunk", "blob", "dbv"}
+)
+_COPY_CALLS = frozenset({"bytes", "bytearray"})
+
+
+def _mentions_body(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in _BODY_NAMES:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr in _BODY_NAMES:
+            return True
+    return False
+
+
+def wire_path_findings(root: str) -> list[Finding]:
+    """Zero ``bytes()`` materializations of request bodies on the wire
+    hot path (files in :data:`WIRE_PATH_FILES` under ``root``; a
+    missing file simply has no findings — synthetic test roots carry
+    only the module under test)."""
+    out: list[Finding] = []
+    for rel in WIRE_PATH_FILES:
+        if not os.path.isfile(os.path.join(root, rel)):
+            continue
+        try:
+            tree, lines = parse_file(root, rel)
+        except SyntaxError as e:
+            out.append(Finding(rel, e.lineno or 0, PASS,
+                               f"syntax error: {e}"))
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            hit = None
+            if (
+                isinstance(fn, ast.Name) and fn.id in _COPY_CALLS
+                and node.args and _mentions_body(node.args[0])
+            ):
+                hit = f"{fn.id}()"
+            elif (
+                isinstance(fn, ast.Attribute) and fn.attr == "tobytes"
+                and not node.args and _mentions_body(fn.value)
+            ):
+                hit = ".tobytes()"
+            if hit is None:
+                continue
+            if pragma(lines, node.lineno, "wire-copy-ok:"):
+                continue  # annotated (with a why): sanctioned copy
+            out.append(Finding(
+                rel, node.lineno, PASS,
+                f"[wire-path] {hit} materializes a request-body buffer "
+                "on the wire hot path — the zero-copy budget is zero "
+                "intermediate bytes copies between socket buffer and "
+                "dispatch operand; keep it a memoryview (np.frombuffer "
+                "accepts views) or annotate the line with "
+                "'# wire-copy-ok: <why>' if it is genuinely off the "
+                "hot path",
+            ))
+    return out
+
 
 def run(root: str, files=None) -> list[Finding]:
+    # The wire-path budget is file-based and root-relative: it runs
+    # everywhere, including the synthetic roots the fixture tests build.
+    out: list[Finding] = wire_path_findings(root)
     if os.path.realpath(root) != os.path.realpath(repo_root()):
-        return [
+        out.append(
             Finding(
                 "dpf_tpu/analysis/perf", 0, PASS,
                 "the perf-contract verifier only certifies the checkout "
-                "it is imported from; run it from the target tree",
+                "it is imported from; run it from the target tree "
+                "(the wire-path budget above DID scan this root)",
             )
-        ]
+        )
+        return out
     from .perf import certify
 
     certs, perf_findings = certify.verify_routes()
-    out: list[Finding] = []
     for f in perf_findings:
         out.append(
             Finding(f"perf://{f.where}", 0, PASS, f"[{f.kind}] {f.message}")

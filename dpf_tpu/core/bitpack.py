@@ -96,12 +96,20 @@ def pack_bits_qmajor_jnp(bits):
     return w.T
 
 
+def words_to_wire_rows(words: np.ndarray, q: int) -> np.ndarray:
+    """uint32[K, W] packed words -> contiguous uint8[K, ceil(q/8)] wire
+    rows (tail bits masked).  THE one definition of the packed row
+    layout: ``words_to_wire`` flattens it to the wire blob, the serving
+    fronts hand its buffer straight to the socket (no ``tobytes``)."""
+    w = np.ascontiguousarray(mask_tail(np.asarray(words, dtype=np.uint32), q))
+    rows = w.view("<u1").reshape(w.shape[0], -1)[:, : packed_bytes(q)]
+    return np.ascontiguousarray(rows)
+
+
 def words_to_wire(words: np.ndarray, q: int) -> bytes:
     """uint32[K, W] packed words -> the wire blob: K rows of ceil(q/8)
     bytes, concatenated (the /v1/eval_points_batch?format=packed body)."""
-    w = np.ascontiguousarray(mask_tail(np.asarray(words, dtype=np.uint32), q))
-    rows = w.view("<u1").reshape(w.shape[0], -1)[:, : packed_bytes(q)]
-    return np.ascontiguousarray(rows).tobytes()
+    return words_to_wire_rows(words, q).tobytes()
 
 
 def wire_to_words(data: bytes, k: int, q: int) -> np.ndarray:

@@ -66,7 +66,10 @@ class KeyBatch:
         for i, k in enumerate(keys):
             if len(k) != want:
                 raise ValueError(f"dpf: key {i} length {len(k)} != {want}")
-            arr[i] = np.frombuffer(bytes(k), dtype=np.uint8)
+            # Buffer views (the wire2 front's zero-copy body slices)
+            # parse without an intermediate bytes copy; the SoA
+            # arrays below own their storage either way.
+            arr[i] = np.frombuffer(k, dtype=np.uint8)
         seeds = arr[:, :16].copy().view("<u4")
         ts = arr[:, 16].copy()
         cws = arr[:, 17 : 17 + 18 * nu].reshape(len(keys), nu, 18)

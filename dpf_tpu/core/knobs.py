@@ -179,6 +179,45 @@ _declare(
     "dpf_tpu/server.py",
 )
 
+# wire2: the zero-copy multiplexed binary front ----------------------------
+_declare(
+    "DPF_TPU_WIRE2", "bool", "off",
+    "Second serving front: length-prefixed binary frames over persistent "
+    "multiplexed connections (serving/wire2.py), request bodies flowing "
+    "zero-copy from socket buffer to dispatch operand.  Runs NEXT TO the "
+    "HTTP/1.1 sidecar on its own port; replies are byte-identical.",
+    "dpf_tpu/server.py",
+)
+_declare(
+    "DPF_TPU_WIRE2_PORT", "int", "8991",
+    "TCP port of the wire2 front (0 = ephemeral; the chosen address is "
+    "printed at boot and exposed as srv.wire2.address).",
+    "dpf_tpu/serving/wire2.py",
+)
+_declare(
+    "DPF_TPU_WIRE2_MAX_STREAMS", "int", "64",
+    "Concurrent streams admitted per wire2 connection; a stream opened "
+    "past the cap is refused with a structured shed reply (429-"
+    "equivalent) instead of queueing unboundedly in the frame reader.",
+    "dpf_tpu/serving/wire2.py",
+)
+_declare(
+    "DPF_TPU_WIRE2_MAX_BODY_BYTES", "int", str(1 << 31),
+    "Largest request body one wire2 stream may declare (the declared "
+    "length allocates the receive buffer up front; an over-cap HEADERS "
+    "frame is refused with a structured 400 and its body discarded off "
+    "the wire — never an allocation).",
+    "dpf_tpu/serving/wire2.py",
+)
+_declare(
+    "DPF_TPU_WIRE2_RECV_BUF_BYTES", "int", str(1 << 22),
+    "Size of the pooled per-connection receive buffers wire2 streams "
+    "borrow for their bodies (bodies larger than this get a dedicated "
+    "allocation for that stream; freed buffers return to the pool, so "
+    "steady-state traffic allocates nothing).",
+    "dpf_tpu/serving/wire2.py",
+)
+
 # Mesh-native serving: shard serving dispatches across the chip mesh -------
 _declare(
     "DPF_TPU_MESH", "str", "auto",

@@ -113,7 +113,10 @@ class DcfKeyBatch:
         for i, b in enumerate(keys):
             if len(b) != want:
                 raise ValueError(f"dcf: key {i} length {len(b)} != {want}")
-            arr[i] = np.frombuffer(bytes(b), dtype=np.uint8)
+            # Buffer views (the wire2 front's zero-copy body slices)
+            # parse without an intermediate bytes copy; the SoA
+            # arrays below own their storage either way.
+            arr[i] = np.frombuffer(b, dtype=np.uint8)
         seeds = arr[:, :16].copy().view("<u4")
         ts = arr[:, 16].copy()
         cws = arr[:, 17 : 17 + 19 * nu].reshape(len(keys), nu, 19)

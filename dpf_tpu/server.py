@@ -3,12 +3,28 @@
 The reference is a Go library consumed in-process (dpf_main.go:6 imports
 ``github.com/dkales/dpf-go/dpf``).  The TPU framework's evaluator lives in a
 Python/JAX process, so foreign-language clients (the reference's Go
-programs, C++ services, ...) reach it through this sidecar instead: a tiny
-HTTP/1.1 server speaking raw key bytes in and raw result bytes out — the
-same keys-as-bytes wire contract as the reference (``type DPFkey []byte``,
-dpf/dpf.go:7), so a Go client is ~20 lines of net/http with no codegen.
+programs, C++ services, ...) reach it through this sidecar instead — now
+over TWO fronts sharing one transport-neutral handler core
+(``serving/handlers.py``):
 
-Endpoints (all POST, binary bodies, profile/params in the query string):
+  * this module's HTTP/1.1 front: raw key bytes in and raw result bytes
+    out — the same keys-as-bytes wire contract as the reference
+    (``type DPFkey []byte``, dpf/dpf.go:7), so a Go client is ~20 lines
+    of net/http with no codegen.  Curl-able, debuggable, the default.
+  * the wire2 front (``serving/wire2.py``, enabled with
+    ``DPF_TPU_WIRE2=on``): length-prefixed binary frames over persistent
+    multiplexed connections — HTTP/2-style streams, one connection
+    carrying many concurrent requests — where request bodies flow as
+    ``memoryview`` slices from a per-connection receive buffer straight
+    into the dispatch path (zero intermediate ``bytes`` copies) and
+    replies are written as gathered frames from the device-returned
+    arrays.  Same routes, same params, byte-identical replies; built for
+    million-client agg/HH campaigns where HTTP/1.1 marshalling is the
+    wall.  DESIGN.md §17 documents the frame format and when to use
+    which front.
+
+Endpoints (all POST, binary bodies, profile/params in the query string;
+wire2 sends the identical param string in its header block):
 
   /v1/gen?log_n=N[&alpha=A][&profile=fast]   -> key_a || key_b
   /v1/eval?log_n=N&x=X[&profile=fast]        body: one key  -> 1 byte (0/1)
@@ -104,9 +120,14 @@ Endpoints (all POST, binary bodies, profile/params in the query string):
         EWMA), key-repack LRU hits, circuit-breaker state
         (closed|open|half_open, trips, retries, fast-fails), active
         fault-injection clauses (when any), flight-recorder ring state,
-        and per-phase timers (queue_wait, pack, dispatch, compute, d2h,
-        reply — utils/profiling.PhaseTimer).  The whole payload is ONE
-        critical section under a single stats lock — never a torn read.
+        per-phase timers (queue_wait, pack, dispatch, compute, d2h,
+        reply — utils/profiling.PhaseTimer), and the per-front ``wire``
+        marshalling ledger (requests, body bytes, bytes COPIED between
+        socket and dispatch operand — the wire2 hot path's entry stays
+        at zero copied; the allocation probe in tests/test_wire2.py and
+        the bench cfg-wire section read this).  The whole payload is
+        ONE critical section under a single stats lock — never a torn
+        read.
   /v1/metrics (GET)                           -> the same snapshot in
         Prometheus text format (obs/metrics.py): counters (sheds,
         expirations, breaker transitions, plan compiles, keycache hits),
@@ -129,372 +150,40 @@ Endpoints (all POST, binary bodies, profile/params in the query string):
         min(S, DPF_TPU_PROFILE_MAX_S); the reply reports the trace
         directory for xprof/tensorboard.
 
-Serving fast path (the request pipeline for the pointwise/DCF/interval
-endpoints):
+The request pipeline itself — admission, micro-batcher, plan cache,
+deadlines, circuit breaker, tracing, degraded modes, format
+negotiation, structured errors — is documented where it lives now:
+``serving/handlers.py`` (the transport-neutral core both fronts call).
+This module is only the HTTP/1.1 byte I/O around it.
 
-  parse/LRU repack (serving/keycache.py — repeated key bytes skip
-  validation + packing + the key-material upload entirely)
-    -> dynamic micro-batcher (serving/batcher.py — concurrent requests
-       on the same (route, profile, log_n) lane coalesce into ONE device
-       dispatch; DPF_TPU_BATCH_WINDOW_US / DPF_TPU_BATCH_MAX_KEYS;
-       DPF_TPU_BATCH=off degrades to direct dispatch)
-    -> plan cache (core/plans.py — K/Q bucketed to powers of two, padded
-       + masked, so the steady state replays pre-traced executables)
-    -> per-request slicing from the packed output words.
-
-With DPF_TPU_MESH resolved (parallel/serving_mesh.py) the plan cache
-dispatches land on the shard_map evaluators: one coalesced batch shards
-its key axis across the chip mesh (DESIGN §14), /v1/stats grows a
-``mesh`` block, /v1/metrics a ``dpf_mesh_shards`` gauge and mesh-
-coordinate labels on the per-device memory gauges, and while the
-circuit breaker is not closed every dispatch falls back byte-
-identically to the single-device executables.  The wire contract is
-unchanged in every mode.
-
-Format negotiation: ``format=bits`` (the byte-per-bit default, for
-back-compat) or ``format=packed``; anything else is a 400.  The server-side
-default for requests that omit the param is the ``DPF_TPU_WIRE_FORMAT``
-env knob (bits).  Packed responses follow the core/bitpack contract —
-clients unpack with ``bitpack.unpack_bits`` / ``dpftpu.UnpackBits``.
-
-Batched endpoints amortize the device dispatch exactly like the in-process
-batch API; errors surface as structured ``{code, detail}`` JSON (clean
-error propagation across the bridge — SURVEY §5.3 — never a crashed
-server): 400 bad_request for validation, 429 shed past an admission
-watermark, 503 unavailable while the device circuit breaker is open (both
-with Retry-After derived from observed dispatch latency), 504 deadline
-when a request's ``X-DPF-Deadline-Ms`` budget expires, 500 internal with
-the exception TYPE only (reprs can embed key material; see DESIGN §11).
-
-Run: ``python -m dpf_tpu.server --port 8990``.
+Run: ``python -m dpf_tpu.server --port 8990`` (add
+``DPF_TPU_WIRE2=on [DPF_TPU_WIRE2_PORT=8991]`` for the wire2 front).
 """
 
 from __future__ import annotations
 
 import argparse
-import contextlib
-import json
 import math
 import socket
 import struct
 import threading
-import time
 import warnings
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import urlparse
 
-import numpy as np
-
-from .core import bitpack, knobs, plans
-from .obs import metrics as obs_metrics
-from .obs import profile as obs_profile
+from .core import knobs
 from .obs import trace as obs_trace
-from .serving import Batcher, IntervalWork, KeyCache, PointsWork, faults
-from .serving.batcher import (
-    HHWork,
-    PirWork,
-    dispatch_hh,
-    dispatch_interval,
-    dispatch_pir,
-    dispatch_points,
+from .serving import faults, handlers
+from .serving.handlers import (  # noqa: F401 — the sidecar's public surface
+    DEADLINE_HEADER,
+    TRACE_HEADER,
+    reset_serving_state,
 )
-from .serving.breaker import CircuitBreaker, is_transient
-from .serving.errors import DeadlineError, ServingError
-from .utils.profiling import PhaseTimer
 
-# Per-request deadline header: remaining budget in milliseconds.  The
-# ``DPF_TPU_DEADLINE_MS`` knob sets the server default for requests that
-# omit it (0 = no default deadline).
-DEADLINE_HEADER = "X-DPF-Deadline-Ms"
-
-# Per-request trace id header (obs/trace.py): propagated from the client
-# (the Go client stamps one per request) or generated at ingress.
-TRACE_HEADER = "X-DPF-Trace"
-
-# ServingError.code -> flight-recorder outcome (obs/trace.OUTCOMES).
-_ERROR_OUTCOMES = {
-    "shed": "shed",
-    "deadline": "expired",
-    "unavailable": "breaker_rejected",
-}
-
-
-def _wire_format(q: dict) -> bool:
-    """Resolve the response format for a points endpoint -> packed? bool.
-    Per-request ``format`` param wins; ``DPF_TPU_WIRE_FORMAT`` sets the
-    server default; unknown values are a 400 (ValueError)."""
-    fmt = q.get("format", knobs.get_str("DPF_TPU_WIRE_FORMAT"))
-    if fmt not in ("bits", "packed"):
-        raise ValueError(f"unknown format {fmt!r} (use bits|packed)")
-    return fmt == "packed"
-
-
-def _deadline_from(headers) -> float | None:
-    """Resolve the request's absolute deadline (perf_counter seconds) or
-    None: the ``X-DPF-Deadline-Ms`` header wins, the DPF_TPU_DEADLINE_MS
-    knob is the server default, 0/absent means unbounded."""
-    raw = headers.get(DEADLINE_HEADER)
-    if raw is None:
-        ms = knobs.get_float("DPF_TPU_DEADLINE_MS")
-        if ms <= 0:
-            return None
-    else:
-        ms = float(raw)
-        if ms <= 0:
-            raise ValueError(f"{DEADLINE_HEADER} must be a positive ms count")
-    return time.perf_counter() + ms / 1e3
-
-
-def _run_evalfull(profile: str, kb):
-    faults.fire("dispatch.evalfull")
-    return plans.run_evalfull(profile, kb)
-
-
-def _profile_api(profile: str):
-    if profile == "fast":
-        from . import fast
-        from .core.chacha_np import key_len
-        from .models.keys_chacha import KeyBatchFast
-
-        return fast, key_len, KeyBatchFast
-    import dpf_tpu
-
-    from .core.spec import key_len
-    from .core.keys import KeyBatch
-
-    return dpf_tpu, key_len, KeyBatch
-
-
-class _ServingState:
-    """Per-process serving machinery: micro-batcher, host-repack LRU and
-    the thread-merged phase timers.  Built lazily on first request so env
-    knobs set by tests/deployments before traffic take effect."""
-
-    def __init__(self):
-        # A DPF_TPU_FAULTS spec activates (or refuses loudly) before any
-        # traffic; programmatic test installs are left untouched when the
-        # knob is empty.
-        faults.install_from_env()
-        # ONE stats lock (re-entrant) shared by every counter surface —
-        # batcher stats, breaker counters, key-cache LRU, phase timers,
-        # metrics histograms — so ``stats_snapshot`` (and /v1/metrics,
-        # rendered from the same snapshot) is a single consistent cut
-        # across all of them, never a torn read of one component mid-
-        # update.  Queue/state structure sharing the same lock is fine:
-        # no component holds it across a dispatch, sleep, or socket op.
-        self.stats_lock = threading.RLock()
-        self.metrics = obs_metrics.MetricsHub(lock=self.stats_lock)
-        self.batcher = Batcher(lock=self.stats_lock, metrics=self.metrics)
-        self.keys = KeyCache(lock=self.stats_lock)
-        self.phases = PhaseTimer()
-        self.batch_enabled = knobs.get_bool("DPF_TPU_BATCH")
-        # The breaker's background probe re-warms what was being served
-        # (most recently used plans) so recovery never lands a recompile
-        # on the half-open trial request.
-        self.breaker = CircuitBreaker(
-            probe=plans.rewarm_recent, lock=self.stats_lock
-        )
-        self.tracer = obs_trace.Tracer()
-        # Readiness (GET /readyz): flipped by the first successful
-        # POST /v1/warmup — a sidecar that never warmed serves traffic
-        # but advertises not-ready so load generators hold fire.
-        self.warmed = False
-
-    def degraded(self) -> bool:
-        """True while the breaker is not closed: the batcher is bypassed
-        (a failing dispatch fans to ONE request, not a coalesced batch),
-        streamed EvalFull falls back to buffered replies (failures
-        surface as a clean status line, never a truncated body), and
-        mesh dispatches fall back to single-device (a wedged chip must
-        not be re-probed through an every-chip collective;
-        ``parallel/serving_mesh.suspended``).  All degraded paths are
-        byte-identical to the fast path."""
-        return self.breaker.degraded()
-
-    def _mesh_ctx(self):
-        """Single-device override for degraded dispatches: inside this
-        context every plan call ignores the serving mesh.  A no-op
-        nullcontext while the breaker is closed."""
-        if self.degraded():
-            from .parallel import serving_mesh
-
-            return serving_mesh.suspended()
-        return contextlib.nullcontext()
-
-    def _note_phase(self, name: str, dt: float, n: int = 1) -> None:
-        """One phase observation into BOTH surfaces — the /v1/stats sum
-        counters and the /v1/metrics latency histogram — under the single
-        stats lock."""
-        with self.stats_lock:
-            self.phases.add(name, dt, n)
-            self.metrics.observe_phase(name, dt)
-
-    @contextlib.contextmanager
-    def phase(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self._note_phase(name, time.perf_counter() - t0)
-
-    def merge_timer(self, tm: PhaseTimer) -> None:
-        # A streamed run's timer arrives pre-accumulated; each merged
-        # phase is one histogram observation of its total.
-        with self.stats_lock:
-            for name, dt in tm.phases.items():
-                self._note_phase(name, dt, tm.counts[name])
-
-    def run(self, work, dispatch):
-        """One request through the fast path: breaker admission ->
-        micro-batcher (when enabled and healthy) -> plan cache ->
-        per-request result rows.  Dispatches run under the breaker
-        (transient retries + trip accounting); deadline checkpoints
-        bracket the passthrough path the same way the batcher brackets
-        its queue."""
-        tr = getattr(work, "trace", None)
-        with obs_trace.maybe_span(tr, "admission"):
-            self.breaker.admit()
-
-        def guarded(items):
-            return self.breaker.call(lambda: dispatch(items))
-
-        if self.batch_enabled and not self.breaker.degraded():
-            res = self.batcher.submit(work, guarded)
-        else:
-            # Passthrough: batching disabled, or degraded while the
-            # breaker recovers.
-            if work.deadline is not None and (
-                time.perf_counter() >= work.deadline
-            ):
-                self.batcher.note_expired("queue")
-                raise DeadlineError(
-                    "deadline expired before dispatch", where="queue"
-                )
-            t0 = time.perf_counter()
-            with obs_trace.traced_dispatch(tr) as dspan, self._mesh_ctx():
-                res = guarded([work])[0]
-                if dspan is not None:
-                    dspan.set_attrs(coalesced=work.n_keys)
-            work.dispatch_s = time.perf_counter() - t0
-            work.coalesced = work.n_keys
-            if work.deadline is not None and (
-                time.perf_counter() >= work.deadline
-            ):
-                self.batcher.note_expired("flight")
-                raise DeadlineError(
-                    "deadline expired in flight", where="flight"
-                )
-        self._note_phase("queue_wait", work.queue_wait)
-        # A coalesced dispatch is shared: attribute each request its
-        # key-row share so phases.compute sums to real device time
-        # (the batcher's dispatch_seconds holds the per-dispatch
-        # truth).
-        self._note_phase(
-            "compute",
-            work.dispatch_s * work.n_keys / max(work.coalesced, 1),
-        )
-        return res
-
-    def direct(self, fn, deadline: float | None = None, trace=None):
-        """Breaker-guarded non-batched dispatch (the evalfull routes)
-        with the same deadline checkpoints as the batcher path; expiry
-        shares the batcher's /v1/stats counters."""
-        with obs_trace.maybe_span(trace, "admission"):
-            self.breaker.admit()
-        if deadline is not None and time.perf_counter() >= deadline:
-            self.batcher.note_expired("queue")
-            raise DeadlineError(
-                "deadline expired before dispatch", where="queue"
-            )
-        with obs_trace.traced_dispatch(trace), self._mesh_ctx():
-            out = self.breaker.call(fn)
-        if deadline is not None and time.perf_counter() >= deadline:
-            self.batcher.note_expired("flight")
-            raise DeadlineError("deadline expired in flight", where="flight")
-        return out
-
-    def stats_snapshot(self) -> dict:
-        """Consistent /v1/stats payload, taken as ONE critical section
-        under the single stats lock (the component stats() calls
-        re-acquire the same RLock): batcher, breaker, and key-cache
-        counters can never be torn against each other mid-update.
-        /v1/metrics renders from this same snapshot, so the two surfaces
-        cannot drift."""
-        from .apps import pir_store
-        from .parallel import serving_mesh
-
-        with self.stats_lock:
-            out = {
-                "plans": plans.cache().stats(),
-                "batcher": self.batcher.stats_dict(),
-                "key_cache": self.keys.stats(),
-                "phases": self.phases.as_dict(),
-                "batch_enabled": self.batch_enabled,
-                "breaker": self.breaker.stats(),
-                "degraded": self.degraded(),
-                "trace": self.tracer.stats(),
-                "mesh": serving_mesh.stats(),
-                "pir": pir_store.registry().stats(),
-            }
-        plan = faults.active()
-        if plan is not None:
-            # An injected run must never be mistakable for a healthy one.
-            out["faults"] = plan.stats()
-        return out
-
-    def metrics_text(self) -> str:
-        """The /v1/metrics body: stats + histogram state captured in one
-        critical section, rendered outside it."""
-        with self.stats_lock:
-            snap = self.stats_snapshot()
-            hists = self.metrics.snapshot()
-        return obs_metrics.render(snap, hists)
-
-
-_STATE: _ServingState | None = None
-_STATE_LOCK = threading.Lock()
-
-
-def _serving_state() -> _ServingState:
-    global _STATE
-    with _STATE_LOCK:
-        if _STATE is None:
-            _STATE = _ServingState()
-        return _STATE
-
-
-def reset_serving_state() -> None:
-    """Drop the lazy serving singleton (tests/benches re-read the batching
-    and cache env knobs on the next request)."""
-    global _STATE
-    with _STATE_LOCK:
-        _STATE = None
-
-
-def _evalfull_out_bytes(profile: str, log_n: int) -> int:
-    """The models' output-row contract, in one place: 2^(log_n-3) bytes
-    with the profile's leaf-width floor (compat 16, fast 64)."""
-    return max((1 << log_n) >> 3, 64 if profile == "fast" else 16)
-
-
-def _stream_mode(q: dict, out_bytes: int) -> bool:
-    """Resolve streaming for /v1/evalfull: per-request ``stream`` param
-    wins; DPF_TPU_STREAM=off|auto|on sets the default (auto streams
-    responses >= DPF_TPU_STREAM_MIN_BYTES, default 1 MiB)."""
-    v = q.get("stream")
-    if v is not None:
-        if v not in ("0", "1"):
-            raise ValueError(f"unknown stream {v!r} (use 0|1)")
-        return v == "1"
-    raw = knobs.get_raw("DPF_TPU_STREAM")
-    env = knobs.knob("DPF_TPU_STREAM").default if raw is None else raw.lower()
-    if env in ("on", "1", "true"):
-        return True
-    if env in ("off", "0", "false", ""):
-        return False
-    if env != "auto":
-        raise ValueError(f"DPF_TPU_STREAM={env!r} unknown (off|auto|on)")
-    return out_bytes >= knobs.get_int("DPF_TPU_STREAM_MIN_BYTES")
+# Back-compat aliases: tests and benches reach the serving singleton
+# through this module (the machinery itself lives in serving/handlers).
+_serving_state = handlers.serving_state
+_evalfull_out_bytes = handlers._evalfull_out_bytes
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -509,35 +198,6 @@ class _Handler(BaseHTTPRequestHandler):
 
     def log_message(self, *a):  # quiet by default
         pass
-
-    def _reply(self, code: int, body: bytes, ctype="application/octet-stream"):
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _reply_error(
-        self, status: int, code: str, detail: str,
-        retry_after_s: float | None = None,
-    ):
-        """Structured error reply: ``{code, detail}`` JSON plus a
-        Retry-After header (whole seconds, rounded up) when the error
-        carries a backoff hint.  ``detail`` must be client-safe — the
-        secret-hygiene lint treats this call as a taint sink."""
-        body = json.dumps({"code": code, "detail": detail}).encode()
-        self.send_response(status)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        if retry_after_s is not None:
-            self.send_header(
-                "Retry-After", str(max(1, math.ceil(retry_after_s)))
-            )
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _bad(self, msg: str):
-        self._reply_error(400, "bad_request", msg)
 
     def _abort_connection(self):
         """Hard-abort the connection: SO_LINGER(1, 0) + close sends a
@@ -557,118 +217,37 @@ class _Handler(BaseHTTPRequestHandler):
             pass
         self.close_connection = True
 
-    def do_GET(self):
-        url = urlparse(self.path)
-        path = url.path
-        if path == "/healthz":
-            # Liveness ONLY: "ok" while the process serves requests,
-            # regardless of breaker state or warmup.  Readiness is
-            # /readyz — a restart-the-pod signal must never be
-            # conflated with a hold-the-traffic signal.
-            self._reply(200, b"ok", "text/plain")
-        elif path == "/readyz":
-            st = _serving_state()
-            if st.breaker.degraded():
-                self._reply_error(
-                    503, "breaker_open",
-                    f"circuit breaker is {st.breaker.state}",
-                    retry_after_s=st.breaker.cooldown_s,
-                )
-            elif not st.warmed:
-                self._reply_error(
-                    503, "cold",
-                    "warmup has not run (POST /v1/warmup first)",
-                )
-            else:
-                self._reply(200, b"ready", "text/plain")
-        elif path == "/v1/stats":
-            payload = _serving_state().stats_snapshot()
-            self._reply(
-                200, json.dumps(payload).encode(), "application/json"
+    def _write_reply(self, reply: handlers.Reply) -> None:
+        """One buffered Reply onto the socket: status line, exact
+        Content-Length, Retry-After when the error carries a backoff
+        hint, then the gathered body chunks (buffer views write without
+        an intermediate join)."""
+        self.send_response(reply.status)
+        self.send_header("Content-Type", reply.ctype)
+        self.send_header("Content-Length", str(reply.body_len))
+        if reply.retry_after_s is not None:
+            self.send_header(
+                "Retry-After", str(max(1, math.ceil(reply.retry_after_s)))
             )
-        elif path == "/v1/metrics":
-            self._reply(
-                200, _serving_state().metrics_text().encode(),
-                "text/plain; version=0.0.4",
-            )
-        elif path == "/v1/trace":
-            # Only the QUERY-PARAM parsing maps to 400 — a rendering
-            # failure must stay a 500, not masquerade as a scraper
-            # misconfiguration.
-            try:
-                q = {k: v[0] for k, v in parse_qs(url.query).items()}
-                outcome = q.get("outcome")
-                if outcome is not None and (
-                    outcome not in obs_trace.OUTCOMES
-                ):
-                    raise ValueError(
-                        f"unknown outcome {outcome!r} "
-                        f"(one of {', '.join(obs_trace.OUTCOMES)})"
-                    )
-                n = int(q.get("n", 32))
-            except ValueError as e:
-                self._reply_error(400, "bad_request", str(e))
-                return
-            st = _serving_state()
-            traces = st.tracer.recorder.query(
-                n=n,
-                slowest=q.get("slowest") == "1",
-                trace_id=q.get("id"),
-                outcome=outcome,
-            )
-            payload = {
-                "enabled": st.tracer.enabled,
-                "ring": st.tracer.recorder.stats(),
-                "traces": [t.as_dict() for t in traces],
-            }
-            self._reply(
-                200, json.dumps(payload).encode(), "application/json"
-            )
-        else:
-            self._reply(404, b"not found", "text/plain")
+        self.end_headers()
+        for chunk in reply.chunks:
+            self.wfile.write(chunk)
+        if reply.close_connection:
+            # The handler left body bytes unread (an error mid-upload):
+            # the next pipelined request would parse mid-body.
+            self.close_connection = True
 
-    def _points_reply(self, words: np.ndarray, nq: int, packed: bool, st,
-                      trace=None):
-        with st.phase("reply"), obs_trace.maybe_span(trace, "reply"):
-            faults.fire("reply.write")
-            if packed:
-                self._reply(200, bitpack.words_to_wire(words, nq))
-            else:
-                self._reply(
-                    200,
-                    np.ascontiguousarray(
-                        bitpack.unpack_bits(words, nq)
-                    ).tobytes(),
-                )
-
-    def _evalfull_stream(self, profile: str, kb, log_n: int, st,
-                         deadline: float | None = None):
-        """Write one key's expansion progressively from the streaming
-        pipeline.  The first chunk is pulled BEFORE the status line so
-        evaluation errors still surface as a clean 400.  Deadline
-        checkpoints mirror the buffered path: expiry before the status
-        line is a clean 504; expiry mid-stream aborts the connection
-        (the body can no longer be completed honestly) and counts as
-        expired-in-flight."""
-        if deadline is not None and time.perf_counter() >= deadline:
-            st.batcher.note_expired("queue")
-            raise DeadlineError(
-                "deadline expired before dispatch", where="queue"
-            )
-        tm = PhaseTimer()
-        if profile == "fast":
-            from .models.dpf_chacha import eval_full_stream
-
-            gen = eval_full_stream(kb, timer=tm)
-        else:
-            from .models.dpf import eval_full_stream
-
-            gen = eval_full_stream(kb, timer=tm)
-        first = next(gen)
-        declared = _evalfull_out_bytes(profile, log_n)
-        self.send_response(200)
-        self.send_header("Content-Type", "application/octet-stream")
-        self.send_header("Content-Length", str(declared))
+    def _write_stream(self, reply: handlers.Reply, st) -> None:
+        """A progressive Reply (streamed EvalFull): exact Content-Length
+        up front, each generated chunk written as it arrives.  The
+        status line is already committed when a mid-stream failure
+        (deadline, injected chunk fault, dispatch error) surfaces, so
+        the only honest signal is an aborted connection — truncation is
+        a loud client-side error, and a keep-alive client can never
+        read the next response out of frame."""
+        self.send_response(reply.status)
+        self.send_header("Content-Type", reply.ctype)
+        self.send_header("Content-Length", str(reply.stream_len))
         self.end_headers()
         written = 0
         aborted = False
@@ -676,606 +255,90 @@ class _Handler(BaseHTTPRequestHandler):
             # Only the socket writes belong to the "reply" phase — the
             # generator's resumption does device dispatch + D2H, which
             # the stream's own timer already records as dispatch/d2h.
-            chunk = first
-            while chunk is not None:
-                if deadline is not None and (
-                    time.perf_counter() >= deadline
-                ):
-                    st.batcher.note_expired("flight")
-                    raise DeadlineError(
-                        "deadline expired mid-stream", where="flight"
-                    )
-                faults.fire("stream.chunk")
-                row = chunk[0].tobytes()
+            for chunk in reply.stream:
                 with st.phase("reply"):
-                    self.wfile.write(row)
-                written += len(row)
-                chunk = next(gen, None)
+                    self.wfile.write(chunk)
+                written += handlers._blen(chunk)
         except Exception:  # noqa: BLE001
-            # The 200 status line is already on the wire: a second
-            # response here would corrupt the client's payload.  The only
-            # honest signal for a mid-stream failure is an aborted
-            # connection.
             aborted = True
         finally:
-            if aborted or written != declared:
-                # Mid-stream failure or declared-length drift: RST the
-                # connection so truncation is a loud client-side error
-                # (and a keep-alive client can never read the next
-                # response out of frame).
+            if aborted or written != reply.stream_len:
                 self._abort_connection()
-            st.merge_timer(tm)
 
-    def _agg_submit(self, q: dict, st, trace):
-        """POST /v1/agg/submit?op=xor|add&k=K&words=W — streamed secure
-        aggregation.  Body: K client share rows of W uint32 words each
-        (little-endian), read and folded in DPF_TPU_AGG_CHUNK_BYTES
-        chunks so the [K, W] upload never materializes on host; reply:
-        the W folded words.  Rides admission (breaker), deadlines (the
-        checkpoint runs between chunks — a doomed upload stops burning
-        device slots mid-body), and per-chunk transient retries like
-        every other dispatch seam.  Any failure before the body is fully
-        consumed aborts the connection (the unread remainder would
-        misframe the next keep-alive request)."""
-        from .apps import aggregation as agg_app
+    def _send(self, reply: handlers.Reply, st) -> None:
+        if reply.stream is not None:
+            self._write_stream(reply, st)
+        elif reply.timed:
+            # Serving replies: the write is a "reply" phase observation,
+            # a reply span on the request's trace, and the reply.write
+            # fault site (injected write failures map like any other).
+            with st.phase("reply"), obs_trace.maybe_span(
+                reply.trace, "reply"
+            ):
+                faults.fire("reply.write")
+                self._write_reply(reply)
+        else:
+            self._write_reply(reply)
 
-        clen = int(self.headers.get("Content-Length", 0))
-        consumed = 0
-        # EVERYTHING from parameter parsing on runs under the framing
-        # guard: any error that leaves body bytes unread must close the
-        # connection, or the next pipelined request parses mid-upload.
-        try:
-            op = q.get("op", "xor")
-            if op not in agg_app.OPS:
-                raise ValueError(f"unknown op {op!r} (use xor|add)")
-            k, words = int(q["k"]), int(q["words"])
-            if k <= 0 or words <= 0:
-                raise ValueError("k and words must be positive")
-            row_bytes = words * 4
-            if clen != k * row_bytes:
-                raise ValueError(
-                    f"body must be {k}*{row_bytes} bytes of uint32 rows"
-                )
-            deadline = _deadline_from(self.headers)
-            if trace is not None:
-                trace.set_attrs(op=op, words=words, rows=k)
-            with obs_trace.maybe_span(trace, "admission"):
-                st.breaker.admit()
-            step = agg_app.chunk_rows(words)
-            carry = np.zeros(words, np.uint32)
-            remaining = k
-            with obs_trace.traced_dispatch(trace) as dspan:
-                while remaining > 0:
-                    if deadline is not None and (
-                        time.perf_counter() >= deadline
-                    ):
-                        where = "queue" if consumed == 0 else "flight"
-                        st.batcher.note_expired(where)
-                        raise DeadlineError(
-                            "deadline expired mid-upload", where=where
-                        )
-                    take = min(step, remaining)
-                    # The socket read accounts to "pack" (host-side
-                    # marshalling), NOT "dispatch": a slow uploader must
-                    # never spike the device-health phase histogram.
-                    with st.phase("pack"):
-                        buf = self.rfile.read(take * row_bytes)
-                        if len(buf) != take * row_bytes:
-                            raise ValueError("upload truncated mid-chunk")
-                        consumed += len(buf)
-                        rows = np.frombuffer(buf, dtype="<u4").reshape(
-                            take, words
-                        )
-                    # The fault seam fires INSIDE the breaker call, like
-                    # every other dispatch.* site, so injected transients
-                    # get the breaker's retry/classification treatment.
-                    def fold_chunk(r=rows, c=carry):
-                        faults.fire("dispatch.agg")
-                        return plans.run_agg_fold(op, c, r)
-
-                    # _mesh_ctx per chunk: a breaker trip mid-upload
-                    # degrades the REMAINING chunks to single-device
-                    # (the fold carry is placement-agnostic numpy).
-                    with st.phase("dispatch"), st._mesh_ctx():
-                        carry = st.breaker.call(fold_chunk)
-                    remaining -= take
-                if dspan is not None:
-                    dspan.set_attrs(coalesced=k, chunks=-(-k // step))
-        except BaseException:
-            if consumed != clen:
-                # The socket still holds unread upload bytes: a reply
-                # now would leave the next pipelined request misframed.
-                self.close_connection = True
-            raise
-        with st.phase("reply"), obs_trace.maybe_span(trace, "reply"):
-            faults.fire("reply.write")
-            self._reply(200, carry.astype("<u4").tobytes())
-
-    def _pir_db_load(self, q: dict, st, trace):
-        """POST /v1/pir/db?name=X&rows=N&row_bytes=B[&profile=] —
-        register a named device-resident PIR database
-        (apps/pir_store.py).  The body is read off the socket in
-        DPF_TPU_PIR_DB_CHUNK_BYTES chunks straight into the packed host
-        buffer (one copy, no giant intermediate bytes object), with
-        deadline checkpoints between chunks; the same framing guard as
-        /v1/agg/submit closes the connection when an error leaves body
-        bytes unread.  On success the database is placed resident for
-        the CURRENT mesh regime, so query traffic never pays the
-        device transfer."""
-        from .apps import pir_store
-
-        clen = int(self.headers.get("Content-Length", 0))
-        consumed = 0
-        try:
-            name = q.get("name", "")
-            pir_store.validate_name(name)  # BEFORE reading a byte
-            profile = q.get("profile", "compat")
-            if profile not in ("compat", "fast"):
-                raise ValueError(f"unknown profile {profile!r}")
-            rows, row_bytes = int(q["rows"]), int(q["row_bytes"])
-            if rows <= 0 or row_bytes <= 0:
-                raise ValueError("rows and row_bytes must be positive")
-            if row_bytes % 4:
-                raise ValueError("row_bytes must be a multiple of 4")
-            if clen != rows * row_bytes:
-                raise ValueError(
-                    f"body must be {rows}*{row_bytes} bytes of row data"
-                )
-            deadline = _deadline_from(self.headers)
-            if trace is not None:
-                trace.set_attrs(db=name, rows=rows, row_bytes=row_bytes)
-            # Breaker admission before the buffer and the read loop: a
-            # wedged/recovering device must shed a multi-GB upload (and
-            # its residency placement) exactly like any other dispatch.
-            with obs_trace.maybe_span(trace, "admission"):
-                st.breaker.admit()
-            db = np.empty((rows, row_bytes), np.uint8)
-            step = pir_store.upload_chunk_rows(row_bytes)
-            done = 0
-            while done < rows:
-                if deadline is not None and (
-                    time.perf_counter() >= deadline
-                ):
-                    where = "queue" if consumed == 0 else "flight"
-                    st.batcher.note_expired(where)
-                    raise DeadlineError(
-                        "deadline expired mid-upload", where=where
-                    )
-                take = min(step, rows - done)
-                # The socket read accounts to "pack" (host marshalling),
-                # like the agg upload — a slow uploader must never spike
-                # the device-health phases.
-                with st.phase("pack"):
-                    faults.fire("pir.db_load")
-                    buf = self.rfile.read(take * row_bytes)
-                    if len(buf) != take * row_bytes:
-                        raise ValueError("upload truncated mid-chunk")
-                    consumed += len(buf)
-                    db[done : done + take] = np.frombuffer(
-                        buf, np.uint8
-                    ).reshape(take, row_bytes)
-                done += take
-            entry = pir_store.registry().load(name, db, profile=profile)
-        except BaseException:
-            if consumed != clen:
-                # Unread upload bytes would misframe the next pipelined
-                # request: close instead of replying over them.
-                self.close_connection = True
-            raise
-        # Place residency NOW (sharded over the mesh when resolved), so
-        # the first query pays neither transfer nor layout.
-        shards = entry.dispatch_shards()
-        srv = entry.server(shards)
-        info = {
-            "name": entry.name,
-            "rows": entry.n_rows,
-            "row_bytes": entry.row_bytes,
-            "log_n": entry.log_n,
-            "profile": entry.profile,
-            "db_bytes": entry.db_bytes,
-            "shards": shards,
-            "stream_chunks": srv.stream_chunks,
-        }
-        with st.phase("reply"), obs_trace.maybe_span(trace, "reply"):
-            faults.fire("reply.write")
-            self._reply(200, json.dumps(info).encode(), "application/json")
-
-    def _pir_query(self, q: dict, body: bytes, st, trace):
-        """POST /v1/pir/query?db=X&k=K — answer K PIR queries against a
-        registered database through the batcher lane (concurrent
-        queries coalesce into one selection-matrix matmul over the
-        resident rows)."""
-        from .apps import pir_store
-
-        name = q["db"]  # KeyError -> 400 missing parameter
-        try:
-            db = pir_store.registry().get(name)
-        except KeyError as e:
-            raise ValueError(str(e.args[0])) from None
-        k = int(q["k"])
-        _, key_len, batch_cls = _profile_api(db.profile)
-        kl = key_len(db.log_n)
-        if len(body) != k * kl:
-            raise ValueError(f"body must be {k}*{kl} key bytes")
-        deadline = _deadline_from(self.headers)
-        if trace is not None:
-            trace.set_attrs(profile=db.profile, log_n=db.log_n, db=db.name)
-        with st.phase("pack"), st._mesh_ctx():
-            kb = st.keys.get(
-                db.profile, db.log_n, bytes(body),
-                lambda: batch_cls.from_bytes(
-                    [bytes(body[i * kl : (i + 1) * kl]) for i in range(k)],
-                    db.log_n,
-                ),
-            )
-        rows = st.run(
-            PirWork(db, kb, deadline=deadline, trace=trace), dispatch_pir
+    def do_GET(self):
+        url = urlparse(self.path)
+        reply = handlers.respond_get(
+            url.path, handlers.parse_params(url.query), _serving_state()
         )
-        with st.phase("reply"), obs_trace.maybe_span(trace, "reply"):
-            faults.fire("reply.write")
-            self._reply(200, np.ascontiguousarray(rows).tobytes())
-
-    def _profile_request(self, body: bytes):
-        """POST /v1/profile: knob-gated, duration-bounded XProf capture
-        (obs/profile.py).  Body: ``{"action": "start"|"stop"|"status"
-        [, "seconds": S][, "dir": path]}``."""
-        spec = json.loads(body or b"{}")
-        action = spec.get("action", "start")
-        try:
-            if action == "start":
-                out = obs_profile.start(
-                    spec.get("dir"),
-                    spec.get("seconds"),
-                )
-            elif action == "stop":
-                out = obs_profile.stop()
-            elif action == "status":
-                out = obs_profile.status()
-            else:
-                raise ValueError(
-                    f"unknown action {action!r} (start|stop|status)"
-                )
-        except obs_profile.ProfileForbidden as e:
-            self._reply_error(403, "profile_forbidden", str(e))
-            return
-        except obs_profile.ProfileBusy as e:
-            self._reply_error(409, "profile_active", str(e))
-            return
-        except obs_profile.ProfileError as e:
-            self._reply_error(400, "bad_request", str(e))
-            return
-        self._reply(200, json.dumps(out).encode(), "application/json")
+        self._write_reply(reply)
 
     def do_POST(self):
-        trace = None
-        st = None
-        outcome = "ok"
+        st = _serving_state()
+        url = urlparse(self.path)
+        route = url.path
         try:
-            url = urlparse(self.path)
-            q = {k: v[0] for k, v in parse_qs(url.query).items()}
-            route = url.path
-            st = _serving_state()
-
-            if route == "/v1/agg/submit":
-                # The aggregation upload is the one body that must NOT
-                # be read whole: it streams off the socket in
-                # DPF_TPU_AGG_CHUNK_BYTES chunks, one fold dispatch per
-                # chunk (apps/aggregation.py).
-                trace = st.tracer.begin(
-                    self.headers.get(TRACE_HEADER), route
-                )
-                self._agg_submit(q, st, trace)
-                return
-            if route == "/v1/pir/db":
-                # The other streamed upload: database rows read in
-                # DPF_TPU_PIR_DB_CHUNK_BYTES chunks into the packed
-                # host buffer (apps/pir_store.py).
-                trace = st.tracer.begin(
-                    self.headers.get(TRACE_HEADER), route
-                )
-                self._pir_db_load(q, st, trace)
-                return
-            body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
-
-            if route == "/v1/warmup":
-                spec = json.loads(body or b"[]")
-                shapes = spec.get("shapes", []) if isinstance(spec, dict) \
-                    else spec
-                warmed = plans.warmup(shapes)
-                if warmed:
-                    # /readyz flips to 200 — but only when this warmup
-                    # actually compiled something: an empty spec must
-                    # not advertise readiness over a cold plan cache.
-                    st.warmed = True
-                self._reply(
-                    200,
-                    json.dumps(
-                        {
-                            "warmed": warmed,
-                            "trace_cache_entries": plans.trace_count(),
-                        }
-                    ).encode(),
-                    "application/json",
-                )
-                return
-            if route == "/v1/profile":
-                self._profile_request(body)
-                return
-
-            # Flight-recorder trace for the serving routes (None when
-            # DPF_TPU_TRACE=off): id from the client's X-DPF-Trace
-            # header, or generated here at ingress.
-            trace = st.tracer.begin(self.headers.get(TRACE_HEADER), route)
-
-            if route == "/v1/pir/query":
-                # Profile and domain come from the registered database,
-                # not the query string — handled before the generic
-                # profile/log_n parsing below.
-                self._pir_query(q, body, st, trace)
-                return
-
-            profile = q.get("profile", "compat")
-            api, key_len, batch_cls = _profile_api(profile)
-            log_n = int(q["log_n"])
-            deadline = _deadline_from(self.headers)
-            if trace is not None:
-                trace.set_attrs(profile=profile, log_n=log_n)
-
-            def cached_keys(kind, blob, k, kl, cls=None):
-                """Parse ``k`` concatenated keys through the repack LRU.
-                Parsing runs under the SAME mesh context the dispatch
-                will (``_mesh_ctx``), so the cache's placement-regime
-                token — and the batch's device operand memos — always
-                match the executable the batch is about to feed."""
-                cls = cls or batch_cls
-                with st.phase("pack"), st._mesh_ctx():
-                    return st.keys.get(
-                        kind, log_n, blob,
-                        lambda: cls.from_bytes(
-                            [
-                                bytes(blob[i * kl : (i + 1) * kl])
-                                for i in range(k)
-                            ],
-                            log_n,
-                        ),
-                    )
-
-            if route == "/v1/gen":
-                alpha = int(q.get("alpha", 0))
-                ka, kb = api.Gen(alpha, log_n)
-                self._reply(200, ka + kb)
-            elif route == "/v1/eval":
-                bit = api.Eval(bytes(body), int(q["x"]), log_n)
-                self._reply(200, bytes([bit]))
-            elif route == "/v1/evalfull":
-                kl = key_len(log_n)
-                if len(body) != kl:
-                    raise ValueError(f"body must be one {kl}-byte key")
-                kb = cached_keys(profile, bytes(body), 1, kl)
-                if _stream_mode(
-                    q, _evalfull_out_bytes(profile, log_n)
-                ) and not st.degraded():
-                    # (Degraded mode buffers: a dispatch error surfaces
-                    # as a clean status line, never a truncated stream.)
-                    with obs_trace.maybe_span(trace, "admission"):
-                        st.breaker.admit()
-                    self._evalfull_stream(
-                        profile, kb, log_n, st, deadline
-                    )
-                else:
-                    with st.phase("dispatch"):
-                        out = st.direct(
-                            lambda: _run_evalfull(profile, kb), deadline,
-                            trace=trace,
-                        )
-                    with st.phase("reply"), obs_trace.maybe_span(
-                        trace, "reply"
-                    ):
-                        self._reply(200, out[0].tobytes())
-            elif route == "/v1/evalfull_batch":
-                k = int(q["k"])
-                kl = key_len(log_n)
-                if len(body) != k * kl:
-                    raise ValueError(f"body must be {k}*{kl} bytes")
-                kb = cached_keys(profile, bytes(body), k, kl)
-                with st.phase("dispatch"):
-                    out = st.direct(
-                        lambda: _run_evalfull(profile, kb), deadline,
-                        trace=trace,
-                    )
-                with st.phase("reply"), obs_trace.maybe_span(
-                    trace, "reply"
-                ):
-                    self._reply(200, np.ascontiguousarray(out).tobytes())
-            elif route == "/v1/eval_points_batch":
-                k, nq = int(q["k"]), int(q["q"])
-                kl = key_len(log_n)
-                if len(body) != k * kl + k * nq * 8:
-                    raise ValueError(
-                        f"body must be {k}*{kl} key bytes + {k}*{nq}*8 index bytes"
-                    )
-                packed = _wire_format(q)
-                kb = cached_keys(profile, bytes(body[: k * kl]), k, kl)
-                xs = np.frombuffer(body[k * kl :], dtype="<u8").reshape(k, nq)
-                words = st.run(
-                    PointsWork(
-                        "points", profile, kb, xs, deadline=deadline,
-                        trace=trace,
-                    ),
-                    dispatch_points,
-                )
-                self._points_reply(words, nq, packed, st, trace)
-            elif route == "/v1/dcf_gen":
-                from .models import dcf
-
-                k = int(q["k"])
-                if len(body) != k * 8:
-                    raise ValueError(f"body must be {k}*8 alpha bytes")
-                alphas = np.frombuffer(body, dtype="<u8")
-                da, db = dcf.gen_lt_batch(alphas, log_n)
-                self._reply(
-                    200, b"".join(da.to_bytes()) + b"".join(db.to_bytes())
-                )
-            elif route == "/v1/dcf_eval_points":
-                from .models import dcf
-
-                k, nq = int(q["k"]), int(q["q"])
-                kl = dcf.key_len(log_n)
-                if len(body) != k * kl + k * nq * 8:
-                    raise ValueError(
-                        f"body must be {k}*{kl} key bytes + {k}*{nq}*8 index bytes"
-                    )
-                packed = _wire_format(q)
-                kb = cached_keys(
-                    "dcf", bytes(body[: k * kl]), k, kl, cls=dcf.DcfKeyBatch
-                )
-                xs = np.frombuffer(body[k * kl :], dtype="<u8").reshape(k, nq)
-                words = st.run(
-                    PointsWork(
-                        "dcf_points", "fast", kb, xs, deadline=deadline,
-                        trace=trace,
-                    ),
-                    dispatch_points,
-                )
-                self._points_reply(words, nq, packed, st, trace)
-            elif route == "/v1/dcf_interval_gen":
-                from .models import dcf
-
-                k = int(q["k"])
-                if len(body) != k * 16:
-                    raise ValueError(f"body must be {k}*8 lo + {k}*8 hi bytes")
-                bounds = np.frombuffer(body, dtype="<u8")
-                ia, ib = dcf.gen_interval_batch(bounds[:k], bounds[k:], log_n)
-
-                def blob(ik):
-                    u, lo_, c = ik
-                    return (
-                        b"".join(u.to_bytes()) + b"".join(lo_.to_bytes())
-                        + c.astype("<u1").tobytes()
-                    )
-
-                self._reply(200, blob(ia) + blob(ib))
-            elif route == "/v1/dcf_interval_eval":
-                from .models import dcf
-
-                k, nq = int(q["k"]), int(q["q"])
-                kl = dcf.key_len(log_n)
-                blob_len = 2 * k * kl + k
-                if len(body) != blob_len + k * nq * 8:
-                    raise ValueError(
-                        f"body must be {blob_len} interval-share bytes "
-                        f"(2*{k}*{kl} keys + {k} consts) + {k}*{nq}*8 "
-                        "index bytes"
-                    )
-                packed = _wire_format(q)
-
-                def build_triple(blob=bytes(body[:blob_len])):
-                    def keys_at(off):
-                        return dcf.DcfKeyBatch.from_bytes(
-                            [
-                                bytes(blob[off + i * kl : off + (i + 1) * kl])
-                                for i in range(k)
-                            ],
-                            log_n,
-                        )
-
-                    return (
-                        keys_at(0),
-                        keys_at(k * kl),
-                        np.frombuffer(
-                            blob[2 * k * kl :], dtype="<u1"
-                        ).copy(),
-                    )
-
-                with st.phase("pack"), st._mesh_ctx():
-                    triple = st.keys.get(
-                        "dcf_interval", log_n, bytes(body[:blob_len]),
-                        build_triple,
-                    )
-                xs = np.frombuffer(body[blob_len:], dtype="<u8").reshape(k, nq)
-                words = st.run(
-                    IntervalWork(triple, xs, deadline=deadline, trace=trace),
-                    dispatch_interval,
-                )
-                self._points_reply(words, nq, packed, st, trace)
-            elif route == "/v1/hh/gen":
-                from .apps import heavy_hitters as hh_app
-
-                k = int(q["k"])
-                if len(body) != k * 8:
-                    raise ValueError(f"body must be {k}*8 value bytes")
-                values = np.frombuffer(body, dtype="<u8")
-                sa, sb = hh_app.gen_shares(values, log_n, profile=profile)
-                self._reply(
-                    200,
-                    hh_app.share_to_blob(sa) + hh_app.share_to_blob(sb),
-                )
-            elif route == "/v1/hh/eval":
-                k, nq = int(q["k"]), int(q["q"])
-                level = int(q["level"])
-                if not 0 <= level < log_n:
-                    raise ValueError(
-                        f"level must be in [0, {log_n}), got {level}"
-                    )
-                kl = key_len(log_n)
-                if len(body) != k * kl + nq * 8:
-                    raise ValueError(
-                        f"body must be {k}*{kl} level-key bytes + "
-                        f"{nq}*8 candidate bytes"
-                    )
-                packed = _wire_format(q)
-                kb = cached_keys(profile, bytes(body[: k * kl]), k, kl)
-                cands = np.frombuffer(body[k * kl :], dtype="<u8")
-                words = st.run(
-                    HHWork(
-                        profile, kb,
-                        np.broadcast_to(cands[None, :], (k, nq)), level,
-                        deadline=deadline, trace=trace,
-                    ),
-                    dispatch_hh,
-                )
-                self._points_reply(words, nq, packed, st, trace)
-            else:
-                # A misrouted client is a client error, not a healthy
-                # request — its trace must not pollute ?outcome=ok.
-                outcome = "bad_request"
-                self._reply(404, b"not found", "text/plain")
-        except ServingError as e:
-            # Load-survival errors carry their own HTTP mapping: 429
-            # shed, 503 open circuit, 504 missed deadline — plus a
-            # Retry-After derived from observed dispatch latency.
-            outcome = _ERROR_OUTCOMES.get(e.code, "error")
-            self._reply_error(e.http_status, e.code, e.detail,
-                              e.retry_after_s)
-        except (ValueError, KeyError) as e:
-            # Validation failures: our own parameter/shape messages (the
-            # secret-hygiene pass keeps raises in this tree free of key
-            # bytes, so str(e) is client-safe here).
-            outcome = "bad_request"
-            detail = (
-                f"missing parameter {e}" if isinstance(e, KeyError)
-                else str(e)
-            )
-            self._reply_error(400, "bad_request", detail)
-        except Exception as e:  # noqa: BLE001 — bridge must not crash
-            # NEVER echo arbitrary exception reprs: deep library errors
-            # can embed operand values (key material).  Type name only;
-            # transient device signatures map to 503 so clients back off
-            # instead of hammering a wedged device.
-            outcome = "error"
-            if is_transient(e):
-                self._reply_error(
-                    503, "unavailable", type(e).__name__,
-                    retry_after_s=_serving_state().breaker.cooldown_s,
-                )
-            else:
-                self._reply_error(500, "internal", type(e).__name__)
+            clen = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            # A malformed header is a clean 400, never a dropped
+            # connection with a server-side traceback.
+            self._write_reply(handlers._reply_error(
+                400, "bad_request", "Content-Length is not an integer"
+            ))
+            self.close_connection = True  # the body, if any, is unread
+            return
+        req = handlers.Request(
+            route=route,
+            params=handlers.parse_params(url.query),
+            content_length=clen,
+            deadline_ms=self.headers.get(DEADLINE_HEADER),
+            trace_id=self.headers.get(TRACE_HEADER),
+            front="http",
+        )
+        if route in handlers.SINK_ROUTES:
+            # Streamed uploads: the handler pulls the body through the
+            # short-read-robust reader in route-sized chunks (ONE
+            # reusable scratch buffer — the copy the ledger charges).
+            req.body_reader = handlers.FileBodyReader(self.rfile, clen)
+        else:
+            # The HTTP/1.1 front's structural marshalling copy: the
+            # body materializes once between socket and handler (the
+            # wire2 front exists to not pay this).
+            req.body = self.rfile.read(clen)
+        st.note_body("http", clen, clen)
+        reply = handlers.respond(req, st)
+        try:
+            self._send(reply, st)
+        except Exception as e:  # noqa: BLE001 — write-time failure
+            # An injected reply.write fault (or a dispatch error inside
+            # a timed write) maps exactly like a handler error; if the
+            # socket itself is gone the error write below fails too and
+            # http.server drops the connection.
+            err = handlers.map_error(e, st)
+            reply.outcome = err.outcome
+            try:
+                self._write_reply(err)
+            except OSError:
+                self.close_connection = True
         finally:
             # Shed/expired/breaker-rejected requests are recorded too —
             # an overload incident must be reconstructable from the
             # flight recorder after the fact.
-            if st is not None:
-                st.tracer.finish(trace, outcome)
+            st.tracer.finish(reply.trace, reply.outcome)
 
 
 def audit_knobs() -> list[str]:
@@ -1304,16 +367,41 @@ class _Server(ThreadingHTTPServer):
     # kernel's accept queue.
     request_queue_size = 128
 
+    # The wire2 listener riding this sidecar's lifecycle (None when
+    # DPF_TPU_WIRE2 is off); its ephemeral address is
+    # ``srv.wire2.address`` for tests/benches.
+    wire2 = None
+
+    def shutdown(self):
+        super().shutdown()
+        if self.wire2 is not None:
+            self.wire2.shutdown()
+
+
+def _maybe_start_wire2(srv: _Server, host: str) -> None:
+    """Start the wire2 binary front next to the HTTP one when
+    DPF_TPU_WIRE2 resolves on — same serving state, same routes,
+    byte-identical replies (serving/wire2.py)."""
+    if not knobs.get_bool("DPF_TPU_WIRE2"):
+        return
+    from .serving import wire2
+
+    srv.wire2 = wire2.serve(
+        port=knobs.get_int("DPF_TPU_WIRE2_PORT"), host=host
+    )
+
 
 def serve(port: int = 8990, host: str = "127.0.0.1") -> ThreadingHTTPServer:
     """Start the sidecar in a daemon thread; returns the server object
-    (call ``.shutdown()`` to stop)."""
+    (call ``.shutdown()`` to stop — the wire2 front, when enabled, is
+    torn down with it)."""
     audit_knobs()
     # A DPF_TPU_FAULTS spec in a non-test environment must be a BOOT
     # error with the full refusal message — not a mystery 500 on the
     # first request (the lazy serving state would strip the message).
     faults.install_from_env()
     srv = _Server((host, port), _Handler)
+    _maybe_start_wire2(srv, host)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv
@@ -1326,8 +414,13 @@ def main():
     args = ap.parse_args()
     audit_knobs()  # warns (stderr) once per unknown DPF_TPU_* var
     faults.install_from_env()  # refuse a leaked fault spec AT BOOT
+    srv = _Server((args.host, args.port), _Handler)
+    _maybe_start_wire2(srv, args.host)
     print(f"dpf-tpu sidecar on {args.host}:{args.port}")
-    _Server((args.host, args.port), _Handler).serve_forever()
+    if srv.wire2 is not None:
+        print(f"dpf-tpu wire2 front on {srv.wire2.address[0]}:"
+              f"{srv.wire2.address[1]}")
+    srv.serve_forever()
 
 
 if __name__ == "__main__":

@@ -58,9 +58,14 @@ class KeyCache:
         except Exception:  # noqa: BLE001 — cache must not take traffic down
             return 0
 
-    def get(self, kind: str, log_n: int, blob: bytes, build):
+    def get(self, kind: str, log_n: int, blob, build):
         """Return the parsed batch for ``blob`` (the request's raw key
-        bytes), building it via ``build()`` on a miss.  Parse failures
+        bytes — ANY buffer-protocol object: ``bytes``, or the wire2
+        front's ``memoryview`` slices of its receive buffer), building
+        it via ``build()`` on a miss.  The digest hashes the buffer
+        directly (``hashlib.sha256`` takes buffer views), so a lookup
+        never copies the key material; byte-identical ``bytes`` and
+        ``memoryview`` inputs hit the same entry.  Parse failures
         propagate and are never cached."""
         if not self.entries:
             return build()

@@ -1,0 +1,1080 @@
+"""wire2: the zero-copy multiplexed binary serving front.
+
+The HTTP/1.1 sidecar front pays, per request: a request-line + header
+parse, a ``rfile.read`` that materializes the body as a fresh ``bytes``
+object, and (for naive clients) a TCP handshake — fine for debugging,
+fatal for a million-client aggregation epoch where the kernels are
+already faster than the marshalling (ROADMAP item 4; the ASIC-HE
+playbook makes the same point: host I/O bounds served throughput once
+kernels are tuned).  wire2 is the second front: length-prefixed binary
+frames over persistent connections, HTTP/2-style streams — ONE
+connection carries many concurrent requests — sharing the exact
+transport-neutral handler core the HTTP front calls
+(``serving/handlers.py``: admission, deadlines, breaker, batcher lanes,
+trace spans, fault sites, and stats all identical; replies byte-
+identical, pinned by tests/test_wire2.py).
+
+Frame format (all integers little-endian; DESIGN.md §17):
+
+  connection preface   client sends 8 bytes: ``b"DPF2" || version(u8=1)
+                       || 3 reserved zero bytes``.
+  frame header (12 B)  length:u32 | type:u8 | flags:u8 | route_id:u16 |
+                       stream_id:u32 — ``length`` counts payload bytes
+                       only; ``route_id`` is meaningful on HEADERS.
+  HEADERS   (type 1)   opens stream_id.  Payload: body_len:u64 || the
+                       request's param string (the HTTP query string,
+                       verbatim — same keys, same values; pseudo-params
+                       ``_deadline_ms`` and ``_trace`` carry what HTTP
+                       sends as X-DPF-Deadline-Ms / X-DPF-Trace).
+                       flags bit 0 (END_STREAM) when body_len == 0.
+  DATA      (type 2)   body bytes for stream_id; the server reads the
+                       payload STRAIGHT into the stream's receive
+                       buffer (``recv_into`` — no intermediate bytes).
+                       flags bit 0 on the last frame.
+  RESP      (type 3)   reply head for stream_id.  Payload (20 B):
+                       status:u16 | reserved:u16 | retry_after:f64 |
+                       body_len:u64.  Non-200 bodies are the same
+                       ``{code, detail}`` JSON the HTTP front sends.
+  RESP_DATA (type 4)   reply body bytes; flags bit 0 ends the stream.
+  GOAWAY    (type 5)   fatal connection condition; receiver must treat
+                       every in-flight stream as failed.  A mid-stream
+                       reply failure (the body can no longer be
+                       completed honestly) is GOAWAY + hard close —
+                       the moral twin of the HTTP front's TCP RST.
+  PING/PONG (6 / 7)    liveness echo (payload mirrored back).
+
+Stream states: idle -> open (HEADERS) -> [body frames] -> replied
+(RESP + RESP_DATA...) -> closed.  A stream that fails validation
+mid-upload is answered immediately and its remaining DATA frames are
+discarded off the wire (the connection stays healthy for its
+neighbors — unlike HTTP/1.1, one poisoned upload does not cost the
+connection).  Streams opened past ``DPF_TPU_WIRE2_MAX_STREAMS`` are
+refused with a structured shed reply (429-equivalent).
+
+Zero-copy path (the allocation probe's contract): every body byte
+crosses exactly once from the kernel socket buffer into a pooled
+per-connection receive buffer (``recv_into``), and the handler core
+sees ``memoryview`` slices of that buffer — ``np.frombuffer`` straight
+to the dispatch operand, zero intermediate ``bytes`` materializations
+(enforced statically by the perf-contract lint's wire-path budget and
+dynamically by tests/test_wire2.py's byte-address identity probe).
+Replies go out as ``sendmsg`` gathered frames over the device-returned
+arrays' buffers — no join, no re-serialization.
+
+This module also ships the Python :class:`Wire2Client` (thread-safe,
+one multiplexed connection) used by the transport-equivalence suite and
+the bench harness; the Go twin lives in bridge/go/dpftpu/wire2.go.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import struct
+import threading
+from urllib.parse import urlencode
+
+from ..core import knobs
+from ..obs import trace as obs_trace
+from . import faults, handlers
+
+MAGIC = b"DPF2\x01\x00\x00\x00"
+
+_HDR = struct.Struct("<IBBHI")  # length, type, flags, route_id, stream_id
+_RESP = struct.Struct("<HHdQ")  # status, reserved, retry_after_s, body_len
+
+T_HEADERS = 1
+T_DATA = 2
+T_RESP = 3
+T_RESP_DATA = 4
+T_GOAWAY = 5
+T_PING = 6
+T_PONG = 7
+
+F_END_STREAM = 1
+
+# Largest control-frame payload the server will buffer (HEADERS/PING —
+# param strings are tiny; a multi-MB "header" is a protocol violation,
+# not a request).
+_MAX_CTRL = 1 << 16
+# DATA split size on the client write path.
+_CLIENT_CHUNK = 1 << 20
+
+# Routes the frame reader runs INLINE once their (small, complete)
+# body is on hand, instead of handing to the worker pool: two thread
+# handoffs saved per request.  Eligible routes must (a) dispatch
+# DIRECTLY — a batcher-lane route handled inline would serialize the
+# connection's requests through the reader and never coalesce — and
+# (b) never block for more body (guaranteed: inline fires only at
+# filled == total, so the sink reader's next_chunk can't wait).
+# Bodies past _INLINE_MAX keep the pool so a big upload's folds overlap
+# its socket reads.  The batcher-lane routes in _INLINE_WHEN_UNBATCHED
+# become eligible when DPF_TPU_BATCH=off resolves the batcher away —
+# there is no coalescing to lose, only handoffs to save (the cfg-wire
+# bench's isolated-transport regime).  Streamed-reply routes
+# (/v1/evalfull) are never inline: a generator would hold the frame
+# loop hostage for the whole body.
+#
+# Tradeoff, stated honestly: inline handling serializes a connection's
+# eligible streams through the frame loop — during a dispatch the
+# reader reads no frames, so on a multi-core host the pool path could
+# overlap device compute across streams where inline cannot.  Under
+# the GIL the handler path serializes anyway and the handoffs are the
+# dominant per-request cost (measured: agg throughput +~40% inline);
+# a deployment that wants cross-stream dispatch overlap on one
+# connection should set _INLINE_MAX to 0 — or simply open a second
+# connection, which the protocol makes cheap.
+_INLINE_ROUTES = frozenset({"/v1/agg/submit"})
+_INLINE_WHEN_UNBATCHED = frozenset({
+    "/v1/eval_points_batch", "/v1/dcf_eval_points",
+    "/v1/dcf_interval_eval", "/v1/hh/eval", "/v1/pir/query",
+})
+_INLINE_MAX = 1 << 20
+
+
+def _inline_eligible(route: str) -> bool:
+    if route in _INLINE_ROUTES:
+        return True
+    if route in _INLINE_WHEN_UNBATCHED:
+        return not handlers.serving_state().batch_enabled
+    return False
+
+
+class Wire2ProtocolError(RuntimeError):
+    """A frame the protocol does not allow — the connection is torn
+    down with GOAWAY (a framing error is never recoverable: byte
+    positions are meaningless afterwards)."""
+
+
+def _recv_exact_into(sock: socket.socket, mv: memoryview) -> None:
+    """Fill ``mv`` straight from the socket (``recv_into`` — the
+    kernel-to-buffer crossing is the ONLY copy), looping over short
+    receives; EOF mid-frame is a connection error."""
+    got = 0
+    n = mv.nbytes
+    while got < n:
+        r = sock.recv_into(mv[got:], n - got)
+        if r == 0:
+            raise ConnectionError("wire2: peer closed mid-frame")
+        got += r
+
+
+def _send_gathered(sock: socket.socket, bufs: list) -> None:
+    """writev-style gathered send with partial-send continuation: the
+    frame header and the device-returned body buffers go to the kernel
+    in ONE vector — no join, no intermediate copy."""
+    views = []
+    for b in bufs:
+        mv = b if isinstance(b, memoryview) else memoryview(b)
+        if mv.format != "B" or mv.ndim != 1:
+            mv = mv.cast("B")
+        if mv.nbytes:
+            views.append(mv)
+    while views:
+        # sendmsg rejects vectors past IOV_MAX (1024 on Linux) with
+        # EMSGSIZE — a multi-GB body split into 1 MiB DATA frames blows
+        # straight past it, so feed the kernel bounded slices.
+        n = sock.sendmsg(views[:512])
+        while views and n >= views[0].nbytes:
+            n -= views[0].nbytes
+            views.pop(0)
+        if n:
+            views[0] = views[0][n:]
+
+
+
+def _read_exact_into_file(rf, mv: memoryview) -> None:
+    """Fill ``mv`` from a buffered reader (the CLIENT's read path —
+    buffered, copies allowed); EOF is a connection error here, not a
+    truncated upload."""
+    handlers.read_exact_into(
+        rf, mv, eof_exc=ConnectionError,
+        eof_msg="wire2: peer closed mid-frame",
+    )
+
+
+class _BufPool:
+    """Pooled per-connection receive buffers: streams borrow a buffer
+    for their body and return it at close, so steady-state traffic
+    allocates nothing.  ``DPF_TPU_WIRE2_RECV_BUF_BYTES`` floors the
+    allocation size; oversized bodies get a dedicated buffer that is
+    pooled too (capped count keeps a burst of giants from pinning
+    memory)."""
+
+    _MAX_POOLED = 8
+
+    def __init__(self, floor: int | None = None):
+        if floor is None:
+            floor = knobs.get_int("DPF_TPU_WIRE2_RECV_BUF_BYTES")
+        self.floor = max(int(floor), 1 << 12)
+        self._free: list[bytearray] = []
+        self._lock = threading.Lock()
+
+    def take(self, n: int) -> bytearray:
+        with self._lock:
+            for i, buf in enumerate(self._free):
+                if len(buf) >= n:
+                    return self._free.pop(i)
+        return bytearray(max(n, self.floor))
+
+    def give(self, buf: bytearray) -> None:
+        with self._lock:
+            # Never pool far-oversized dedicated buffers: a handful of
+            # multi-GB uploads must not leave gigabytes pinned to an
+            # idle connection (they also make ``take`` hand a giant
+            # buffer to a tiny stream).  4x the floor bounds the pool
+            # at a few tens of MB at the default knob.
+            if (
+                len(self._free) < self._MAX_POOLED
+                and len(buf) <= 4 * self.floor
+            ):
+                self._free.append(buf)
+
+
+class _StreamBody(handlers.BodyReader):
+    """The wire2 BodyReader: the connection's frame reader fills the
+    stream's pooled buffer as DATA frames arrive; the handler thread
+    pulls zero-copy views of it (``next_chunk``) — socket overlap for
+    free, the streamed-upload routes fold chunk j while chunk j+1 is
+    still on the wire."""
+
+    def __init__(self, buf: bytearray, total: int):
+        self.buf = buf
+        self.mv = memoryview(buf)
+        self.total = int(total)
+        self.filled = 0
+        self.consumed = 0
+        # Body bytes COPIED out of the receive buffer (the ``readinto``
+        # path — e.g. into the persistent PIR database array).  The
+        # marshalling ledger charges these honestly; the zero-copy
+        # claim is the ``next_chunk`` view path.
+        self.copied = 0
+        self._cond = threading.Condition()
+        self._error: Exception | None = None
+
+    # -- frame-reader side --------------------------------------------------
+    def fill_from(self, sock: socket.socket, n: int) -> None:
+        _recv_exact_into(sock, self.mv[self.filled : self.filled + n])
+        with self._cond:
+            self.filled += n
+            self._cond.notify_all()
+
+    def fail(self, exc: Exception) -> None:
+        with self._cond:
+            self._error = exc
+            self._cond.notify_all()
+
+    # -- handler side -------------------------------------------------------
+    def _wait(self, upto: int) -> None:
+        with self._cond:
+            while self.filled < upto and self._error is None:
+                self._cond.wait()
+            if self.filled < upto:
+                # Same message (and 400 mapping) as the HTTP front's
+                # short-read guard: a dead uploader is a truncated fold.
+                raise ValueError("upload truncated mid-chunk")
+
+    def next_chunk(self, n: int) -> memoryview:
+        self._wait(self.consumed + n)
+        view = self.mv[self.consumed : self.consumed + n]
+        self.consumed += n
+        return view
+
+    def readinto(self, dst: memoryview) -> None:
+        dst[:] = self.next_chunk(dst.nbytes)
+        self.copied += dst.nbytes
+
+    def whole(self) -> memoryview:
+        """The complete body as one view (buffered routes)."""
+        self._wait(self.total)
+        self.consumed = self.total
+        return self.mv[: self.total]
+
+
+class _Stream:
+    __slots__ = (
+        "sid", "route", "params", "body", "resp_sent", "aborted",
+        "received", "inline",
+    )
+
+    def __init__(self, sid: int, route: str, params: dict,
+                 body: _StreamBody):
+        self.sid = sid
+        self.route = route
+        self.params = params
+        self.body = body
+        self.resp_sent = False
+        self.aborted = False  # reader discards this stream's DATA
+        # Body bytes taken off the wire for this stream (filled into
+        # the buffer OR discarded) — the stream retires when this
+        # reaches body.total, whatever mix got it there.
+        self.received = 0
+        # Deferred-inline stream: the reader runs the handler itself
+        # once the body completes (see _INLINE_ROUTES).
+        self.inline = False
+
+
+class _Conn:
+    """One accepted wire2 connection: a frame-reader thread that owns
+    the socket's read side (and every body buffer fill), one worker
+    thread per open stream, and a write lock serializing gathered reply
+    frames.  The reader NEVER blocks on a handler: stream bodies land
+    in their own buffers, poisoned streams drain to a scratch buffer,
+    and replies interleave freely."""
+
+    def __init__(self, server: "Wire2Server", sock: socket.socket):
+        self.server = server
+        self.sock = sock
+        self.pool = _BufPool()
+        self.max_streams = knobs.get_int("DPF_TPU_WIRE2_MAX_STREAMS")
+        self.max_body = knobs.get_int("DPF_TPU_WIRE2_MAX_BODY_BYTES")
+        self.streams: dict[int, _Stream] = {}
+        self._lock = threading.Lock()  # stream table
+        self._wlock = threading.Lock()  # socket write side
+        self._scratch = memoryview(bytearray(1 << 16))  # discard sink
+        self._closed = False
+        # Per-connection worker pool: spawning a thread per stream
+        # would put ~100 us of pure overhead on every request — the
+        # exact class of cost this transport exists to delete.  Workers
+        # spawn on demand up to the stream cap and then persist for the
+        # connection's life, pulling streams off a queue.
+        self._work: "queue.SimpleQueue[_Stream | None]" = (
+            queue.SimpleQueue()
+        )
+        self._workers = 0
+        self._idle = 0
+        self.reader = threading.Thread(
+            target=self._read_loop, daemon=True, name="wire2-conn"
+        )
+
+    def start(self) -> None:
+        self.reader.start()
+
+    def _dispatch_stream(self, stream: _Stream) -> None:
+        """Hand a stream to the pool, growing it while every worker is
+        busy (bounded by the stream cap, so a connection's thread count
+        is bounded by its admission watermark)."""
+        with self._lock:
+            # Spawn while a burst outruns the idle workers (idle counts
+            # workers blocked on the queue; comparing against the queue
+            # depth keeps a rapid burst from transiently serializing).
+            spawn = (
+                self._idle <= self._work.qsize()
+                and self._workers < self.max_streams
+            )
+            if spawn:
+                self._workers += 1
+        if spawn:
+            threading.Thread(
+                target=self._work_loop, daemon=True,
+                name="wire2-worker",
+            ).start()
+        self._work.put(stream)
+
+    def _work_loop(self) -> None:
+        while True:
+            with self._lock:
+                self._idle += 1
+            stream = self._work.get()
+            with self._lock:
+                self._idle -= 1
+            if stream is None:
+                return
+            self._serve_stream(stream)
+
+    # -- write side ---------------------------------------------------------
+    def send_frames(self, bufs: list) -> None:
+        with self._wlock:
+            _send_gathered(self.sock, bufs)
+
+    def goaway_close(self) -> None:
+        """Fatal condition: best-effort GOAWAY, then hard close.  Every
+        in-flight stream fails loudly at the client — a truncated reply
+        must never parse as a short-but-well-formed one."""
+        try:
+            self.send_frames([_HDR.pack(0, T_GOAWAY, 0, 0, 0)])
+        except OSError:
+            pass
+        self.close()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            streams = list(self.streams.values())
+            workers = self._workers
+        for s in streams:
+            s.body.fail(ConnectionError("wire2: connection closed"))
+        for _ in range(workers):
+            self._work.put(None)  # retire the pool
+        self.server._forget(self)
+
+    # -- read side ----------------------------------------------------------
+    def _read_loop(self) -> None:
+        try:
+            magic = bytearray(len(MAGIC))
+            _recv_exact_into(self.sock, memoryview(magic))
+            if bytes(magic) != MAGIC:
+                raise Wire2ProtocolError("bad connection preface")
+            hdr = bytearray(_HDR.size)
+            hmv = memoryview(hdr)
+            while True:
+                _recv_exact_into(self.sock, hmv)
+                length, ftype, flags, route_id, sid = _HDR.unpack(hdr)
+                if ftype == T_HEADERS:
+                    self._on_headers(length, flags, route_id, sid)
+                elif ftype == T_DATA:
+                    self._on_data(length, sid)
+                elif ftype == T_PING:
+                    self._on_ping(length)
+                elif ftype == T_GOAWAY:
+                    break
+                else:
+                    raise Wire2ProtocolError(f"unknown frame type {ftype}")
+        except (ConnectionError, OSError):
+            pass
+        except Wire2ProtocolError:
+            self.goaway_close()
+            return
+        except Exception:  # noqa: BLE001
+            # ANY unexpected reader failure (undecodable params, a
+            # MemoryError, a bug) must still tear the connection down
+            # loudly: a silently-dead reader would leave every in-flight
+            # handler blocked in _StreamBody._wait forever.
+            self.goaway_close()
+            return
+        self.close()
+
+    def _discard(self, n: int) -> None:
+        while n > 0:
+            take = min(n, self._scratch.nbytes)
+            _recv_exact_into(self.sock, self._scratch[:take])
+            n -= take
+
+    def _read_ctrl(self, length: int) -> memoryview:
+        if length > _MAX_CTRL:
+            raise Wire2ProtocolError(f"control frame too large ({length})")
+        buf = memoryview(bytearray(length))
+        _recv_exact_into(self.sock, buf)
+        return buf
+
+    def _on_ping(self, length: int) -> None:
+        payload = self._read_ctrl(length)
+        self.send_frames([_HDR.pack(length, T_PONG, 0, 0, 0), payload])
+
+    def _on_headers(self, length: int, flags: int, route_id: int,
+                    sid: int) -> None:
+        payload = self._read_ctrl(length)
+        if length < 8:
+            raise Wire2ProtocolError("HEADERS payload shorter than body_len")
+        (body_len,) = struct.unpack_from("<Q", payload, 0)
+        # wire-copy-ok: the param string is control metadata, not body.
+        params = handlers.parse_params(bytes(payload[8:]).decode("utf-8"))
+        route = handlers.ROUTE_IDS.get(route_id)
+        with self._lock:
+            dup = sid in self.streams
+            live = len(self.streams)
+        if dup:
+            raise Wire2ProtocolError(f"stream {sid} reused while open")
+        if route is None:
+            self._refuse(
+                sid, body_len,
+                handlers.Reply(
+                    404, [b"not found"], "text/plain", outcome="bad_request"
+                ),
+            )
+            return
+        if live >= self.max_streams:
+            # Admission at the frame reader: a connection past its
+            # stream cap sheds NEW streams with the same structured
+            # 429 the lane watermarks use, instead of queueing them
+            # invisibly in the reader.
+            reply = handlers._reply_error(
+                429, "shed",
+                f"connection stream cap reached ({self.max_streams} "
+                "concurrent; raise DPF_TPU_WIRE2_MAX_STREAMS or add a "
+                "connection)",
+                retry_after_s=0.05,
+            )
+            reply.outcome = "shed"
+            self._refuse(sid, body_len, reply)
+            return
+        if body_len > self.max_body:
+            # The declared length allocates the receive buffer BEFORE a
+            # single body byte arrives — an unbounded u64 here would let
+            # one frame OOM the sidecar.  Refuse and discard; the
+            # connection (and its neighbors) survive.
+            reply = handlers._reply_error(
+                400, "bad_request",
+                f"declared body_len {body_len} exceeds "
+                "DPF_TPU_WIRE2_MAX_BODY_BYTES "
+                f"({self.max_body}); split the upload or raise the knob",
+            )
+            reply.outcome = "bad_request"
+            self._refuse(sid, body_len, reply)
+            return
+        body = _StreamBody(self.pool.take(body_len), body_len)
+        stream = _Stream(sid, route, params, body)
+        stream.inline = (
+            0 < body_len <= _INLINE_MAX and _inline_eligible(route)
+        )
+        with self._lock:
+            self.streams[sid] = stream
+        if not stream.inline:
+            self._dispatch_stream(stream)
+
+    def _refuse(self, sid: int, body_len: int,
+                reply: handlers.Reply) -> None:
+        """Answer a stream the server will not run and arrange for its
+        body bytes to be discarded off the wire (the connection's
+        framing must survive a refused neighbor)."""
+        stream = _Stream(sid, "", {}, _StreamBody(bytearray(0), body_len))
+        stream.aborted = True
+        if body_len:
+            with self._lock:
+                self.streams[sid] = stream
+        self._write_buffered(stream, reply)
+
+    def _on_data(self, length: int, sid: int) -> None:
+        with self._lock:
+            stream = self.streams.get(sid)
+            aborted = stream.aborted if stream is not None else False
+        if stream is None:
+            raise Wire2ProtocolError(f"DATA for unknown stream {sid}")
+        body = stream.body
+        if stream.received + length > body.total:
+            raise Wire2ProtocolError(
+                f"stream {sid} body overflows declared length"
+            )
+        if aborted:
+            self._discard(length)
+        else:
+            body.fill_from(self.sock, length)
+            if stream.inline and body.filled >= body.total:
+                # Complete body, direct-dispatch route: run the handler
+                # on the frame loop — the request is CPU-bound from
+                # here, and the pool handoff would cost more than it
+                # buys.  (The stream cap still applied at HEADERS.)
+                self._serve_stream(stream)
+        with self._lock:
+            stream.received += length
+            done = stream.received >= body.total
+            if done and stream.aborted:
+                # The poisoned stream is fully drained: retire it and
+                # recycle its buffer (no fill can be in flight — this
+                # reader is the only filler).
+                self.streams.pop(sid, None)
+                retire = body.buf
+            else:
+                retire = None
+        if retire is not None and len(retire):
+            self.pool.give(retire)
+
+    # -- per-stream worker --------------------------------------------------
+    def _serve_stream(self, stream: _Stream) -> None:
+        st = handlers.serving_state()
+        body = stream.body
+        params = dict(stream.params)
+        deadline_ms = params.pop("_deadline_ms", None)
+        trace_id = params.pop("_trace", None)
+        req = handlers.Request(
+            route=stream.route,
+            params=params,
+            content_length=body.total,
+            deadline_ms=deadline_ms,
+            trace_id=trace_id,
+            front="wire2",
+        )
+        if stream.route in handlers.SINK_ROUTES:
+            req.body_reader = body
+        else:
+            # Buffered routes see the COMPLETE body as one zero-copy
+            # view of the stream's pooled receive buffer.
+            req.body = body.whole()
+        reply = handlers.respond(req, st)
+        # The probe's committed claim: zero body bytes copied between
+        # socket buffer and dispatch operand on this front — charged
+        # AFTER the handler so the readinto routes (the PIR database
+        # copy into its persistent resident array) are counted
+        # honestly rather than assumed away.
+        st.note_body("wire2", body.total, body.copied)
+        # Retire the stream BEFORE the reply hits the wire: the moment
+        # the client reads the reply it may open its next stream, and
+        # the admission count must not still hold this one.  (Reply
+        # chunks never alias the request buffer — dispatch results are
+        # fresh arrays — so recycling the body buffer here is safe;
+        # streamed-evalfull generators hold parsed key batches, not the
+        # body view.)
+        self._finish_stream(stream)
+        try:
+            self._send_reply(stream, reply, st)
+        except OSError:
+            pass
+        except Exception as e:  # noqa: BLE001 — injected write faults
+            err = handlers.map_error(e, st)
+            reply.outcome = err.outcome
+            if not stream.resp_sent:
+                try:
+                    self._write_buffered(stream, err)
+                except OSError:
+                    pass
+            else:
+                self.goaway_close()
+        finally:
+            st.tracer.finish(reply.trace, reply.outcome)
+
+    def _finish_stream(self, stream: _Stream) -> None:
+        body = stream.body
+        with self._lock:
+            # Decide on ``filled``, not ``received``: filled is only
+            # advanced AFTER a fill completes, so filled == total
+            # guarantees the reader is done with the buffer (received
+            # can lag by one in-flight bookkeeping step and exists for
+            # the discard path).
+            if body.filled >= body.total:
+                self.streams.pop(stream.sid, None)
+                retire = body.buf
+            else:
+                # Body bytes still on the wire: flip to discard mode —
+                # the reader drains the remainder to scratch and retires
+                # the stream (and its buffer) itself.  The wire2 twin of
+                # the HTTP framing guard, without losing the connection.
+                # The buffer is NOT recycled here: the reader may be
+                # mid-fill into it for a frame that passed the aborted
+                # check — it returns to the pool at drain time.
+                stream.aborted = True
+                retire = None
+        if retire is not None:
+            self.pool.give(retire)
+
+    # -- reply writing ------------------------------------------------------
+    def _send_reply(self, stream: _Stream, reply: handlers.Reply,
+                    st) -> None:
+        if reply.stream is not None:
+            self._write_streamed(stream, reply, st)
+        elif reply.timed:
+            # Same write-side semantics as the HTTP front: a "reply"
+            # phase observation, a reply span, and the reply.write
+            # fault site.
+            with st.phase("reply"), obs_trace.maybe_span(
+                reply.trace, "reply"
+            ):
+                faults.fire("reply.write")
+                self._write_buffered(stream, reply)
+        else:
+            self._write_buffered(stream, reply)
+
+    def _write_buffered(self, stream: _Stream,
+                        reply: handlers.Reply) -> None:
+        total = reply.body_len
+        frames = [
+            _HDR.pack(_RESP.size, T_RESP, 0, 0, stream.sid),
+            _RESP.pack(
+                reply.status, 0, reply.retry_after_s or 0.0, total
+            ),
+            _HDR.pack(total, T_RESP_DATA, F_END_STREAM, 0, stream.sid),
+        ]
+        frames.extend(reply.chunks)
+        stream.resp_sent = True
+        # ONE gathered vector: frame headers + the device-returned
+        # buffers, no join, no re-serialization.
+        self.send_frames(frames)
+
+    def _write_streamed(self, stream: _Stream, reply: handlers.Reply,
+                        st) -> None:
+        stream.resp_sent = True
+        self.send_frames([
+            _HDR.pack(_RESP.size, T_RESP, 0, 0, stream.sid),
+            _RESP.pack(
+                reply.status, 0, reply.retry_after_s or 0.0,
+                reply.stream_len,
+            ),
+        ])
+        written = 0
+        aborted = False
+        try:
+            for chunk in reply.stream:
+                with st.phase("reply"):
+                    self.send_frames([
+                        _HDR.pack(
+                            handlers._blen(chunk), T_RESP_DATA, 0, 0,
+                            stream.sid,
+                        ),
+                        chunk,
+                    ])
+                written += handlers._blen(chunk)
+            self.send_frames(
+                [_HDR.pack(0, T_RESP_DATA, F_END_STREAM, 0, stream.sid)]
+            )
+        except Exception:  # noqa: BLE001
+            aborted = True
+        finally:
+            if aborted or written != reply.stream_len:
+                # Mid-stream failure after the RESP head committed a
+                # length: the whole connection aborts (GOAWAY + close)
+                # so truncation is a loud client-side error — the
+                # multiplexed twin of the HTTP front's TCP RST.
+                self.goaway_close()
+
+
+class Wire2Server:
+    """The wire2 listener: accepts connections and runs one frame
+    reader each.  Rides the same lazy serving state as the HTTP front —
+    both fronts hit one batcher, one breaker, one stats surface."""
+
+    def __init__(self, port: int | None = None, host: str = "127.0.0.1"):
+        if port is None:
+            port = knobs.get_int("DPF_TPU_WIRE2_PORT")
+        self._sock = socket.create_server(
+            (host, port), backlog=128, reuse_port=False
+        )
+        self.address = self._sock.getsockname()
+        self._conns: set[_Conn] = set()
+        self._lock = threading.Lock()
+        self._closed = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="wire2-accept"
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, _ = self._sock.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = _Conn(self, sock)
+            with self._lock:
+                self._conns.add(conn)
+            conn.start()
+
+    def _forget(self, conn: _Conn) -> None:
+        with self._lock:
+            self._conns.discard(conn)
+
+    def shutdown(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            c.close()
+
+
+def serve(port: int | None = None, host: str = "127.0.0.1") -> Wire2Server:
+    """Start the wire2 front (usually via ``server.serve`` with
+    DPF_TPU_WIRE2=on); returns the listener (``.address``,
+    ``.shutdown()``)."""
+    return Wire2Server(port=port, host=host)
+
+
+# ---------------------------------------------------------------------------
+# Python client — one multiplexed connection, safe for concurrent
+# threads (the transport-equivalence suite and bench_all's cfg-wire
+# section drive 64-way concurrency through ONE of these).
+# ---------------------------------------------------------------------------
+
+
+class Wire2Error(Exception):
+    """A structured non-200 wire2 reply — same {code, detail} payload
+    (and Retry-After semantics) as the HTTP front's APIError."""
+
+    def __init__(self, status: int, code: str, detail: str,
+                 retry_after_s: float = 0.0):
+        super().__init__(f"wire2: {status} {code}: {detail}")
+        self.status = status
+        self.code = code
+        self.detail = detail
+        self.retry_after_s = retry_after_s
+
+
+def _error_from(status: int, body: bytes,
+                retry_after: float) -> "Wire2Error":
+    """Structured non-200 body -> Wire2Error (same {code, detail}
+    parsing as the Go client's APIError)."""
+    code, detail = "", body.decode("utf-8", "replace")
+    try:
+        parsed = json.loads(body)
+        code = parsed.get("code", "")
+        detail = parsed.get("detail", detail)
+    except (ValueError, AttributeError):
+        pass
+    return Wire2Error(status, code or str(status), detail, retry_after)
+
+
+class _Pending:
+    __slots__ = ("event", "status", "retry_after", "total", "buf",
+                 "got", "error", "done")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.status = 0
+        self.retry_after = 0.0
+        self.total = -1
+        self.buf: bytearray | None = None
+        self.got = 0
+        self.error: Exception | None = None
+        self.done = False
+
+
+class Wire2Client:
+    """Client for one wire2 connection.  ``request`` is thread-safe and
+    blocking; concurrent callers multiplex as independent streams —
+    N threads sharing one client IS the intended serving shape (one
+    connection per campaign, not per call)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 120.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Buffered READ side: one reply is several tiny frames (RESP
+        # head + RESP_DATA); reading them through a buffer turns ~4
+        # recv syscalls per reply into ~1.  Client-side copies are
+        # fine — the zero-copy contract is the SERVER's receive path.
+        self._rf = self.sock.makefile("rb", buffering=1 << 16)
+        self.timeout = timeout
+        self._wlock = threading.Lock()
+        self._slock = threading.Lock()
+        self._streams: dict[int, _Pending] = {}
+        self._next_sid = 1
+        self._closed = False
+        with self._wlock:
+            _send_gathered(self.sock, [MAGIC])
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True, name="wire2-client"
+        )
+        self._reader.start()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self._fail_all(ConnectionError("wire2: client closed"))
+
+    def __enter__(self) -> "Wire2Client":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _fail_all(self, exc: Exception) -> None:
+        with self._slock:
+            pending = list(self._streams.values())
+            self._streams.clear()
+        for p in pending:
+            p.error = exc
+            p.event.set()
+
+    def _read_loop(self) -> None:
+        hdr = bytearray(_HDR.size)
+        hmv = memoryview(hdr)
+        try:
+            while True:
+                _read_exact_into_file(self._rf, hmv)
+                length, ftype, flags, _route, sid = _HDR.unpack(hdr)
+                if ftype == T_RESP:
+                    payload = memoryview(bytearray(length))
+                    _read_exact_into_file(self._rf, payload)
+                    status, _, retry_after, body_len = _RESP.unpack_from(
+                        payload, 0
+                    )
+                    with self._slock:
+                        p = self._streams.get(sid)
+                    if p is None:
+                        continue
+                    p.status = status
+                    p.retry_after = retry_after
+                    p.total = body_len
+                    p.buf = bytearray(body_len)
+                elif ftype == T_RESP_DATA:
+                    with self._slock:
+                        p = self._streams.get(sid)
+                    if p is None or p.buf is None:
+                        # Reply data for a stream we gave up on.
+                        self._drain(length)
+                    else:
+                        if p.got + length > p.total:
+                            raise ConnectionError(
+                                "wire2: reply overflows declared length"
+                            )
+                        _read_exact_into_file(
+                            self._rf,
+                            memoryview(p.buf)[p.got : p.got + length],
+                        )
+                        p.got += length
+                    if flags & F_END_STREAM and p is not None:
+                        if p.got != p.total:
+                            p.error = ConnectionError(
+                                f"wire2: reply truncated ({p.got} of "
+                                f"{p.total} bytes)"
+                            )
+                        p.done = True
+                        with self._slock:
+                            self._streams.pop(sid, None)
+                        p.event.set()
+                elif ftype == T_PONG:
+                    self._drain(length)
+                elif ftype == T_GOAWAY:
+                    raise ConnectionError("wire2: server sent GOAWAY")
+                else:
+                    raise ConnectionError(
+                        f"wire2: unknown reply frame type {ftype}"
+                    )
+        except (ConnectionError, OSError) as e:
+            self._fail_all(
+                e if isinstance(e, ConnectionError)
+                else ConnectionError(f"wire2: {e}")
+            )
+
+    def _drain(self, n: int) -> None:
+        scratch = memoryview(bytearray(min(n, 1 << 16)))
+        while n > 0:
+            take = min(n, scratch.nbytes)
+            _read_exact_into_file(self._rf, scratch[:take])
+            n -= take
+
+    def _begin(self, route: str, params, body, deadline_ms,
+               trace_id) -> tuple[int, _Pending]:
+        """Fire one request (HEADERS + DATA frames, no waiting) and
+        return its (stream id, pending-reply handle) — the building
+        block of both the blocking ``request`` and the single-thread
+        ``pipeline`` (many streams in flight at once)."""
+        route_id = handlers.ROUTE_PATHS.get(route)
+        if route_id is None:
+            raise ValueError(f"wire2: unknown route {route!r}")
+        if isinstance(params, (str, bytes)):
+            # Pre-encoded query string (a campaign fires thousands of
+            # identical requests; encode once, not per call).
+            qs = params.encode() if isinstance(params, str) else params
+            if deadline_ms is not None or trace_id is not None:
+                extra = dict(
+                    _deadline_ms=str(deadline_ms)
+                    if deadline_ms is not None else None,
+                    _trace=trace_id,
+                )
+                tail = urlencode(
+                    {k: v for k, v in extra.items() if v is not None}
+                ).encode()
+                qs = qs + b"&" + tail if qs else tail
+        else:
+            q = dict(params or {})
+            if deadline_ms is not None:
+                q["_deadline_ms"] = str(deadline_ms)
+            if trace_id is not None:
+                q["_trace"] = trace_id
+            qs = urlencode(q).encode()
+        mv = body if isinstance(body, memoryview) else memoryview(body)
+        if mv.format != "B" or mv.ndim != 1:
+            mv = mv.cast("B")
+        p = _Pending()
+        with self._slock:
+            sid = self._next_sid
+            self._next_sid += 1
+            self._streams[sid] = p
+        head_flags = F_END_STREAM if mv.nbytes == 0 else 0
+        frames = [
+            _HDR.pack(8 + len(qs), T_HEADERS, head_flags, route_id, sid),
+            struct.pack("<Q", mv.nbytes),
+            qs,
+        ]
+        off = 0
+        while off < mv.nbytes:
+            take = min(_CLIENT_CHUNK, mv.nbytes - off)
+            last = off + take >= mv.nbytes
+            frames.append(_HDR.pack(
+                take, T_DATA, F_END_STREAM if last else 0, 0, sid
+            ))
+            frames.append(mv[off : off + take])
+            off += take
+        with self._wlock:
+            _send_gathered(self.sock, frames)
+        return sid, p
+
+    def _finish(self, sid: int, p: _Pending,
+                timeout: float | None) -> tuple[int, bytes, float]:
+        if not p.event.wait(timeout or self.timeout):
+            with self._slock:
+                self._streams.pop(sid, None)
+            raise TimeoutError(f"wire2: stream {sid} timed out")
+        if p.error is not None:
+            raise p.error
+        # wire-copy-ok: CLIENT-side reply materialization (convenience)
+        return p.status, bytes(p.buf), p.retry_after
+
+    def request_full(
+        self, route: str, params: dict | str | bytes | None = None,
+        body=b"",
+        deadline_ms: int | None = None, trace_id: str | None = None,
+        timeout: float | None = None,
+    ) -> tuple[int, bytes, float]:
+        """One request -> (status, body bytes, retry_after_s).  ``route``
+        is the HTTP path (mapped to the wire2 route id); ``params`` the
+        same query params the HTTP front takes; ``body`` any buffer."""
+        sid, p = self._begin(route, params, body, deadline_ms, trace_id)
+        return self._finish(sid, p, timeout)
+
+    def pipeline(self, route: str, params, bodies, inflight: int = 64,
+                 deadline_ms: int | None = None,
+                 timeout: float | None = None) -> list[bytes]:
+        """Fire ``bodies`` as independent streams keeping up to
+        ``inflight`` of them open at once, from ONE thread — the
+        multiplexed transport's native campaign shape (an HTTP/1.1
+        client needs a connection+thread per in-flight request to get
+        the same concurrency; this needs neither).  Returns the reply
+        bodies in order; any non-200 raises :class:`Wire2Error` after
+        the in-flight tail drains."""
+        out: list[bytes] = []
+        window: list[tuple[int, _Pending]] = []
+        failure: Wire2Error | None = None
+
+        def reap(sid, p):
+            nonlocal failure
+            status, body, retry_after = self._finish(sid, p, timeout)
+            if status != 200 and failure is None:
+                failure = _error_from(status, body, retry_after)
+            out.append(body)
+
+        for body in bodies:
+            if len(window) >= inflight:
+                reap(*window.pop(0))
+            window.append(
+                self._begin(route, params, body, deadline_ms, None)
+            )
+        for sid, p in window:
+            reap(sid, p)
+        if failure is not None:
+            raise failure
+        return out
+
+    def request(self, route: str, params: dict | str | bytes | None = None,
+                body=b"",
+                deadline_ms: int | None = None,
+                trace_id: str | None = None,
+                timeout: float | None = None) -> bytes:
+        """``request_full`` that raises :class:`Wire2Error` on any
+        non-200 status (code/detail parsed from the structured JSON
+        body, matching the Go client's APIError)."""
+        status, out, retry_after = self.request_full(
+            route, params, body, deadline_ms, trace_id, timeout
+        )
+        if status != 200:
+            raise _error_from(status, out, retry_after)
+        return out
+
+    def ping(self, payload: bytes = b"wire2") -> None:
+        """Liveness echo (fire-and-forget send; the reader drains the
+        PONG)."""
+        with self._wlock:
+            _send_gathered(
+                self.sock,
+                [_HDR.pack(len(payload), T_PING, 0, 0, 0), payload],
+            )

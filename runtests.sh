@@ -30,12 +30,16 @@
 #       output differentials (every packed route vs its byte-per-bit twin
 #       plus the sidecar wire contract), the serving fast path
 #       (plan cache / micro-batcher / streaming EvalFull differentials,
-#       tests/test_serving.py), the observability plane (flight-recorder
-#       span trees, strict Prometheus exposition + /v1/stats equality,
-#       readyz/profile gating, tests/test_obs.py), the threaded
-#       keycache/batcher stress test, and the static-analysis suite's
-#       own tests — surfaces kernel + serving regressions in minutes
-#       instead of the full-suite half hour.
+#       tests/test_serving.py), the wire2 binary front
+#       (tests/test_wire2.py — byte-identical replies HTTP vs wire2 on
+#       every compared route, multiplexed streams on one connection,
+#       deadline/shed/breaker semantics on the new front, and the
+#       zero-copy allocation probe), the observability plane
+#       (flight-recorder span trees, strict Prometheus exposition +
+#       /v1/stats equality, readyz/profile gating, tests/test_obs.py),
+#       the threaded keycache/batcher stress test, and the
+#       static-analysis suite's own tests — surfaces kernel + serving
+#       regressions in minutes instead of the full-suite half hour.
 #   ./runtests.sh --faults [pytest args] fault-injection lane: the
 #       load-survival suite (tests/test_load_survival.py — admission
 #       control/shedding, deadlines, circuit-breaker trip/recover,
@@ -71,7 +75,7 @@ elif [ "${1:-}" = "--fast" ]; then
       tests/test_packed.py tests/test_serving.py tests/test_obs.py \
       tests/test_serving_stress.py tests/test_analysis.py \
       tests/test_oblivious.py tests/test_perf_contracts.py \
-      tests/test_apps.py tests/test_pir_serving.py \
+      tests/test_apps.py tests/test_pir_serving.py tests/test_wire2.py \
       -q -m 'not slow' "$@"
 else
   # -m is last-wins in pytest, so a caller-supplied -m overrides ours.

@@ -450,6 +450,66 @@ def test_perf_donation_fixtures():
 
 
 # ---------------------------------------------------------------------------
+# Wire-path budget (perf-contract): zero bytes() materializations of
+# request bodies in the wire2 transport + handler core.
+# ---------------------------------------------------------------------------
+
+
+def _wire_tree(td: str, src: str) -> None:
+    d = os.path.join(td, "dpf_tpu", "serving")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "wire2.py"), "w") as f:
+        f.write(src)
+
+
+def test_wire_path_budget_fires(tmp_path):
+    """A bytes()/tobytes() over a body buffer in the wire modules is a
+    perf-contract finding; the same line pragma'd is sanctioned."""
+    from dpf_tpu.analysis.perf_pass import wire_path_findings
+
+    td = str(tmp_path)
+    _wire_tree(td, "def handle(body):\n    return bytes(body)\n")
+    findings = wire_path_findings(td)
+    assert len(findings) == 1 and "wire-path" in findings[0].message
+
+    _wire_tree(
+        td,
+        "def handle(mv):\n"
+        "    # wire-copy-ok: control metadata, not the body\n"
+        "    a = bytes(mv)\n"
+        "    return mv.tobytes()\n",
+    )
+    findings = wire_path_findings(td)
+    # The pragma'd bytes() is sanctioned; the bare .tobytes() fires.
+    assert len(findings) == 1 and ".tobytes()" in findings[0].message
+
+
+def test_wire_path_scope_and_real_tree_clean():
+    """The budget scans BOTH wire modules, and the real tree honors it
+    (every copy in the transport/handler core is pragma-annotated or
+    view-based)."""
+    from dpf_tpu.analysis.perf_pass import WIRE_PATH_FILES, wire_path_findings
+
+    assert "dpf_tpu/serving/wire2.py" in WIRE_PATH_FILES
+    assert "dpf_tpu/serving/handlers.py" in WIRE_PATH_FILES
+    findings = wire_path_findings(ROOT)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_hygiene_scopes_cover_wire2():
+    """The secret-hygiene and host-sync passes scan the new wire
+    modules: serving/ is inside both scopes, so key material and silent
+    D2H syncs in the transport are lint failures like everywhere else."""
+    from dpf_tpu.analysis import host_sync_pass as hs
+    from dpf_tpu.analysis import secret_hygiene_pass as sh
+    from dpf_tpu.analysis.common import in_scope
+
+    for rel in ("dpf_tpu/serving/wire2.py", "dpf_tpu/serving/handlers.py"):
+        assert in_scope(rel, hs._SCOPE), rel
+        assert in_scope(rel, sh._SCOPE), rel
+
+
+# ---------------------------------------------------------------------------
 # Test-discipline pass: stale lane references, lost tier-1 glob,
 # undeclared markers, and dangling conftest hooks each fire on a
 # synthetic tree; the real tree is covered by test_real_tree_clean.

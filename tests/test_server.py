@@ -62,6 +62,25 @@ def test_batch_endpoint_fast_profile(srv):
     assert hits[:, 1].tolist() == [1, 2, 3, 700]
 
 
+def test_malformed_content_length_is_400(srv):
+    """A non-integer Content-Length header is a structured 400, never a
+    dropped connection with a server-side traceback."""
+    import http.client
+    import json
+
+    host, port = srv.replace("http://", "").split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=30)
+    try:
+        conn.putrequest("POST", "/v1/gen?log_n=9")
+        conn.putheader("Content-Length", "abc")
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 400
+        assert json.loads(resp.read())["code"] == "bad_request"
+    finally:
+        conn.close()
+
+
 def test_errors_propagate_as_400(srv):
     with pytest.raises(urllib.error.HTTPError) as ei:
         _post(f"{srv}/v1/evalfull?log_n=9", b"\x00" * 3)  # bad key length

@@ -1,0 +1,602 @@
+"""wire2 transport-equivalence suite: the binary multiplexed front must
+be indistinguishable from the HTTP/1.1 front at the byte level.
+
+What this file pins (runtests.sh --fast lane):
+
+  * byte-identical replies HTTP vs wire2 for eval_points_batch (both
+    formats, both profiles), evalfull (buffered AND streamed),
+    evalfull_batch, dcf points + interval, hh rounds, streamed agg
+    folds, and pir register+query;
+  * multiplexing: N concurrent streams on ONE connection come back
+    correct and uncrossed, and a poisoned upload stream does not cost
+    its connection-mates anything;
+  * the load-survival semantics on the new front: deadline -> 504
+    "deadline", breaker-open -> 503 "unavailable", per-connection
+    stream-cap -> 429 "shed" (all the same structured codes the HTTP
+    front maps);
+  * the zero-copy allocation probe: the per-front marshalling ledger
+    in /v1/stats records ZERO body bytes copied on the wire2 front
+    (the HTTP front records every body byte), and the recv_into ->
+    np.frombuffer seam is proven copy-free by byte-address identity;
+  * the keycache satellite: buffer-protocol key blobs digest without
+    copying, and byte-identical bytes/memoryview inputs hit one entry.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpf_tpu import server as srv_mod
+from dpf_tpu.core import chacha_np as cc
+from dpf_tpu.core import spec
+from dpf_tpu.serving import faults
+from dpf_tpu.serving.wire2 import Wire2Client, Wire2Error, _StreamBody
+
+pytestmark = pytest.mark.filterwarnings("ignore::RuntimeWarning")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.clear()
+
+
+@pytest.fixture()
+def fronts(monkeypatch):
+    """One sidecar with BOTH fronts up (ephemeral ports); returns
+    (http base url, wire2 (host, port)).  Extra knobs land in the
+    environment before the lazy serving state reads them."""
+    started = []
+
+    def start(**env):
+        monkeypatch.setenv("DPF_TPU_WIRE2", "on")
+        monkeypatch.setenv("DPF_TPU_WIRE2_PORT", "0")
+        for name, value in env.items():
+            monkeypatch.setenv(name, value)
+        srv_mod.reset_serving_state()
+        s = srv_mod.serve(port=0)
+        started.append(s)
+        return (
+            f"http://127.0.0.1:{s.server_address[1]}",
+            (s.wire2.address[0], s.wire2.address[1]),
+        )
+
+    yield start
+    for s in started:
+        s.shutdown()
+    srv_mod.reset_serving_state()
+
+
+def _post(url, body=b"", headers=None, timeout=120):
+    req = urllib.request.Request(
+        url, data=body, method="POST", headers=headers or {}
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read()
+
+
+def _gen_keys(base, log_n, alphas, profile="compat"):
+    kl = (cc if profile == "fast" else spec).key_len(log_n)
+    blobs = [
+        _post(f"{base}/v1/gen?log_n={log_n}&alpha={a}&profile={profile}")
+        for a in alphas
+    ]
+    return kl, blobs
+
+
+def _stats(base):
+    with urllib.request.urlopen(base + "/v1/stats", timeout=30) as r:
+        return json.loads(r.read())
+
+
+# ---------------------------------------------------------------------------
+# Byte identity, route by route
+# ---------------------------------------------------------------------------
+
+
+def test_points_byte_identity_both_formats_both_profiles(fronts):
+    base, (host, port) = fronts()
+    rng = np.random.default_rng(5)
+    with Wire2Client(host, port) as w2:
+        for profile in ("compat", "fast"):
+            log_n, k, q = 9, 3, 33  # q % 8 != 0: tail-masked packed rows
+            kl, blobs = _gen_keys(
+                base, log_n, (5, 77, 300), profile=profile
+            )
+            body = b"".join(b[:kl] for b in blobs)
+            xs = rng.integers(0, 1 << log_n, size=(k, q), dtype=np.uint64)
+            body += xs.tobytes()
+            for fmt in ("bits", "packed"):
+                via_http = _post(
+                    f"{base}/v1/eval_points_batch?log_n={log_n}&k={k}"
+                    f"&q={q}&profile={profile}&format={fmt}",
+                    body,
+                )
+                via_wire2 = w2.request(
+                    "/v1/eval_points_batch",
+                    {"log_n": log_n, "k": k, "q": q,
+                     "profile": profile, "format": fmt},
+                    body,
+                )
+                assert via_http == via_wire2, (profile, fmt)
+
+
+def test_evalfull_byte_identity_buffered_and_streamed(fronts):
+    base, (host, port) = fronts()
+    log_n = 9
+    kl, blobs = _gen_keys(base, log_n, (77,))
+    key = blobs[0][:kl]
+    want = _post(f"{base}/v1/evalfull?log_n={log_n}", key)
+    assert want == spec.eval_full(key, log_n)
+    with Wire2Client(host, port) as w2:
+        for stream in ("0", "1"):
+            got = w2.request(
+                "/v1/evalfull", {"log_n": log_n, "stream": stream}, key
+            )
+            assert got == want, f"stream={stream}"
+        # The batch route rides the same handler core.
+        k2 = 2
+        batch_http = _post(
+            f"{base}/v1/evalfull_batch?log_n={log_n}&k={k2}", key + key
+        )
+        batch_w2 = w2.request(
+            "/v1/evalfull_batch", {"log_n": log_n, "k": k2}, key + key
+        )
+        assert batch_http == batch_w2
+
+
+def test_dcf_byte_identity(fronts):
+    base, (host, port) = fronts()
+    from dpf_tpu.models import dcf as dcf_mod
+
+    log_n, k, q = 10, 2, 5
+    alphas = np.array([17, 900], dtype="<u8")
+    blob = _post(
+        f"{base}/v1/dcf_gen?log_n={log_n}&k={k}", alphas.tobytes()
+    )
+    kl = dcf_mod.key_len(log_n)
+    xs = np.array(
+        [[a, max(int(a) - 1, 0), 0, (1 << log_n) - 1, int(a)]
+         for a in alphas],
+        dtype="<u8",
+    )
+    body = blob[: k * kl] + xs.tobytes()
+    with Wire2Client(host, port) as w2:
+        via_http = _post(
+            f"{base}/v1/dcf_eval_points?log_n={log_n}&k={k}&q={q}", body
+        )
+        via_wire2 = w2.request(
+            "/v1/dcf_eval_points", {"log_n": log_n, "k": k, "q": q}, body
+        )
+        assert via_http == via_wire2
+
+        # Interval route, packed format.
+        lo = np.array([0, 100], dtype="<u8")
+        hi = np.array([0, 400], dtype="<u8")
+        iblob = _post(
+            f"{base}/v1/dcf_interval_gen?log_n={log_n}&k={k}",
+            lo.tobytes() + hi.tobytes(),
+        )
+        half = 2 * k * kl + k
+        ibody = iblob[:half] + xs.tobytes()
+        ih = _post(
+            f"{base}/v1/dcf_interval_eval?log_n={log_n}&k={k}&q={q}"
+            "&format=packed",
+            ibody,
+        )
+        iw = w2.request(
+            "/v1/dcf_interval_eval",
+            {"log_n": log_n, "k": k, "q": q, "format": "packed"}, ibody
+        )
+        assert ih == iw
+
+
+def test_hh_byte_identity(fronts):
+    base, (host, port) = fronts()
+    log_n, k, q, level = 8, 4, 8, 3
+    values = np.arange(k, dtype="<u8") * 31 % (1 << log_n)
+    blob = _post(
+        f"{base}/v1/hh/gen?log_n={log_n}&k={k}&profile=fast",
+        values.tobytes(),
+    )
+    kl = cc.key_len(log_n)
+    per = log_n * kl
+    half = len(blob) // 2
+    level_keys = b"".join(
+        blob[i * per + level * kl : i * per + (level + 1) * kl]
+        for i in range(k)
+    )
+    cands = (np.arange(q, dtype="<u8") << (log_n - level - 1)).tobytes()
+    body = level_keys + cands
+    params = {"log_n": log_n, "k": k, "q": q, "level": level,
+              "profile": "fast", "format": "packed"}
+    assert half % per == 0
+    via_http = _post(
+        f"{base}/v1/hh/eval?log_n={log_n}&k={k}&q={q}&level={level}"
+        "&profile=fast&format=packed",
+        body,
+    )
+    with Wire2Client(host, port) as w2:
+        assert w2.request("/v1/hh/eval", params, body) == via_http
+
+
+def test_agg_byte_identity_multichunk(fronts, monkeypatch):
+    """The streamed-upload route across fronts, with a chunk size small
+    enough that one request folds through MANY chunks on both."""
+    base, (host, port) = fronts()
+    monkeypatch.setenv("DPF_TPU_AGG_CHUNK_BYTES", "4096")
+    k, words = 300, 16  # 300 rows x 64 B = ~5 chunks of 4096 B
+    rows = (
+        np.random.default_rng(6)
+        .integers(0, 1 << 32, size=(k, words), dtype=np.uint64)
+        .astype(np.uint32)
+    )
+    with Wire2Client(host, port) as w2:
+        for op, ref in (
+            ("xor", np.bitwise_xor.reduce(rows, axis=0)),
+            ("add", rows.astype(np.uint64).sum(0).astype(np.uint32)),
+        ):
+            via_http = _post(
+                f"{base}/v1/agg/submit?op={op}&k={k}&words={words}",
+                rows.tobytes(),
+            )
+            via_wire2 = w2.request(
+                "/v1/agg/submit",
+                {"op": op, "k": k, "words": words}, rows.tobytes()
+            )
+            assert via_http == via_wire2
+            np.testing.assert_array_equal(
+                np.frombuffer(via_wire2, "<u4"), ref
+            )
+
+
+def test_pir_byte_identity_register_and_query(fronts):
+    """Register the database THROUGH wire2 (the other sink route), then
+    answer the same queries on both fronts."""
+    base, (host, port) = fronts()
+    rng = np.random.default_rng(7)
+    nrows, rb = 64, 8
+    db = rng.integers(0, 256, size=(nrows, rb), dtype=np.uint8)
+    with Wire2Client(host, port) as w2:
+        info = json.loads(w2.request(
+            "/v1/pir/db",
+            {"name": "w2db", "rows": nrows, "row_bytes": rb},
+            db.tobytes(),
+        ))
+        assert info["rows"] == nrows and info["row_bytes"] == rb
+        log_n = info["log_n"]
+        kl, blobs = _gen_keys(base, log_n, (3, 9))
+        keys = b"".join(b[:kl] for b in blobs)
+        via_http = _post(f"{base}/v1/pir/query?db=w2db&k=2", keys)
+        via_wire2 = w2.request("/v1/pir/query", {"db": "w2db", "k": 2}, keys)
+        assert via_http == via_wire2
+        # And the answers select the right rows (2-server XOR with the
+        # other share omitted == direct row for the dealer's key pair):
+        kb = b"".join(b[kl:] for b in blobs)
+        other = _post(f"{base}/v1/pir/query?db=w2db&k=2", kb)
+        rec = np.frombuffer(via_wire2, np.uint8) ^ np.frombuffer(
+            other, np.uint8
+        )
+        np.testing.assert_array_equal(
+            rec.reshape(2, rb), db[[3, 9]]
+        )
+
+
+# ---------------------------------------------------------------------------
+# Multiplexing and framing survival
+# ---------------------------------------------------------------------------
+
+
+def test_multiplexed_streams_on_one_connection(fronts):
+    """N threads share ONE client (one TCP connection); every reply must
+    match its own HTTP reference — no crossed streams, no tearing.
+    (The lane age watermark is disabled: on a loaded single-core CI box
+    a scheduler stall can legitimately shed arrivals as 429 — correct
+    load survival, but not what this test pins.)"""
+    base, (host, port) = fronts(DPF_TPU_QUEUE_MAX_AGE_MS="0")
+    log_n, q, workers, reps = 9, 16, 8, 4
+    rng = np.random.default_rng(8)
+    jobs = []
+    for i in range(workers):
+        kl, blobs = _gen_keys(base, log_n, (int(i * 13 % (1 << log_n)),))
+        xs = rng.integers(0, 1 << log_n, size=(1, q), dtype=np.uint64)
+        body = blobs[0][:kl] + xs.tobytes()
+        want = _post(
+            f"{base}/v1/eval_points_batch?log_n={log_n}&k=1&q={q}"
+            "&format=packed",
+            body,
+        )
+        jobs.append((body, want))
+    errs = []
+    with Wire2Client(host, port) as w2:
+
+        def worker(i):
+            body, want = jobs[i]
+            try:
+                for _ in range(reps):
+                    got = w2.request(
+                        "/v1/eval_points_batch",
+                        {"log_n": log_n, "k": 1, "q": q,
+                         "format": "packed"},
+                        body,
+                    )
+                    if got != want:
+                        raise AssertionError(f"stream {i} crossed")
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+    assert not errs, errs[0]
+
+
+def test_poisoned_upload_stream_spares_the_connection(fronts):
+    """A validation failure mid-upload (half-read body) retires only ITS
+    stream: the server discards the remainder off the wire and the SAME
+    connection keeps serving — the wire2 twin of the HTTP framing guard
+    without the connection loss."""
+    base, (host, port) = fronts()
+    with Wire2Client(host, port) as w2:
+        k, words = 64, 16
+        rows = np.zeros((k, words), np.uint32)
+        # k param disagrees with the body length -> 400 BEFORE the body
+        # is consumed; the 1 MiB of body bytes are already in flight on
+        # the same connection.
+        with pytest.raises(Wire2Error) as ei:
+            w2.request(
+                "/v1/agg/submit",
+                {"op": "xor", "k": k + 1, "words": words}, rows.tobytes()
+            )
+        assert ei.value.status == 400 and ei.value.code == "bad_request"
+        # The connection survives and serves the corrected request.
+        out = w2.request(
+            "/v1/agg/submit",
+            {"op": "xor", "k": k, "words": words}, rows.tobytes()
+        )
+        np.testing.assert_array_equal(
+            np.frombuffer(out, "<u4"), np.zeros(words, np.uint32)
+        )
+
+
+def test_oversized_body_declaration_refused_not_allocated(fronts):
+    """A HEADERS frame declaring a body past DPF_TPU_WIRE2_MAX_BODY_BYTES
+    is refused with a structured 400 BEFORE any buffer is allocated,
+    and the connection keeps serving (the declared length is
+    client-controlled — it must never be able to OOM the sidecar)."""
+    base, (host, port) = fronts(DPF_TPU_WIRE2_MAX_BODY_BYTES="1024")
+    with Wire2Client(host, port) as w2:
+        body = bytes(2048)
+        with pytest.raises(Wire2Error) as ei:
+            w2.request(
+                "/v1/agg/submit", {"op": "xor", "k": 64, "words": 8}, body
+            )
+        assert ei.value.status == 400
+        assert "DPF_TPU_WIRE2_MAX_BODY_BYTES" in ei.value.detail
+        # Same connection, in-cap request: still healthy.
+        out = w2.request(
+            "/v1/agg/submit", {"op": "xor", "k": 16, "words": 8},
+            bytes(16 * 32),
+        )
+        assert out == bytes(32)
+
+
+def test_undecodable_params_fail_loudly_not_silently(fronts):
+    """A HEADERS param string that is not UTF-8 is a protocol-level
+    failure: the server tears the connection down (GOAWAY/close) so the
+    client sees a loud connection error — never a silently-dead reader
+    with handlers parked forever.  A fresh connection serves fine."""
+    import socket as socket_mod
+    import struct as struct_mod
+
+    from dpf_tpu.serving import wire2 as w2_mod
+
+    base, (host, port) = fronts()
+    raw = socket_mod.create_connection((host, port), timeout=30)
+    try:
+        raw.sendall(w2_mod.MAGIC)
+        payload = struct_mod.pack("<Q", 0) + b"log_n=9&x=\xff\xfe"
+        raw.sendall(
+            w2_mod._HDR.pack(len(payload), w2_mod.T_HEADERS,
+                             w2_mod.F_END_STREAM, 2, 1)
+            + payload
+        )
+        raw.settimeout(30)
+        # GOAWAY or straight close — either way the read side ends.
+        got = raw.recv(64)
+        assert got == b"" or got[:4] != b"\xff\xff\xff\xff"
+    finally:
+        raw.close()
+    with Wire2Client(host, port) as w2:
+        w2.ping()  # the listener is still accepting and serving
+
+
+# ---------------------------------------------------------------------------
+# Load-survival semantics on the new front
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_maps_to_504_on_wire2(fronts):
+    base, (host, port) = fronts(DPF_TPU_BATCH_WINDOW_US="0")
+    faults.install("dispatch.points:latency:ms=80")
+    log_n, q = 9, 8
+    kl, blobs = _gen_keys(base, log_n, (5,))
+    xs = np.zeros((1, q), np.uint64)
+    body = blobs[0][:kl] + xs.tobytes()
+    with Wire2Client(host, port) as w2:
+        with pytest.raises(Wire2Error) as ei:
+            w2.request(
+                "/v1/eval_points_batch",
+                {"log_n": log_n, "k": 1, "q": q}, body,
+                deadline_ms=20,
+            )
+    assert ei.value.status == 504 and ei.value.code == "deadline"
+
+
+def test_breaker_open_maps_to_503_on_wire2(fronts):
+    """Two injected transients trip the breaker; the wire2 front then
+    fails fast with the same structured 503 the HTTP front sends,
+    Retry-After included."""
+    base, (host, port) = fronts(
+        DPF_TPU_BREAKER_THRESHOLD="2",
+        DPF_TPU_BREAKER_COOLDOWN_MS="60000",
+        DPF_TPU_DISPATCH_RETRIES="0",
+        DPF_TPU_BREAKER_PROBE="off",
+        DPF_TPU_BATCH_WINDOW_US="0",
+    )
+    faults.install("dispatch.points:unavailable:times=2")
+    log_n, q = 9, 8
+    kl, blobs = _gen_keys(base, log_n, (5,))
+    body = blobs[0][:kl] + np.zeros((1, q), np.uint64).tobytes()
+    params = {"log_n": log_n, "k": 1, "q": q}
+    with Wire2Client(host, port) as w2:
+        for _ in range(2):  # transient failures trip the breaker open
+            with pytest.raises(Wire2Error):
+                w2.request("/v1/eval_points_batch", params, body)
+        assert _stats(base)["breaker"]["state"] == "open"
+        with pytest.raises(Wire2Error) as ei:  # fail-fast, fault untouched
+            w2.request("/v1/eval_points_batch", params, body)
+    assert ei.value.status == 503 and ei.value.code == "unavailable"
+    assert ei.value.retry_after_s > 0
+
+
+def test_stream_cap_sheds_as_429(fronts):
+    """Streams opened past DPF_TPU_WIRE2_MAX_STREAMS are refused with a
+    structured shed — the frame reader's admission control."""
+    base, (host, port) = fronts(DPF_TPU_WIRE2_MAX_STREAMS="1")
+    faults.install("dispatch.points:latency:ms=400")
+    log_n, q = 9, 8
+    kl, blobs = _gen_keys(base, log_n, (5,))
+    body = blobs[0][:kl] + np.zeros((1, q), np.uint64).tobytes()
+    params = {"log_n": log_n, "k": 1, "q": q}
+    results = {}
+    with Wire2Client(host, port) as w2:
+
+        def slow():
+            try:
+                results["slow"] = w2.request(
+                    "/v1/eval_points_batch", params, body
+                )
+            except Exception as e:  # noqa: BLE001
+                results["slow"] = e
+
+        t = threading.Thread(target=slow)
+        t.start()
+        time.sleep(0.1)  # the slow stream is in-flight: cap is full
+        with pytest.raises(Wire2Error) as ei:
+            w2.request("/v1/eval_points_batch", params, body)
+        t.join(60)
+    assert ei.value.status == 429 and ei.value.code == "shed"
+    assert isinstance(results["slow"], bytes)  # the occupant completed
+
+
+# ---------------------------------------------------------------------------
+# The allocation probe: zero body-byte copies on the wire2 hot path
+# ---------------------------------------------------------------------------
+
+
+def test_marshalling_ledger_wire2_copies_zero(fronts):
+    """/v1/stats 'wire': the HTTP front copies every body byte once
+    (rfile.read); the wire2 front copies ZERO — the committed
+    allocation-probe surface the bench cfg-wire section reads."""
+    base, (host, port) = fronts()
+    log_n, q = 9, 16
+    kl, blobs = _gen_keys(base, log_n, (5,))
+    body = blobs[0][:kl] + np.zeros((1, q), np.uint64).tobytes()
+    path = f"/v1/eval_points_batch?log_n={log_n}&k=1&q={q}"
+    params = {"log_n": log_n, "k": 1, "q": q}
+    k_agg, words = 32, 8
+    agg_body = np.ones((k_agg, words), np.uint32).tobytes()
+    _post(base + path, body)
+    _post(f"{base}/v1/agg/submit?op=xor&k={k_agg}&words={words}", agg_body)
+    with Wire2Client(host, port) as w2:
+        w2.request("/v1/eval_points_batch", params, body)
+        w2.request(
+            "/v1/agg/submit",
+            {"op": "xor", "k": k_agg, "words": words}, agg_body
+        )
+    wire = _stats(base)["wire"]
+    want_bytes = len(body) + len(agg_body)
+    assert wire["http"]["body_bytes"] >= want_bytes
+    assert wire["http"]["body_bytes_copied"] == wire["http"]["body_bytes"]
+    assert wire["wire2"]["requests"] == 2
+    assert wire["wire2"]["body_bytes"] == want_bytes
+    assert wire["wire2"]["body_bytes_copied"] == 0
+
+
+def test_recv_to_operand_is_byte_address_identical():
+    """The recv_into -> memoryview -> np.frombuffer seam is copy-free:
+    the dispatch operand's data pointer lands INSIDE the stream's
+    receive buffer — zero intermediate bytes objects, proven by
+    address, not by accounting."""
+    import socket as socket_mod
+
+    a, b = socket_mod.socketpair()
+    try:
+        payload = np.arange(64, dtype="<u4").tobytes()
+        body = _StreamBody(bytearray(len(payload)), len(payload))
+        a.sendall(payload)
+        body.fill_from(b, len(payload))
+        view = body.next_chunk(len(payload))
+        arr = np.frombuffer(view, dtype="<u4")
+        base_addr = np.frombuffer(body.buf, np.uint8).__array_interface__[
+            "data"
+        ][0]
+        arr_addr = arr.__array_interface__["data"][0]
+        assert base_addr <= arr_addr < base_addr + len(body.buf)
+        np.testing.assert_array_equal(arr, np.arange(64, dtype="<u4"))
+    finally:
+        a.close()
+        b.close()
+
+
+def test_static_wire_path_budget_is_clean():
+    """The perf-contract pass's wire-path budget holds on the real tree:
+    zero unsanctioned bytes() materializations in the transport and the
+    handler core (the static half of the allocation probe)."""
+    from dpf_tpu.analysis.common import repo_root
+    from dpf_tpu.analysis.perf_pass import wire_path_findings
+
+    findings = wire_path_findings(repo_root())
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# Keycache satellite: buffer-protocol key blobs
+# ---------------------------------------------------------------------------
+
+
+def test_keycache_memoryview_and_bytes_hit_one_entry():
+    from dpf_tpu.serving.keycache import KeyCache
+
+    cache = KeyCache(entries=4)
+    blob = bytes(range(64)) * 3
+    built = []
+
+    def build():
+        built.append(1)
+        return object()
+
+    first = cache.get("k", 9, blob, build)
+    # A memoryview over byte-identical content digests to the same
+    # entry — no copy, no rebuild, SAME object back.
+    view = memoryview(bytearray(blob))
+    assert cache.get("k", 9, view, build) is first
+    # ... including odd-offset slices of a larger transport buffer.
+    framed = bytearray(b"\x00" * 3 + blob + b"\x00" * 5)
+    assert cache.get("k", 9, memoryview(framed)[3 : 3 + len(blob)],
+                     build) is first
+    assert built == [1]
+    assert cache.stats()["hits"] == 2 and cache.stats()["misses"] == 1
