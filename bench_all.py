@@ -50,7 +50,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import time
 
 import numpy as np
@@ -59,7 +58,9 @@ from dpf_tpu.analysis import LINT_SUITE_VERSION
 from dpf_tpu.analysis.perf import PERF_CONTRACT_VERSION
 from dpf_tpu.analysis.trace import OBLIVIOUS_VERIFIER_VERSION
 from dpf_tpu.core import knobs
-from dpf_tpu.serving.breaker import TRANSIENT_SIGNATURES
+from dpf_tpu.core.transients import TRANSIENT_SIGNATURES
+from dpf_tpu.tune import ledger as sweep_ledger
+from dpf_tpu.tune import tuned as tuned_defaults
 
 from bench import (
     _chain_scan,
@@ -124,6 +125,11 @@ _ROUTE_KNOBS = (
     # wire2 row must never collide with an HTTP-only row on resume.
     "DPF_TPU_WIRE2", "DPF_TPU_WIRE2_PORT", "DPF_TPU_WIRE2_MAX_STREAMS",
     "DPF_TPU_WIRE2_RECV_BUF_BYTES", "DPF_TPU_WIRE2_MAX_BODY_BYTES",
+    # Tuned-defaults knobs: whether (and from which file) per-plan tuned
+    # configs steer the measured dispatches.  The FILE CONTENT digest is
+    # a separate key field ("tuned") — mode alone cannot tell two
+    # different TUNED.json generations apart on resume.
+    "DPF_TPU_TUNED", "DPF_TPU_TUNED_PATH",
 )
 # DPF_TPU_BENCH_LEDGER_RETRY_ERRORS=1: sections whose recorded rows
 # contain an error row are NOT replayed (and not re-recorded) — the
@@ -141,35 +147,17 @@ def _has_error_row(rows: list) -> bool:
 def _ledger_key(scale: str) -> dict:
     """Identity of the code being measured: tree hashes of the measured
     package + harness (so doc/log commits between attempts don't discard
-    rows), marked never-matching while any of it has uncommitted edits."""
+    rows), marked never-matching while any of it has uncommitted edits.
+    File mechanics live in dpf_tpu/tune/ledger.py (shared with the
+    autotuner's sweep ledger); what's in the key stays bench policy."""
     repo = os.path.dirname(os.path.abspath(__file__))
-    paths = ["dpf_tpu", "native", "bench.py", "bench_all.py"]
     override = knobs.get_raw("DPF_TPU_BENCH_LEDGER_KEY")
     if override:  # tests: pin the key regardless of tree state
-        return {
-            "head": override,
-            "scale": scale,
-            "knobs": knobs.snapshot(_ROUTE_KNOBS),
-            "lint": LINT_SUITE_VERSION,
-            "oblivious": OBLIVIOUS_VERIFIER_VERSION,
-            "perf": PERF_CONTRACT_VERSION,
-        }
-    try:
-        rp = subprocess.run(
-            ["git", "rev-parse"] + [f"HEAD:{p}" for p in paths],
-            cwd=repo, capture_output=True, text=True, timeout=10,
+        head = override
+    else:
+        head = sweep_ledger.tree_head(
+            repo, ["dpf_tpu", "native", "bench.py", "bench_all.py"]
         )
-        st = subprocess.run(
-            ["git", "status", "--porcelain", "--"] + paths,
-            cwd=repo, capture_output=True, text=True, timeout=10,
-        )
-        if rp.returncode or st.returncode:  # non-git deploy: never match
-            raise RuntimeError(rp.stderr or st.stderr)
-        head = rp.stdout.strip().replace("\n", ",")
-        if st.stdout.strip():
-            head += f"+dirty@{time.time_ns()}"
-    except Exception:  # noqa: BLE001 — ledger is best-effort
-        head = f"unknown@{time.time_ns()}"
     return {
         "head": head,
         "scale": scale,
@@ -185,6 +173,10 @@ def _ledger_key(scale: str) -> dict:
         # (docs/PERF_CONTRACTS.md) pinned their collective/donation/
         # dispatch budgets — a budget change re-measures.
         "perf": PERF_CONTRACT_VERSION,
+        # Content digest of the tuned-defaults file: rows measured under
+        # one TUNED.json generation must never replay under another
+        # ("absent" when no file — also a distinct identity).
+        "tuned": sweep_ledger.file_digest(tuned_defaults.default_path()),
     }
 
 
@@ -192,41 +184,21 @@ def _ledger_load(scale: str) -> None:
     if not _LEDGER_PATH:
         return
     key = _ledger_key(scale)
-    lines = []
-    try:
-        with open(_LEDGER_PATH) as f:
-            for ln in f:
-                if not ln.strip():
-                    continue
-                try:
-                    lines.append(json.loads(ln))
-                except ValueError:
-                    break  # torn tail (killed mid-append): keep the prefix
-    except OSError:
-        pass
-    if lines and lines[0] == key:
-        for rec in lines[1:]:
-            if isinstance(rec, dict) and "section" in rec and "rows" in rec:
-                if _RETRY_ERRORS and _has_error_row(rec["rows"]):
-                    continue  # re-measure instead of replaying the error
-                _LEDGER[rec["section"]] = rec["rows"]
-    else:  # absent, unreadable, or stale — start a fresh ledger
-        try:
-            with open(_LEDGER_PATH, "w") as f:
-                f.write(json.dumps(key) + "\n")
-        except OSError:
-            pass  # best-effort: run without persistence
+    stored = sweep_ledger.load(_LEDGER_PATH, key)
+    if stored is None:  # absent, unreadable, or stale — start fresh
+        sweep_ledger.start_fresh(_LEDGER_PATH, key)
+        return
+    for section, rows in stored.items():
+        if _RETRY_ERRORS and _has_error_row(rows):
+            continue  # re-measure instead of replaying the error
+        _LEDGER[section] = rows
 
 
 def _ledger_record(section: str, rows: list) -> None:
     if not _LEDGER_PATH:
         return
     _LEDGER[section] = rows
-    try:
-        with open(_LEDGER_PATH, "a") as f:
-            f.write(json.dumps({"section": section, "rows": rows}) + "\n")
-    except OSError:
-        pass  # best-effort: the matrix must keep producing rows
+    sweep_ledger.append(_LEDGER_PATH, section, rows)
 
 
 def _timed_host_call(fn, reps: int = 3) -> float:

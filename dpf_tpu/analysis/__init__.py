@@ -1,7 +1,7 @@
 """Repo-native static analysis: the discipline the ROADMAP's production
 north star needs, checked on every commit for free.
 
-Five AST-based passes plus two jaxpr-level passes over the whole tree
+Six file/AST-based passes plus two jaxpr-level passes over the whole tree
 (one entrypoint: ``python -m dpf_tpu.analysis`` / ``scripts/lint_all.sh``;
 exits nonzero on any finding):
 
@@ -42,6 +42,14 @@ exits nonzero on any finding):
                   vs the ops budget), and the resulting obliviousness
                   certificates (docs/OBLIVIOUS.md + docs/oblivious.json)
                   checked for drift against the committed tree.
+  tuned-defaults  the committed ``docs/TUNED.json`` autotuner output
+                  validates against the schema/registry contract in
+                  ``dpf_tpu/tune/tuned.py``: known routes/profiles,
+                  config knobs on declared search-space axes with
+                  allowed values, sane margins, and a ``knobs_digest``
+                  fresh against the current tunable-knob declarations
+                  (a stale file fails soft at serving time by design —
+                  CI is where it must fail hard).
   perf-contract   the jaxpr-level performance-contract verifier
                   (``analysis/perf/``): the SAME route traces (shared
                   trace cache — each route traces once per lint run)
@@ -71,8 +79,9 @@ from __future__ import annotations
 # on it re-measure).  "2": the oblivious-trace jaxpr verifier joined the
 # suite and host-sync grew the models/ + parallel/ scope.  "3": the
 # perf-contract verifier and the test-discipline pass joined, and
-# knob-registry grew unused-knob detection.
-LINT_SUITE_VERSION = "3"
+# knob-registry grew unused-knob detection.  "4": the tuned-defaults
+# pass joined (committed autotuner output validated every commit).
+LINT_SUITE_VERSION = "4"
 
 # name -> (module, callable); imported lazily so `import dpf_tpu.analysis`
 # stays cheap for the bench harness's version stamp.  Passes run in
@@ -84,6 +93,7 @@ PASSES = {
     "host-sync": ("dpf_tpu.analysis.host_sync_pass", "run"),
     "pallas-jit": ("dpf_tpu.analysis.pallas_discipline_pass", "run"),
     "test-discipline": ("dpf_tpu.analysis.test_discipline_pass", "run"),
+    "tuned-defaults": ("dpf_tpu.analysis.tuned_pass", "run"),
     "oblivious-trace": ("dpf_tpu.analysis.trace_pass", "run"),
     "perf-contract": ("dpf_tpu.analysis.perf_pass", "run"),
 }

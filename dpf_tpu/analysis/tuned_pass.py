@@ -1,0 +1,57 @@
+"""Pass: committed tuned-defaults discipline (``tuned-defaults``).
+
+``docs/TUNED.json`` is a committed artifact the plan cache consults at
+warmup (``core/plans.py`` under ``DPF_TPU_TUNED``) — a broken or stale
+file fails SOFT at serving time by design (the loader falls back to
+registry defaults and surfaces the error only in ``/v1/stats``), which
+is exactly why CI must fail HARD here: nothing else stops a bad commit
+from silently serving untuned.  Rules:
+
+  D1  the file parses as JSON.
+  D2  it validates against the schema/registry/staleness contract in
+      ``dpf_tpu/tune/tuned.py`` (schema version, provenance backend and
+      head, per-entry route/profile/shape keys, every config knob on a
+      declared search-space axis with an allowed value, margins in
+      (0, 1), no duplicate keys, and ``knobs_digest`` fresh against the
+      current tunable-knob declarations + search space — a changed knob
+      default or axis means the measured winners no longer describe
+      this tree and the sweep must be re-run with ``--write-tuned``).
+
+An absent file is clean: the tuner simply has not been run (or its
+winners were never committed), and the plan cache serves registry
+defaults.  ``files`` may name fixture .json documents to scan instead
+of the committed path (the lint suite's own tests use this).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .common import Finding
+
+PASS = "tuned-defaults"
+
+_DOC = os.path.join("docs", "TUNED.json")
+
+
+def run(root: str, files=None) -> list[Finding]:
+    rels = [f for f in files if f.endswith(".json")] if files else [_DOC]
+    out: list[Finding] = []
+    from ..tune import tuned
+
+    for rel in rels:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue  # no tuned winners committed: registry defaults
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except ValueError as e:
+            out.append(Finding(rel, 1, PASS, f"unparseable JSON: {e}"))
+            continue
+        out.extend(
+            Finding(rel, 1, PASS, problem)
+            for problem in tuned.validate(doc)
+        )
+    return out

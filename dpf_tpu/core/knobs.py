@@ -39,8 +39,11 @@ and the analysis suite import it before any backend initializes.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
+import threading
+from collections.abc import Iterator, Mapping
 
 # Spellings that mean "off" for boolean knobs (get_bool).  Matches the
 # historical per-site parsers (server.py's DPF_TPU_BATCH, bench_all.py's
@@ -447,6 +450,36 @@ _declare(
     "bench_all.py", values="<name[:transient],...>",
 )
 
+# On-hardware autotuner (dpf_tpu/tune/) -------------------------------------
+_declare(
+    "DPF_TPU_TUNED", "enum", "auto",
+    "Apply tuned per-plan knob defaults from the committed TUNED file at "
+    "dispatch/warmup time: off ignores the file, on applies any valid "
+    "file, auto applies device-measured files on TPU only (sim-measured "
+    "winners never steer real hardware implicitly).",
+    "dpf_tpu/core/plans.py", choices=("off", "auto", "on"),
+)
+_declare(
+    "DPF_TPU_TUNED_PATH", "str", "docs/TUNED.json",
+    "Path of the tuned-defaults file (relative paths resolve against the "
+    "repo root).",
+    "dpf_tpu/tune/tuned.py", values="<path>",
+)
+_declare(
+    "DPF_TPU_TUNE_BUDGET_S", "float", "0",
+    "Wall-clock budget for one tuner sweep, seconds (0 = unbounded; an "
+    "exceeded budget stops the sweep cleanly BETWEEN configs, with the "
+    "ledger intact for the next window).",
+    "dpf_tpu/tune/driver.py",
+)
+_declare(
+    "DPF_TPU_TUNE_TRIALS", "int", "0",
+    "Cap on candidate configs measured per sweep point (0 = exhaustive "
+    "enumeration; a capped sweep always keeps the default config plus a "
+    "deterministic hash-ordered sample of the rest).",
+    "dpf_tpu/tune/driver.py",
+)
+
 
 # ---------------------------------------------------------------------------
 # Typed accessors
@@ -464,21 +497,66 @@ def knob(name: str) -> Knob:
         ) from None
 
 
+# Thread-local override stack: the innermost active ``overrides()`` layer
+# a read on THIS thread resolves against before os.environ.  Dispatch-
+# scoped (a tuned plan config applies to one dispatch on one thread),
+# never process identity: ``snapshot()`` deliberately stays env-only so
+# ledger/route identity records the deployment, not an in-flight tuning
+# overlay.
+_TLS = threading.local()
+
+
+def _override_get(name: str) -> str | None:
+    layers = getattr(_TLS, "layers", None)
+    if not layers:
+        return None
+    for layer in reversed(layers):
+        if name in layer:
+            return layer[name]
+    return None
+
+
+@contextlib.contextmanager
+def overrides(values: Mapping[str, str]) -> Iterator[None]:
+    """Apply ``values`` as this thread's knob reads until exit.  Every
+    name must be declared (KeyError otherwise — an overlay must not
+    smuggle in what the environment could not).  Layers nest; the
+    innermost value wins.  Raw-string semantics match the environment:
+    '' means "unset -> default" to the typed accessors."""
+    layer = {}
+    for name, value in values.items():
+        layer[knob(name).name] = str(value)
+    layers = getattr(_TLS, "layers", None)
+    if layers is None:
+        layers = []
+        _TLS.layers = layers
+    layers.append(layer)
+    try:
+        yield
+    finally:
+        layers.pop()
+
+
 def get_raw(name: str) -> str | None:
-    """The raw env value (None when unset, '' preserved) — for call sites
+    """The raw value (None when unset, '' preserved) — for call sites
     with historical alias/empty-string semantics the typed accessors do
-    not model.  The name must still be declared."""
-    return os.environ.get(knob(name).name)
+    not model.  The name must still be declared.  An active thread-local
+    ``overrides()`` layer wins over os.environ."""
+    k = knob(name)
+    ov = _override_get(k.name)
+    if ov is not None:
+        return ov
+    return os.environ.get(k.name)
 
 
 def is_set(name: str) -> bool:
     """True when the var is present AND non-empty (flag semantics)."""
-    return bool(os.environ.get(knob(name).name))
+    return bool(get_raw(name))
 
 
 def get_str(name: str) -> str:
     k = knob(name)
-    raw = os.environ.get(k.name)
+    raw = get_raw(name)
     return k.default if raw is None or raw == "" else raw
 
 

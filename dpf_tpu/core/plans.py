@@ -8,8 +8,9 @@ the shape space down to a small closed set of **plans** so steady-state
 serving never traces:
 
   * a plan is keyed on ``(route, profile, log_n, K-bucket, Q-bucket,
-    packed, fuse, sbox)`` — everything that selects a distinct compiled
-    executable.  K is bucketed to powers of two (requests pad up with
+    packed, fuse, sbox, mesh, tuned)`` — everything that selects a
+    distinct compiled executable (``tuned`` is the canonical tag of the
+    per-plan knob overlay from docs/TUNED.json; see below).  K is bucketed to powers of two (requests pad up with
     zero keys and slice the padding back off — "pad + mask"), Q to
     power-of-two multiples of 32 (the packed-word quantum), so the
     number of live traces is logarithmic in the request-shape space.
@@ -33,6 +34,19 @@ XLA may reuse their buffers in place.  ``off`` / ``auto`` / ``on``;
 ``auto`` donates on TPU and stays off elsewhere (CPU XLA may decline
 the aliasing hint with a warning).
 
+Tuned per-plan defaults (``DPF_TPU_TUNED``): every ``run_*`` dispatch
+resolves its (route, profile, log_n, K-bucket) against the committed
+``docs/TUNED.json`` table (``dpf_tpu/tune/tuned.py``) and runs under
+that config as a thread-local ``knobs.overrides`` overlay — so the
+autotuner's winners (fuse group size per scale, walk backend, donation)
+apply per-plan rather than process-globally, and a knob the operator
+sets in the environment still wins for every shape the table does not
+cover.  The tag rides in ``PlanKey.tuned`` and round-trips through
+``recent_shapes``/``warmup``, so the breaker's re-warm replays each
+plan's ORIGINAL config (never a recompile from a config flip) and
+tuned/untuned executables never collide.  Tuning changes speed, never
+bytes: outputs are identical by construction and pinned by test.
+
 Mesh-native dispatch (``DPF_TPU_MESH``): when the serving mesh is
 resolved (``parallel/serving_mesh.py``), every ``run_*`` body lands on
 the shard_map evaluators in ``parallel/sharding.py`` instead of the
@@ -48,6 +62,7 @@ single-device twins, byte-identically.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import NamedTuple
@@ -131,6 +146,7 @@ class PlanKey(NamedTuple):
     fuse: str  # DPF_TPU_FUSE in force (expansion routes)
     sbox: str  # active S-box schedule (compat cipher routes)
     mesh: int = 0  # serving-mesh shard count (0 = single-device)
+    tuned: str = ""  # canonical tuned-config tag ("" = registry defaults)
 
 
 def plan_key(
@@ -154,7 +170,112 @@ def plan_key(
         knobs.get_str("DPF_TPU_FUSE"),
         sbox_circuit.active_sbox(),
         int(mesh),
+        _tuned_tag(),
     )
+
+
+# ---------------------------------------------------------------------------
+# Tuned per-plan defaults (DPF_TPU_TUNED / docs/TUNED.json)
+# ---------------------------------------------------------------------------
+
+# Thread-local tuned-dispatch state: ``tag`` is the canonical config tag
+# plan_key stamps into the key of the dispatch currently in flight on
+# this thread; ``forced`` pins an explicit config (the re-warm path and
+# the tuner's measurement loops) over table resolution.
+_TUNED = threading.local()
+
+
+def _tuned_tag() -> str:
+    return getattr(_TUNED, "tag", "")
+
+
+def _resolve_tuned(
+    route: str, profile: str, log_n: int, kb_val: int
+) -> dict[str, str]:
+    """The tuned knob config this dispatch should run under ({} = the
+    registry defaults).  Mode semantics (DPF_TPU_TUNED): ``off`` never
+    consults the table; ``on`` applies any valid table; ``auto`` (the
+    default) applies only DEVICE-measured tables, and only on TPU — a
+    sim-backend TUNED.json (CPU CI round-trip artifact) can steer a
+    real device only by explicit opt-in."""
+    mode = knobs.get_enum("DPF_TPU_TUNED")
+    if mode == "off":
+        return {}
+    from ..tune import tuned as tuned_defaults
+
+    table = tuned_defaults.table()
+    if table is None:
+        return {}
+    if mode == "auto":
+        if table.backend != "device":
+            return {}
+        import jax
+
+        if jax.default_backend() != "tpu":
+            return {}
+    return table.lookup(route, profile, log_n, kb_val)
+
+
+@contextlib.contextmanager
+def forced_tuned(config):
+    """Pin the tuned config for every plan dispatch on this thread:
+    ``{}`` forces untuned, a dict forces exactly that overlay, ``None``
+    restores normal table resolution.  Used by ``warmup`` (so a re-warm
+    replays each plan's ORIGINAL config) and by the tuner's measurement
+    loop (so a candidate config steers exactly one dispatch path)."""
+    prev = getattr(_TUNED, "forced", None)
+    _TUNED.forced = dict(config) if config is not None else None
+    try:
+        yield
+    finally:
+        _TUNED.forced = prev
+
+
+@contextlib.contextmanager
+def _tuned_dispatch(route, profile, log_n, k, mesh=0):
+    """Resolve + apply the tuned config of ONE dispatch: every ``run_*``
+    body runs inside this, so the tuned overlay steers every knob read
+    on the dispatch path (fuse selection, backend picks, donation) and
+    ``plan_key`` stamps the tag — tuned and untuned executables never
+    share a plan."""
+    forced = getattr(_TUNED, "forced", None)
+    if forced is not None:
+        config = forced
+    else:
+        config = _resolve_tuned(
+            route, profile, int(log_n),
+            _pow2_bucket(k, max(k_floor(), int(mesh) or 1)),
+        )
+    prev = getattr(_TUNED, "tag", "")
+    if not config:
+        _TUNED.tag = ""
+        try:
+            yield
+        finally:
+            _TUNED.tag = prev
+        return
+    from ..tune import tuned as tuned_defaults
+
+    _TUNED.tag = tuned_defaults.canonical_tag(config)
+    try:
+        with knobs.overrides(config):
+            yield
+    finally:
+        _TUNED.tag = prev
+
+
+def _spec_tuned(spec: dict):
+    """Warmup-spec tuned pin: a spec carrying ``"tuned"`` (the tag
+    recorded by ``recent_shapes``) re-warms under exactly that config —
+    including ``""`` = untuned — so a breaker half-open trial lands on
+    the SAME executable the plan was first compiled with even if the
+    tuned table or a knob changed while the circuit was open.  Specs
+    without the key resolve normally (tuned defaults apply at warmup)."""
+    if "tuned" not in spec:
+        return contextlib.nullcontext()
+    from ..tune import tuned as tuned_defaults
+
+    return forced_tuned(tuned_defaults.parse_tag(str(spec["tuned"])))
 
 
 def _dispatch_mesh():
@@ -213,10 +334,12 @@ class PlanCache:
     def stats(self) -> dict:
         with self._lock:
             plans = [p.as_dict() for p in self._plans.values()]
+            tuned_plans = sum(1 for p in self._plans if p.tuned)
         return {
             "plans": plans,
             "hits": sum(p["hits"] for p in plans),
             "misses": sum(p["misses"] for p in plans),
+            "tuned_plans": tuned_plans,
             "trace_cache_entries": trace_count(),
         }
 
@@ -347,37 +470,41 @@ def run_points(route: str, profile: str, kb, xs: np.ndarray) -> np.ndarray:
     xs = np.asarray(xs, dtype=np.uint64)
     K, Q = xs.shape
     mesh, n_shards = _dispatch_mesh()
-    key = plan_key(route, profile, kb.log_n, K, Q, packed=True, mesh=n_shards)
-    plan, first = _CACHE.get(key)
-    obs_trace.add_event(
-        "plan_lookup", hit=not first, route=route,
-        k_bucket=key.k_bucket, q_bucket=key.q_bucket,
-    )
-    t0 = time.perf_counter()
-    kbp = _pad_keys(kb, key.k_bucket - K)
-    # "compute" is the (async) jit dispatch; the asarray below blocks on
-    # the device result, so "d2h" includes the device wait.  The sharded
-    # evaluators marshal their own output (the gather + D2H happens
-    # inside the wrapper), so under the mesh there is no separate d2h
-    # span — emitting a zero-length one would misattribute the transfer.
-    with obs_trace.child_span("compute"):
-        dev = _points_eval(
-            route, profile, kbp,
-            _pad_queries(xs, key.k_bucket, key.q_bucket), mesh,
+    with _tuned_dispatch(route, profile, kb.log_n, K, n_shards):
+        key = plan_key(
+            route, profile, kb.log_n, K, Q, packed=True, mesh=n_shards
         )
-    if mesh is not None:
-        words = dev  # already host words (sharded wrapper marshalled)
-    else:
-        # The packed words leave the device exactly once per dispatch.
-        with obs_trace.child_span("d2h"):
-            # host-sync: final reply marshalling (points route)
-            words = np.asarray(dev)
-    if first:
-        plan.compile_s = time.perf_counter() - t0
-    plan.last_used = time.time()
-    return bitpack.mask_tail(
-        np.ascontiguousarray(words[:K, : bitpack.packed_words(Q)]), Q
-    )
+        plan, first = _CACHE.get(key)
+        obs_trace.add_event(
+            "plan_lookup", hit=not first, route=route,
+            k_bucket=key.k_bucket, q_bucket=key.q_bucket,
+        )
+        t0 = time.perf_counter()
+        kbp = _pad_keys(kb, key.k_bucket - K)
+        # "compute" is the (async) jit dispatch; the asarray below blocks
+        # on the device result, so "d2h" includes the device wait.  The
+        # sharded evaluators marshal their own output (the gather + D2H
+        # happens inside the wrapper), so under the mesh there is no
+        # separate d2h span — emitting a zero-length one would
+        # misattribute the transfer.
+        with obs_trace.child_span("compute"):
+            dev = _points_eval(
+                route, profile, kbp,
+                _pad_queries(xs, key.k_bucket, key.q_bucket), mesh,
+            )
+        if mesh is not None:
+            words = dev  # already host words (sharded wrapper marshalled)
+        else:
+            # The packed words leave the device exactly once per dispatch.
+            with obs_trace.child_span("d2h"):
+                # host-sync: final reply marshalling (points route)
+                words = np.asarray(dev)
+        if first:
+            plan.compile_s = time.perf_counter() - t0
+        plan.last_used = time.time()
+        return bitpack.mask_tail(
+            np.ascontiguousarray(words[:K, : bitpack.packed_words(Q)]), Q
+        )
 
 
 def run_interval(ik, xs: np.ndarray) -> np.ndarray:
@@ -389,61 +516,63 @@ def run_interval(ik, xs: np.ndarray) -> np.ndarray:
     xs = np.asarray(xs, dtype=np.uint64)
     K, Q = xs.shape
     mesh, n_shards = _dispatch_mesh()
-    key = plan_key(
-        "dcf_interval", "fast", upper.log_n, K, Q, packed=True, mesh=n_shards
-    )
-    plan, first = _CACHE.get(key)
-    obs_trace.add_event(
-        "plan_lookup", hit=not first, route="dcf_interval",
-        k_bucket=key.k_bucket, q_bucket=key.q_bucket,
-    )
-    t0 = time.perf_counter()
-    pad = key.k_bucket - K
-    if pad:
-        # The padded triple memoizes on the upper batch so a re-queried
-        # gate set reuses its fused 2K-key device operands.
-        cached = getattr(upper, "_plan_interval_padded", None)
-        if cached is not None and cached[0] is lower and cached[1] == pad:
-            up, lp, cp_ = cached[2]
+    with _tuned_dispatch("dcf_interval", "fast", upper.log_n, K, n_shards):
+        key = plan_key(
+            "dcf_interval", "fast", upper.log_n, K, Q, packed=True,
+            mesh=n_shards,
+        )
+        plan, first = _CACHE.get(key)
+        obs_trace.add_event(
+            "plan_lookup", hit=not first, route="dcf_interval",
+            k_bucket=key.k_bucket, q_bucket=key.q_bucket,
+        )
+        t0 = time.perf_counter()
+        pad = key.k_bucket - K
+        if pad:
+            # The padded triple memoizes on the upper batch so a
+            # re-queried gate set reuses its fused 2K-key device operands.
+            cached = getattr(upper, "_plan_interval_padded", None)
+            if cached is not None and cached[0] is lower and cached[1] == pad:
+                up, lp, cp_ = cached[2]
+            else:
+                up = _pad_keys(upper, pad)
+                lp = _pad_keys(lower, pad)
+                cp_ = np.concatenate(
+                    [np.asarray(const, np.uint8), np.zeros(pad, np.uint8)]
+                )
+                try:
+                    upper._plan_interval_padded = (lower, pad, (up, lp, cp_))
+                except AttributeError:
+                    pass
         else:
-            up = _pad_keys(upper, pad)
-            lp = _pad_keys(lower, pad)
-            cp_ = np.concatenate(
-                [np.asarray(const, np.uint8), np.zeros(pad, np.uint8)]
-            )
-            try:
-                upper._plan_interval_padded = (lower, pad, (up, lp, cp_))
-            except AttributeError:
-                pass
-    else:
-        up, lp, cp_ = upper, lower, const
-    with obs_trace.child_span("compute"):
-        if mesh is not None:
-            from ..parallel.sharding import eval_interval_points_sharded
+            up, lp, cp_ = upper, lower, const
+        with obs_trace.child_span("compute"):
+            if mesh is not None:
+                from ..parallel.sharding import eval_interval_points_sharded
 
-            dev = eval_interval_points_sharded(
-                (up, lp, cp_),
-                _pad_queries(xs, key.k_bucket, key.q_bucket),
-                mesh, packed=True,
-            )
+                dev = eval_interval_points_sharded(
+                    (up, lp, cp_),
+                    _pad_queries(xs, key.k_bucket, key.q_bucket),
+                    mesh, packed=True,
+                )
+            else:
+                dev = dcf.eval_interval_points(
+                    (up, lp, cp_),
+                    _pad_queries(xs, key.k_bucket, key.q_bucket),
+                    packed=True,
+                )
+        if mesh is not None:
+            words = dev  # already host words (sharded wrapper marshalled)
         else:
-            dev = dcf.eval_interval_points(
-                (up, lp, cp_),
-                _pad_queries(xs, key.k_bucket, key.q_bucket),
-                packed=True,
-            )
-    if mesh is not None:
-        words = dev  # already host words (sharded wrapper marshalled)
-    else:
-        with obs_trace.child_span("d2h"):
-            # host-sync: final reply marshalling (interval route)
-            words = np.asarray(dev)
-    if first:
-        plan.compile_s = time.perf_counter() - t0
-    plan.last_used = time.time()
-    return bitpack.mask_tail(
-        np.ascontiguousarray(words[:K, : bitpack.packed_words(Q)]), Q
-    )
+            with obs_trace.child_span("d2h"):
+                # host-sync: final reply marshalling (interval route)
+                words = np.asarray(dev)
+        if first:
+            plan.compile_s = time.perf_counter() - t0
+        plan.last_used = time.time()
+        return bitpack.mask_tail(
+            np.ascontiguousarray(words[:K, : bitpack.packed_words(Q)]), Q
+        )
 
 
 def run_hh_level(profile: str, kb, xs: np.ndarray, level: int) -> np.ndarray:
@@ -466,47 +595,48 @@ def run_hh_level(profile: str, kb, xs: np.ndarray, level: int) -> np.ndarray:
     if K != kb.k:
         raise ValueError("hh: xs first axis must match key batch")
     mesh, n_shards = _dispatch_mesh()
-    key = plan_key(
-        "hh_level", profile, kb.log_n, K, Q, packed=True, mesh=n_shards
-    )
-    plan, first = _CACHE.get(key)
-    obs_trace.add_event(
-        "plan_lookup", hit=not first, route="hh_level",
-        k_bucket=key.k_bucket, q_bucket=key.q_bucket,
-    )
-    t0 = time.perf_counter()
-    kbp = _pad_keys(kb, key.k_bucket - K)
-    if profile == "fast":
-        from ..models.dpf_chacha import eval_points_level_grouped
-    else:
-        from ..models.dpf import eval_points_level_grouped
-    with obs_trace.child_span("compute"):
-        # The grouped levels= path returns host words (the walk bodies
-        # marshal their own packed output) — no separate d2h span here.
-        if mesh is not None:
-            from ..models.dpf import _masked_level_queries
-            from ..parallel import sharding
-
-            masked = _masked_level_queries(
-                _pad_queries(xs, key.k_bucket, key.q_bucket),
-                kb.log_n, (int(level),), 1,
-            )
-            eval_sharded = (
-                sharding.eval_points_sharded_fast if profile == "fast"
-                else sharding.eval_points_sharded
-            )
-            words = eval_sharded(kbp, masked, mesh, packed=True)
+    with _tuned_dispatch("hh_level", profile, kb.log_n, K, n_shards):
+        key = plan_key(
+            "hh_level", profile, kb.log_n, K, Q, packed=True, mesh=n_shards
+        )
+        plan, first = _CACHE.get(key)
+        obs_trace.add_event(
+            "plan_lookup", hit=not first, route="hh_level",
+            k_bucket=key.k_bucket, q_bucket=key.q_bucket,
+        )
+        t0 = time.perf_counter()
+        kbp = _pad_keys(kb, key.k_bucket - K)
+        if profile == "fast":
+            from ..models.dpf_chacha import eval_points_level_grouped
         else:
-            words = eval_points_level_grouped(
-                kbp, _pad_queries(xs, key.k_bucket, key.q_bucket), groups=1,
-                packed=True, levels=(int(level),),
-            )
-    if first:
-        plan.compile_s = time.perf_counter() - t0
-    plan.last_used = time.time()
-    return bitpack.mask_tail(
-        np.ascontiguousarray(words[:K, : bitpack.packed_words(Q)]), Q
-    )
+            from ..models.dpf import eval_points_level_grouped
+        with obs_trace.child_span("compute"):
+            # The grouped levels= path returns host words (the walk bodies
+            # marshal their own packed output) — no separate d2h span.
+            if mesh is not None:
+                from ..models.dpf import _masked_level_queries
+                from ..parallel import sharding
+
+                masked = _masked_level_queries(
+                    _pad_queries(xs, key.k_bucket, key.q_bucket),
+                    kb.log_n, (int(level),), 1,
+                )
+                eval_sharded = (
+                    sharding.eval_points_sharded_fast if profile == "fast"
+                    else sharding.eval_points_sharded
+                )
+                words = eval_sharded(kbp, masked, mesh, packed=True)
+            else:
+                words = eval_points_level_grouped(
+                    kbp, _pad_queries(xs, key.k_bucket, key.q_bucket),
+                    groups=1, packed=True, levels=(int(level),),
+                )
+        if first:
+            plan.compile_s = time.perf_counter() - t0
+        plan.last_used = time.time()
+        return bitpack.mask_tail(
+            np.ascontiguousarray(words[:K, : bitpack.packed_words(Q)]), Q
+        )
 
 
 def run_agg_fold(
@@ -530,39 +660,40 @@ def run_agg_fold(
         raise ValueError("agg: rows must be [R, W]")
     R, W = rows.shape
     mesh, n_shards = _dispatch_mesh()
-    key = plan_key(f"agg_{op}", "agg", 0, R, W * 32, packed=True,
-                   mesh=n_shards)
-    plan, first = _CACHE.get(key)
-    obs_trace.add_event(
-        "plan_lookup", hit=not first, route=f"agg_{op}",
-        k_bucket=key.k_bucket, q_bucket=key.q_bucket,
-    )
-    t0 = time.perf_counter()
-    wb = key.q_bucket // 32
-    rows_p = np.zeros((key.k_bucket, wb), np.uint32)
-    rows_p[:R, :W] = rows
-    carry_p = np.zeros(wb, np.uint32)
-    if carry is not None:
-        carry = np.asarray(carry, dtype=np.uint32)
-        if carry.shape != (W,):
-            raise ValueError("agg: carry must be [W]")
-        carry_p[:W] = carry
-    with obs_trace.child_span("compute"):
-        if mesh is not None:
-            from ..parallel.sharding import fold_rows_sharded
+    with _tuned_dispatch(f"agg_{op}", "agg", 0, R, n_shards):
+        key = plan_key(f"agg_{op}", "agg", 0, R, W * 32, packed=True,
+                       mesh=n_shards)
+        plan, first = _CACHE.get(key)
+        obs_trace.add_event(
+            "plan_lookup", hit=not first, route=f"agg_{op}",
+            k_bucket=key.k_bucket, q_bucket=key.q_bucket,
+        )
+        t0 = time.perf_counter()
+        wb = key.q_bucket // 32
+        rows_p = np.zeros((key.k_bucket, wb), np.uint32)
+        rows_p[:R, :W] = rows
+        carry_p = np.zeros(wb, np.uint32)
+        if carry is not None:
+            carry = np.asarray(carry, dtype=np.uint32)
+            if carry.shape != (W,):
+                raise ValueError("agg: carry must be [W]")
+            carry_p[:W] = carry
+        with obs_trace.child_span("compute"):
+            if mesh is not None:
+                from ..parallel.sharding import fold_rows_sharded
 
-            dev = fold_rows_sharded(
-                op, carry_p, rows_p, mesh, donate=donation_enabled()
-            )
-        else:
-            dev = agg._fold_jit(op, carry_p, rows_p)
-    with obs_trace.child_span("d2h"):
-        # host-sync: final reply marshalling (aggregation carry)
-        out = np.asarray(dev)
-    if first:
-        plan.compile_s = time.perf_counter() - t0
-    plan.last_used = time.time()
-    return np.ascontiguousarray(out[:W])
+                dev = fold_rows_sharded(
+                    op, carry_p, rows_p, mesh, donate=donation_enabled()
+                )
+            else:
+                dev = agg._fold_jit(op, carry_p, rows_p)
+        with obs_trace.child_span("d2h"):
+            # host-sync: final reply marshalling (aggregation carry)
+            out = np.asarray(dev)
+        if first:
+            plan.compile_s = time.perf_counter() - t0
+        plan.last_used = time.time()
+        return np.ascontiguousarray(out[:W])
 
 
 def run_pir(db, kb) -> np.ndarray:
@@ -586,30 +717,33 @@ def run_pir(db, kb) -> np.ndarray:
             f"pir: query domain 2^{kb.log_n} != db domain 2^{db.log_n}"
         )
     n_shards = db.dispatch_shards()
-    # Exact row-bits in the q slot (the DB is fixed — bucketing it would
-    # let two different executables share one plan entry).
-    key = PlanKey(
-        "pir", db.profile, int(db.log_n),
-        _pow2_bucket(K, k_floor()), int(db.row_bytes) * 8, True,
-        knobs.get_str("DPF_TPU_FUSE"), _active_sbox(), int(n_shards),
-    )
-    plan, first = _CACHE.get(key)
-    obs_trace.add_event(
-        "plan_lookup", hit=not first, route="pir",
-        k_bucket=key.k_bucket, q_bucket=key.q_bucket,
-    )
-    t0 = time.perf_counter()
-    kbp = _pad_keys(kb, key.k_bucket - K)
-    srv = db.server(n_shards)
-    with obs_trace.child_span("compute"):
-        # PirServer.answer marshals its own output (the answer rows are
-        # the one D2H) — no separate d2h span, like the sharded routes.
-        rows = srv.answer(kbp)
-    if first:
-        plan.compile_s = time.perf_counter() - t0
-    plan.last_used = time.time()
-    db.note_scan(K, srv.stream_chunks)
-    return np.ascontiguousarray(rows[:K])
+    with _tuned_dispatch("pir", db.profile, db.log_n, K):
+        # Exact row-bits in the q slot (the DB is fixed — bucketing it
+        # would let two different executables share one plan entry).
+        key = PlanKey(
+            "pir", db.profile, int(db.log_n),
+            _pow2_bucket(K, k_floor()), int(db.row_bytes) * 8, True,
+            knobs.get_str("DPF_TPU_FUSE"), _active_sbox(), int(n_shards),
+            _tuned_tag(),
+        )
+        plan, first = _CACHE.get(key)
+        obs_trace.add_event(
+            "plan_lookup", hit=not first, route="pir",
+            k_bucket=key.k_bucket, q_bucket=key.q_bucket,
+        )
+        t0 = time.perf_counter()
+        kbp = _pad_keys(kb, key.k_bucket - K)
+        srv = db.server(n_shards)
+        with obs_trace.child_span("compute"):
+            # PirServer.answer marshals its own output (the answer rows
+            # are the one D2H) — no separate d2h span, like the sharded
+            # routes.
+            rows = srv.answer(kbp)
+        if first:
+            plan.compile_s = time.perf_counter() - t0
+        plan.last_used = time.time()
+        db.note_scan(K, srv.stream_chunks)
+        return np.ascontiguousarray(rows[:K])
 
 
 def _active_sbox() -> str:
@@ -626,37 +760,38 @@ def run_evalfull(profile: str, kb) -> np.ndarray:
     double-buffered pipeline is a latency tool, not a throughput one."""
     K = kb.k
     mesh, n_shards = _dispatch_mesh()
-    key = plan_key(
-        "evalfull", profile, kb.log_n, K, 0, packed=True, mesh=n_shards
-    )
-    plan, first = _CACHE.get(key)
-    obs_trace.add_event(
-        "plan_lookup", hit=not first, route="evalfull",
-        k_bucket=key.k_bucket, q_bucket=0,
-    )
-    t0 = time.perf_counter()
-    kbp = _pad_keys(kb, key.k_bucket - K)
-    with obs_trace.child_span("compute"):
-        if mesh is not None:
-            from ..parallel import sharding
+    with _tuned_dispatch("evalfull", profile, kb.log_n, K, n_shards):
+        key = plan_key(
+            "evalfull", profile, kb.log_n, K, 0, packed=True, mesh=n_shards
+        )
+        plan, first = _CACHE.get(key)
+        obs_trace.add_event(
+            "plan_lookup", hit=not first, route="evalfull",
+            k_bucket=key.k_bucket, q_bucket=0,
+        )
+        t0 = time.perf_counter()
+        kbp = _pad_keys(kb, key.k_bucket - K)
+        with obs_trace.child_span("compute"):
+            if mesh is not None:
+                from ..parallel import sharding
 
-            out = (
-                sharding.eval_full_sharded_fast(kbp, mesh)
-                if profile == "fast"
-                else sharding.eval_full_sharded(kbp, mesh)
-            )
-        elif profile == "fast":
-            from ..models import dpf_chacha
+                out = (
+                    sharding.eval_full_sharded_fast(kbp, mesh)
+                    if profile == "fast"
+                    else sharding.eval_full_sharded(kbp, mesh)
+                )
+            elif profile == "fast":
+                from ..models import dpf_chacha
 
-            out = dpf_chacha.eval_full(kbp)
-        else:
-            from ..models import dpf
+                out = dpf_chacha.eval_full(kbp)
+            else:
+                from ..models import dpf
 
-            out = dpf.eval_full(kbp)
-    if first:
-        plan.compile_s = time.perf_counter() - t0
-    plan.last_used = time.time()
-    return out[:K]
+                out = dpf.eval_full(kbp)
+        if first:
+            plan.compile_s = time.perf_counter() - t0
+        plan.last_used = time.time()
+        return out[:K]
 
 
 # ---------------------------------------------------------------------------
@@ -685,7 +820,10 @@ def warmup(shapes: list[dict]) -> list[dict]:
     (its per-chunk finish executables are distinct compiles from the
     blocking plan's — a deployment serving streamed /v1/evalfull must
     warm them too or the first large streamed request pays the compile).
-    Returns one summary dict per spec (the bucketed key, wall seconds)."""
+    A spec may carry ``"tuned": <tag>`` (``recent_shapes`` always emits
+    it) to pin the exact tuned knob config — ``""`` pins untuned; absent
+    means "resolve tuned defaults normally".  Returns one summary dict
+    per spec (the bucketed key, wall seconds)."""
     out = []
     rng = np.random.default_rng(0)
     for spec in shapes:
@@ -702,104 +840,115 @@ def warmup(shapes: list[dict]) -> list[dict]:
         k = int(spec.get("k", 1))
         q = int(spec.get("q", 32))
         t0 = time.perf_counter()
-        if route == "pir":
-            # One registered-database scan shape ({"route": "pir", "db":
-            # name[, "k": K]}): compiles the expansion + parity-matmul
-            # executables for the CURRENT placement regime AND places the
-            # database words.  log_n/profile come from the registry
-            # entry; an unknown name is a loud KeyError -> 400.
-            from ..apps import pir_store
+        # A spec carrying "tuned" (recent_shapes' re-warm round trip)
+        # pins that exact config; otherwise the run_* bodies resolve
+        # tuned defaults normally — warmup compiles what serving runs.
+        with _spec_tuned(spec):
+            if route == "pir":
+                # One registered-database scan shape ({"route": "pir",
+                # "db": name[, "k": K]}): compiles the expansion +
+                # parity-matmul executables for the CURRENT placement
+                # regime AND places the database words.  log_n/profile
+                # come from the registry entry; an unknown name is a
+                # loud KeyError -> 400.
+                from ..apps import pir_store
 
-            db = pir_store.registry().get(str(spec["db"]))
-            k = int(spec.get("k", 1))
-            kb_count = k_bucket(k)
-            if db.profile == "fast":
-                from ..models.keys_chacha import gen_batch
-            else:
-                from ..core.keys import gen_batch
-
-            kb, _ = gen_batch(
-                np.zeros(kb_count, np.uint64), db.log_n, rng=rng
-            )
-            run_pir(db, kb)
-            out.append(
-                {
-                    "route": "pir",
-                    "profile": db.profile,
-                    "db": db.name,
-                    "log_n": db.log_n,
-                    "k_bucket": kb_count,
-                    "q_bucket": db.row_bytes * 8,
-                    "seconds": round(time.perf_counter() - t0, 3),
-                }
-            )
-            continue
-        kb_count = k_bucket(k)
-        alphas = np.zeros(kb_count, np.uint64)
-        if route in ("agg_xor", "agg_add"):
-            run_agg_fold(
-                route[4:], None,
-                np.zeros((kb_count, max(q_bucket(q) // 32, 1)), np.uint32),
-            )
-        elif route == "hh_level":
-            if profile == "fast":
-                from ..models.keys_chacha import gen_batch
-            else:
-                from ..core.keys import gen_batch
-
-            kb, _ = gen_batch(alphas, log_n, rng=rng)
-            run_hh_level(
-                profile, kb, np.zeros((kb_count, q), np.uint64), 0
-            )
-        elif route == "evalfull":
-            if profile == "fast":
-                from ..models.keys_chacha import gen_batch
-
-                kb, _ = gen_batch(alphas, log_n, rng=rng)
-            else:
-                from ..core.keys import gen_batch
-
-                kb, _ = gen_batch(alphas, log_n, rng=rng)
-            run_evalfull(profile, kb)
-            if spec.get("stream"):
-                # The streaming path is NOT K-bucketed (the sidecar
-                # streams the parsed batch directly), so warm at the
-                # spec's exact K.
-                if profile == "fast":
-                    from ..models.dpf_chacha import eval_full_stream
+                db = pir_store.registry().get(str(spec["db"]))
+                k = int(spec.get("k", 1))
+                kb_count = k_bucket(k)
+                if db.profile == "fast":
+                    from ..models.keys_chacha import gen_batch
                 else:
-                    from ..models.dpf import eval_full_stream
-                kb_s = kb
-                if kb.k != k:
-                    kb_s, _ = gen_batch(
-                        np.zeros(k, np.uint64), log_n, rng=rng
-                    )
-                for _ in eval_full_stream(kb_s):
-                    pass
-        elif route == "dcf_interval":
-            from ..models import dcf
+                    from ..core.keys import gen_batch
 
-            ia, _ = dcf.gen_interval_batch(
-                alphas, alphas, log_n, rng=rng
-            )
-            run_interval(ia, np.zeros((kb_count, q), np.uint64))
-        elif route == "dcf_points":
-            from ..models import dcf
-
-            da, _ = dcf.gen_lt_batch(alphas, log_n, rng=rng)
-            run_points(route, "fast", da, np.zeros((kb_count, q), np.uint64))
-        elif route == "points":
-            if profile == "fast":
-                from ..models.keys_chacha import gen_batch
+                kb, _ = gen_batch(
+                    np.zeros(kb_count, np.uint64), db.log_n, rng=rng
+                )
+                run_pir(db, kb)
+                out.append(
+                    {
+                        "route": "pir",
+                        "profile": db.profile,
+                        "db": db.name,
+                        "log_n": db.log_n,
+                        "k_bucket": kb_count,
+                        "q_bucket": db.row_bytes * 8,
+                        "seconds": round(time.perf_counter() - t0, 3),
+                    }
+                )
+                continue
+            kb_count = k_bucket(k)
+            alphas = np.zeros(kb_count, np.uint64)
+            if route in ("agg_xor", "agg_add"):
+                run_agg_fold(
+                    route[4:], None,
+                    np.zeros(
+                        (kb_count, max(q_bucket(q) // 32, 1)), np.uint32
+                    ),
+                )
+            elif route == "hh_level":
+                if profile == "fast":
+                    from ..models.keys_chacha import gen_batch
+                else:
+                    from ..core.keys import gen_batch
 
                 kb, _ = gen_batch(alphas, log_n, rng=rng)
+                run_hh_level(
+                    profile, kb, np.zeros((kb_count, q), np.uint64), 0
+                )
+            elif route == "evalfull":
+                if profile == "fast":
+                    from ..models.keys_chacha import gen_batch
+
+                    kb, _ = gen_batch(alphas, log_n, rng=rng)
+                else:
+                    from ..core.keys import gen_batch
+
+                    kb, _ = gen_batch(alphas, log_n, rng=rng)
+                run_evalfull(profile, kb)
+                if spec.get("stream"):
+                    # The streaming path is NOT K-bucketed (the sidecar
+                    # streams the parsed batch directly), so warm at the
+                    # spec's exact K.
+                    if profile == "fast":
+                        from ..models.dpf_chacha import eval_full_stream
+                    else:
+                        from ..models.dpf import eval_full_stream
+                    kb_s = kb
+                    if kb.k != k:
+                        kb_s, _ = gen_batch(
+                            np.zeros(k, np.uint64), log_n, rng=rng
+                        )
+                    for _ in eval_full_stream(kb_s):
+                        pass
+            elif route == "dcf_interval":
+                from ..models import dcf
+
+                ia, _ = dcf.gen_interval_batch(
+                    alphas, alphas, log_n, rng=rng
+                )
+                run_interval(ia, np.zeros((kb_count, q), np.uint64))
+            elif route == "dcf_points":
+                from ..models import dcf
+
+                da, _ = dcf.gen_lt_batch(alphas, log_n, rng=rng)
+                run_points(
+                    route, "fast", da, np.zeros((kb_count, q), np.uint64)
+                )
+            elif route == "points":
+                if profile == "fast":
+                    from ..models.keys_chacha import gen_batch
+
+                    kb, _ = gen_batch(alphas, log_n, rng=rng)
+                else:
+                    from ..core.keys import gen_batch
+
+                    kb, _ = gen_batch(alphas, log_n, rng=rng)
+                run_points(
+                    route, profile, kb, np.zeros((kb_count, q), np.uint64)
+                )
             else:
-                from ..core.keys import gen_batch
-
-                kb, _ = gen_batch(alphas, log_n, rng=rng)
-            run_points(route, profile, kb, np.zeros((kb_count, q), np.uint64))
-        else:
-            raise ValueError(f"warmup: unknown route {route!r}")
+                raise ValueError(f"warmup: unknown route {route!r}")
         out.append(
             {
                 "route": route,
@@ -841,6 +990,11 @@ def recent_shapes(limit: int = 4) -> list[dict]:
         }
         if key.q_bucket:
             spec["q"] = key.q_bucket
+        # Always present (possibly ""): the probe's re-warm must replay
+        # the EXACT tuned config the plan was compiled with — "" pins
+        # untuned even if a tuned table appeared while the circuit was
+        # open, so the half-open trial never pays a recompile.
+        spec["tuned"] = key.tuned
         out.append(spec)
     return out
 

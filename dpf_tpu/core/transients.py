@@ -1,0 +1,38 @@
+"""Transient-vs-wedge failure classification — the single source of truth.
+
+A wedged TPU fails every dispatch with the same transport signatures
+(``XlaRuntimeError: UNAVAILABLE``, connection failures, deadline
+expiries).  Three subsystems need to agree on what counts as "the
+environment, not the code":
+
+  * ``serving/breaker.py`` — retry-in-place vs trip-the-circuit,
+  * ``bench_all.py`` — re-measure next attempt vs pin the error row,
+  * ``dpf_tpu/tune`` — abort the sweep with the ledger intact vs record
+    a non-transient error row against the candidate config.
+
+They import from here so the classification can never drift between the
+serving path and the measurement harnesses.  Matched against
+``"TypeName: message"`` text, which is also what the shell-side mirrors
+in ``scripts/tpu_when_up.sh`` grep for.
+"""
+
+from __future__ import annotations
+
+# Substrings that mark a failure as environment-transient.
+TRANSIENT_SIGNATURES = (
+    "UNAVAILABLE",
+    "Connection refused",
+    "Connection Failed",
+    "DEADLINE_EXCEEDED",
+)
+
+
+def is_transient_text(text: str) -> bool:
+    """True when ``text`` carries a transient environment signature."""
+    return any(sig in text for sig in TRANSIENT_SIGNATURES)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """True when ``exc`` carries a transient environment signature
+    (classified on type name + message, like the bench ledger)."""
+    return is_transient_text(f"{type(exc).__name__}: {exc}")

@@ -346,6 +346,18 @@ def render(stats: dict, hists: dict,
         w.sample(f"{ns}_pir_db_bytes_resident", None,
                  pir["db_bytes_resident"])
 
+    tuned = stats.get("tuned")
+    if tuned is not None:
+        w.family(f"{ns}_tuned_configs", "gauge",
+                 "Tuned per-plan configs loaded from docs/TUNED.json "
+                 "(0 = file absent/invalid or DPF_TPU_TUNED gating it "
+                 "off for this backend).")
+        w.sample(f"{ns}_tuned_configs", None, tuned["entries"])
+        w.family(f"{ns}_tuned_plans", "gauge",
+                 "Dispatch plans in the cache compiled under a tuned "
+                 "config — which plans actually run tuned right now.")
+        w.sample(f"{ns}_tuned_plans", None, pl.get("tuned_plans", 0))
+
     mem = device_memory_gauges() if device_mem is None else device_mem
     if mem:
         w.family(f"{ns}_device_memory_bytes", "gauge",

@@ -32,9 +32,10 @@ buffered replies (a dispatch error surfaces as a clean status line, not
 a truncated body).  Both degraded modes are byte-identical to the fast
 path by construction and by differential test.
 
-``TRANSIENT_SIGNATURES`` is the single source of truth for "this failure
-is the environment, not the code" — bench_all's wedge-tolerant ledger
-imports it rather than keeping its own copy.
+``core/transients.py`` is the single source of truth for "this failure
+is the environment, not the code"; ``TRANSIENT_SIGNATURES`` and
+``is_transient`` are re-exported here so serving-layer callers (and the
+bench/tune harnesses' historical import path) keep working.
 """
 
 from __future__ import annotations
@@ -43,29 +44,16 @@ import threading
 import time
 
 from ..core import knobs
+from ..core.transients import (  # noqa: F401 — re-exported compat names
+    TRANSIENT_SIGNATURES,
+    is_transient,
+)
 from ..obs import trace as obs_trace
 from .errors import OverloadedError
-
-# Substrings that mark an exception as environment-transient — the same
-# signatures bench_all's ledger treats as wedge verdicts (re-measure, do
-# not pin).  Matched against "TypeName: message".
-TRANSIENT_SIGNATURES = (
-    "UNAVAILABLE",
-    "Connection refused",
-    "Connection Failed",
-    "DEADLINE_EXCEEDED",
-)
 
 _RETRY_BACKOFF_CAP_S = 1.0
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
-
-
-def is_transient(exc: BaseException) -> bool:
-    """True when ``exc`` carries a transient environment signature
-    (classified on type name + message, like the bench ledger)."""
-    text = f"{type(exc).__name__}: {exc}"
-    return any(sig in text for sig in TRANSIENT_SIGNATURES)
 
 
 class CircuitBreaker:

@@ -493,6 +493,7 @@ class _ServingState:
         cannot drift."""
         from ..apps import pir_store
         from ..parallel import serving_mesh
+        from ..tune import tuned
 
         with self.stats_lock:
             out = {
@@ -506,6 +507,7 @@ class _ServingState:
                 "trace": self.tracer.stats(),
                 "mesh": serving_mesh.stats(),
                 "pir": pir_store.registry().stats(),
+                "tuned": tuned.stats(),
                 "wire": {k: dict(v) for k, v in self.wire.items()},
             }
         plan = faults.active()

@@ -1,0 +1,34 @@
+"""On-hardware autotuner: wedge-tolerant knob search over dispatch plans.
+
+The registry declares 50+ ``DPF_TPU_*`` knobs; the ones that matter for
+throughput (fuse group size, walk backend, donation, PIR chunk rows)
+interact with shape — the right ``DPF_TPU_FUSE`` at ``log_n=14`` is not
+the right one at ``log_n=22`` — and the hardware windows that could
+settle them keep dying to wedged tunnels.  This package closes the loop
+the way ``bench_all.py`` survives the same windows: measure every
+candidate through the SAME dispatch paths ``core/plans.py`` serves
+(plan-cache warm, zero-retrace timing loops, transient classification
+from ``core/transients.py``), journal every measurement into a
+resumable sweep ledger so a wedge mid-sweep loses at most the in-flight
+config, and persist winners as committed per-plan defaults in
+``docs/TUNED.json`` — which ``core/plans.py`` consults at
+warmup/``plan_key`` time (``DPF_TPU_TUNED``), so tuned defaults apply
+per (route, profile, log_n, K-bucket) plan rather than process-globally.
+
+Modules:
+
+  * ``space``   — the declared search space: which knobs are tunable
+                  per (route, profile), with closed value sets.
+  * ``ledger``  — the shared resumable JSONL section ledger (also used
+                  by ``bench_all.py``) + git tree-identity stamps.
+  * ``measure`` — measurement backends: ``DeviceBackend`` times real
+                  dispatches; ``SimBackend`` is the deterministic
+                  synthetic cost surface CPU CI searches against.
+  * ``driver``  — the sweep loop: enumerate configs, resume from the
+                  ledger, stop cleanly on budget, pick winners.
+  * ``tuned``   — ``docs/TUNED.json`` schema, validation, provenance,
+                  and the cached lookup table ``core/plans.py`` reads.
+
+CLI: ``python -m dpf_tpu.tune --help`` (``scripts/tpu_when_up.sh`` runs
+it as the autotune step of a hardware window).
+"""

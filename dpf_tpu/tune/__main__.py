@@ -1,0 +1,194 @@
+"""CLI for the autotuner sweep.
+
+CPU smoke (deterministic, no hardware)::
+
+    python -m dpf_tpu.tune --backend sim \\
+        --routes points,evalfull,agg_xor --ledger /tmp/tune.jsonl
+
+Hardware window (what scripts/tpu_when_up.sh runs)::
+
+    python -m dpf_tpu.tune --backend device \\
+        --routes evalfull,points --log-n 14,18 --k 128 \\
+        --ledger logs/tune_ledger.jsonl --write-tuned
+
+Emits one JSON line per measurement (bench-style), then a summary
+line.  ``--write-tuned`` persists the winners as docs/TUNED.json
+(``--allow-sim`` is required to write a sim-backend file — its
+provenance marks it ``backend: sim`` so ``DPF_TPU_TUNED=auto`` never
+applies it on a real device).  Exit status: 0 on a complete sweep,
+3 on a wedge or exhausted budget (partial — ledger intact, resume
+later), 2 on bad arguments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import driver, space
+from .measure import DeviceBackend, SimBackend, SweepPoint
+
+
+def _points_from_args(args) -> list[SweepPoint]:
+    points = []
+    for route in args.routes.split(","):
+        route = route.strip()
+        if not route:
+            continue
+        available = space.profiles_for(route)  # ValueError on unknown
+        wanted = [p.strip() for p in args.profiles.split(",") if p.strip()]
+        profiles = [p for p in wanted if p in available] or list(available)
+        for profile in profiles:
+            for log_n in (int(n) for n in args.log_n.split(",")):
+                for k in (int(k) for k in args.k.split(",")):
+                    from ..core import plans
+
+                    points.append(
+                        SweepPoint(
+                            route, profile,
+                            0 if route.startswith("agg_") else log_n,
+                            plans.k_bucket(k),
+                        )
+                    )
+    # agg routes ignore log_n; collapsing duplicates keeps the sweep
+    # from measuring the same (route, profile, 0, K) once per log_n.
+    seen: set[SweepPoint] = set()
+    out = []
+    for p in points:
+        if p not in seen:
+            seen.add(p)
+            out.append(p)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dpf_tpu.tune",
+        description="wedge-tolerant knob search over dispatch plans",
+    )
+    ap.add_argument(
+        "--backend", choices=("sim", "device"), default="sim",
+        help="sim = deterministic synthetic surface (CPU CI); "
+        "device = time real plan dispatches",
+    )
+    ap.add_argument(
+        "--routes", default="points,evalfull,agg_xor",
+        help="comma-separated plan routes to tune",
+    )
+    ap.add_argument(
+        "--profiles", default="compat,fast,agg",
+        help="profiles to tune per route (filtered to each route's "
+        "tunable set)",
+    )
+    ap.add_argument("--log-n", default="14", help="comma-separated domains")
+    ap.add_argument(
+        "--k", default="8", help="comma-separated key counts (bucketed)"
+    )
+    ap.add_argument(
+        "--ledger", default="",
+        help="resumable sweep-ledger path (empty = no persistence)",
+    )
+    ap.add_argument(
+        "--ledger-key", default="",
+        help="pin the ledger identity (tests; otherwise git tree hashes)",
+    )
+    ap.add_argument(
+        "--budget-s", type=float, default=None,
+        help="wall-clock budget (default DPF_TPU_TUNE_BUDGET_S)",
+    )
+    ap.add_argument(
+        "--trials", type=int, default=None,
+        help="config cap per point (default DPF_TPU_TUNE_TRIALS)",
+    )
+    ap.add_argument(
+        "--margin", type=float, default=driver.DEFAULT_MARGIN_MIN,
+        help="minimum fractional win over the default to crown an entry",
+    )
+    ap.add_argument(
+        "--seed", type=int, default=0,
+        help="sim-surface / enumeration-order seed",
+    )
+    ap.add_argument(
+        "--write-tuned", nargs="?", const="", default=None,
+        metavar="PATH",
+        help="write winners as a TUNED.json (default path: "
+        "DPF_TPU_TUNED_PATH); only a COMPLETE sweep may write",
+    )
+    ap.add_argument(
+        "--allow-sim", action="store_true",
+        help="permit --write-tuned from the sim backend (CI round-trip "
+        "tests; auto mode never applies sim files to hardware)",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        points = _points_from_args(args)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not points:
+        print("error: no sweep points selected", file=sys.stderr)
+        return 2
+    if args.backend == "sim":
+        backend = SimBackend(seed=args.seed)
+    else:
+        backend = DeviceBackend()
+
+    def emit(rec: dict) -> None:
+        print(json.dumps(rec), flush=True)
+
+    outcome = driver.run_sweep(
+        points, backend,
+        ledger_path=args.ledger, key_override=args.ledger_key,
+        budget_s=args.budget_s, trials=args.trials, seed=args.seed,
+        emit=emit,
+    )
+    entries = driver.pick_winners(outcome, margin_min=args.margin)
+    emit({
+        "summary": True,
+        "points": len(points),
+        "measured": outcome.measured,
+        "replayed": outcome.replayed,
+        "complete": outcome.complete,
+        "wedged": outcome.wedged,
+        "winners": len(entries),
+    })
+
+    if args.write_tuned is not None:
+        from . import ledger as lg
+        from . import tuned
+
+        if not outcome.complete:
+            print(
+                "not writing TUNED.json: sweep incomplete "
+                "(wedge/budget) — resume against the same ledger first",
+                file=sys.stderr,
+            )
+            return 3
+        if args.backend == "sim" and not args.allow_sim:
+            print(
+                "refusing to write a sim-backend TUNED.json without "
+                "--allow-sim (synthetic winners are for testing the "
+                "pipeline, not for steering hardware)",
+                file=sys.stderr,
+            )
+            return 2
+        path = args.write_tuned or tuned.default_path()
+        head = args.ledger_key or lg.tree_head(
+            tuned.repo_root(), ["dpf_tpu"]
+        )
+        doc = tuned.build_doc(entries, args.backend, head)
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(
+            f"wrote {len(entries)} tuned entr"
+            f"{'y' if len(entries) == 1 else 'ies'} -> {path}",
+            file=sys.stderr,
+        )
+    return 0 if outcome.complete else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

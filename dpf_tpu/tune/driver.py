@@ -1,0 +1,216 @@
+"""The sweep driver: enumerate, resume, measure, stop cleanly, crown.
+
+One sweep = a list of :class:`~dpf_tpu.tune.measure.SweepPoint` (plan
+shape buckets) x the declared config space of each point's (route,
+profile).  Every (point, config) measurement is one ledger SECTION —
+recorded the moment it completes, replayed (not re-measured) on the
+next run under the same identity key.  The failure discipline mirrors
+bench_all.py exactly:
+
+  * transient signature (:class:`WedgeAbort`) — the environment died;
+    stop the whole sweep, ledger intact, nothing recorded for the
+    in-flight config.  The next hardware window resumes there.
+  * non-transient error — the CANDIDATE is broken; an error row is
+    recorded against it and the sweep moves on.  Error rows are never
+    winners.
+  * budget exceeded (``DPF_TPU_TUNE_BUDGET_S``) — stop cleanly BETWEEN
+    configs; the outcome says so and the ledger resumes later.
+
+Winners (:func:`pick_winners`) must beat the measured DEFAULT config of
+their point by ``margin_min`` (default 3%) — a tuned entry that merely
+ties the default is noise that would churn docs/TUNED.json forever.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import time
+from typing import Callable, Mapping
+
+from ..core import knobs
+from . import ledger, space
+from .measure import SweepPoint, WedgeAbort
+from .tuned import canonical_tag
+
+DEFAULT_MARGIN_MIN = 0.03
+
+
+def configs_for(
+    point: SweepPoint, trials: int = 0, seed: int = 0
+) -> list[dict[str, str]]:
+    """The candidate configs measured at ``point``, in deterministic
+    order: the registry default first (the baseline winners must beat),
+    then the cartesian product of the axes, hash-ordered so a
+    ``trials`` cap keeps a stable, spread sample instead of a prefix of
+    one axis."""
+    axes = space.axes_for(point.route, point.profile)
+    default = space.default_config(point.route, point.profile)
+    combos: list[dict[str, str]] = [{}]
+    for ax in axes:
+        combos = [
+            {**c, ax.knob: v} for c in combos for v in ax.values
+        ]
+    default_tag = canonical_tag(default)
+    rest = [c for c in combos if canonical_tag(c) != default_tag]
+    rest.sort(
+        key=lambda c: hashlib.sha256(
+            f"{seed}/{point.section()}/{canonical_tag(c)}".encode()
+        ).hexdigest()
+    )
+    out = [default] + rest
+    if trials and trials > 0:
+        out = out[: max(int(trials), 1)]
+    return out
+
+
+def sweep_key(backend_name: str, key_override: str = "") -> dict:
+    """Ledger identity of one sweep: the measured tree, the backend, the
+    declared space, and the route-affecting environment (tuned overlays
+    are thread-local and deliberately absent — ``knobs.snapshot`` is
+    env-only)."""
+    head = key_override or ledger.tree_head(
+        os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ),
+        ["dpf_tpu"],
+    )
+    return {
+        "kind": "dpf-tune",
+        "head": head,
+        "backend": backend_name,
+        "space": space.space_digest(),
+        "knobs": knobs.snapshot(space.tunable_knobs()),
+    }
+
+
+@dataclasses.dataclass
+class SweepOutcome:
+    """What one driver run did: per-section rows (replayed + fresh),
+    and why it stopped."""
+
+    rows: dict[str, dict]  # section -> row (config + measurement)
+    points: list[SweepPoint]
+    complete: bool = True
+    wedged: str = ""  # transient text when a wedge stopped the sweep
+    measured: int = 0  # live measurements this run
+    replayed: int = 0  # sections replayed from the ledger
+
+
+def _section(point: SweepPoint, config: Mapping[str, str]) -> str:
+    return f"{point.section()}::{canonical_tag(config)}"
+
+
+def run_sweep(
+    points: list[SweepPoint],
+    backend,
+    *,
+    ledger_path: str = "",
+    key_override: str = "",
+    budget_s: float | None = None,
+    trials: int | None = None,
+    seed: int = 0,
+    emit: Callable[[dict], None] | None = None,
+) -> SweepOutcome:
+    """Measure every (point, config) not already in the ledger.  Returns
+    the full row map (stored + fresh) — never raises for wedges or
+    budget expiry; inspect ``wedged``/``complete``."""
+    if budget_s is None:
+        budget_s = knobs.get_float("DPF_TPU_TUNE_BUDGET_S")
+    if trials is None:
+        trials = knobs.get_int("DPF_TPU_TUNE_TRIALS")
+    key = sweep_key(getattr(backend, "name", "unknown"), key_override)
+    stored: dict[str, list] = {}
+    if ledger_path:
+        loaded = ledger.load(ledger_path, key)
+        if loaded is None:
+            ledger.start_fresh(ledger_path, key)
+        else:
+            stored = loaded
+    outcome = SweepOutcome(rows={}, points=list(points))
+    t_start = time.monotonic()
+    for point in points:
+        for config in configs_for(point, trials=trials, seed=seed):
+            section = _section(point, config)
+            if section in stored and stored[section]:
+                row = dict(stored[section][0])
+                outcome.rows[section] = row
+                outcome.replayed += 1
+                if emit is not None:
+                    emit({"section": section, "replayed": True, **row})
+                continue
+            if budget_s and time.monotonic() - t_start > budget_s:
+                outcome.complete = False
+                if emit is not None:
+                    emit({
+                        "budget_exhausted": True,
+                        "budget_s": budget_s,
+                        "next": section,
+                    })
+                return outcome
+            try:
+                row = dict(backend.measure(point, config))
+            except WedgeAbort as e:
+                # The environment died, not the candidate: nothing is
+                # recorded for the in-flight config, the ledger keeps
+                # every completed one, and the next window resumes here.
+                outcome.complete = False
+                outcome.wedged = str(e)
+                if emit is not None:
+                    emit({"wedge": str(e), "in_flight": section})
+                return outcome
+            row["point"] = point.section()
+            row["config"] = dict(config)
+            outcome.rows[section] = row
+            outcome.measured += 1
+            if ledger_path:
+                ledger.append(ledger_path, section, [row])
+            if emit is not None:
+                emit({"section": section, **row})
+    return outcome
+
+
+def pick_winners(
+    outcome: SweepOutcome, margin_min: float = DEFAULT_MARGIN_MIN
+) -> list[dict]:
+    """TUNED.json entries from a sweep: per point, the best error-free
+    non-retracing config, IF it differs from the default and beats the
+    default's measured time by ``margin_min``.  Points whose default
+    config has no clean measurement yield nothing (no baseline, no
+    crown)."""
+    entries = []
+    for point in outcome.points:
+        default_tag = canonical_tag(
+            space.default_config(point.route, point.profile)
+        )
+        candidates: list[tuple[float, str, dict]] = []
+        default_s = None
+        for section, row in outcome.rows.items():
+            if not section.startswith(point.section() + "::"):
+                continue
+            if "error" in row or row.get("retraces") or "seconds" not in row:
+                continue
+            tag = section.split("::", 1)[1]
+            candidates.append((float(row["seconds"]), tag, row))
+            if tag == default_tag:
+                default_s = float(row["seconds"])
+        if default_s is None or not candidates:
+            continue
+        best_s, best_tag, best_row = min(candidates)
+        if best_tag == default_tag:
+            continue
+        margin = (default_s - best_s) / default_s
+        if margin < margin_min:
+            continue
+        entries.append({
+            "route": point.route,
+            "profile": point.profile,
+            "log_n": point.log_n,
+            "k_bucket": point.k_bucket,
+            "config": dict(best_row["config"]),
+            "margin": round(margin, 4),
+            "default_s": round(default_s, 9),
+            "best_s": round(best_s, 9),
+        })
+    return entries

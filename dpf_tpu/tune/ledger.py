@@ -1,0 +1,107 @@
+"""Resumable JSONL section ledger — shared by bench_all.py and the tuner.
+
+The format that let the bench matrix survive wedge-shortened hardware
+windows, extracted so the autotuner's sweep gets the identical
+guarantees instead of a reimplementation that drifts:
+
+  line 1   the identity KEY (one JSON dict: tree hashes + knobs + scale
+           — whatever the caller says must match for stored rows to be
+           replayable).  Any mismatch discards the file wholesale; stale
+           rows must never masquerade as current-code measurements.
+  line 2+  one ``{"section": name, "rows": [...]}`` record per COMPLETED
+           section, appended the moment the section finishes.
+
+A process killed mid-append leaves a torn last line; loading tolerates
+it (the prefix is kept), so an interrupted run loses at most the
+section that was in flight.  All I/O is best-effort: a read-only disk
+degrades to "no persistence", never to a crashed measurement run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+
+
+def tree_head(repo: str, paths: list[str]) -> str:
+    """Git identity of the measured code: comma-joined tree hashes of
+    ``paths`` at HEAD, marked never-matching (``+dirty@<ns>`` /
+    ``unknown@<ns>``) while any of it has uncommitted edits or the repo
+    is not a git checkout."""
+    try:
+        rp = subprocess.run(
+            ["git", "rev-parse"] + [f"HEAD:{p}" for p in paths],
+            cwd=repo, capture_output=True, text=True, timeout=10,
+        )
+        st = subprocess.run(
+            ["git", "status", "--porcelain", "--"] + paths,
+            cwd=repo, capture_output=True, text=True, timeout=10,
+        )
+        if rp.returncode or st.returncode:  # non-git deploy: never match
+            raise RuntimeError(rp.stderr or st.stderr)
+        head = rp.stdout.strip().replace("\n", ",")
+        if st.stdout.strip():
+            head += f"+dirty@{time.time_ns()}"
+        return head
+    except Exception:  # noqa: BLE001 — identity capture is best-effort
+        return f"unknown@{time.time_ns()}"
+
+
+def file_digest(path: str) -> str:
+    """Short content digest of ``path`` ("absent" when unreadable) — how
+    a derived artifact (docs/TUNED.json) enters a ledger key without
+    parsing it."""
+    import hashlib
+
+    try:
+        with open(path, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        return "absent"
+
+
+def load(path: str, key: dict) -> dict[str, list] | None:
+    """Stored sections when the file's first line equals ``key``; None
+    when the file is absent, unreadable, or keyed differently (the
+    caller starts fresh).  A torn tail (killed mid-append) keeps the
+    intact prefix."""
+    lines = []
+    try:
+        with open(path) as f:
+            for ln in f:
+                if not ln.strip():
+                    continue
+                try:
+                    lines.append(json.loads(ln))
+                except ValueError:
+                    break  # torn tail: keep the prefix
+    except OSError:
+        return None
+    if not lines or lines[0] != key:
+        return None
+    out: dict[str, list] = {}
+    for rec in lines[1:]:
+        if isinstance(rec, dict) and "section" in rec and "rows" in rec:
+            out[rec["section"]] = rec["rows"]
+    return out
+
+
+def start_fresh(path: str, key: dict) -> None:
+    """Truncate the ledger to just the key line (best-effort)."""
+    try:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(json.dumps(key) + "\n")
+    except OSError:
+        pass  # best-effort: run without persistence
+
+
+def append(path: str, section: str, rows: list) -> None:
+    """Record one COMPLETED section (best-effort append)."""
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps({"section": section, "rows": rows}) + "\n")
+    except OSError:
+        pass  # best-effort: the run must keep producing rows

@@ -1,0 +1,214 @@
+"""Measurement backends for the tuner sweep.
+
+``DeviceBackend`` times real plan-cached dispatches — the same
+``core/plans.run_*`` entrypoints serving traffic rides, under
+``plans.forced_tuned(config)`` so the candidate config steers exactly
+what a tuned plan would: warm once (the compile), then best-of timed
+calls that must not retrace (the growth is recorded on the row).  A
+failure with a transient signature (``core/transients.py`` — shared
+with the circuit breaker and bench ledger) raises :class:`WedgeAbort`:
+the sweep stops with the ledger intact and the next hardware window
+resumes at the in-flight config.  A non-transient failure (a config the
+backend genuinely cannot lower) is an ERROR ROW against that candidate
+— recorded, never a winner, never retried.
+
+``SimBackend`` is the deterministic synthetic cost surface CPU CI
+searches against: pure hash arithmetic, no jax, a unique argmin per
+sweep point.  It exists so search logic, resume semantics, and the
+TUNED.json round trip are fully testable without hardware — and its
+provenance marks the file ``backend: sim`` so ``DPF_TPU_TUNED=auto``
+never lets synthetic winners steer a real device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+from typing import Callable, Mapping
+
+from . import space
+
+
+class WedgeAbort(RuntimeError):
+    """The environment died under the sweep (transient signature) — stop
+    cleanly, keep the ledger, resume next window."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepPoint:
+    """One tuning granule: exactly a plan-cache shape bucket."""
+
+    route: str
+    profile: str
+    log_n: int
+    k_bucket: int
+
+    def section(self) -> str:
+        return (
+            f"{self.route}/{self.profile}/n{self.log_n}/k{self.k_bucket}"
+        )
+
+
+def _h(text: str) -> int:
+    return int.from_bytes(hashlib.sha256(text.encode()).digest()[:8], "big")
+
+
+class SimBackend:
+    """Deterministic synthetic cost surface.
+
+    Per (seed, point, axis) a hash picks the axis's ideal value index;
+    cost grows linearly with distance from the ideal on every axis, plus
+    a config-unique jitter orders of magnitude below one step — so the
+    argmin is unique, deterministic, and independently computable by
+    tests (:func:`SimBackend.ideal_config`).  ``fail_after=N`` makes the
+    N+1-th measurement die with a transient signature — the simulated
+    mid-sweep wedge the resume tests kill the driver with."""
+
+    name = "sim"
+
+    def __init__(self, seed: int = 0, fail_after: int | None = None):
+        self.seed = int(seed)
+        self.fail_after = fail_after
+        self.measured = 0  # live measurements performed (not replays)
+
+    def ideal_config(self, point: SweepPoint) -> dict[str, str]:
+        """The surface's unique argmin at ``point`` — what a converged
+        search must find."""
+        out = {}
+        for ax in space.axes_for(point.route, point.profile):
+            ideal = _h(f"{self.seed}/{point.section()}/{ax.knob}")
+            out[ax.knob] = ax.values[ideal % len(ax.values)]
+        return out
+
+    def measure(
+        self, point: SweepPoint, config: Mapping[str, str]
+    ) -> dict:
+        if self.fail_after is not None and self.measured >= self.fail_after:
+            raise WedgeAbort(
+                "UNAVAILABLE: injected sim wedge "
+                f"(fail_after={self.fail_after})"
+            )
+        self.measured += 1
+        axes = space.axes_for(point.route, point.profile)
+        base = 1e-3 * (
+            1.0 + 0.1 * point.log_n + 0.01 * point.k_bucket.bit_length()
+        )
+        cost = base
+        for ax in axes:
+            ideal = _h(f"{self.seed}/{point.section()}/{ax.knob}") % len(
+                ax.values
+            )
+            chosen = ax.values.index(
+                str(config.get(ax.knob, ax.values[0]))
+            )
+            cost += base * 0.25 * abs(chosen - ideal)
+        from .tuned import canonical_tag
+
+        jitter = _h(f"{self.seed}/{point.section()}/{canonical_tag(config)}")
+        cost += base * 1e-6 * (jitter % 997) / 997.0
+        return {"seconds": cost, "reps": 3, "method": "sim"}
+
+
+class DeviceBackend:
+    """Times real plan-cached dispatches on whatever backend jax
+    resolved (TPU in a hardware window; CPU works too, just slowly)."""
+
+    name = "device"
+
+    def __init__(self, reps: int = 3):
+        self.reps = max(int(reps), 1)
+        self.measured = 0
+        self._fns: dict[SweepPoint, Callable[[], object]] = {}
+
+    # -- input construction (mirrors plans.warmup, deterministic) -----------
+
+    def _fn(self, point: SweepPoint) -> Callable[[], object]:
+        """A zero-arg dispatch closure for ``point``; inputs built once
+        and reused across every candidate config, so timing differences
+        come from the config, not operand churn."""
+        fn = self._fns.get(point)
+        if fn is not None:
+            return fn
+        import numpy as np
+
+        from ..core import plans
+
+        rng = np.random.default_rng(0)
+        k, log_n = point.k_bucket, point.log_n
+        alphas = np.zeros(k, np.uint64)
+        q = 256
+        route, profile = point.route, point.profile
+        if route in ("agg_xor", "agg_add"):
+            rows = np.zeros((k, 32), np.uint32)
+            fn = lambda: plans.run_agg_fold(route[4:], None, rows)  # noqa: E731
+        elif route == "dcf_interval":
+            from ..models import dcf
+
+            ia, _ = dcf.gen_interval_batch(alphas, alphas, log_n, rng=rng)
+            xs = np.zeros((k, q), np.uint64)
+            fn = lambda: plans.run_interval(ia, xs)  # noqa: E731
+        elif route == "dcf_points":
+            from ..models import dcf
+
+            da, _ = dcf.gen_lt_batch(alphas, log_n, rng=rng)
+            xs = np.zeros((k, q), np.uint64)
+            fn = lambda: plans.run_points(route, "fast", da, xs)  # noqa: E731
+        elif route in ("points", "hh_level", "evalfull"):
+            if profile == "fast":
+                from ..models.keys_chacha import gen_batch
+            else:
+                from ..core.keys import gen_batch
+
+            kb, _ = gen_batch(alphas, log_n, rng=rng)
+            if route == "evalfull":
+                fn = lambda: plans.run_evalfull(profile, kb)  # noqa: E731
+            elif route == "hh_level":
+                xs = np.zeros((k, q), np.uint64)
+                fn = lambda: plans.run_hh_level(profile, kb, xs, 0)  # noqa: E731
+            else:
+                xs = np.zeros((k, q), np.uint64)
+                fn = lambda: plans.run_points(route, profile, kb, xs)  # noqa: E731
+        else:
+            raise ValueError(
+                f"tune: device backend cannot drive route {route!r} "
+                "(pir needs a registered database; tune it from a "
+                "serving process or use the sim backend)"
+            )
+        self._fns[point] = fn
+        return fn
+
+    def measure(
+        self, point: SweepPoint, config: Mapping[str, str]
+    ) -> dict:
+        from ..core import plans
+        from ..core.transients import is_transient
+
+        fn = self._fn(point)
+        self.measured += 1
+        try:
+            with plans.forced_tuned(dict(config)):
+                fn()  # compile + warm under THIS config's plan
+                traces_before = plans.trace_count()
+                best = float("inf")
+                for _ in range(self.reps):
+                    t0 = time.perf_counter()
+                    fn()
+                    best = min(best, time.perf_counter() - t0)
+                retraces = plans.trace_count() - traces_before
+        except WedgeAbort:
+            raise
+        except Exception as e:  # noqa: BLE001 — classified below
+            if is_transient(e):
+                raise WedgeAbort(f"{type(e).__name__}: {e}") from e
+            return {
+                "error": f"{type(e).__name__}: {str(e)[:300]}",
+                "method": "plans",
+            }
+        row = {"seconds": best, "reps": self.reps, "method": "plans"}
+        if retraces:
+            # A config that retraces inside its timing loop broke the
+            # zero-retrace contract — visible on the row, and the driver
+            # refuses to crown it.
+            row["retraces"] = int(retraces)
+        return row

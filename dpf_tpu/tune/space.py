@@ -1,0 +1,129 @@
+"""The declared autotuning search space.
+
+One axis = one registered knob plus the CLOSED set of values the tuner
+may try for it.  The space is declared per (route, profile) because
+that is the granularity ``core/plans.py`` dispatches at — an axis that
+cannot change a route's executable (sbox on the fast profile, fuse on
+the pointwise walk) is simply absent from that route's axes, so the
+sweep never burns budget on knobs the route ignores.
+
+Every axis includes the registry default, so the sweep always measures
+the baseline it must beat, and ``docs/TUNED.json`` margins are always
+"vs the shipped default".  Values are raw knob strings (what
+``knobs.overrides`` applies); they must parse under the knob's own
+accessor or ``validate``/tests fail loudly.
+
+Import-light on purpose (registry only): the analysis pass and the CLI
+load this before any backend initializes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+from ..core import knobs
+
+
+@dataclasses.dataclass(frozen=True)
+class Axis:
+    """One tunable knob and the values the sweep enumerates for it."""
+
+    knob: str
+    values: tuple[str, ...]
+
+    def __post_init__(self) -> None:
+        k = knobs.knob(self.knob)  # KeyError = axis on an undeclared knob
+        if k.default not in self.values:
+            raise ValueError(
+                f"tune axis {self.knob}: registry default {k.default!r} "
+                f"missing from values {self.values!r} — the sweep must "
+                "always measure the shipped baseline"
+            )
+
+
+# Fused-vs-per-level GGM expansion — the headline A/B ROADMAP item 2
+# has waited on.  Explicit group sizes (not "auto") so the winner is a
+# durable, reproducible setting, not a VMEM heuristic's mood.
+_FUSE = Axis("DPF_TPU_FUSE", ("off", "2", "3", "4"))
+# Pointwise walk backend per profile ("auto" resolves to the Pallas
+# kernel on TPU; "xla" is the fallback the kernel must beat).
+_POINTS_FAST = Axis("DPF_TPU_POINTS", ("auto", "xla"))
+_POINTS_COMPAT = Axis("DPF_TPU_POINTS_AES", ("auto", "xla"))
+# Buffer donation on the chunk-finish carries.
+_DONATE = Axis("DPF_TPU_DONATE", ("auto", "off", "on"))
+# PIR parity-matmul chunk granularity.
+_PIR_CHUNK = Axis(
+    "DPF_TPU_PIR_CHUNK_ROWS", (str(1 << 14), str(1 << 16), str(1 << 18))
+)
+
+# (route, profile) -> axes.  A combo absent here is not tunable; the
+# driver and the TUNED.json validator both reject it.
+_AXES: dict[tuple[str, str], tuple[Axis, ...]] = {
+    ("points", "compat"): (_POINTS_COMPAT,),
+    ("points", "fast"): (_POINTS_FAST,),
+    ("hh_level", "compat"): (_POINTS_COMPAT,),
+    ("hh_level", "fast"): (_POINTS_FAST,),
+    ("evalfull", "compat"): (_FUSE,),
+    ("evalfull", "fast"): (_FUSE,),
+    ("dcf_points", "fast"): (_POINTS_FAST,),
+    ("dcf_interval", "fast"): (_POINTS_FAST,),
+    ("agg_xor", "agg"): (_DONATE,),
+    ("agg_add", "agg"): (_DONATE,),
+    ("pir", "compat"): (_FUSE, _PIR_CHUNK),
+    ("pir", "fast"): (_FUSE, _PIR_CHUNK),
+}
+
+
+def axes_for(route: str, profile: str) -> tuple[Axis, ...]:
+    """The tunable axes of one (route, profile); ValueError when the
+    combo is not in the declared space."""
+    try:
+        return _AXES[(route, profile)]
+    except KeyError:
+        known = ", ".join(f"{r}/{p}" for r, p in sorted(_AXES))
+        raise ValueError(
+            f"tune: {route}/{profile} is not a tunable combo ({known})"
+        ) from None
+
+
+def profiles_for(route: str) -> tuple[str, ...]:
+    """Profiles with a declared axis set for ``route`` (sorted)."""
+    out = sorted(p for r, p in _AXES if r == route)
+    if not out:
+        raise ValueError(f"tune: no tunable profiles for route {route!r}")
+    return tuple(out)
+
+
+def routes() -> tuple[str, ...]:
+    """Every route with at least one tunable (route, profile) combo."""
+    return tuple(sorted({r for r, _ in _AXES}))
+
+
+def default_config(route: str, profile: str) -> dict[str, str]:
+    """The registry-default value of every axis — the baseline config
+    the sweep measures first and winners must beat."""
+    return {
+        ax.knob: knobs.knob(ax.knob).default
+        for ax in axes_for(route, profile)
+    }
+
+
+def tunable_knobs() -> tuple[str, ...]:
+    """Every knob any axis touches (sorted) — the TUNED.json provenance
+    digest covers exactly these declarations."""
+    return tuple(
+        sorted({ax.knob for axes in _AXES.values() for ax in axes})
+    )
+
+
+def space_digest() -> str:
+    """Stable digest of the whole declared space (axes + value sets).
+    Part of the sweep-ledger identity AND the TUNED.json provenance
+    digest: changing the space invalidates both, so stale winners can
+    never be replayed or silently applied."""
+    h = hashlib.sha256()
+    for (route, profile), axes in sorted(_AXES.items()):
+        h.update(repr((route, profile, [(a.knob, a.values) for a in axes]))
+                 .encode())
+    return h.hexdigest()[:16]

@@ -1,0 +1,302 @@
+"""``docs/TUNED.json`` — committed per-plan tuned defaults.
+
+The durable output of a tuner sweep: one entry per (route, profile,
+log_n, K-bucket) whose measured winner beat the registry default by a
+real margin, plus provenance (which tree measured it, on which backend,
+against which knob declarations).  ``core/plans.py`` consults the table
+at dispatch/warmup time under ``DPF_TPU_TUNED``:
+
+  off    ignore the file.
+  auto   (default) apply only DEVICE-measured files, and only on TPU —
+         a sim-backend file (CPU CI exercising the pipeline) or a CPU
+         process never gets silently steered by it.
+  on     apply any valid file (tests pin byte-identity this way).
+
+Staleness policy: the provenance carries ``knobs_digest`` — a digest of
+the declarations of every tunable knob plus the declared search space.
+Change a tunable knob's default/choices or the space itself and the
+committed file stops validating ("stale — re-run with --write-tuned");
+unrelated commits do NOT invalidate it (a tuned default is a durable
+measured fact, not a per-commit artifact).  ``head`` records which tree
+measured the winners, for humans and the bench ledger key.
+
+Schema (version 1)::
+
+    {"schema": 1,
+     "provenance": {"generator": ..., "backend": "device"|"sim",
+                    "head": <tree hashes>, "generated_at": <iso8601>,
+                    "knobs_digest": <16 hex>},
+     "entries": [{"route": ..., "profile": ..., "log_n": N,
+                  "k_bucket": B,          # 0 = any K bucket (wildcard)
+                  "config": {KNOB: value, ...},
+                  "margin": 0.17,         # fraction saved vs default
+                  "default_s": ..., "best_s": ...}, ...]}
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Any, Mapping
+
+from ..core import knobs
+from . import space
+
+SCHEMA_VERSION = 1
+
+_PROFILES = ("agg", "compat", "fast")
+
+
+def repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def default_path() -> str:
+    """DPF_TPU_TUNED_PATH, resolved against the repo root when relative
+    (so the committed docs/TUNED.json is found from any cwd)."""
+    raw = knobs.get_str("DPF_TPU_TUNED_PATH")
+    return raw if os.path.isabs(raw) else os.path.join(repo_root(), raw)
+
+
+def canonical_tag(config: Mapping[str, str]) -> str:
+    """The sorted ``K=V,K=V`` form of a config — the plan-key field that
+    keeps tuned and untuned executables distinct, and the ledger section
+    suffix that keeps their measurements from colliding on resume."""
+    return ",".join(f"{k}={v}" for k, v in sorted(config.items()))
+
+
+def parse_tag(tag: str) -> dict[str, str]:
+    """Inverse of :func:`canonical_tag` ('' -> {})."""
+    out: dict[str, str] = {}
+    for part in tag.split(","):
+        if part:
+            name, _, value = part.partition("=")
+            out[name] = value
+    return out
+
+
+def registry_digest() -> str:
+    """Digest of the declarations of every tunable knob + the declared
+    search space — the TUNED.json staleness gate."""
+    h = hashlib.sha256()
+    h.update(space.space_digest().encode())
+    for name in space.tunable_knobs():
+        k = knobs.knob(name)
+        h.update(
+            repr((k.name, k.kind, k.default, k.choices, k.values)).encode()
+        )
+    return h.hexdigest()[:16]
+
+
+def build_doc(entries: list[dict], backend: str, head: str) -> dict:
+    """Assemble a schema-valid document (the CLI's --write-tuned path);
+    raises ValueError when the result would not validate."""
+    import datetime
+
+    doc = {
+        "schema": SCHEMA_VERSION,
+        "provenance": {
+            "generator": "python -m dpf_tpu.tune",
+            "backend": backend,
+            "head": head,
+            "generated_at": datetime.datetime.now(
+                datetime.timezone.utc
+            ).isoformat(timespec="seconds"),
+            "knobs_digest": registry_digest(),
+        },
+        "entries": sorted(
+            entries,
+            key=lambda e: (
+                e["route"], e["profile"], e["log_n"], e["k_bucket"]
+            ),
+        ),
+    }
+    problems = validate(doc)
+    if problems:
+        raise ValueError("tuned doc invalid: " + "; ".join(problems))
+    return doc
+
+
+def validate(doc: Any) -> list[str]:
+    """Every way ``doc`` fails the schema/registry/staleness contract,
+    as human-readable strings (empty = valid).  Shared by the analysis
+    pass, the loader, and the writer."""
+    from ..core.plans import PLAN_ROUTES
+
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return [f"document must be a JSON object, got {type(doc).__name__}"]
+    extra = sorted(set(doc) - {"schema", "provenance", "entries"})
+    if extra:
+        problems.append(f"unknown top-level keys: {', '.join(extra)}")
+    if doc.get("schema") != SCHEMA_VERSION:
+        problems.append(
+            f"schema {doc.get('schema')!r} != {SCHEMA_VERSION} "
+            "(re-run with --write-tuned)"
+        )
+    prov = doc.get("provenance")
+    if not isinstance(prov, dict):
+        problems.append("provenance must be an object")
+        prov = {}
+    backend = prov.get("backend")
+    if backend not in ("device", "sim"):
+        problems.append(f"provenance.backend {backend!r} not device|sim")
+    head = prov.get("head")
+    if not isinstance(head, str) or not head:
+        problems.append("provenance.head missing")
+    digest = prov.get("knobs_digest")
+    if digest != registry_digest():
+        problems.append(
+            f"provenance.knobs_digest {digest!r} stale vs registry/space "
+            f"{registry_digest()!r} — tunable knob declarations or the "
+            "search space changed; re-run the sweep with --write-tuned"
+        )
+    entries = doc.get("entries")
+    if not isinstance(entries, list):
+        return problems + ["entries must be a list"]
+    seen: set[tuple] = set()
+    for i, e in enumerate(entries):
+        where = f"entries[{i}]"
+        if not isinstance(e, dict):
+            problems.append(f"{where}: must be an object")
+            continue
+        route = e.get("route")
+        profile = e.get("profile")
+        if route not in PLAN_ROUTES:
+            problems.append(f"{where}: unknown route {route!r}")
+            continue
+        if profile not in _PROFILES:
+            problems.append(f"{where}: unknown profile {profile!r}")
+            continue
+        try:
+            axes = space.axes_for(route, profile)
+        except ValueError as err:
+            problems.append(f"{where}: {err}")
+            continue
+        log_n = e.get("log_n")
+        kb = e.get("k_bucket")
+        if not isinstance(log_n, int) or log_n < 0:
+            problems.append(f"{where}: log_n must be an int >= 0")
+            continue
+        if not isinstance(kb, int) or kb < 0 or (kb & (kb - 1)):
+            problems.append(
+                f"{where}: k_bucket must be 0 (wildcard) or a power of two"
+            )
+            continue
+        ident = (route, profile, log_n, kb)
+        if ident in seen:
+            problems.append(f"{where}: duplicate key {ident}")
+        seen.add(ident)
+        config = e.get("config")
+        if not isinstance(config, dict) or not config:
+            problems.append(f"{where}: config must be a non-empty object")
+            continue
+        by_knob = {ax.knob: ax for ax in axes}
+        for name, value in sorted(config.items()):
+            ax = by_knob.get(name)
+            if ax is None:
+                problems.append(
+                    f"{where}: {name} is not a tunable axis of "
+                    f"{route}/{profile}"
+                )
+            elif value not in ax.values:
+                problems.append(
+                    f"{where}: {name}={value!r} outside the declared "
+                    f"axis values {ax.values!r}"
+                )
+        margin = e.get("margin")
+        if not isinstance(margin, (int, float)) or not 0 < margin < 1:
+            problems.append(f"{where}: margin must be in (0, 1)")
+    return problems
+
+
+class TunedTable:
+    """Parsed, validated TUNED.json with (route, profile, log_n,
+    K-bucket) lookup; ``k_bucket=0`` entries are per-shape wildcards."""
+
+    def __init__(self, doc: dict, path: str):
+        self.path = path
+        self.backend = str(doc.get("provenance", {}).get("backend", ""))
+        self.head = str(doc.get("provenance", {}).get("head", ""))
+        self._by_key: dict[tuple, dict[str, str]] = {}
+        for e in doc.get("entries", []):
+            key = (e["route"], e["profile"], int(e["log_n"]),
+                   int(e["k_bucket"]))
+            self._by_key[key] = {
+                str(k): str(v) for k, v in e["config"].items()
+            }
+
+    @property
+    def entries(self) -> int:
+        return len(self._by_key)
+
+    def lookup(
+        self, route: str, profile: str, log_n: int, k_bucket: int
+    ) -> dict[str, str]:
+        """The tuned config for one plan shape ({} = serve the registry
+        defaults); the exact K bucket wins over the wildcard."""
+        for kb in (int(k_bucket), 0):
+            config = self._by_key.get((route, profile, int(log_n), kb))
+            if config is not None:
+                return dict(config)
+        return {}
+
+
+# Cached load, keyed on the resolved path so tests that point
+# DPF_TPU_TUNED_PATH elsewhere get a fresh table without a reload()
+# call.  Same-path content edits DO need reload() (the dispatch path
+# cannot afford a stat per plan lookup).
+_LOCK = threading.Lock()
+_STATE: dict[str, Any] = {"path": None, "table": None, "error": ""}
+
+
+def table() -> TunedTable | None:
+    """The current tuned table, or None when the file is absent or
+    invalid (the error shows up in ``stats()``, never on the dispatch
+    path)."""
+    path = default_path()
+    with _LOCK:
+        if _STATE["path"] == path:
+            return _STATE["table"]
+        tab: TunedTable | None = None
+        error = ""
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except OSError:
+            error = "absent"
+        except ValueError as e:
+            error = f"unparseable: {e}"
+        else:
+            problems = validate(doc)
+            if problems:
+                error = "; ".join(problems)
+            else:
+                tab = TunedTable(doc, path)
+        _STATE.update(path=path, table=tab, error=error)
+        return tab
+
+
+def reload() -> None:
+    """Drop the cached table (next ``table()`` re-reads the file)."""
+    with _LOCK:
+        _STATE.update(path=None, table=None, error="")
+
+
+def stats() -> dict:
+    """The ``tuned`` block of ``/v1/stats``: mode, file identity, and
+    whether/why the table loaded."""
+    tab = table()
+    with _LOCK:
+        return {
+            "mode": knobs.get_str("DPF_TPU_TUNED"),
+            "path": str(_STATE["path"]),
+            "loaded": tab is not None,
+            "entries": tab.entries if tab is not None else 0,
+            "backend": tab.backend if tab is not None else "",
+            "error": str(_STATE["error"]),
+        }

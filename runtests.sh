@@ -8,11 +8,12 @@
 #       excluded by the default -m; append your own -m to override, e.g.
 #       `./runtests.sh -m slow` for the fused acceptance sweep, or
 #       `./runtests.sh -m ''` for absolutely everything)
-#   ./runtests.sh --lint                 static-analysis lane: the seven
+#   ./runtests.sh --lint                 static-analysis lane: the eight
 #       repo-native passes (knob registry incl. unused-knob detection,
 #       secret hygiene, host-sync, pallas/jit discipline, test-suite
-#       wiring discipline, the oblivious-trace jaxpr verifier with its
-#       certificate drift check, and the perf-contract verifier with its
+#       wiring discipline, tuned-defaults TUNED.json validation, the
+#       oblivious-trace jaxpr verifier with its certificate drift check,
+#       and the perf-contract verifier with its
 #       collective/donation/dispatch budgets — one shared trace cache, so
 #       each route traces once) + docs/KNOBS.md drift + mypy typed-core
 #       and Go vet/fmt when those toolchains exist —
@@ -50,6 +51,13 @@
 #       so it lives ONLY here and in the full tier-1 suite — CI runs
 #       this lane as its own job so a loaded fast-lane runner cannot
 #       flake it and the fast job stays fast.
+#   ./runtests.sh --tune [pytest args]   autotuner lane: the sweep
+#       driver on the deterministic sim backend (tests/test_tune.py —
+#       convergence to the seeded synthetic optimum over >= 3 routes x 2
+#       profiles, wedge-abort mid-sweep + ledger resume re-measuring
+#       only the in-flight config, torn-tail tolerance, TUNED.json
+#       schema/staleness validation, and byte-identical plan outputs
+#       with DPF_TPU_TUNED on vs off) — CPU-only, no TPU, minutes.
 #   ./runtests.sh --mesh [pytest args]   mesh-native serving lane: the
 #       sharded serving fast path on the 8-virtual-device CPU mesh
 #       (tests/test_serving_mesh.py — byte identity of every sharded
@@ -64,6 +72,9 @@ elif [ "${1:-}" = "--mesh" ]; then
   shift
   set -- tests/test_serving_mesh.py tests/test_sharding.py \
       -q -m 'not slow' "$@"
+elif [ "${1:-}" = "--tune" ]; then
+  shift
+  set -- tests/test_tune.py -q -m 'not slow' "$@"
 elif [ "${1:-}" = "--faults" ]; then
   shift
   set -- tests/test_load_survival.py tests/test_serving_stress.py \

@@ -2,12 +2,14 @@
 # One-command static-analysis gate (hermetic: CPU jax, no TPU, no axon
 # tunnel — safe in CI and on laptops).  Runs:
 #
-#   1. python -m dpf_tpu.analysis      the seven repo-native passes
+#   1. python -m dpf_tpu.analysis      the eight repo-native passes
 #      (knob-registry incl. unused-knob detection, secret-hygiene,
-#      host-sync, pallas-jit, test-discipline, the oblivious-trace jaxpr
-#      verifier with its certificate drift check, and the perf-contract
-#      verifier — collective/donation/dispatch budgets over the SAME
-#      route traces via the shared trace cache)
+#      host-sync, pallas-jit, test-discipline, tuned-defaults (the
+#      committed docs/TUNED.json autotuner output vs the schema/registry
+#      contract), the oblivious-trace jaxpr verifier with its
+#      certificate drift check, and the perf-contract verifier —
+#      collective/donation/dispatch budgets over the SAME route traces
+#      via the shared trace cache)
 #   2. --check-knobs-doc               docs/KNOBS.md drift vs the registry
 #   3. mypy --strict (mypy.ini)        dpf_tpu/core + dpf_tpu/analysis
 #      (skipped with a notice when no mypy is installed)

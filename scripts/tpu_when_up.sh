@@ -133,6 +133,26 @@ run smalltree_ab    2400 python scripts/bench_small_tree_ab.py
 run fused_ab        2400 python scripts/bench_compat_ab.py \
     pallas_bm:128:bp113:0 pallas_bm:128:bp113:2 pallas_bm:128:bp113:3 \
     pallas_bm:128:bp113:0 pallas_bm:128:bp113:2 pallas_bm:128:bp113:3
+# On-hardware autotune sweep (device backend).  Same resume discipline
+# as bench_all: every completed (point, config) measurement is a ledger
+# section, a wedge mid-sweep exits 3 with the UNAVAILABLE signature in
+# the log (so run() retries, and the retry replays completed sections
+# instead of re-measuring), and --write-tuned refuses a partial sweep —
+# docs/TUNED.json only ever records a fully-measured matrix.  Runs
+# BEFORE bench_all so the matrix benches the tree the tuned defaults
+# will actually serve (bench_all stamps the TUNED.json digest into its
+# ledger key).
+run tune_sweep      7200 python -m dpf_tpu.tune --backend device \
+    --routes points,dcf_points,dcf_interval,evalfull,hh_level,agg_xor,agg_add \
+    --log-n 14,18 --k 128 \
+    --ledger "$OUT/tune.ledger.jsonl" --write-tuned
+# save() scopes to tpu_logs/r5; the tuned winners live in docs/ and are
+# the one measurement artifact meant to be SERVED, so commit them too.
+if [ -e "$OUT/tune_sweep.done" ] && ! git diff --quiet -- docs/TUNED.json; then
+  git add docs/TUNED.json >/dev/null 2>&1 && \
+    git commit -q -m "tune: device-measured TUNED.json winners" \
+      -- docs/TUNED.json >/dev/null 2>&1 || true
+fi
 # The section ledger makes the matrix resume across retry attempts and
 # watcher restarts instead of re-measuring from scratch.
 run bench_all       7200 env DPF_TPU_BENCH_LEDGER=$OUT/bench_all.ledger.jsonl \

@@ -95,6 +95,39 @@ def test_pallas_pass_catches_fixture():
     assert len(found) == 6
 
 
+def test_tuned_pass_catches_fixture():
+    found = _run("tuned-defaults", "bad_tuned.json")
+    messages = "\n".join(f.message for f in found)
+    # Seeded violations: a backend outside device|sim, a stale
+    # knobs_digest, an unknown route, a non-power-of-two K bucket, a
+    # config value off its declared axis, an off-axis knob for the
+    # route, and a margin outside (0, 1) — plus the unknown top-level
+    # key catcher.
+    assert "not device|sim" in messages
+    assert "stale vs registry/space" in messages
+    assert "unknown route 'teleport'" in messages
+    assert "k_bucket must be 0 (wildcard) or a power of two" in messages
+    assert "outside the declared axis values" in messages
+    assert "not a tunable axis of points/fast" in messages
+    assert "margin must be in (0, 1)" in messages
+    assert "unknown top-level keys: rationale" in messages
+    assert all(f.path == FIXDIR + "bad_tuned.json" for f in found)
+
+
+def test_tuned_pass_absent_file_clean(tmp_path):
+    """A tree with no committed docs/TUNED.json is clean — the tuner
+    simply has not been run."""
+    assert get_pass("tuned-defaults")(str(tmp_path)) == []
+
+
+def test_tuned_pass_unparseable_json(tmp_path):
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "TUNED.json").write_text("{not json")
+    found = get_pass("tuned-defaults")(str(tmp_path))
+    assert len(found) == 1
+    assert "unparseable JSON" in found[0].message
+
+
 def test_cli_nonzero_on_fixture_dir():
     """The module entrypoint exits 1 when the scan root contains seeded
     violations (here: scanning the package WITH fixtures included by

@@ -131,9 +131,12 @@ def test_recent_shapes_excludes_pir_rewarms_the_rest():
         assert "pir" not in routes, shapes
         assert {"points", "hh_level", "agg_xor"} <= set(routes), shapes
         # The warmup-spec shape survives the round trip (q only when
-        # the plan has a q bucket).
+        # the plan has a q bucket; "tuned" always present — the re-warm
+        # must replay each plan's original tuned config, "" = untuned).
         for s in shapes:
-            assert set(s) <= {"route", "profile", "log_n", "k", "q"}
+            assert set(s) <= {"route", "profile", "log_n", "k", "q",
+                              "tuned"}
+            assert s["tuned"] == ""
             if s["route"] in ("points", "hh_level", "agg_xor"):
                 assert s["q"] >= 32
     finally:
