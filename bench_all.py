@@ -113,6 +113,13 @@ _ROUTE_KNOBS = (
     # streamed-fold chunk size shape what the hh/agg rows measure.
     "DPF_TPU_HH_THRESHOLD", "DPF_TPU_HH_LEVELS_PER_ROUND",
     "DPF_TPU_HH_MAX_CANDIDATES", "DPF_TPU_AGG_CHUNK_BYTES",
+    # Incremental-descent knobs (cfg-hh): whether the frontier cache and
+    # the MXU count fold are in play — an incremental row must never
+    # collide with a from-root row on a ledger resume, and the session
+    # bounds shape the served frontier registry.
+    "DPF_TPU_HH_STATE", "DPF_TPU_HH_STATE_MAX_SESSIONS",
+    "DPF_TPU_HH_STATE_MAX_BYTES", "DPF_TPU_HH_STATE_TTL_S",
+    "DPF_TPU_HH_FOLD",
     # Mesh-native serving knobs: a sharded row must never collide with a
     # single-device row on a ledger resume (cfg-serving-mesh sets these
     # per-row, so they are also stamped into each row's route label).
@@ -1509,6 +1516,102 @@ def main():
             )
 
     _section("cfg-apps", cfg_apps)
+
+    def cfg_hh():
+        """Incremental frontier-cache descent vs from-root recompute —
+        PR 17's headline row pair (same shares, same planted hitters,
+        gated on EXACT hitter-set equality) with the measured PRG
+        level-eval counts stamped into each row — plus the MXU count
+        fold vs the host popcount on identical reconstructed rows."""
+        from dpf_tpu.apps import heavy_hitters as hh_app
+        from dpf_tpu.core import bitpack
+
+        g_hh, n_hh, per_hh = (16384, 16, 320) if not small else (256, 10, 16)
+        rng_h = np.random.default_rng(26)
+        planted = np.array(
+            [3, 777 % (1 << n_hh), (1 << n_hh) - 5, (1 << n_hh) // 5],
+            dtype=np.uint64,
+        )
+        vals = rng_h.integers(0, 1 << n_hh, size=g_hh, dtype=np.uint64)
+        for i, hv in enumerate(planted):
+            vals[i * per_hh : (i + 1) * per_hh] = hv
+        thr = per_hh // 2
+        sh_a, sh_b = hh_app.gen_shares(vals, n_hh, profile="fast", rng=rng_h)
+        want = {
+            int(hv): int((vals == hv).sum()) for hv in set(planted.tolist())
+        }
+
+        by_mode = {}
+        for mode, flag in (("incremental", True), ("from-root", False)):
+            # First run warms every bucket executable; the timed second
+            # run is the steady-state descent.
+            hh_app.find_heavy_hitters(sh_a, sh_b, threshold=thr, state=flag)
+            t0 = time.perf_counter()
+            res = hh_app.find_heavy_hitters(
+                sh_a, sh_b, threshold=thr, state=flag
+            )
+            wall_s = time.perf_counter() - t0
+            got = {int(v): int(c) for v, c in zip(res.values, res.counts)}
+            if got != want:
+                raise RuntimeError(
+                    f"hh {mode} recovery mismatch: {len(got)} found, "
+                    f"{len(want)} planted"
+                )
+            prg = sum(r.prg_level_evals for r in res.rounds)
+            evals = sum(r.key_evals for r in res.rounds)
+            eval_s = sum(r.eval_s for r in res.rounds)
+            by_mode[mode] = (prg, got)
+            _emit(
+                f"hh descent {mode} {g_hh} clients n={n_hh} "
+                f"({len(res.rounds)} rounds, fast)",
+                evals / eval_s / 1e6, "Mkeyevals/sec",
+                route=_route(f"apps,hh-descent,{mode}"),
+                extra={
+                    "prg_level_evals": prg,
+                    "descent_wall_s": round(wall_s, 4),
+                    "rounds": len(res.rounds),
+                },
+            )
+        if by_mode["incremental"][1] != by_mode["from-root"][1]:
+            raise RuntimeError("hh incremental/from-root hitter sets differ")
+        ratio = by_mode["from-root"][0] / max(by_mode["incremental"][0], 1)
+        _emit(
+            f"hh PRG level-evals from-root/incremental n={n_hh}",
+            ratio, "x", route=_route("apps,hh-descent"), scale=1,
+            extra={
+                "prg_incremental": by_mode["incremental"][0],
+                "prg_from_root": by_mode["from-root"][0],
+            },
+        )
+
+        # MXU count fold vs host popcount, identical public rows.
+        q_fold = 512 if not small else 64
+        w_fold = bitpack.packed_words(q_fold)
+        rows_x = rng_h.integers(
+            0, 1 << 32, size=(g_hh, w_fold), dtype=np.uint64
+        ).astype(np.uint32)
+        zeros = np.zeros_like(rows_x)
+        timings = {}
+        for fold in ("host", "mxu"):
+            with knobs.overrides({"DPF_TPU_HH_FOLD": fold}):
+                timings[fold] = (
+                    _timed_host_call(
+                        lambda: hh_app.reconstruct_counts(
+                            rows_x, zeros, q_fold
+                        )
+                    ),
+                    hh_app.reconstruct_counts(rows_x, zeros, q_fold),
+                )
+        np.testing.assert_array_equal(timings["host"][1], timings["mxu"][1])
+        for fold in ("host", "mxu"):
+            _emit(
+                f"hh count fold {fold} {g_hh} clients x {q_fold} candidates",
+                g_hh * q_fold / timings[fold][0] / 1e6, "Mcounts/sec",
+                route=_route(f"apps,hh-fold,{fold}"),
+                extra={"words": w_fold},
+            )
+
+    _section("cfg-hh", cfg_hh)
 
     # ---- wire transports: HTTP/1.1 vs wire2 at matched concurrency ---------
     # The ISSUE-14 acceptance rows: agg fold shares/s and HH round

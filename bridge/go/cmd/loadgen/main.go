@@ -38,6 +38,22 @@
 // fold against a locally computed reference before reporting — a wrong
 // answer is exit 2, never a throughput row.
 //
+//	loadgen -mode hh -logn 10 -hh-clients 24 -hh-threshold 5 \
+//	        -wire2-addr 127.0.0.1:8991   # full heavy-hitters descent:
+//	                                     # dealer gen, then round-by-round
+//	                                     # /v1/hh/eval?session= with the
+//	                                     # level-(n-1) key column over ONE
+//	                                     # connection per front (HTTP/1.1
+//	                                     # keep-alive, plus wire2 when
+//	                                     # -wire2-addr is set), recovered
+//	                                     # hitter set checked against the
+//	                                     # planted truth
+//
+// hh is a descent replay like agg-epoch is an epoch replay: closed-loop,
+// sequential by protocol (round d+1's candidates are pruned from round
+// d's public counts), and self-checking — a wrong or missing hitter is
+// exit 2, never a throughput row.
+//
 // Output: one JSON object on stdout (bench-ledger-shaped).
 package main
 
@@ -240,6 +256,205 @@ func runAggEpoch(base, wire2Addr, op string, clients, words, batch,
 	}
 }
 
+type hhFrontResult struct {
+	Transport string         `json:"transport"`
+	Rounds    int            `json:"rounds"`
+	Requests  int64          `json:"requests"`
+	KeyEvals  int64          `json:"key_evals"`
+	DurationS float64        `json:"duration_s"`
+	Hitters   map[string]int `json:"hitters"`
+}
+
+type hhResult struct {
+	Mode           string          `json:"mode"`
+	Profile        string          `json:"profile"`
+	LogN           uint            `json:"log_n"`
+	Clients        int             `json:"clients"`
+	LevelsPerRound uint            `json:"levels_per_round"`
+	Threshold      int             `json:"threshold"`
+	Incremental    bool            `json:"incremental"`
+	Fronts         []hhFrontResult `json:"fronts"`
+	HittersChecked bool            `json:"hitters_checked"`
+}
+
+// runHH replays one full heavy-hitters descent per front: the sidecar's
+// dealer generates both aggregators' share blobs for a planted
+// distribution, then each round uploads one key column plus the round's
+// candidate values to /v1/hh/eval, XOR-reconstructs the two sessions'
+// rows into public counts, prunes on -hh-threshold, and extends the
+// survivors — root to leaves.  By default every round of a descent sends
+// the SAME level-(logN-1) column under a pinned session id, so the
+// server serves round d+1 from its device-resident frontier instead of
+// re-walking d+1 tree levels (the incremental-descent engine this
+// exercises end-to-end); -hh-stateless sends per-level keys with no
+// session for the legacy from-root shape.  Both aggregator roles run
+// against the one sidecar under distinct session ids, exactly like the
+// in-repo serving tests, and the recovered hitter set must equal the
+// planted truth on every front — a wrong set is exit 2, never a row.
+func runHH(base, wire2Addr, profile string, logN uint, clients int,
+	levels uint, threshold int, stateless bool, seed int64) {
+	if levels == 0 || levels > logN {
+		levels = logN
+	}
+	planted := map[uint64]int{3: 8, (uint64(1) << logN) - 5: 7}
+	if clients < 16 || uint64(clients) > uint64(1)<<(logN-2) {
+		fmt.Fprintf(os.Stderr,
+			"loadgen: -hh-clients must be in [16, 2^(logn-2)]\n")
+		os.Exit(1)
+	}
+	if threshold < 2 || threshold > 7 {
+		// The planted counts are 8 and 7; outside [2, 7] the truth the
+		// run checks itself against would no longer be {both planted}.
+		fmt.Fprintf(os.Stderr, "loadgen: -hh-threshold must be in [2, 7]\n")
+		os.Exit(1)
+	}
+	values := make([]uint64, 0, clients)
+	for _, p := range []struct {
+		v uint64
+		n int
+	}{{3, 8}, {(uint64(1) << logN) - 5, 7}} {
+		for i := 0; i < p.n; i++ {
+			values = append(values, p.v)
+		}
+	}
+	// Deterministic distinct below-threshold fillers: odd values never
+	// collide with each other, skip the planted pair explicitly, and
+	// clients <= 2^(logn-2) keeps them inside the domain (count 1 <
+	// threshold each, so none can fake a hitter).
+	for f := uint64(5); len(values) < clients; f += 2 {
+		if _, hot := planted[f]; !hot {
+			values = append(values, f)
+		}
+	}
+
+	c := dpftpu.New(base)
+	c.Profile = profile
+	blobA, blobB, err := c.HHGen(values, logN)
+	if err != nil {
+		fmt.Fprintf(os.Stderr, "loadgen: hh gen: %v\n", err)
+		os.Exit(1)
+	}
+	levelCol := func(blob []byte, level uint) []dpftpu.DPFkey {
+		keys, err := c.HHLevelKeys(blob, logN, level)
+		if err != nil {
+			fmt.Fprintf(os.Stderr, "loadgen: hh keys: %v\n", err)
+			os.Exit(1)
+		}
+		return keys
+	}
+	topA, topB := levelCol(blobA, logN-1), levelCol(blobB, logN-1)
+
+	type evalFn func(keys []dpftpu.DPFkey, cands []uint64, level uint,
+		session string) ([][]byte, error)
+	descend := func(transport string, eval evalFn) hhFrontResult {
+		// Session ids carry the transport so the HTTP and wire2
+		// descents never share (or digest-evict) each other's frontier.
+		sid := func(side string) string {
+			if stateless {
+				return ""
+			}
+			return fmt.Sprintf("loadgen-%s-%s-%d", transport, side, seed)
+		}
+		res := hhFrontResult{Transport: transport, Hitters: map[string]int{}}
+		frontier := []uint64{0}
+		start := time.Now()
+		for depth := uint(0); depth < logN; {
+			r := levels
+			if depth+r > logN {
+				r = logN - depth
+			}
+			depth += r
+			prefixes := dpftpu.HHExtend(frontier, r)
+			cands := dpftpu.HHQueryValues(prefixes, logN, depth)
+			kA, kB := topA, topB
+			if stateless {
+				kA = levelCol(blobA, depth-1)
+				kB = levelCol(blobB, depth-1)
+			}
+			rowsA, err := eval(kA, cands, depth-1, sid("a"))
+			if err == nil {
+				var rowsB [][]byte
+				rowsB, err = eval(kB, cands, depth-1, sid("b"))
+				if err == nil {
+					var counts []int
+					counts, err = dpftpu.HHCounts(rowsA, rowsB, len(cands))
+					if err == nil {
+						live := prefixes[:0]
+						for i, n := range counts {
+							if n >= threshold {
+								live = append(live, prefixes[i])
+								if depth == logN {
+									res.Hitters[fmt.Sprint(cands[i])] = n
+								}
+							}
+						}
+						frontier = live
+					}
+				}
+			}
+			if err != nil {
+				fmt.Fprintf(os.Stderr, "loadgen: hh round at depth %d "+
+					"(%s): %v\n", depth, transport, err)
+				os.Exit(1)
+			}
+			res.Rounds++
+			res.Requests += 2
+			res.KeyEvals += 2 * int64(clients) * int64(len(cands))
+		}
+		res.DurationS = time.Since(start).Seconds()
+		return res
+	}
+
+	fronts := []hhFrontResult{descend("http",
+		func(keys []dpftpu.DPFkey, cands []uint64, level uint,
+			session string) ([][]byte, error) {
+			return c.HHEvalLevelSession(keys, cands, logN, level, session)
+		})}
+	if wire2Addr != "" {
+		w2, err := dpftpu.DialWire2(wire2Addr)
+		if err != nil {
+			fmt.Fprintf(os.Stderr, "loadgen: %v\n", err)
+			os.Exit(1)
+		}
+		defer w2.Close()
+		fronts = append(fronts, descend("wire2",
+			func(keys []dpftpu.DPFkey, cands []uint64, level uint,
+				session string) ([][]byte, error) {
+				return w2.HHEvalLevelSession(keys, cands, logN, level, session)
+			}))
+	}
+
+	checked := true
+	for _, f := range fronts {
+		if len(f.Hitters) != len(planted) {
+			checked = false
+		}
+		for v, n := range planted {
+			if f.Hitters[fmt.Sprint(v)] != n {
+				checked = false
+			}
+		}
+	}
+	res := hhResult{
+		Mode:           "hh",
+		Profile:        profile,
+		LogN:           logN,
+		Clients:        clients,
+		LevelsPerRound: levels,
+		Threshold:      threshold,
+		Incremental:    !stateless,
+		Fronts:         fronts,
+		HittersChecked: checked,
+	}
+	out, _ := json.Marshal(res)
+	fmt.Println(string(out))
+	if !checked {
+		fmt.Fprintf(os.Stderr,
+			"loadgen: recovered hitter set diverged from planted truth\n")
+		os.Exit(2)
+	}
+}
+
 func percentile(sorted []float64, p float64) float64 {
 	if len(sorted) == 0 {
 		return 0
@@ -261,14 +476,18 @@ func main() {
 	mode := flag.String("mode", "points",
 		"load shape: points (pointwise eval), pir (register a database "+
 			"once, then drive /v1/pir/query; -pir-rows/-pir-row-bytes size "+
-			"it), or agg-epoch (closed-loop aggregation-campaign replay; "+
+			"it), agg-epoch (closed-loop aggregation-campaign replay; "+
 			"-agg-clients/-agg-words/-agg-batch/-concurrency shape it, "+
-			"-wire2-addr selects the wire2 front)")
+			"-wire2-addr selects the wire2 front), or hh (full "+
+			"heavy-hitters descent replay with self-checked recovery; "+
+			"-hh-clients/-hh-levels/-hh-threshold shape it, -wire2-addr "+
+			"adds a second descent over the wire2 front)")
 	pirRows := flag.Int("pir-rows", 4096, "pir mode: database rows")
 	pirRowBytes := flag.Int("pir-row-bytes", 32, "pir mode: bytes per row")
 	wire2Addr := flag.String("wire2-addr", "",
-		"agg-epoch mode: wire2 front host:port; empty = replay the epoch "+
-			"through the HTTP front instead")
+		"wire2 front host:port (agg-epoch: replay the epoch over wire2 "+
+			"instead of HTTP; hh: add a second descent over wire2); empty "+
+			"= HTTP front only")
 	aggClients := flag.Int("agg-clients", 1<<20,
 		"agg-epoch mode: total client share rows in the epoch")
 	aggWords := flag.Int("agg-words", 64,
@@ -276,6 +495,15 @@ func main() {
 	aggBatch := flag.Int("agg-batch", 4096,
 		"agg-epoch mode: client rows per /v1/agg/submit request")
 	aggOp := flag.String("agg-op", "xor", "agg-epoch mode: fold op (xor|add)")
+	hhClients := flag.Int("hh-clients", 24,
+		"hh mode: clients in the planted distribution (>= 16)")
+	hhLevels := flag.Uint("hh-levels", 3,
+		"hh mode: tree levels descended per round (0 = whole tree at once)")
+	hhThreshold := flag.Int("hh-threshold", 5,
+		"hh mode: heavy-hitter count threshold (planted counts are 8 and 7)")
+	hhStateless := flag.Bool("hh-stateless", false,
+		"hh mode: send per-level keys with no session id (legacy "+
+			"from-root rounds) instead of the incremental session descent")
 	concurrency := flag.Int("concurrency", 64,
 		"agg-epoch mode: concurrent in-flight requests (streams on the "+
 			"one wire2 connection, pooled keep-alive conns on HTTP)")
@@ -296,6 +524,11 @@ func main() {
 	if *mode == "agg-epoch" {
 		runAggEpoch(*url, *wire2Addr, *aggOp, *aggClients, *aggWords,
 			*aggBatch, *concurrency, *seed)
+		return
+	}
+	if *mode == "hh" {
+		runHH(*url, *wire2Addr, *profile, *logN, *hhClients, *hhLevels,
+			*hhThreshold, *hhStateless, *seed)
 		return
 	}
 
@@ -350,7 +583,8 @@ func main() {
 			return err
 		}
 	default:
-		fmt.Fprintf(os.Stderr, "loadgen: unknown -mode %q (points|pir)\n", *mode)
+		fmt.Fprintf(os.Stderr,
+		"loadgen: unknown -mode %q (points|pir|agg-epoch|hh)\n", *mode)
 		os.Exit(1)
 	}
 

@@ -26,6 +26,7 @@ import (
 	"fmt"
 	"io"
 	"net/http"
+	"net/url"
 	"strconv"
 	"time"
 )
@@ -529,31 +530,62 @@ func (c *Client) HHLevelKeys(shareBlob []byte, logN, level uint) ([]DPFkey, erro
 // (ceil(Q/8) bytes, the packed wire contract); XOR two aggregators'
 // rows and popcount with HHCounts for the public per-candidate counts.
 func (c *Client) HHEvalLevel(levelKeys []DPFkey, candidates []uint64, logN, level uint) ([][]byte, error) {
+	return c.HHEvalLevelSession(levelKeys, candidates, logN, level, "")
+}
+
+// HHEvalLevelSession is HHEvalLevel with the incremental-descent session
+// contract: a non-empty session id pins a device-resident frontier at
+// the aggregator, and every round of that descent uploads the SAME
+// level-(logN-1) key column (slice it once with HHLevelKeys at
+// level logN-1) — the server re-derives or replays each depth from the
+// cached frontier instead of walking the tree from the root.  The reply
+// bytes are the same pure function of (keys, candidates, level) whether
+// the cache served, rebuilt, or was evicted mid-descent.
+func (c *Client) HHEvalLevelSession(levelKeys []DPFkey, candidates []uint64, logN, level uint, session string) ([][]byte, error) {
 	if len(levelKeys) == 0 || len(candidates) == 0 {
 		return nil, nil
 	}
+	body, _, err := hhEvalBody(levelKeys, candidates)
+	if err != nil {
+		return nil, err
+	}
+	path := fmt.Sprintf(
+		"/v1/hh/eval?log_n=%d&k=%d&q=%d&level=%d&format=packed",
+		logN, len(levelKeys), len(candidates), level)
+	if session != "" {
+		path += "&session=" + url.QueryEscape(session)
+	}
+	out, err := c.post(path, body)
+	if err != nil {
+		return nil, err
+	}
+	return hhEvalRows(out, len(levelKeys), len(candidates))
+}
+
+// hhEvalBody serializes one hh round's upload: the key column then the
+// candidate values, the body layout both fronts share.
+func hhEvalBody(levelKeys []DPFkey, candidates []uint64) ([]byte, int, error) {
 	kl := len(levelKeys[0])
 	body := make([]byte, 0, kl*len(levelKeys)+8*len(candidates))
 	for _, k := range levelKeys {
 		if len(k) != kl {
-			return nil, fmt.Errorf("dpftpu: inconsistent key lengths")
+			return nil, 0, fmt.Errorf("dpftpu: inconsistent key lengths")
 		}
 		body = append(body, k...)
 	}
 	for _, x := range candidates {
 		body = binary.LittleEndian.AppendUint64(body, x)
 	}
-	out, err := c.post(fmt.Sprintf(
-		"/v1/hh/eval?log_n=%d&k=%d&q=%d&level=%d&format=packed",
-		logN, len(levelKeys), len(candidates), level), body)
-	if err != nil {
-		return nil, err
-	}
-	row := (len(candidates) + 7) / 8
-	if len(out) != len(levelKeys)*row {
+	return body, kl, nil
+}
+
+// hhEvalRows splits a packed hh eval reply into per-client rows.
+func hhEvalRows(out []byte, k, q int) ([][]byte, error) {
+	row := (q + 7) / 8
+	if len(out) != k*row {
 		return nil, fmt.Errorf("dpftpu: bad hh eval reply length %d", len(out))
 	}
-	res := make([][]byte, len(levelKeys))
+	res := make([][]byte, k)
 	for i := range res {
 		res[i] = out[i*row : (i+1)*row]
 	}

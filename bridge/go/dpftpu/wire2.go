@@ -428,39 +428,38 @@ func (c *Wire2Client) EvalPointsBatchPacked(keys []DPFkey, xs [][]uint64, logN u
 // HHEvalLevel runs one heavy-hitters round, like Client.HHEvalLevel —
 // the descent primitive a single multiplexed connection is built for.
 func (c *Wire2Client) HHEvalLevel(levelKeys []DPFkey, candidates []uint64, logN, level uint) ([][]byte, error) {
+	return c.HHEvalLevelSession(levelKeys, candidates, logN, level, "")
+}
+
+// HHEvalLevelSession is HHEvalLevel with the incremental-descent session
+// contract (see Client.HHEvalLevelSession): a non-empty session id pins
+// a device-resident frontier at the aggregator and every round uploads
+// the same level-(logN-1) key column.  On a single multiplexed wire2
+// connection this is the cheapest full descent the serving stack offers:
+// one socket, one session, no per-round tree rebuild.
+func (c *Wire2Client) HHEvalLevelSession(levelKeys []DPFkey, candidates []uint64, logN, level uint, session string) ([][]byte, error) {
 	if len(levelKeys) == 0 || len(candidates) == 0 {
 		return nil, nil
 	}
-	kl := len(levelKeys[0])
-	body := make([]byte, 0, kl*len(levelKeys)+8*len(candidates))
-	for _, k := range levelKeys {
-		if len(k) != kl {
-			return nil, fmt.Errorf("dpftpu: inconsistent key lengths")
-		}
-		body = append(body, k...)
+	body, _, err := hhEvalBody(levelKeys, candidates)
+	if err != nil {
+		return nil, err
 	}
-	for _, x := range candidates {
-		body = binary.LittleEndian.AppendUint64(body, x)
-	}
-	out, err := c.Do(wire2RouteHHEval, url.Values{
+	params := url.Values{
 		"log_n":  {strconv.Itoa(int(logN))},
 		"k":      {strconv.Itoa(len(levelKeys))},
 		"q":      {strconv.Itoa(len(candidates))},
 		"level":  {strconv.Itoa(int(level))},
 		"format": {"packed"},
-	}, body)
+	}
+	if session != "" {
+		params.Set("session", session)
+	}
+	out, err := c.Do(wire2RouteHHEval, params, body)
 	if err != nil {
 		return nil, err
 	}
-	row := (len(candidates) + 7) / 8
-	if len(out) != len(levelKeys)*row {
-		return nil, fmt.Errorf("dpftpu: bad hh eval reply length %d", len(out))
-	}
-	res := make([][]byte, len(levelKeys))
-	for i := range res {
-		res[i] = out[i*row : (i+1)*row]
-	}
-	return res, nil
+	return hhEvalRows(out, len(levelKeys), len(candidates))
 }
 
 // AggregateSubmit streams K client share rows to the aggregation fold,

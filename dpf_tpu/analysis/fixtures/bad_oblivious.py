@@ -164,6 +164,19 @@ def leaky_pir_chunk_eval(seeds, db):
     return jnp.bitwise_xor.reduce(chunk, axis=0)
 
 
+def leaky_frontier_index_eval(seeds, state):
+    """Gathers a frontier-cache column by a SECRET-derived selector —
+    the forbidden incremental-descent shape.  The production extend
+    bodies (models/dpf_chacha ``_hh_extend_cc_body`` and the compat
+    mirror) gather by ``sel``, the PUBLIC survivor positions both
+    aggregators learn from the announced counts; deriving the column
+    index from the carried seed state would make the frontier's memory
+    access pattern — which cached prefixes a round touches — a function
+    of key material, visible in HBM traffic."""
+    sel = (seeds[:2] & jnp.uint32(3)).astype(jnp.int32)
+    return jnp.take(state.reshape(2, -1), sel, axis=1)
+
+
 #: (function, n secret leading args, total args builder) — the tests
 #: iterate this to keep fixture and assertion lists in sync.
 LEAKY = (
@@ -178,4 +191,5 @@ LEAKY = (
     ("leaky_hh_descend_eval", leaky_hh_descend_eval, "secret-branch"),
     ("leaky_shard_index_eval", leaky_shard_index_eval, "secret-index"),
     ("leaky_pir_chunk_eval", leaky_pir_chunk_eval, "secret-index"),
+    ("leaky_frontier_index_eval", leaky_frontier_index_eval, "secret-index"),
 )

@@ -81,6 +81,48 @@ _OVERRIDES: dict[str, PerfContract] = {
         donated=(0, 1, 2, 3, 4),
         note="per-chunk level-state slices (s0..s3, T) donated",
     ),
+    # -- incremental heavy-hitter descent (PR 17's headline): the
+    # frontier carry is donated every tree/leaf_first round (steady-state
+    # descent allocates no fresh frontier HBM), the extend routes move
+    # ZERO collectives even sharded (rows stay client-sharded until the
+    # public fold), and the one cross-shard reduce of a whole round is
+    # the count fold's psum. ------------------------------------------------
+    "hh/extend/fast": PerfContract(
+        donated=(0, 1, 2, 3, 4),
+        note="frontier carry (s0..s3, T) donated into the level step",
+    ),
+    "hh/extend_leaf_first/fast": PerfContract(
+        donated=(0, 1, 2, 3, 4),
+        note="frontier carry donated into the one-time leaf conversion",
+    ),
+    "hh/extend/compat": PerfContract(
+        donated=(0, 1),
+        note="frontier carry (S, T) donated into the level step",
+    ),
+    "hh/extend_leaf_first/compat": PerfContract(
+        donated=(0, 1),
+        note="frontier carry donated into the one-time leaf conversion",
+    ),
+    "hh_extend_sharded/fast/tree": PerfContract(
+        donated=(0, 1, 2, 3, 4),
+        note="zero collectives: shards expand their clients locally",
+    ),
+    "hh_extend_sharded/fast/leaf_first": PerfContract(
+        donated=(0, 1, 2, 3, 4),
+        note="zero collectives: shards convert their clients locally",
+    ),
+    "hh_extend_sharded/compat/tree": PerfContract(
+        donated=(0, 1),
+        note="zero collectives: shards expand their key words locally",
+    ),
+    "hh_extend_sharded/compat/leaf_first": PerfContract(
+        donated=(0, 1),
+        note="zero collectives: shards convert their key words locally",
+    ),
+    "hh_fold_sharded/mxu": PerfContract(
+        collectives={"psum": 1},
+        note="the ONE count all-reduce of a sharded descent round",
+    ),
     # -- mesh aggregation: ONE all-reduce per streamed chunk -------------
     "agg_sharded/fold_xor": PerfContract(
         collectives=dict(_ONE_ALLGATHER), donated=(0,),
@@ -301,6 +343,72 @@ def _agg_site(op: str) -> DonationSite:
     )
 
 
+def _hh_extend_site(profile: str, leaf_first: bool) -> DonationSite:
+    """The frontier-carry donated twins (apps/hh_state's per-round
+    dispatch through core.plans.run_hh_extend): tree steps and the
+    one-time leaf conversion consume the carried state destructively;
+    the resident leaf planes (leaf_fold) are deliberately NOT here —
+    they are reused by every deeper round."""
+
+    def build() -> tuple[Any, Any, tuple]:
+        import jax.numpy as jnp
+
+        from ..trace import entrypoints as ep
+
+        sel = jnp.zeros(16, jnp.int32)
+        if profile == "fast":
+            from ...models import dpf_chacha as m
+
+            kb, (scw, tcw, fcw), state = ep._hh_state_fast(16, 16, 32)
+            if leaf_first:
+                args = (
+                    kb.log_n - kb.nu, *state, sel,
+                    *(fcw[:, j] for j in range(16)),
+                )
+                return (
+                    m._hh_leaf_first_cc_donated_jit,
+                    m._hh_leaf_first_cc_body, args,
+                )
+            args = (
+                *state, sel, scw[:, 0, 0], scw[:, 0, 1], scw[:, 0, 2],
+                scw[:, 0, 3], tcw[:, 0, 0], tcw[:, 0, 1],
+            )
+            return m._hh_extend_cc_donated_jit, m._hh_extend_cc_body, args
+        from ...models import dpf as m
+
+        dk, (S, T) = ep._hh_state_compat(9, 32, 32)
+        if leaf_first:
+            args = (9 - dk.nu, S, T, sel, dk.fcw_planes)
+            return (
+                m._hh_leaf_first_donated_jit, m._hh_leaf_first_body, args
+            )
+        args = (S, T, sel, dk.scw_planes[0], dk.tl_words[0], dk.tr_words[0])
+        return m._hh_extend_donated_jit, m._hh_extend_body, args
+
+    if profile == "fast":
+        from ...models import dpf_chacha as m
+
+        twin = (
+            "_hh_leaf_first_cc_donated_jit" if leaf_first
+            else "_hh_extend_cc_donated_jit"
+        )
+        mod = "models.dpf_chacha"
+    else:
+        from ...models import dpf as m
+
+        twin = (
+            "_hh_leaf_first_donated_jit" if leaf_first
+            else "_hh_extend_donated_jit"
+        )
+        mod = "models.dpf"
+    static, donate = m.DONATED_TWINS[twin]
+    route = (
+        f"hh/extend_leaf_first/{profile}" if leaf_first
+        else f"hh/extend/{profile}"
+    )
+    return DonationSite(f"{mod}.{twin}", (route,), static, donate, build)
+
+
 def _pir_site(sharded: bool) -> DonationSite:
     def build() -> tuple[Any, Any, tuple]:
         import jax.numpy as jnp
@@ -352,6 +460,10 @@ def donation_sites() -> tuple[DonationSite, ...]:
         _agg_site("add"),
         _pir_site(sharded=False),
         _pir_site(sharded=True),
+        _hh_extend_site("fast", leaf_first=False),
+        _hh_extend_site("fast", leaf_first=True),
+        _hh_extend_site("compat", leaf_first=False),
+        _hh_extend_site("compat", leaf_first=True),
     )
 
 

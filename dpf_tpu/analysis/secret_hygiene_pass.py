@@ -70,6 +70,12 @@ SECRET_NAMES = frozenset(
         "vcw", "vcw_t", "fvcw", "fvcw_t",
         "key_bytes", "key_blob", "key_material", "raw_key", "blob",
         "ka", "kb", "kbp", "kb_s",
+        # Frontier-cache resident state (apps/hh_state.FrontierState): the
+        # carried seed/control-bit tuple and the converted leaf planes are
+        # live PRG seeds at the surviving-prefix frontier — exactly as
+        # secret as the key batch they were expanded from.
+        "seed_state", "planes", "_seeds", "_ts", "_scw", "_tcw", "_fcw",
+        "_fcw_words",
     }
 )
 

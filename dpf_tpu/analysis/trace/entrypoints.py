@@ -277,6 +277,106 @@ def _hh_level_fast():
     )
 
 
+def _hh_state_fast(log_n: int, k: int, cb: int):
+    """A fast-profile frontier-cache state tuple at column bucket ``cb``
+    (apps/hh_state.FrontierState.reset's shapes) plus the key batch —
+    the carried seed/control-bit arrays every extend dispatch consumes."""
+    import jax.numpy as jnp
+
+    kb = _fast_batch(log_n, k)
+    seeds, ts, scw, tcw, fcw = kb.device_args()
+    S = [jnp.tile(seeds[:, i : i + 1], (1, cb)) for i in range(4)]
+    T = jnp.tile(ts[:, None], (1, cb))
+    return kb, (scw, tcw, fcw), (*S, T)
+
+
+def _hh_extend_fast(kind: str):
+    """The incremental-descent dispatch bodies on the fast profile
+    (core.plans.run_hh_extend -> models.dpf_chacha): the carried
+    frontier state and every correction-word operand are secret; the
+    survivor selector / child index is PUBLIC (survivors are announced
+    to both aggregators by protocol — DESIGN §19)."""
+    import jax.numpy as jnp
+
+    from ...models import dpf_chacha as dc
+
+    kb, (scw, tcw, fcw), state = _hh_state_fast(16, 16, 32)
+    sel = jnp.zeros(16, jnp.int32)
+    ibits = kb.log_n - kb.nu
+    if kind == "tree":
+        args = (
+            *state, sel, scw[:, 0, 0], scw[:, 0, 1], scw[:, 0, 2],
+            scw[:, 0, 3], tcw[:, 0, 0], tcw[:, 0, 1],
+        )
+        return _trace(
+            dc._hh_extend_cc_body, args,
+            secret=(0, 1, 2, 3, 4, 6, 7, 8, 9, 10, 11),
+        )
+    if kind == "leaf_first":
+        args = (ibits, *state, sel, *(fcw[:, j] for j in range(16)))
+        return _trace(
+            dc._hh_leaf_first_cc_body, args, static_argnums=(0,),
+            secret=tuple(range(1, 6)) + tuple(range(7, 23)),
+        )
+    P = jnp.zeros((16, 16, 16), jnp.uint32)  # resident leaf planes
+    idx = jnp.zeros(64, jnp.int32)
+    return _trace(
+        dc._hh_leaf_fold_cc_body, (2, ibits, P, idx),
+        static_argnums=(0, 1), secret=(2,),
+    )
+
+
+def _hh_state_compat(log_n: int, k: int, cb: int):
+    """Compat mirror of :func:`_hh_state_fast`: bitsliced plane state
+    [128, cb, Kp] / key-packed control words [cb, Kp]."""
+    import jax.numpy as jnp
+
+    from ...models import dpf
+
+    dk = dpf.DeviceKeys(_compat_batch(log_n, k))
+    S = jnp.tile(dk.seed_planes, (1, cb, 1))
+    T = jnp.tile(dk.t_words, (cb, 1))
+    return dk, (S, T)
+
+
+def _hh_extend_compat(kind: str):
+    import jax.numpy as jnp
+
+    from ...models import dpf
+
+    dk, (S, T) = _hh_state_compat(9, 32, 32)
+    sel = jnp.zeros(16, jnp.int32)
+    ibits = 9 - dk.nu
+    if kind == "tree":
+        args = (S, T, sel, dk.scw_planes[0], dk.tl_words[0], dk.tr_words[0])
+        return _trace(dpf._hh_extend_body, args, secret=(0, 1, 3, 4, 5))
+    if kind == "leaf_first":
+        args = (ibits, S, T, sel, dk.fcw_planes)
+        return _trace(
+            dpf._hh_leaf_first_body, args, static_argnums=(0,),
+            secret=(1, 2, 4),
+        )
+    C = jnp.zeros((128, 16, dk.k_padded // 32), jnp.uint32)
+    idx = jnp.zeros(64, jnp.int32)
+    return _trace(
+        dpf._hh_leaf_fold_body, (2, ibits, C, idx),
+        static_argnums=(0, 1), secret=(2,),
+    )
+
+
+def _hh_fold_mxu():
+    """The MXU count fold (core.plans.run_hh_fold): only PUBLIC data —
+    the driver XORs the two aggregators' rows before folding, so the
+    matmul's operand is the reconstructed predicate matrix (models/
+    hh_fold's module docstring; zero secret invars IS the claim)."""
+    import jax.numpy as jnp
+
+    from ...models import hh_fold
+
+    x = jnp.zeros((64, 2), jnp.uint32)
+    return _trace(hh_fold._count_fold_body, (x,), secret=())
+
+
 def _agg_fold(op: str):
     """One streamed-aggregation fold chunk (apps/aggregation.py): the
     carry and the client share rows are both secret; the fold must be
@@ -574,6 +674,77 @@ def _agg_fold_sharded(op: str):
     rows = jnp.zeros((256, 64), jnp.uint32)  # 32 rows per shard
     fn = sharding._sharded_agg_fold_sm(mesh, op)
     return _trace(fn, (carry, rows), secret=(0, 1))
+
+
+def _hh_extend_sharded_fast(kind: str):
+    """The mesh-resident frontier extend (parallel/sharding hh
+    factories): state and correction words shard over the client axis;
+    the public selector replicates.  NO collective — each shard's
+    clients expand locally and the rows stay client-sharded until the
+    public fold."""
+    import jax.numpy as jnp
+
+    from ...parallel import sharding
+
+    mesh = _serving_mesh_8()
+    kb, (scw, tcw, fcw), state = _hh_state_fast(16, 32, 32)  # 4 keys/shard
+    sel = jnp.zeros(16, jnp.int32)
+    ibits = kb.log_n - kb.nu
+    if kind == "tree":
+        fn = sharding._sharded_hh_extend_fast_sm(mesh)
+        args = (
+            *state, sel, scw[:, 0, 0], scw[:, 0, 1], scw[:, 0, 2],
+            scw[:, 0, 3], tcw[:, 0, 0], tcw[:, 0, 1],
+        )
+        return _trace(fn, args, secret=(0, 1, 2, 3, 4, 6, 7, 8, 9, 10, 11))
+    if kind == "leaf_first":
+        fn = sharding._sharded_hh_leaf_first_fast_sm(mesh, ibits)
+        args = (*state, sel, *(fcw[:, j] for j in range(16)))
+        return _trace(
+            fn, args, secret=tuple(range(0, 5)) + tuple(range(6, 22))
+        )
+    fn = sharding._sharded_hh_leaf_fold_fast_sm(mesh, 2, ibits)
+    P = jnp.zeros((32, 16, 16), jnp.uint32)
+    idx = jnp.zeros(64, jnp.int32)
+    return _trace(fn, (P, idx), secret=(0,))
+
+
+def _hh_extend_sharded_compat(kind: str):
+    import jax.numpy as jnp
+
+    from ...parallel import sharding
+
+    mesh = _serving_mesh_8()
+    dk, (S, T) = _hh_state_compat(9, 256, 32)  # Kp = 8 words, 1/shard
+    sel = jnp.zeros(16, jnp.int32)
+    ibits = 9 - dk.nu
+    if kind == "tree":
+        fn = sharding._sharded_hh_extend_compat_sm(mesh)
+        args = (S, T, sel, dk.scw_planes[0], dk.tl_words[0], dk.tr_words[0])
+        return _trace(fn, args, secret=(0, 1, 3, 4, 5))
+    if kind == "leaf_first":
+        fn = sharding._sharded_hh_leaf_first_compat_sm(mesh, ibits)
+        return _trace(
+            fn, (S, T, sel, dk.fcw_planes), secret=(0, 1, 3)
+        )
+    fn = sharding._sharded_hh_leaf_fold_compat_sm(mesh, 2, ibits)
+    C = jnp.zeros((128, 16, dk.k_padded // 32), jnp.uint32)
+    idx = jnp.zeros(64, jnp.int32)
+    return _trace(fn, (C, idx), secret=(0,))
+
+
+def _hh_fold_sharded():
+    """The mesh count fold: shard-local int8 matmuls + the ONE psum over
+    the client axis (parallel/sharding.hh_count_fold_sharded).  Public
+    operand, same trust argument as hh/fold_mxu."""
+    import jax.numpy as jnp
+
+    from ...parallel import sharding
+
+    mesh = _serving_mesh_8()
+    fn = sharding._sharded_hh_count_fold_sm(mesh)
+    x = jnp.zeros((64, 2), jnp.uint32)  # 8 rows per shard
+    return _trace(fn, (x,), secret=())
 
 
 # ---------------------------------------------------------------------------
@@ -897,6 +1068,62 @@ ROUTES: tuple[Route, ...] = (
         _hh_level_fast,
     ),
     _route(
+        "hh/extend/fast",
+        "apps.hh_state.FrontierState._tree_step "
+        "(core.plans.run_hh_extend -> models.dpf_chacha._hh_extend_cc)",
+        "hh_extend",
+        {"profile": "fast", "phase": "tree"},
+        lambda: _hh_extend_fast("tree"),
+    ),
+    _route(
+        "hh/extend_leaf_first/fast",
+        "apps.hh_state.FrontierState._leaf_first "
+        "(core.plans.run_hh_extend -> models.dpf_chacha._hh_leaf_first_cc)",
+        "hh_extend",
+        {"profile": "fast", "phase": "leaf_first"},
+        lambda: _hh_extend_fast("leaf_first"),
+    ),
+    _route(
+        "hh/extend_leaf_fold/fast",
+        "apps.hh_state.FrontierState._leaf_fold "
+        "(core.plans.run_hh_extend -> models.dpf_chacha._hh_leaf_fold_cc)",
+        "hh_extend",
+        {"profile": "fast", "phase": "leaf_fold"},
+        lambda: _hh_extend_fast("leaf_fold"),
+    ),
+    _route(
+        "hh/extend/compat",
+        "apps.hh_state.FrontierState._tree_step "
+        "(core.plans.run_hh_extend -> models.dpf._hh_extend)",
+        "hh_extend",
+        {"profile": "compat", "phase": "tree"},
+        lambda: _hh_extend_compat("tree"),
+    ),
+    _route(
+        "hh/extend_leaf_first/compat",
+        "apps.hh_state.FrontierState._leaf_first "
+        "(core.plans.run_hh_extend -> models.dpf._hh_leaf_first)",
+        "hh_extend",
+        {"profile": "compat", "phase": "leaf_first"},
+        lambda: _hh_extend_compat("leaf_first"),
+    ),
+    _route(
+        "hh/extend_leaf_fold/compat",
+        "apps.hh_state.FrontierState._leaf_fold "
+        "(core.plans.run_hh_extend -> models.dpf._hh_leaf_fold)",
+        "hh_extend",
+        {"profile": "compat", "phase": "leaf_fold"},
+        lambda: _hh_extend_compat("leaf_fold"),
+    ),
+    _route(
+        "hh/fold_mxu",
+        "apps.heavy_hitters.reconstruct_counts "
+        "(core.plans.run_hh_fold -> models.hh_fold._count_fold)",
+        "hh_fold",
+        {"profile": "public", "backend": "mxu"},
+        _hh_fold_mxu,
+    ),
+    _route(
         "agg/fold_xor",
         "apps.aggregation._fold_body (core.plans.run_agg_fold; "
         "/v1/agg/submit chunk dispatch)",
@@ -968,6 +1195,66 @@ ROUTES: tuple[Route, ...] = (
         "agg_add",
         {"profile": "agg", "op": "add", "mesh": 8},
         lambda: _agg_fold_sharded("add"), min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "hh_extend_sharded/fast/tree",
+        "parallel.sharding.hh_extend_fn_sharded "
+        "(core.plans.run_hh_extend mesh dispatch)",
+        "hh_extend",
+        {"profile": "fast", "phase": "tree", "mesh": 8},
+        lambda: _hh_extend_sharded_fast("tree"), min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "hh_extend_sharded/fast/leaf_first",
+        "parallel.sharding.hh_extend_fn_sharded "
+        "(core.plans.run_hh_extend mesh dispatch)",
+        "hh_extend",
+        {"profile": "fast", "phase": "leaf_first", "mesh": 8},
+        lambda: _hh_extend_sharded_fast("leaf_first"),
+        min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "hh_extend_sharded/fast/leaf_fold",
+        "parallel.sharding.hh_extend_fn_sharded "
+        "(core.plans.run_hh_extend mesh dispatch)",
+        "hh_extend",
+        {"profile": "fast", "phase": "leaf_fold", "mesh": 8},
+        lambda: _hh_extend_sharded_fast("leaf_fold"),
+        min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "hh_extend_sharded/compat/tree",
+        "parallel.sharding.hh_extend_fn_sharded "
+        "(core.plans.run_hh_extend mesh dispatch)",
+        "hh_extend",
+        {"profile": "compat", "phase": "tree", "mesh": 8},
+        lambda: _hh_extend_sharded_compat("tree"), min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "hh_extend_sharded/compat/leaf_first",
+        "parallel.sharding.hh_extend_fn_sharded "
+        "(core.plans.run_hh_extend mesh dispatch)",
+        "hh_extend",
+        {"profile": "compat", "phase": "leaf_first", "mesh": 8},
+        lambda: _hh_extend_sharded_compat("leaf_first"),
+        min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "hh_extend_sharded/compat/leaf_fold",
+        "parallel.sharding.hh_extend_fn_sharded "
+        "(core.plans.run_hh_extend mesh dispatch)",
+        "hh_extend",
+        {"profile": "compat", "phase": "leaf_fold", "mesh": 8},
+        lambda: _hh_extend_sharded_compat("leaf_fold"),
+        min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "hh_fold_sharded/mxu",
+        "parallel.sharding.hh_count_fold_sharded "
+        "(core.plans.run_hh_fold mesh dispatch; one psum/round)",
+        "hh_fold",
+        {"profile": "public", "backend": "mxu", "mesh": 8},
+        _hh_fold_sharded, min_devices=_MESH_SHARDS,
     ),
     # -- served 2-server PIR (models/pir.py; /v1/pir/query) ------------------
     _route(

@@ -44,6 +44,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..core import bitpack, knobs, plans
+from . import hh_state
 
 __all__ = [
     "HHShare",
@@ -202,6 +203,9 @@ def eval_level_shares(
     candidates = np.asarray(candidates, dtype=np.uint64).reshape(-1)
     kb = share.level_keys(level)
     xs = np.broadcast_to(candidates[None, :], (kb.k, candidates.shape[0]))
+    hh_state.PRG_EVALS.add(
+        hh_state.stateless_round_evals(kb.nu, kb.k, candidates.shape[0])
+    )
     return plans.run_hh_level(share.profile, kb, xs, int(level))
 
 
@@ -222,6 +226,18 @@ def reconstruct_counts(
         raise ValueError("heavy_hitters: share row shapes differ")
     x = rows_a ^ rows_b
     q = int(q)
+    fold = knobs.get_enum("DPF_TPU_HH_FOLD")
+    if fold == "auto":
+        import jax
+
+        fold = "host" if jax.default_backend() == "cpu" else "mxu"
+    if fold == "mxu":
+        qq = min(q, x.shape[1] * 32)  # short rows count 0, as on host
+        counts = np.zeros(q, np.int64)
+        counts[:qq] = plans.run_hh_fold(
+            np.ascontiguousarray(x[:, : bitpack.packed_words(qq)]), qq
+        )
+        return counts
     counts = np.zeros(q, np.int64)
     for w in range(min(x.shape[1], bitpack.packed_words(q))):
         col = x[:, w]
@@ -243,6 +259,12 @@ class HHRound:
     truncated: bool  # frontier clipped to DPF_TPU_HH_MAX_CANDIDATES
     eval_s: float  # wall seconds in the two share evaluations
     key_evals: int  # clients x candidates x 2 aggregators
+    # PRG level-evaluations actually performed this round (both
+    # aggregators; hh_state.PRG_EVALS delta).  Stateless rounds pay
+    # clients x candidates x (nu + 1) per aggregator; incremental rounds
+    # pay clients x surviving-parents per extended level and ZERO for
+    # intra-leaf folds — the >= 4x headline the tests assert.
+    prg_level_evals: int = 0
 
 
 @dataclass
@@ -271,6 +293,7 @@ def find_heavy_hitters(
     threshold: int | None = None,
     levels_per_round: int | None = None,
     max_candidates: int | None = None,
+    state: bool | None = None,
 ) -> HHResult:
     """Two-aggregator protocol driver: thresholded prefix-tree descent.
 
@@ -292,6 +315,16 @@ def find_heavy_hitters(
     frontier holds at most ``clients / threshold`` survivors and
     truncation needs ``2 * frontier > max_candidates``, so with
     ``threshold >= 2 * clients / max_candidates`` this cannot trigger).
+
+    ``state`` selects the incremental descent engine (apps/hh_state.py):
+    each aggregator's frontier seeds stay resident on device and every
+    round extends only the surviving parents, instead of re-walking all
+    candidates from the root.  ``None`` resolves ``DPF_TPU_HH_STATE``
+    (off disables; auto/on enable).  Incremental needs in-process
+    :class:`HHShare` aggregators — callables always evaluate stateless.
+    The recovered hitter set and counts are IDENTICAL either way: the
+    cached walk is a pure optimization, and any cache failure falls back
+    to a from-root rebuild of the same pipeline mid-descent.
     """
     if isinstance(eval_a, HHShare):
         if isinstance(eval_b, HHShare):
@@ -312,6 +345,44 @@ def find_heavy_hitters(
     if max_candidates is None:
         max_candidates = knobs.get_int("DPF_TPU_HH_MAX_CANDIDATES")
     max_candidates = max(int(max_candidates), 2)
+
+    if state is None:
+        state = knobs.get_enum("DPF_TPU_HH_STATE") != "off"
+    frontiers: dict = {}
+    if state and isinstance(eval_a, HHShare) and isinstance(eval_b, HHShare):
+        for agg in (eval_a, eval_b):
+            frontiers[id(agg)] = hh_state.FrontierState(
+                agg.profile, agg.level_keys(n - 1)
+            )
+
+    def advance(fstate, cands, depth):
+        try:
+            return fstate.advance(cands, depth)
+        except hh_state.StaleState:
+            fstate.reset()  # replant at root; replay is byte-identical
+            return fstate.advance(cands, depth)
+
+    def run_round(level, cands, cand_values):
+        # A round's two row sets must come from the SAME key pair: the
+        # incremental path evaluates both aggregators' level-(n-1) keys,
+        # the stateless path both aggregators' level-`level` keys — each
+        # pair XOR-reconstructs the same public predicate, but the pairs
+        # do not mix.  So incremental-vs-stateless is decided per ROUND,
+        # for both sides atomically.
+        if frontiers:
+            try:
+                return (
+                    advance(frontiers[id(eval_a)], cands, level + 1),
+                    advance(frontiers[id(eval_b)], cands, level + 1),
+                )
+            except Exception:
+                # Device-side failure mid-extension: the donated frontier
+                # is poisoned.  Drop the cache and finish the descent
+                # stateless — same keys, same math, same hitters.
+                frontiers.clear()
+        return (
+            run(eval_a, level, cand_values), run(eval_b, level, cand_values)
+        )
 
     def run(agg, level, cand_values):
         if isinstance(agg, HHShare):
@@ -342,8 +413,8 @@ def find_heavy_hitters(
         level = depth - 1
         cand_values = cands << np.uint64(n - depth)
         t0 = time.perf_counter()
-        rows_a = run(eval_a, level, cand_values)
-        rows_b = run(eval_b, level, cand_values)
+        prg0 = hh_state.PRG_EVALS.value
+        rows_a, rows_b = run_round(level, cands, cand_values)
         eval_s = time.perf_counter() - t0
         rows_a = _as_words(rows_a, cands.size)
         rows_b = _as_words(rows_b, cands.size)
@@ -360,6 +431,7 @@ def find_heavy_hitters(
                 truncated=truncated,
                 eval_s=eval_s,
                 key_evals=2 * int(rows_a.shape[0]) * int(cands.size),
+                prg_level_evals=hh_state.PRG_EVALS.value - prg0,
             )
         )
     return HHResult(values=frontier, counts=frontier_counts, rounds=rounds)

@@ -1,0 +1,539 @@
+"""Device-resident frontier cache for incremental heavy-hitter descent.
+
+The stateless driver (apps/heavy_hitters.py) re-walks every candidate
+from the ROOT each round: a level-``l`` evaluation of G clients x Q
+candidates costs ``G * Q * (nu + 1)`` PRG expansions (nu GGM levels plus
+the leaf conversion) no matter how deep the descent already is.  But the
+descent only ever asks about CHILDREN of prefixes that already survived
+— and the GGM walk of a client's level-``(n-1)`` key computes, at every
+tree node it visits, a control bit that IS a valid XOR share of "does
+this client's value start with this node's prefix" (the level-``(n-1)``
+key's point is the full value, so the sign-share invariant holds at
+every depth, not just the leaves).  This module caches that walk: the
+per-client seeds and control bits at the current surviving frontier stay
+RESIDENT ON DEVICE between rounds, and each round extends every cached
+parent ONE level (both children in one ``core/plans.run_hh_extend``
+dispatch) for ``G * parents`` PRG expansions — a ``~2 * (nu + 1) /
+levels_per_round`` reduction in PRG work per descent (>= 4x at
+``log_n >= 16``; the tests assert it).
+
+Past the tree depth ``nu`` the cached seeds convert to leaf planes ONCE
+(``leaf_first``); deeper rounds are pure XOR folds over the resident
+planes (``leaf_fold``, ZERO PRG evaluations): after XOR reconstruction
+at most one leaf bit is set per client, so a range-OR over a leaf-bit
+range equals the XOR fold the device computes.
+
+Correctness stance: the frontier cache is an OPTIMIZATION of a pure
+function — the share rows it produces are exactly the rows a from-root
+walk of the same level-``(n-1)`` keys computes, bit for bit.  Whenever
+the cache cannot serve a round (:class:`StaleState`: ancestors pruned
+beyond recovery, the serving mesh changed — e.g. a circuit-breaker trip
+degraded dispatch to single-device — or a dispatch died mid-donation and
+poisoned the carried buffers) the owner replants the frontier at the
+root and replays the SAME extend pipeline, which is byte-identical by
+construction.  Privacy stance (docs/DESIGN.md §19): the frontier is
+pruned on the PUBLICLY reconstructed survivor set — the same public
+output the stateless protocol reveals — so which columns are kept leaks
+nothing beyond the protocol's output; the cached seeds themselves are
+secret taint sources (analysis/secret_hygiene_pass.py) and the extend
+bodies carry obliviousness certificates like every eval body.
+
+Knobs: ``DPF_TPU_HH_STATE`` (off|auto|on) gates the driver and serving
+session registry; ``DPF_TPU_HH_STATE_MAX_SESSIONS`` /
+``DPF_TPU_HH_STATE_MAX_BYTES`` / ``DPF_TPU_HH_STATE_TTL_S`` bound the
+serving-side :class:`SessionCache`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..core import bitpack, knobs, plans
+
+__all__ = [
+    "StaleState",
+    "PRG_EVALS",
+    "FrontierState",
+    "SessionCache",
+    "serve_extend",
+    "warm_ladder",
+    "stateless_round_evals",
+]
+
+
+class StaleState(Exception):
+    """The cached frontier cannot serve this round — rebuild from root
+    (byte-identical by construction; see module docstring)."""
+
+
+class _EvalCounter:
+    """Process-wide PRG level-evaluation odometer (one unit = one PRG
+    expansion or leaf conversion of one client's node).  Both the
+    stateless from-root path and the incremental path report here, so a
+    descent's cost ratio is a plain counter quotient in the tests and
+    the bench ledger."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def add(self, n: int) -> None:
+        self.value += int(n)
+
+    def reset(self) -> int:
+        v, self.value = self.value, 0
+        return v
+
+
+PRG_EVALS = _EvalCounter()
+
+
+def stateless_round_evals(nu: int, g: int, q: int) -> int:
+    """PRG level-evals one from-root round costs one aggregator: every
+    (client, candidate) pair walks ``nu`` GGM levels + one leaf
+    conversion regardless of the requested level."""
+    return int(g) * int(q) * (int(nu) + 1)
+
+
+def _children(parents: np.ndarray) -> np.ndarray:
+    """Sorted depth-(d+1) children of sorted depth-d prefixes, in the
+    L,R-interleaved column order the level-step bodies emit."""
+    return (
+        (parents[:, None] << np.uint64(1))
+        | np.arange(2, dtype=np.uint64)[None, :]
+    ).reshape(-1)
+
+
+class FrontierState:
+    """One aggregator's device-resident descent frontier over a G-key
+    level-``(n-1)`` sub-batch (``HHShare.level_keys(log_n - 1)``).
+
+    The state machine: at tree depth ``d <= nu`` the state is the
+    UNPRUNED children of the last round's surviving parents — seeds and
+    control bits for ``len(emitted)`` columns (``emitted``: the sorted
+    depth-``d`` prefixes those columns hold), padded to the monotone
+    plan bucket ``cb``.  Pruning is fused into the NEXT extension: the
+    public survivor selector gathers only the surviving parent columns,
+    so the consumed state and its replacement share one bucketed shape
+    and the dispatch donates the dead frontier in place.  Crossing depth
+    ``nu`` converts the gathered seeds to leaf planes once; from then on
+    the planes are immutable (never donated) and every round is a pure
+    XOR fold addressed by a public gather index.
+
+    Column buckets only ever GROW (``cb`` is monotone per descent):
+    parents fit the previous bucket, so each step at most doubles it —
+    the executable ladder 32, 64, ..., cap is exactly what
+    ``warm_ladder`` pre-compiles, and a repeated descent performs zero
+    retraces."""
+
+    def __init__(self, profile: str, kb, *, g: int | None = None):
+        if profile not in ("fast", "compat"):
+            raise ValueError(f"hh_state: unknown profile {profile!r}")
+        self.profile = profile
+        self.log_n = int(kb.log_n)
+        self.g = int(kb.k if g is None else g)
+        self.nu = int(kb.nu)
+        self.ibits = self.log_n - self.nu
+        _, n_shards = plans._dispatch_mesh()
+        self.n_shards = n_shards
+        # Compat state lane-packs the key axis (Kp = K/32 words), so a
+        # sharded mesh needs whole WORDS per shard, not whole keys.
+        quantum = max(n_shards, 1)
+        if profile == "compat":
+            quantum = 32 * quantum if n_shards else 1
+        self.kp = plans._pow2_bucket(kb.k, max(plans.k_floor(), quantum, 32))
+        kbp = plans._pad_keys(kb, self.kp - kb.k)
+        if profile == "fast":
+            (
+                self._seeds, self._ts, self._scw, self._tcw, self._fcw,
+            ) = kbp.device_args()
+            self._fcw_words = None
+        else:
+            from ..models import dpf
+
+            self._dk = dpf._cached_device_keys(kbp)
+        self._lvl_args: dict = {}
+        self.reset()
+
+    # -- lifecycle ---------------------------------------------------
+
+    def reset(self) -> None:
+        """(Re)plant the frontier at the root: depth 0, one real column
+        (the key's root seed + t bit), bucket-padded by repetition.  The
+        per-level correction operands are never donated, so reset always
+        recovers — including from a dispatch that died mid-donation."""
+        import jax.numpy as jnp
+
+        self.depth = 0
+        self.cb = 32
+        self.dead = False
+        self.planes = None
+        self.anc = None
+        self.emitted = np.zeros(1, np.uint64)
+        if self.profile == "fast":
+            self.seed_state = tuple(
+                jnp.tile(self._seeds[:, i : i + 1], (1, self.cb))
+                for i in range(4)
+            ) + (jnp.tile(self._ts[:, None], (1, self.cb)),)
+        else:
+            self.seed_state = (
+                jnp.tile(self._dk.seed_planes, (1, self.cb, 1)),
+                jnp.tile(self._dk.t_words, (self.cb, 1)),
+            )
+
+    @property
+    def nbytes(self) -> int:
+        """Device bytes held by the resident frontier (uint32 lanes)."""
+        return sum(int(a.size) * 4 for a in self.seed_state)
+
+    # -- round API ---------------------------------------------------
+
+    def advance(self, cands: np.ndarray, depth: int) -> np.ndarray:
+        """Extend the frontier to ``depth`` and return the packed
+        prefix-predicate share rows uint32[G, ceil(Q/32)] for ``cands``
+        (depth-``depth`` prefixes, any order, duplicates allowed) — byte
+        identical to a from-root ``run_hh_level`` of the same keys.
+
+        Raises :class:`StaleState` when the cache cannot serve (the
+        caller rebuilds via :meth:`reset` and retries — a root replant
+        serves ANY depth).  Any other dispatch failure marks the state
+        dead: the consumed frontier was donated and may be poisoned."""
+        cands = np.asarray(cands, dtype=np.uint64).reshape(-1)
+        D = int(depth)
+        if cands.size == 0 or not 0 < D <= self.log_n:
+            raise ValueError("hh_state: bad candidate set or depth")
+        if (cands >> np.uint64(D)).any():
+            raise ValueError("hh_state: candidate exceeds its depth")
+        if self.dead:
+            raise StaleState("frontier poisoned by a failed dispatch")
+        if plans._dispatch_mesh()[1] != self.n_shards:
+            # Mesh changed under us (breaker degraded to single-device,
+            # or recovered): the resident shards are laid out for the
+            # old mesh AND the plan bucket quantum may differ.
+            raise StaleState("serving mesh changed")
+        if D <= self.depth and not (self.planes is not None and D > self.nu):
+            raise StaleState("descent must deepen")
+        try:
+            return self._advance(cands, D)
+        except StaleState:
+            raise
+        except Exception:
+            self.dead = True
+            raise
+
+    def _advance(self, cands: np.ndarray, D: int) -> np.ndarray:
+        rows = None
+        for di in range(self.depth + 1, min(D, self.nu) + 1):
+            parents = np.unique(cands >> np.uint64(D - di + 1))
+            sel, cbn = self._sel(parents)
+            rows = self._tree_step(di, parents, sel, cbn)
+        if D > self.nu:
+            m = D - self.nu
+            fresh_planes = self.planes is None
+            if fresh_planes:
+                anc = np.unique(cands >> np.uint64(m))
+                sel, cbn = self._sel(anc)
+                rows = self._leaf_first(anc, sel, cbn)
+            if m > 1 or not fresh_planes:
+                out = self._leaf_fold(cands, m)
+                self.depth = D
+                return out
+        self.depth = D
+        return self._gather(rows, cands)
+
+    # -- internals ---------------------------------------------------
+
+    def _sel(self, parents: np.ndarray):
+        """Survivor selector: positions of ``parents`` in the emitted
+        column order, padded to the (monotone) new bucket's parent width
+        by repeating column 0 — a valid column, and the resulting
+        garbage children are never gathered."""
+        pos = np.searchsorted(self.emitted, parents)
+        if (pos >= self.emitted.size).any() or (
+            self.emitted[np.minimum(pos, self.emitted.size - 1)] != parents
+        ).any():
+            raise StaleState("round ancestors not in cached frontier")
+        cbn = max(self.cb, plans.q_bucket(2 * parents.size))
+        sel = np.zeros(cbn // 2, np.int32)
+        sel[: pos.size] = pos
+        return sel, cbn
+
+    def _level_operands(self, level: int) -> tuple:
+        ops = self._lvl_args.get(level)
+        if ops is None:
+            if self.profile == "fast":
+                ops = (
+                    self._scw[:, level, 0], self._scw[:, level, 1],
+                    self._scw[:, level, 2], self._scw[:, level, 3],
+                    self._tcw[:, level, 0], self._tcw[:, level, 1],
+                )
+            else:
+                ops = (
+                    self._dk.scw_planes[level],
+                    self._dk.tl_words[level],
+                    self._dk.tr_words[level],
+                )
+            self._lvl_args[level] = ops
+        return ops
+
+    def _tree_step(self, di: int, parents, sel, cbn: int) -> np.ndarray:
+        self.seed_state, rows = plans.run_hh_extend(
+            self.profile, self.log_n, self.kp, "tree", self.seed_state,
+            (sel,) + self._level_operands(di - 1), q=cbn,
+        )
+        PRG_EVALS.add(self.g * parents.size)
+        self.emitted = _children(parents)
+        self.depth = di
+        self.cb = cbn
+        return rows
+
+    def _leaf_first(self, anc, sel, cbn: int) -> np.ndarray:
+        if self.profile == "fast":
+            if self._fcw_words is None:
+                self._fcw_words = tuple(
+                    self._fcw[:, j] for j in range(16)
+                )
+            args = (sel,) + self._fcw_words
+        else:
+            args = (sel, self._dk.fcw_planes)
+        (planes,), rows = plans.run_hh_extend(
+            self.profile, self.log_n, self.kp, "leaf_first", self.seed_state,
+            args, q=cbn, ibits=self.ibits,
+        )
+        PRG_EVALS.add(self.g * anc.size)
+        self.planes = planes
+        self.seed_state = (planes,)
+        self.anc = anc
+        self.emitted = _children(anc)
+        self.cb = cbn
+        return rows
+
+    def _leaf_fold(self, cands: np.ndarray, m: int) -> np.ndarray:
+        """Intra-leaf depths: a pure XOR fold over the resident planes,
+        addressed per requested candidate — zero PRG evaluations, no
+        column gather on host (the index IS the request order)."""
+        anc_pos = np.searchsorted(self.anc, cands >> np.uint64(m))
+        if (anc_pos >= self.anc.size).any() or (
+            self.anc[np.minimum(anc_pos, self.anc.size - 1)]
+            != (cands >> np.uint64(m))
+        ).any():
+            raise StaleState("leaf ancestors not in converted planes")
+        cbn = max(self.cb, plans.q_bucket(cands.size))
+        idx = np.zeros(cbn, np.int32)
+        idx[: cands.size] = (
+            anc_pos.astype(np.int64) << m
+        ) | (cands & np.uint64((1 << m) - 1)).astype(np.int64)
+        self.cb = cbn
+        _, rows = plans.run_hh_extend(
+            self.profile, self.log_n, self.kp, "leaf_fold", self.seed_state,
+            (idx,), q=cbn, m=m, ibits=self.ibits,
+        )
+        return bitpack.mask_tail(
+            np.ascontiguousarray(
+                rows[: self.g, : bitpack.packed_words(cands.size)]
+            ),
+            cands.size,
+        )
+
+    def _gather(self, rows: np.ndarray, cands: np.ndarray) -> np.ndarray:
+        """Re-pack the requested candidate columns (request order) out of
+        the emitted column order of the last device rows."""
+        pos = np.searchsorted(self.emitted, cands)
+        if (pos >= self.emitted.size).any() or (
+            self.emitted[np.minimum(pos, self.emitted.size - 1)] != cands
+        ).any():
+            raise StaleState("requested candidates not in emitted columns")
+        bits = bitpack.unpack_bits(rows[: self.g], self.emitted.size)
+        return bitpack.pack_bits(bits[:, pos])
+
+
+# ---------------------------------------------------------------------------
+# Serving-side session registry
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Session:
+    sid: str
+    digest: str
+    profile: str
+    log_n: int
+    state: FrontierState
+    created: float
+    last_used: float
+    rounds: int = 0
+
+
+class SessionCache:
+    """Descent-session registry for the sidecar: session id -> resident
+    :class:`FrontierState`, bounded by the ``DPF_TPU_HH_STATE_*`` knobs
+    (LRU count + device-byte budget + idle TTL; limits are re-read per
+    call so live knob overrides apply without a restart).  All mutation
+    happens under the provided lock — serving passes its stats lock so
+    ``/v1/stats`` snapshots and evictions serialize with request
+    bookkeeping."""
+
+    def __init__(self, lock: threading.RLock | None = None):
+        self._lock = lock if lock is not None else threading.RLock()
+        self._sessions: dict[str, _Session] = {}  # insertion == LRU order
+        self.hits = 0
+        self.misses = 0
+        self.rebuilds = 0
+        self.evicted = 0
+
+    def _evict_locked(self, sid: str) -> None:
+        if self._sessions.pop(sid, None) is not None:
+            self.evicted += 1
+
+    def evict(self, sid: str) -> None:
+        with self._lock:
+            self._evict_locked(sid)
+
+    def clear(self) -> None:
+        with self._lock:
+            self.evicted += len(self._sessions)
+            self._sessions.clear()
+
+    def nbytes(self) -> int:
+        with self._lock:
+            return sum(s.state.nbytes for s in self._sessions.values())
+
+    def sweep(self, now: float | None = None) -> None:
+        """Enforce TTL, session-count, and byte budgets (oldest-idle
+        first; the budget never evicts the last remaining session — a
+        single over-budget descent still completes incrementally)."""
+        now = time.time() if now is None else now
+        ttl = knobs.get_int("DPF_TPU_HH_STATE_TTL_S")
+        max_n = knobs.get_int("DPF_TPU_HH_STATE_MAX_SESSIONS")
+        max_b = knobs.get_int("DPF_TPU_HH_STATE_MAX_BYTES")
+        with self._lock:
+            for sid, s in list(self._sessions.items()):
+                if now - s.last_used > ttl:
+                    self._evict_locked(sid)
+            by_idle = sorted(
+                self._sessions, key=lambda k: self._sessions[k].last_used
+            )
+            while len(self._sessions) > max(max_n, 1):
+                self._evict_locked(by_idle.pop(0))
+            while (
+                len(self._sessions) > 1
+                and sum(s.state.nbytes for s in self._sessions.values())
+                > max_b
+            ):
+                self._evict_locked(by_idle.pop(0))
+
+    def lookup(self, sid: str, digest: str, profile: str, log_n: int):
+        """The live session for ``sid`` — evicted (and None returned) if
+        the caller's key material or shape no longer matches (a reused
+        session id with fresh keys is a NEW descent)."""
+        with self._lock:
+            s = self._sessions.get(sid)
+            if s is None:
+                self.misses += 1
+                return None
+            if (
+                s.digest != digest
+                or s.profile != profile
+                or s.log_n != int(log_n)
+            ):
+                self._evict_locked(sid)
+                self.misses += 1
+                return None
+            self.hits += 1
+            s.last_used = time.time()
+            return s
+
+    def store(self, sid: str, digest: str, state: FrontierState) -> _Session:
+        now = time.time()
+        s = _Session(
+            sid=sid, digest=digest, profile=state.profile,
+            log_n=state.log_n, state=state, created=now, last_used=now,
+        )
+        with self._lock:
+            self._sessions[sid] = s
+        self.sweep(now)
+        return s
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "sessions": len(self._sessions),
+                "bytes": sum(
+                    s.state.nbytes for s in self._sessions.values()
+                ),
+                "hits": self.hits,
+                "misses": self.misses,
+                "rebuilds": self.rebuilds,
+                "evicted": self.evicted,
+            }
+
+
+def serve_extend(
+    cache: SessionCache, sid: str, profile: str, kb, digest: str,
+    values: np.ndarray, level: int,
+) -> np.ndarray:
+    """Sidecar round primitive behind ``/v1/hh/eval?session=<sid>``:
+    ``kb`` is the G-key LEVEL-``(n-1)`` batch from the request body (the
+    session contract — the cached walk needs the full-value key; the
+    ``level`` param selects the depth as usual), ``values`` the raw
+    shifted candidate values.  Pure-function semantics: the reply equals
+    a from-root evaluation of those keys at ``level`` bit for bit,
+    whether the cached frontier served, was rebuilt, or was just
+    created."""
+    depth = int(level) + 1
+    prefixes = np.asarray(values, np.uint64) >> np.uint64(kb.log_n - depth)
+    sess = cache.lookup(sid, digest, profile, kb.log_n)
+    if sess is None:
+        sess = cache.store(sid, digest, FrontierState(profile, kb))
+    try:
+        try:
+            rows = sess.state.advance(prefixes, depth)
+        except StaleState:
+            with cache._lock:
+                cache.rebuilds += 1
+            sess.state.reset()
+            rows = sess.state.advance(prefixes, depth)
+    except Exception:
+        # The dispatch itself failed — the donated frontier may be
+        # poisoned.  Evict so the next round rebuilds from the root,
+        # and let the breaker see the failure.
+        cache.evict(sid)
+        raise
+    with cache._lock:
+        sess.rounds += 1
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# Warmup
+# ---------------------------------------------------------------------------
+
+
+def warm_ladder(profile: str, log_n: int, k: int, q: int) -> None:
+    """Drive one synthetic maximal descent (every candidate survives
+    until the ``q`` cap, one level per round) over a zero key batch:
+    visits the monotone bucket ladder 32, 64, ..., ``q`` of every phase
+    executable — tree grow + steady state, the leaf crossing, and every
+    intra-leaf fold depth — which is the exact shape set a saturating
+    session touches (``core/plans.warmup`` route ``hh_extend``)."""
+    from . import heavy_hitters as hh
+
+    gen, _, _ = hh._profile_api(profile)
+    ka, _ = gen(
+        np.zeros(max(int(k), 1), np.uint64), int(log_n),
+        rng=np.random.default_rng(0),
+    )
+    st = FrontierState(profile, ka)
+    q = max(plans.q_bucket(max(int(q), 2)), 32)
+    frontier = np.zeros(1, np.uint64)
+    for d in range(1, int(log_n) + 1):
+        cands = _children(frontier)
+        st.advance(cands, d)
+        frontier = cands
+        if 2 * frontier.size > q:
+            frontier = frontier[: q // 2]

@@ -368,6 +368,45 @@ _declare(
     "dpf_tpu/apps/heavy_hitters.py",
 )
 _declare(
+    "DPF_TPU_HH_STATE", "enum", "auto",
+    "Incremental heavy-hitters descent: cache each session's frontier "
+    "seeds/control bits on device and extend ONE level per round "
+    "(apps/hh_state.py) instead of re-walking every candidate from the "
+    "root.  off = always stateless from-root; auto/on = incremental with "
+    "byte-identical from-root rebuild on any cache miss, eviction, or "
+    "breaker trip.",
+    "dpf_tpu/apps/hh_state.py", choices=("off", "auto", "on"),
+)
+_declare(
+    "DPF_TPU_HH_STATE_MAX_SESSIONS", "int", "64",
+    "Serving-side cap on concurrently cached descent sessions "
+    "(/v1/hh/eval?session=...); the oldest-idle frontier is evicted "
+    "first and its next round rebuilds from root.",
+    "dpf_tpu/apps/hh_state.py",
+)
+_declare(
+    "DPF_TPU_HH_STATE_MAX_BYTES", "int", str(1 << 28),
+    "Device-byte budget across all cached descent frontiers (seed lanes "
+    "+ converted leaf planes); least-recently-used sessions are evicted "
+    "until under budget (the last live session is never evicted, so one "
+    "over-budget descent still completes incrementally).",
+    "dpf_tpu/apps/hh_state.py",
+)
+_declare(
+    "DPF_TPU_HH_STATE_TTL_S", "int", "600",
+    "Idle seconds before a cached descent session is evicted (a client "
+    "that abandons a descent mid-way must not pin device memory).",
+    "dpf_tpu/apps/hh_state.py",
+)
+_declare(
+    "DPF_TPU_HH_FOLD", "enum", "auto",
+    "Count reconstruction route for heavy-hitters rounds: host = the "
+    "per-word popcount loop; mxu = one int8 matmul over the client axis "
+    "(models/hh_fold.py, preferred_element_type=int32) through the plan "
+    "cache; auto = mxu on an accelerator backend, host on CPU.",
+    "dpf_tpu/apps/heavy_hitters.py", choices=("auto", "host", "mxu"),
+)
+_declare(
     "DPF_TPU_AGG_CHUNK_BYTES", "int", str(1 << 22),
     "Upload bytes folded per device dispatch on the secure-aggregation "
     "routes (/v1/agg/submit reads the body in chunks of this many bytes "

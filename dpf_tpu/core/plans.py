@@ -131,7 +131,7 @@ def q_bucket(q: int) -> int:
 PLAN_ROUTES = frozenset(
     {
         "points", "dcf_points", "dcf_interval", "evalfull", "hh_level",
-        "agg_xor", "agg_add", "pir",
+        "hh_extend", "hh_fold", "agg_xor", "agg_add", "pir",
     }
 )
 
@@ -147,11 +147,12 @@ class PlanKey(NamedTuple):
     sbox: str  # active S-box schedule (compat cipher routes)
     mesh: int = 0  # serving-mesh shard count (0 = single-device)
     tuned: str = ""  # canonical tuned-config tag ("" = registry defaults)
+    variant: str = ""  # sub-route executable tag (hh_extend phase/shape)
 
 
 def plan_key(
     route: str, profile: str, log_n: int, k: int, q: int = 0,
-    packed: bool = True, mesh: int = 0,
+    packed: bool = True, mesh: int = 0, variant: str = "",
 ) -> PlanKey:
     from ..ops import sbox_circuit
 
@@ -171,6 +172,7 @@ def plan_key(
         sbox_circuit.active_sbox(),
         int(mesh),
         _tuned_tag(),
+        str(variant),
     )
 
 
@@ -639,6 +641,160 @@ def run_hh_level(profile: str, kb, xs: np.ndarray, level: int) -> np.ndarray:
         )
 
 
+def run_hh_extend(
+    profile: str, log_n: int, k: int, phase: str, state: tuple, args: tuple,
+    *, q: int, m: int = 0, ibits: int = 0,
+):
+    """Plan-cached incremental frontier extension: expand a cached
+    descent frontier ONE level (both children of every surviving parent
+    in a single dispatch) instead of re-walking every candidate from the
+    root.  ``state`` is the session's device-resident frontier (fast:
+    ``(s0..s3, T)`` seed lanes + control bits; compat: ``(S, T)``
+    bitsliced planes; leaf phases: the converted leaf planes), ``args``
+    the public operands (surviving-parent selector / leaf-bit gather
+    index, plus the level's correction words), ``q`` the bucketed
+    candidate width of the emitted children.
+
+    Three phases share the route: ``tree`` (one GGM level step over the
+    gathered parents), ``leaf_first`` (the nu -> nu+1 crossing: convert
+    the frontier seeds to leaf planes once, fold to the first intra-leaf
+    depth), ``leaf_fold`` (pure XOR folds over the cached planes — zero
+    PRG evaluations).  Tree and leaf_first run donated twins under
+    ``donation_enabled()`` (the consumed frontier is dead the moment its
+    children exist); leaf_fold reuses its planes across rounds and never
+    donates.  Returns ``(new_state, rows)`` with ``rows`` the packed
+    candidate share words uint32[K, q // 32] on host and ``new_state``
+    still on device — callers (apps/hh_state) own slicing, masking and
+    session bookkeeping.  With the serving mesh resolved the state lives
+    sharded over the key axis and the same bodies run under shard_map
+    with zero collectives (the per-key rows never meet on device)."""
+    if phase not in ("tree", "leaf_first", "leaf_fold"):
+        raise ValueError(f"hh_extend: unknown phase {phase!r}")
+    mesh, n_shards = _dispatch_mesh()
+    if phase == "tree":
+        w_in = state[-1].shape[1] if profile == "fast" else state[1].shape[0]
+        variant = f"tree{w_in}"
+    elif phase == "leaf_first":
+        variant = "leaf1"
+    else:
+        variant = f"fold{m}x{state[0].shape[1]}"
+    with _tuned_dispatch("hh_extend", profile, log_n, k, n_shards):
+        key = plan_key(
+            "hh_extend", profile, log_n, k, q, packed=True, mesh=n_shards,
+            variant=variant,
+        )
+        plan, first = _CACHE.get(key)
+        obs_trace.add_event(
+            "plan_lookup", hit=not first, route="hh_extend",
+            k_bucket=key.k_bucket, q_bucket=key.q_bucket,
+        )
+        t0 = time.perf_counter()
+        donate = donation_enabled() and phase != "leaf_fold"
+        if profile == "fast":
+            from ..models import dpf_chacha as _m
+        else:
+            from ..models import dpf as _m
+        with obs_trace.child_span("compute"):
+            if mesh is not None:
+                from ..parallel import sharding
+
+                fn = sharding.hh_extend_fn_sharded(
+                    mesh, profile, phase, ibits=ibits, m=m, donate=donate
+                )
+                out = fn(*state, *args)
+            elif phase == "tree":
+                if profile == "fast":
+                    fn = (
+                        _m._hh_extend_cc_donated_jit if donate
+                        else _m._hh_extend_cc_jit
+                    )
+                else:
+                    fn = (
+                        _m._hh_extend_donated_jit if donate
+                        else _m._hh_extend_jit
+                    )
+                out = fn(*state, *args)
+            elif phase == "leaf_first":
+                if profile == "fast":
+                    fn = (
+                        _m._hh_leaf_first_cc_donated_jit if donate
+                        else _m._hh_leaf_first_cc_jit
+                    )
+                else:
+                    fn = (
+                        _m._hh_leaf_first_donated_jit if donate
+                        else _m._hh_leaf_first_jit
+                    )
+                out = fn(ibits, *state, *args)
+            else:
+                fn = (
+                    _m._hh_leaf_fold_cc_jit if profile == "fast"
+                    else _m._hh_leaf_fold_jit
+                )
+                out = fn(m, ibits, *state, *args)
+        if phase == "tree":
+            new_state, rows_dev = tuple(out[:-1]), out[-1]
+        elif phase == "leaf_first":
+            new_state, rows_dev = (out[0],), out[1]
+        else:
+            new_state, rows_dev = state, out
+        with obs_trace.child_span("d2h"):
+            # The new frontier state stays resident on device; only the
+            # tiny packed rows cross per round.
+            # host-sync: per-round candidate share rows
+            rows = np.asarray(rows_dev)
+        if first:
+            plan.compile_s = time.perf_counter() - t0
+        plan.last_used = time.time()
+        return new_state, rows
+
+
+def run_hh_fold(rows_xor: np.ndarray, q: int | None = None) -> np.ndarray:
+    """Plan-cached MXU count fold: XOR-reconstructed PUBLIC predicate
+    rows uint32[G, W] (one packed candidate row per client) -> int64[q]
+    per-candidate counts via one int8 matmul over the client axis
+    (models/hh_fold; mirrors pir._parity_matmul's
+    ``preferred_element_type=int32`` idiom).  Rows and word columns are
+    bucketed like every plan (zero rows add zero counts).  With the
+    serving mesh resolved the rows shard over the client axis and the
+    shard partials meet in ONE psum.  Secret share rows must never reach
+    this route un-XORed — integer sums of XOR shares reconstruct
+    nothing; the caller XORs the two aggregators' rows first."""
+    rows_xor = np.asarray(rows_xor, dtype=np.uint32)
+    if rows_xor.ndim != 2:
+        raise ValueError("hh_fold: rows must be [G, W]")
+    G, W = rows_xor.shape
+    q = W * 32 if q is None else int(q)
+    if q > W * 32:
+        raise ValueError("hh_fold: q exceeds packed row width")
+    mesh, n_shards = _dispatch_mesh()
+    with _tuned_dispatch("hh_fold", "public", 0, G, n_shards):
+        key = plan_key("hh_fold", "public", 0, G, W * 32, packed=True,
+                       mesh=n_shards)
+        plan, first = _CACHE.get(key)
+        obs_trace.add_event(
+            "plan_lookup", hit=not first, route="hh_fold",
+            k_bucket=key.k_bucket, q_bucket=key.q_bucket,
+        )
+        t0 = time.perf_counter()
+        wb = key.q_bucket // 32
+        rows_p = np.zeros((key.k_bucket, wb), np.uint32)
+        rows_p[:G, :W] = rows_xor
+        from ..models import hh_fold
+
+        with obs_trace.child_span("compute"):
+            if mesh is not None:
+                from ..parallel.sharding import hh_count_fold_sharded
+
+                counts = hh_count_fold_sharded(rows_p, mesh)
+            else:
+                counts = hh_fold.count_fold(rows_p)
+        if first:
+            plan.compile_s = time.perf_counter() - t0
+        plan.last_used = time.time()
+        return np.ascontiguousarray(counts[:q])
+
+
 def run_agg_fold(
     op: str, carry: np.ndarray | None, rows: np.ndarray
 ) -> np.ndarray:
@@ -896,6 +1052,22 @@ def warmup(shapes: list[dict]) -> list[dict]:
                 run_hh_level(
                     profile, kb, np.zeros((kb_count, q), np.uint64), 0
                 )
+            elif route == "hh_extend":
+                # Drives a synthetic maximal descent (every candidate
+                # survives until the q cap) over a zero key batch through
+                # apps/hh_state — that visits the bucket ladder 32, 64,
+                # ..., q of every phase executable (tree grow + steady,
+                # leaf crossing, every intra-leaf fold depth), which is
+                # exactly the shape set a session saturating q touches.
+                from ..apps import hh_state
+
+                hh_state.warm_ladder(profile, log_n, kb_count, q)
+            elif route == "hh_fold":
+                run_hh_fold(
+                    np.zeros(
+                        (kb_count, max(q_bucket(q) // 32, 1)), np.uint32
+                    )
+                )
             elif route == "evalfull":
                 if profile == "fast":
                     from ..models.keys_chacha import gen_batch
@@ -981,6 +1153,13 @@ def recent_shapes(limit: int = 4) -> list[dict]:
             # so re-warm happens on the first post-recovery query instead
             # (the resident placement survives the breaker trip; only the
             # degraded single-device twin may pay a compile).
+            continue
+        if key.route == "hh_extend":
+            # A frontier-extend plan is keyed on a session's live state
+            # shape — the probe has no session to replay, and a tripped
+            # breaker evicts the cached frontiers anyway (donated buffers
+            # may be poisoned mid-dispatch), so the first post-recovery
+            # descent rebuilds from root and re-warms itself.
             continue
         spec = {
             "route": key.route,

@@ -424,6 +424,90 @@ _finish_chunk_donated_jit = partial(
 
 
 # ---------------------------------------------------------------------------
+# Incremental heavy-hitter frontier extension (apps/hh_state.py) — the
+# compat-profile mirror of models/dpf_chacha's hh extend bodies; see the
+# block comment there for the control-bit-invariant derivation.  State
+# stays in the bitsliced plane layout ([128, F, Kp] seeds, [F, Kp]
+# key-packed control words); the emitted rows transpose to the
+# client-major packed contract on device.
+# ---------------------------------------------------------------------------
+
+
+def _keywords_to_rows(Tq):
+    """Key-packed bit words uint32[Q, Kp] (key k at word k // 32, bit
+    k % 32) -> client-major packed rows uint32[K, Q // 32] (the
+    core/bitpack output contract)."""
+    bits = (Tq[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(
+        1
+    )
+    return bitpack.pack_bits_qmajor_jnp(bits.reshape(Tq.shape[0], -1))
+
+
+def hh_leaf_fold_planes(C, m, ibits):
+    """Fold converted leaf planes to depth-``m`` intra-leaf predicate
+    bits.  C uint32[128, A, Kp] (plane x = leaf value bit x, key-packed);
+    only planes < 2**ibits are populated (ibits = log_n - nu <= 7).
+    Returns uint32[2**m, A, Kp]: entry v = XOR of planes
+    [v * s, (v + 1) * s), s = 2**(ibits - m) — key-packing is orthogonal
+    to the plane axis, so the fold is a plain XOR reduction."""
+    s = (1 << ibits) >> m
+    w = C[: 1 << ibits].reshape(1 << m, s, C.shape[1], C.shape[2])
+    return jax.lax.reduce(w, np.uint32(0), jax.lax.bitwise_xor, (1,))
+
+
+def _hh_extend_body(S, T, sel, cw_plane, tl_w, tr_w):
+    """One incremental frontier level (compat): gather the surviving
+    parent columns (public ``sel`` int32[F]) from the carried
+    [128, 2F, Kp] / [2F, Kp] state and expand one level -> new state +
+    client-major packed rows uint32[K, 2F // 32]."""
+    Sg = jnp.take(S, sel, axis=1)
+    Tg = jnp.take(T, sel, axis=0)
+    S2, T2 = _level_step(Sg, Tg, cw_plane, tl_w, tr_w, "xla")
+    return S2, T2, _keywords_to_rows(T2)
+
+
+def _hh_leaf_first_body(ibits, S, T, sel, fcw_planes):
+    """Frontier crossing into the leaf (compat): convert the surviving
+    depth-nu columns once -> resident plane state uint32[128, F, Kp] +
+    the m=1 split rows uint32[K, 2F // 32]."""
+    Sg = jnp.take(S, sel, axis=1)
+    Tg = jnp.take(T, sel, axis=0)
+    C = _MMO_IMPLS["xla"](Sg.reshape(128, -1)).reshape(Sg.shape)
+    C = C ^ (fcw_planes & Tg[None, :, :])
+    B = hh_leaf_fold_planes(C, 1, ibits)  # [2, F, Kp]
+    rows = _keywords_to_rows(
+        jnp.moveaxis(B, 0, 1).reshape(-1, B.shape[2])
+    )  # (parent, bit) order
+    return C, rows
+
+
+def _hh_leaf_fold_body(m, ibits, C, idx):
+    """Intra-leaf frontier level m >= 2 (compat): fold the resident
+    plane state (NOT donated — reused by deeper rounds) and gather the
+    requested children (public ``idx`` int32[Q] = anc * 2**m + v)."""
+    B = hh_leaf_fold_planes(C, m, ibits)
+    flat = jnp.moveaxis(B, 0, 1).reshape(-1, B.shape[2])
+    return _keywords_to_rows(jnp.take(flat, idx, axis=0))
+
+
+_hh_extend_jit = jax.jit(_hh_extend_body)
+_hh_extend_donated_jit = partial(jax.jit, donate_argnums=(0, 1))(
+    _hh_extend_body
+)
+_hh_leaf_first_jit = partial(jax.jit, static_argnums=(0,))(
+    _hh_leaf_first_body
+)
+_hh_leaf_first_donated_jit = partial(
+    jax.jit, static_argnums=(0,), donate_argnums=(1, 2)
+)(_hh_leaf_first_body)
+_hh_leaf_fold_jit = partial(jax.jit, static_argnums=(0, 1))(
+    _hh_leaf_fold_body
+)
+DONATED_TWINS["_hh_extend_donated_jit"] = ((), (0, 1))
+DONATED_TWINS["_hh_leaf_first_donated_jit"] = ((0,), (1, 2))
+
+
+# ---------------------------------------------------------------------------
 # Public API
 # ---------------------------------------------------------------------------
 

@@ -207,6 +207,105 @@ _finish_chunk_cc_donated_jit = partial(
 )(_finish_chunk_cc_body)
 
 
+# ---------------------------------------------------------------------------
+# Incremental heavy-hitter frontier extension (apps/hh_state.py)
+#
+# The GGM control-bit invariant makes a descent round a ONE-level PRG
+# step instead of a from-root walk: for the client's LAST level key
+# (point = the full value), the two aggregators' states at any tree node
+# are equal off the value's path and differ exactly on it, so the
+# control bit T at a depth-d node is a valid XOR share of "the value's
+# d-bit prefix is this node".  The frontier cache carries (S, T) at the
+# surviving prefixes across rounds; each round gathers the publicly
+# surviving parent columns (sel is PUBLIC — survivors are announced to
+# both aggregators by protocol) and expands both children in one
+# dispatch.  Past the tree (depth > nu), leaves convert ONCE and deeper
+# prefixes become XOR folds over intra-leaf bit ranges: after XOR
+# reconstruction at most one leaf bit is set, so the range-OR the
+# descent needs IS the XOR fold of the share bits.
+# ---------------------------------------------------------------------------
+
+
+def hh_leaf_fold_cc(P, m, ibits):
+    """Fold converted leaf words to depth-``m`` intra-leaf predicate bits.
+
+    P uint32[K, A, 16] leaf output words (value bit x at word x // 32,
+    bit x % 32, LSB-first); only the low ``2**ibits`` bits are populated
+    (ibits = log_n - nu <= 9).  Returns uint32[K, A, 2**m] 0/1 share
+    bits: entry v is the XOR of the leaf bits in value range
+    [v * s, (v + 1) * s), s = 2**(ibits - m)."""
+    K, A = P.shape[0], P.shape[1]
+    n_bits = 1 << ibits
+    s = n_bits >> m
+    if s >= 32:
+        w = P[:, :, : n_bits // 32].reshape(K, A, 1 << m, s // 32)
+        w = jax.lax.reduce(w, np.uint32(0), jax.lax.bitwise_xor, (3,))
+        for sh in (16, 8, 4, 2, 1):
+            w = w ^ (w >> sh)
+        return w & np.uint32(1)
+    # Sub-word ranges: in-word parity fold (shifts < s never cross a
+    # range), then extract each range's LSB at bit c * s.
+    p = P[:, :, : max(n_bits // 32, 1)]
+    sh = s >> 1
+    while sh:
+        p = p ^ (p >> sh)
+        sh >>= 1
+    idx = np.arange(min(32, n_bits) // s, dtype=np.uint32) * np.uint32(s)
+    b = (p[:, :, :, None] >> idx) & np.uint32(1)
+    return b.reshape(K, A, -1)
+
+
+def _hh_extend_cc_body(s0, s1, s2, s3, T, sel, c0, c1, c2, c3, tlcw, trcw):
+    """One incremental frontier level: gather the surviving parent
+    columns (public ``sel`` int32[F]) out of the carried uint32[K, 2F]
+    state, expand each one level -> new [K, 2F] child state (children
+    interleaved L,R per parent) + the children's control-bit share rows
+    packed client-major uint32[K, 2F // 32]."""
+    S = [jnp.take(s, sel, axis=1) for s in (s0, s1, s2, s3)]
+    Tg = jnp.take(T, sel, axis=1)
+    S2, T2 = _level_step_cc(S, Tg, [c0, c1, c2, c3], tlcw, trcw)
+    return (*S2, T2, bitpack.pack_bits_jnp(T2))
+
+
+def _hh_leaf_first_cc_body(ibits, s0, s1, s2, s3, T, sel, *fcw):
+    """Frontier crossing into the leaf: gather the surviving depth-nu
+    columns, convert their leaves ONCE (-> the session's resident
+    uint32[K, F, 16] plane state) and emit the first intra-leaf split
+    (m=1) as packed rows uint32[K, 2F // 32]."""
+    S = [jnp.take(s, sel, axis=1) for s in (s0, s1, s2, s3)]
+    Tg = jnp.take(T, sel, axis=1)
+    P = _convert_leaves_cc(S, Tg, list(fcw))
+    B = hh_leaf_fold_cc(P, 1, ibits)  # [K, F, 2], (parent, bit) order
+    return P, bitpack.pack_bits_jnp(B.reshape(B.shape[0], -1))
+
+
+def _hh_leaf_fold_cc_body(m, ibits, P, idx):
+    """Intra-leaf frontier level m >= 2: fold the resident plane state
+    (NOT donated — it is reused by every deeper round) and gather the
+    requested children (public ``idx`` int32[Q] = anc * 2**m + v) ->
+    packed rows uint32[K, Q // 32]."""
+    B = hh_leaf_fold_cc(P, m, ibits)
+    bits = jnp.take(B.reshape(B.shape[0], -1), idx, axis=1)
+    return bitpack.pack_bits_jnp(bits)
+
+
+_hh_extend_cc_jit = jax.jit(_hh_extend_cc_body)
+_hh_extend_cc_donated_jit = partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))(
+    _hh_extend_cc_body
+)
+_hh_leaf_first_cc_jit = partial(jax.jit, static_argnums=(0,))(
+    _hh_leaf_first_cc_body
+)
+_hh_leaf_first_cc_donated_jit = partial(
+    jax.jit, static_argnums=(0,), donate_argnums=(1, 2, 3, 4, 5)
+)(_hh_leaf_first_cc_body)
+_hh_leaf_fold_cc_jit = partial(jax.jit, static_argnums=(0, 1))(
+    _hh_leaf_fold_cc_body
+)
+DONATED_TWINS["_hh_extend_cc_donated_jit"] = ((), (0, 1, 2, 3, 4))
+DONATED_TWINS["_hh_leaf_first_cc_donated_jit"] = ((0,), (1, 2, 3, 4, 5))
+
+
 # Soft cap on K * 2^nu leaf nodes per compiled expansion (each leaf is 64 B
 # plus transient children); above it the tree splits into independent
 # subtree chunks, mirroring the compat path (models/dpf.py:MAX_PLANE_WORDS).

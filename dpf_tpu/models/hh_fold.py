@@ -1,0 +1,48 @@
+"""MXU-routed heavy-hitter count fold (ROADMAP item 3's second half).
+
+A descent round's count reconstruction is an inner product over the
+client axis: the driver XORs the two aggregators' packed share rows
+(PUBLIC once reconstructed — exactly the per-candidate predicate bits)
+and sums each candidate's column.  The host loop in
+``apps/heavy_hitters.reconstruct_counts`` walks word x bit in Python;
+here the same sum is one int8 MXU matmul, mirroring
+``models/pir._parity_matmul``: unpack the packed words to int8 bits and
+multiply by an all-ones row with ``preferred_element_type=jnp.int32``
+so the MXU accumulates the int32 counts directly.
+
+Only PUBLIC data flows through this body (the obliviousness certificate
+for ``hh/fold_mxu`` records zero secret invars); the secret share rows
+never reach it un-XORed — per-aggregator integer sums of XOR share bits
+reconstruct nothing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _count_fold_body(x):
+    """Packed XOR-reconstructed rows uint32[G, W] -> int32[W * 32]
+    per-candidate counts (one matmul over the client axis)."""
+    g, w = x.shape
+    bits = (
+        (x[:, :, None] >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(1)
+    ).astype(jnp.int8)
+    ones = jnp.ones((1, g), jnp.int8)
+    return jnp.matmul(
+        ones, bits.reshape(g, w * 32), preferred_element_type=jnp.int32
+    )[0]
+
+
+_count_fold_jit = jax.jit(_count_fold_body)
+
+
+def count_fold(x: np.ndarray) -> np.ndarray:
+    """Host entry: uint32[G, W] packed public rows -> int64[W * 32]."""
+    # host-sync: tiny per-round count vector (one word row per candidate)
+    return np.asarray(_count_fold_jit(jnp.asarray(x)), dtype=np.int64)
+
+
+__all__ = ["count_fold", "_count_fold_body", "_count_fold_jit"]

@@ -288,6 +288,25 @@ def render(stats: dict, hists: dict,
         w.sample(f"{ns}_pir_bytes_scanned_total", None,
                  pir["bytes_scanned"])
 
+    hhs = stats.get("hh_state")
+    if hhs is not None:
+        w.family(f"{ns}_hh_session_hits_total", "counter",
+                 "Incremental heavy-hitters rounds served from a cached "
+                 "device frontier.")
+        w.sample(f"{ns}_hh_session_hits_total", None, hhs["hits"])
+        w.family(f"{ns}_hh_session_misses_total", "counter",
+                 "Descent rounds that found no (or a mismatched) cached "
+                 "session and built a fresh frontier.")
+        w.sample(f"{ns}_hh_session_misses_total", None, hhs["misses"])
+        w.family(f"{ns}_hh_session_rebuilds_total", "counter",
+                 "Stale cached frontiers replanted at the root and "
+                 "replayed (byte-identical from-root recompute).")
+        w.sample(f"{ns}_hh_session_rebuilds_total", None, hhs["rebuilds"])
+        w.family(f"{ns}_hh_session_evictions_total", "counter",
+                 "Descent sessions evicted (TTL, LRU budget, digest "
+                 "mismatch, or poisoned state).")
+        w.sample(f"{ns}_hh_session_evictions_total", None, hhs["evicted"])
+
     phases = stats.get("phases", {})
     w.family(f"{ns}_phase_seconds_total", "counter",
              "Cumulative wall seconds per request phase.")
@@ -326,6 +345,13 @@ def render(stats: dict, hists: dict,
     w.family(f"{ns}_keycache_entries", "gauge",
              "Key batches resident in the host-repack LRU.")
     w.sample(f"{ns}_keycache_entries", None, kc["entries"])
+    if hhs is not None:
+        w.family(f"{ns}_hh_sessions", "gauge",
+                 "Descent sessions with a device-resident frontier.")
+        w.sample(f"{ns}_hh_sessions", None, hhs["sessions"])
+        w.family(f"{ns}_hh_session_bytes", "gauge",
+                 "Device bytes held by cached descent frontiers.")
+        w.sample(f"{ns}_hh_session_bytes", None, hhs["bytes"])
     if tr:
         w.family(f"{ns}_trace_ring_size", "gauge",
                  "Traces currently held by the flight recorder.")

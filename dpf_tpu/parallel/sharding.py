@@ -875,3 +875,218 @@ def fold_rows_sharded(
     if R % n:
         raise ValueError(f"agg: rows {R} must tile the {n}-shard mesh")
     return _sharded_agg_fold(mesh, op, donate)(carry, rows)
+
+
+# ---------------------------------------------------------------------------
+# Sharded incremental heavy-hitter frontier extension (apps/hh_state.py)
+#
+# The frontier state shards over the ``keys`` axis (fast profile:
+# key-major arrays; compat: the lane-word axis) and the one-level
+# extend is embarrassingly parallel — ZERO collectives, the perf
+# contract pins it; the public sel/idx operands replicate.  Only the
+# MXU count fold (PUBLIC reconstructed rows) meets in a collective:
+# shard-local matmul + ONE psum over the client shards.
+# ---------------------------------------------------------------------------
+
+
+def _sharded_hh_extend_fast_sm(mesh: Mesh):
+    from ..models import dpf_chacha as dc
+
+    return shard_map_compat(
+        dc._hh_extend_cc_body,
+        mesh=mesh,
+        in_specs=(P(KEYS_AXIS, None),) * 5
+        + (P(None),)
+        + (P(KEYS_AXIS),) * 6,
+        out_specs=(P(KEYS_AXIS, None),) * 6,
+        check_vma=False,
+    )
+
+
+@cache
+def _sharded_hh_extend_fast(mesh: Mesh, donate: bool = False):
+    fn = _sharded_hh_extend_fast_sm(mesh)
+    jitted = (
+        jax.jit(fn, donate_argnums=(0, 1, 2, 3, 4)) if donate
+        else jax.jit(fn)
+    )
+    return SHARDED_JITS.register(jitted)
+
+
+def _sharded_hh_leaf_first_fast_sm(mesh: Mesh, ibits: int):
+    from functools import partial
+
+    from ..models import dpf_chacha as dc
+
+    return shard_map_compat(
+        partial(dc._hh_leaf_first_cc_body, ibits),
+        mesh=mesh,
+        in_specs=(P(KEYS_AXIS, None),) * 5
+        + (P(None),)
+        + (P(KEYS_AXIS),) * 16,
+        out_specs=(P(KEYS_AXIS, None, None), P(KEYS_AXIS, None)),
+        check_vma=False,
+    )
+
+
+@cache
+def _sharded_hh_leaf_first_fast(mesh: Mesh, ibits: int, donate: bool = False):
+    fn = _sharded_hh_leaf_first_fast_sm(mesh, ibits)
+    jitted = (
+        jax.jit(fn, donate_argnums=(0, 1, 2, 3, 4)) if donate
+        else jax.jit(fn)
+    )
+    return SHARDED_JITS.register(jitted)
+
+
+def _sharded_hh_leaf_fold_fast_sm(mesh: Mesh, m: int, ibits: int):
+    from functools import partial
+
+    from ..models import dpf_chacha as dc
+
+    return shard_map_compat(
+        partial(dc._hh_leaf_fold_cc_body, m, ibits),
+        mesh=mesh,
+        in_specs=(P(KEYS_AXIS, None, None), P(None)),
+        out_specs=P(KEYS_AXIS, None),
+        check_vma=False,
+    )
+
+
+@cache
+def _sharded_hh_leaf_fold_fast(mesh: Mesh, m: int, ibits: int):
+    return SHARDED_JITS.register(
+        jax.jit(_sharded_hh_leaf_fold_fast_sm(mesh, m, ibits))
+    )
+
+
+def _sharded_hh_extend_compat_sm(mesh: Mesh):
+    from ..models import dpf as dm
+
+    return shard_map_compat(
+        dm._hh_extend_body,
+        mesh=mesh,
+        in_specs=(
+            P(None, None, KEYS_AXIS),
+            P(None, KEYS_AXIS),
+            P(None),
+            P(None, KEYS_AXIS),
+            P(KEYS_AXIS),
+            P(KEYS_AXIS),
+        ),
+        out_specs=(
+            P(None, None, KEYS_AXIS),
+            P(None, KEYS_AXIS),
+            P(KEYS_AXIS, None),
+        ),
+        check_vma=False,
+    )
+
+
+@cache
+def _sharded_hh_extend_compat(mesh: Mesh, donate: bool = False):
+    fn = _sharded_hh_extend_compat_sm(mesh)
+    jitted = jax.jit(fn, donate_argnums=(0, 1)) if donate else jax.jit(fn)
+    return SHARDED_JITS.register(jitted)
+
+
+def _sharded_hh_leaf_first_compat_sm(mesh: Mesh, ibits: int):
+    from functools import partial
+
+    from ..models import dpf as dm
+
+    return shard_map_compat(
+        partial(dm._hh_leaf_first_body, ibits),
+        mesh=mesh,
+        in_specs=(
+            P(None, None, KEYS_AXIS),
+            P(None, KEYS_AXIS),
+            P(None),
+            P(None, None, KEYS_AXIS),
+        ),
+        out_specs=(P(None, None, KEYS_AXIS), P(KEYS_AXIS, None)),
+        check_vma=False,
+    )
+
+
+@cache
+def _sharded_hh_leaf_first_compat(
+    mesh: Mesh, ibits: int, donate: bool = False
+):
+    fn = _sharded_hh_leaf_first_compat_sm(mesh, ibits)
+    jitted = jax.jit(fn, donate_argnums=(0, 1)) if donate else jax.jit(fn)
+    return SHARDED_JITS.register(jitted)
+
+
+def _sharded_hh_leaf_fold_compat_sm(mesh: Mesh, m: int, ibits: int):
+    from functools import partial
+
+    from ..models import dpf as dm
+
+    return shard_map_compat(
+        partial(dm._hh_leaf_fold_body, m, ibits),
+        mesh=mesh,
+        in_specs=(P(None, None, KEYS_AXIS), P(None)),
+        out_specs=P(KEYS_AXIS, None),
+        check_vma=False,
+    )
+
+
+@cache
+def _sharded_hh_leaf_fold_compat(mesh: Mesh, m: int, ibits: int):
+    return SHARDED_JITS.register(
+        jax.jit(_sharded_hh_leaf_fold_compat_sm(mesh, m, ibits))
+    )
+
+
+def hh_extend_fn_sharded(
+    mesh: Mesh, profile: str, phase: str, *, ibits: int = 0, m: int = 0,
+    donate: bool = False,
+):
+    """The sharded extend executable for one (profile, phase): plans
+    dispatches through this exactly like the single-device jit twins in
+    the model modules (same bodies under shard_map, byte-identical
+    rows)."""
+    if profile == "fast":
+        if phase == "tree":
+            return _sharded_hh_extend_fast(mesh, donate)
+        if phase == "leaf_first":
+            return _sharded_hh_leaf_first_fast(mesh, ibits, donate)
+        return _sharded_hh_leaf_fold_fast(mesh, m, ibits)
+    if phase == "tree":
+        return _sharded_hh_extend_compat(mesh, donate)
+    if phase == "leaf_first":
+        return _sharded_hh_leaf_first_compat(mesh, ibits, donate)
+    return _sharded_hh_leaf_fold_compat(mesh, m, ibits)
+
+
+def _sharded_hh_count_fold_sm(mesh: Mesh):
+    from ..models import hh_fold
+
+    def body(x):
+        return jax.lax.psum(hh_fold._count_fold_body(x), KEYS_AXIS)
+
+    return shard_map_compat(
+        body,
+        mesh=mesh,
+        in_specs=(P(KEYS_AXIS, None),),
+        out_specs=P(None),
+        check_vma=False,
+    )
+
+
+@cache
+def _sharded_hh_count_fold(mesh: Mesh):
+    return SHARDED_JITS.register(jax.jit(_sharded_hh_count_fold_sm(mesh)))
+
+
+def hh_count_fold_sharded(x: np.ndarray, mesh: Mesh) -> np.ndarray:
+    """Mesh dispatch of the MXU count fold: uint32[G, W] public
+    reconstructed rows (G a mesh multiple) -> int64[W * 32] counts via
+    shard-local int8 matmuls and ONE psum."""
+    g = int(x.shape[0])
+    n = int(mesh.shape[KEYS_AXIS])
+    if g % n:
+        raise ValueError(f"hh: rows {g} must tile the {n}-shard mesh")
+    # host-sync: tiny per-round count vector
+    return np.asarray(_sharded_hh_count_fold(mesh)(x), dtype=np.int64)

@@ -153,6 +153,40 @@ class HHWork:
 
 
 @dataclass
+class HHExtendWork:
+    """One incremental descent round (/v1/hh/eval?session=...): advance
+    the session's device-resident frontier (apps/hh_state.py) to the
+    requested depth.  The lane keys on the SESSION ID: successive rounds
+    of one descent are sequentially dependent (each consumes the device
+    state — possibly donated — that its predecessor produced), so they
+    serialize in arrival order within the lane; independent sessions
+    ride separate lanes and never mix.  ``kb`` is the G-key
+    LEVEL-(log_n - 1) batch (the session contract: the cached walk needs
+    the full-value key; ``level`` still selects the depth)."""
+
+    profile: str
+    kb: object
+    digest: str  # key-blob digest — session identity check
+    sid: str
+    values: np.ndarray  # uint64 [Q] raw shifted candidate values
+    level: int
+    cache: object  # hh_state.SessionCache (the serving registry)
+    deadline: float | None = None
+    trace: object = None
+    queue_wait: float = 0.0
+    dispatch_s: float = 0.0
+    coalesced: int = 0
+
+    @property
+    def n_keys(self) -> int:
+        return int(self.kb.k)
+
+    @property
+    def lane(self) -> tuple:
+        return ("hh_extend", self.profile, self.kb.log_n, self.sid)
+
+
+@dataclass
 class PirWork:
     """One PIR query request: K query keys against one registered
     database (the /v1/pir/query body).  The lane keys on the DB OBJECT
@@ -263,6 +297,23 @@ def dispatch_hh(items: list[HHWork]) -> list[np.ndarray]:
         items[0].profile, merged_kb, _merged_queries(items), items[0].level
     )
     return _slice_rows(words, items)
+
+
+def dispatch_hh_extend(items: list[HHExtendWork]) -> list[np.ndarray]:
+    """Lane dispatcher for incremental descent rounds -> per-item packed
+    share rows.  No cross-item merging: the lane holds successive rounds
+    of ONE session, each consuming the frontier its predecessor left on
+    device — they run strictly in arrival order."""
+    faults.fire("dispatch.hh_extend")
+    from ..apps import hh_state
+
+    return [
+        hh_state.serve_extend(
+            it.cache, it.sid, it.profile, it.kb, it.digest, it.values,
+            it.level,
+        )
+        for it in items
+    ]
 
 
 def dispatch_pir(items: list[PirWork]) -> list[np.ndarray]:

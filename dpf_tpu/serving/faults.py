@@ -71,6 +71,7 @@ SITES = (
     "dispatch.interval",
     "dispatch.evalfull",
     "dispatch.hh",
+    "dispatch.hh_extend",
     "dispatch.agg",
     "dispatch.pir",
     "pir.db_load",

@@ -57,11 +57,13 @@ from ..utils.profiling import PhaseTimer
 from . import faults
 from .batcher import (
     Batcher,
+    HHExtendWork,
     HHWork,
     IntervalWork,
     PirWork,
     PointsWork,
     dispatch_hh,
+    dispatch_hh_extend,
     dispatch_interval,
     dispatch_pir,
     dispatch_points,
@@ -349,6 +351,12 @@ class _ServingState:
         self.breaker = CircuitBreaker(
             probe=plans.rewarm_recent, lock=self.stats_lock
         )
+        # Incremental heavy-hitters descent sessions (apps/hh_state.py):
+        # session id -> device-resident frontier.  Shares the stats lock
+        # so eviction sweeps and /v1/stats snapshots never tear.
+        from ..apps import hh_state as _hh_state
+
+        self.hh_sessions = _hh_state.SessionCache(lock=self.stats_lock)
         self.tracer = obs_trace.Tracer()
         # Readiness (GET /readyz): flipped by the first successful
         # POST /v1/warmup — a sidecar that never warmed serves traffic
@@ -507,6 +515,7 @@ class _ServingState:
                 "trace": self.tracer.stats(),
                 "mesh": serving_mesh.stats(),
                 "pir": pir_store.registry().stats(),
+                "hh_state": self.hh_sessions.stats(),
                 "tuned": tuned.stats(),
                 "wire": {k: dict(v) for k, v in self.wire.items()},
             }
@@ -890,6 +899,24 @@ def _handle(req: Request, st: _ServingState, trace) -> Reply:
         packed = _wire_format(q)
         kb = cached_keys(profile, body[: k * kl], k, kl)
         cands = np.frombuffer(body[k * kl :], dtype="<u8")
+        sid = q.get("session")
+        if sid and knobs.get_enum("DPF_TPU_HH_STATE") != "off":
+            # Incremental descent: the body's keys are the LEVEL-(n-1)
+            # keys (the session contract — same k, same key length) and
+            # the session's cached frontier advances to depth level+1.
+            # The reply is the same pure function of (keys, candidates,
+            # level) whether the cache served, rebuilt, or just formed.
+            import hashlib
+
+            digest = hashlib.sha256(body[: k * kl]).hexdigest()
+            words = st.run(
+                HHExtendWork(
+                    profile, kb, digest, sid, cands, level,
+                    st.hh_sessions, deadline=deadline, trace=trace,
+                ),
+                dispatch_hh_extend,
+            )
+            return _points_reply(words, nq, packed)
         words = st.run(
             HHWork(
                 profile, kb,

@@ -22,7 +22,11 @@
 #       (now incl. the protocol-applications layer, tests/test_apps.py —
 #       heavy-hitters recovery + the 10^5-key plan-cached acceptance run,
 #       aggregation fold differentials, hh/agg wire identity,
-#       deadline/shed on the hh route — and the served-PIR suite,
+#       deadline/shed on the hh route — the incremental-descent frontier
+#       cache (tests/test_hh_state.py — incremental-vs-from-root byte
+#       identity on both profiles, the >=4x PRG-eval contract, session
+#       registry bounds, fault/eviction fallback, mesh identity) — and
+#       the served-PIR suite,
 #       tests/test_pir_serving.py — registry/run_pir/native byte
 #       identity, the streamed chunk scan, mesh dispatch + degraded
 #       fallback, the /v1/pir/* wire):
@@ -86,7 +90,8 @@ elif [ "${1:-}" = "--fast" ]; then
       tests/test_packed.py tests/test_serving.py tests/test_obs.py \
       tests/test_serving_stress.py tests/test_analysis.py \
       tests/test_oblivious.py tests/test_perf_contracts.py \
-      tests/test_apps.py tests/test_pir_serving.py tests/test_wire2.py \
+      tests/test_apps.py tests/test_hh_state.py tests/test_pir_serving.py \
+      tests/test_wire2.py \
       -q -m 'not slow' "$@"
 else
   # -m is last-wins in pytest, so a caller-supplied -m overrides ours.

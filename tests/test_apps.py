@@ -227,11 +227,15 @@ def test_hh_e2e_100k_keys_plan_cached():
     sa, sb = hh.gen_shares(vals, n, profile="fast", rng=rng)
     assert sa.levels.k == 102_400  # the K >= 10^5 contract
 
-    # Warm the two (K, Q) buckets the descent will hit (the grouped body
-    # is level-independent, so this covers all 16 levels; the candidate
-    # cap keeps every round in the q<=64 bucket).
+    # Warm the buckets the descent will hit: the hh_extend ladder covers
+    # every incremental phase executable up to the candidate cap (the
+    # default DPF_TPU_HH_STATE=auto descends incrementally), and the two
+    # hh_level (K, Q) buckets cover the stateless fallback (the grouped
+    # body is level-independent, so they cover all 16 levels).
     plans.warmup(
         [
+            {"route": "hh_extend", "profile": "fast", "log_n": n, "k": g,
+             "q": 64},
             {"route": "hh_level", "profile": "fast", "log_n": n, "k": g,
              "q": 16},
             {"route": "hh_level", "profile": "fast", "log_n": n, "k": g,
@@ -248,10 +252,12 @@ def test_hh_e2e_100k_keys_plan_cached():
     want = {v: int((vals == v).sum()) for v in plant}
     assert got == want  # all planted recovered, no false positives
     assert not any(r.truncated for r in res.rounds)
-    # Every round went through the hh_level plan route.
+    # Every round went through the incremental hh_extend plan route (the
+    # default DPF_TPU_HH_STATE=auto keeps a frontier per aggregator):
+    # each of the two aggregators dispatches at least once per round.
     stats = plans.cache().stats()
-    hh_plans = [p for p in stats["plans"] if p["key"].startswith("hh_level")]
-    assert sum(p["hits"] for p in hh_plans) >= 2 * len(res.rounds) - 2
+    hh_plans = [p for p in stats["plans"] if p["key"].startswith("hh_extend")]
+    assert sum(p["hits"] for p in hh_plans) >= 2 * len(res.rounds)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,529 @@
+"""Incremental heavy-hitter descent (apps/hh_state.py).
+
+The frontier cache must be an INVISIBLE optimization: byte-identical
+hitter sets and share rows vs the from-root walk on both profiles
+(single-device and on the 8-virtual-device mesh), >= 4x fewer PRG
+level-evaluations at log_n >= 16, zero retraces when a warmed descent
+repeats, and byte-identical degradation to from-root recompute on
+eviction, injected dispatch faults, or pruned-beyond-recovery frontiers.
+The serving session registry is bounded by the DPF_TPU_HH_STATE_* knobs.
+
+Compat cases stay on small shapes (K <= 32, log_n = 9) to share compile
+budget with the rest of the suite; fast cases use log_n 10 and 16.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dpf_tpu.apps import heavy_hitters as hh
+from dpf_tpu.apps import hh_state
+from dpf_tpu.core import bitpack, knobs, plans
+
+
+def _post(url, body=b""):
+    req = urllib.request.Request(url, data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return r.read()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=60) as r:
+        return r.read()
+
+
+@pytest.fixture()
+def srv():
+    from dpf_tpu import server as srv_mod
+
+    srv_mod.reset_serving_state()
+    s = srv_mod.serve(port=0)
+    yield f"http://127.0.0.1:{s.server_address[1]}"
+    s.shutdown()
+    srv_mod.reset_serving_state()
+
+
+def _planted_values(rng, g, log_n, plant):
+    vals = rng.integers(0, 1 << log_n, size=g, dtype=np.uint64)
+    off = 0
+    for v, c in plant.items():
+        vals[off : off + c] = v
+        off += c
+    return vals
+
+
+def _res_tuple(res):
+    """The public protocol output, exactly: hitters, counts, and the
+    per-round public record (minus timings/eval accounting)."""
+    return (
+        res.values.tolist(),
+        res.counts.tolist(),
+        [
+            (r.depth, r.levels, r.n_candidates, r.n_survivors, r.truncated)
+            for r in res.rounds
+        ],
+    )
+
+
+# ---------------------------------------------------------------------------
+# Differential: incremental descent == from-root descent
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "profile,g,n,thr,lpr",
+    [
+        ("fast", 120, 10, 10, 1),
+        ("fast", 120, 10, 10, 2),
+        # compat stays on the shared (K<=32, log_n=9) compile shape
+        ("compat", 24, 9, 5, 3),
+    ],
+)
+def test_incremental_matches_stateless(profile, g, n, thr, lpr):
+    rng = np.random.default_rng(61)
+    plant = {3: thr + 5, (1 << n) - 7: thr + 2, 99: thr}
+    vals = _planted_values(rng, g, n, plant)
+    sa, sb = hh.gen_shares(vals, n, profile=profile, rng=rng)
+    inc = hh.find_heavy_hitters(
+        sa, sb, threshold=thr, levels_per_round=lpr, state=True
+    )
+    ref = hh.find_heavy_hitters(
+        sa, sb, threshold=thr, levels_per_round=lpr, state=False
+    )
+    assert _res_tuple(inc) == _res_tuple(ref)
+    want = {v: int((vals == v).sum()) for v in plant}
+    assert {int(v): int(c) for v, c in zip(inc.values, inc.counts)} == want
+    # The whole point: strictly fewer PRG level-evals, every round —
+    # intra-leaf fold rounds legitimately cost ZERO.
+    for ri, rs in zip(inc.rounds, ref.rounds):
+        assert ri.prg_level_evals < rs.prg_level_evals
+    assert sum(r.prg_level_evals for r in inc.rounds) > 0
+    # And the stateless rounds pay exactly the from-root formula.
+    nu = sa.level_keys(n - 1).nu
+    for r in ref.rounds:
+        assert r.prg_level_evals == 2 * hh_state.stateless_round_evals(
+            nu, g, r.n_candidates
+        )
+
+
+def test_prg_eval_ratio_at_log16():
+    """ISSUE 17 headline: >= 4x fewer PRG level-evals for a full descent
+    at log_n >= 16 (measured ~29x at levels_per_round=1)."""
+    rng = np.random.default_rng(62)
+    g, n, thr = 64, 16, 12
+    vals = _planted_values(rng, g, n, {40000: 20, 123: 16, 65535: 13})
+    sa, sb = hh.gen_shares(vals, n, profile="fast", rng=rng)
+    kw = dict(threshold=thr, levels_per_round=1, max_candidates=32)
+    inc = hh.find_heavy_hitters(sa, sb, state=True, **kw)
+    ref = hh.find_heavy_hitters(sa, sb, state=False, **kw)
+    assert _res_tuple(inc) == _res_tuple(ref)
+    spent = sum(r.prg_level_evals for r in inc.rounds)
+    baseline = sum(r.prg_level_evals for r in ref.rounds)
+    assert spent > 0
+    assert baseline >= 4 * spent, (
+        f"incremental descent spent {spent} PRG level-evals vs "
+        f"{baseline} from-root — below the 4x contract"
+    )
+
+
+# ---------------------------------------------------------------------------
+# FrontierState rows vs ground truth, pruning, stale recovery
+# ---------------------------------------------------------------------------
+
+
+def test_frontier_rows_match_ground_truth_through_all_phases():
+    """Drive both aggregators' FrontierStates by hand through tree
+    steps, the leaf conversion, and every intra-leaf fold shape; at each
+    depth the XOR-reconstructed rows must equal the brute-force
+    prefix-membership matrix, with candidates in arbitrary order and
+    with duplicates.  fast log_n=16 has nu=7, so depths 8.. exercise
+    the leaf planes."""
+    rng = np.random.default_rng(63)
+    g, n = 64, 16
+    vals = _planted_values(rng, g, n, {7: 12, 60000: 9})
+    sa, sb = hh.gen_shares(vals, n, profile="fast", rng=rng)
+    fa = hh_state.FrontierState("fast", sa.level_keys(n - 1))
+    fb = hh_state.FrontierState("fast", sb.level_keys(n - 1))
+
+    def check(cands, depth):
+        cands = np.asarray(cands, np.uint64)
+        x = fa.advance(cands, depth) ^ fb.advance(cands, depth)
+        got = bitpack.unpack_bits(x, cands.size)
+        want = (
+            (vals[:, None] >> np.uint64(n - depth)) == cands[None, :]
+        ).astype(np.uint8)
+        np.testing.assert_array_equal(got, want)
+
+    # Descend with deterministic pruning: each round keeps half the
+    # previous round's candidate set as parents, so every requested
+    # candidate stays under the cached frontier by construction.
+    cur = np.arange(4, dtype=np.uint64)
+    check(cur, 2)
+    for depth, prev in ((5, 2), (6, 5), (8, 6), (11, 8), (16, 11)):
+        kids = cur[: max(1, cur.size // 2)]
+        for _ in range(depth - prev):
+            kids = hh_state._children(kids)
+        kids = kids[:40]
+        check(np.concatenate([kids[::-1], kids[:1]]), depth)  # order+dup
+        cur = np.unique(kids)
+    # Re-serve the max depth out of the resident planes (serving retry).
+    check(cur[:8], 16)
+
+    # A candidate under a pruned leaf ancestor is unrecoverable in
+    # place...
+    anc = set(int(a) for a in fa.anc.tolist())
+    miss = next(v for v in range(1 << 7) if v not in anc)
+    with pytest.raises(hh_state.StaleState):
+        fa.advance(np.array([miss << 9], np.uint64), 16)
+    # ...but a root replant serves ANY depth, byte-identically.
+    fa.reset()
+    fb.reset()
+    check(vals[:16], 16)
+
+
+def test_fallback_mid_descent_is_byte_identical(monkeypatch):
+    """Injected frontier failures mid-descent (both a recoverable
+    StaleState and a hard dispatch error) must leave the protocol output
+    exactly equal to the pure from-root run."""
+    rng = np.random.default_rng(64)
+    g, n, thr = 120, 10, 10
+    vals = _planted_values(rng, g, n, {700: 20, 44: 15, 1001: 12})
+    sa, sb = hh.gen_shares(vals, n, profile="fast", rng=rng)
+    kw = dict(threshold=thr, levels_per_round=2)
+    ref = hh.find_heavy_hitters(sa, sb, state=False, **kw)
+
+    orig = hh_state.FrontierState.advance
+    for boom, exc in ((3, hh_state.StaleState), (4, RuntimeError)):
+        calls = {"n": 0}
+
+        def flaky(self, cands, depth, _boom=boom, _exc=exc):
+            calls["n"] += 1
+            if calls["n"] == _boom:
+                raise _exc("injected mid-descent failure")
+            return orig(self, cands, depth)
+
+        monkeypatch.setattr(hh_state.FrontierState, "advance", flaky)
+        res = hh.find_heavy_hitters(sa, sb, state=True, **kw)
+        monkeypatch.setattr(hh_state.FrontierState, "advance", orig)
+        assert calls["n"] >= boom  # the fault actually fired
+        assert _res_tuple(res) == _res_tuple(ref)
+
+
+def test_state_knob_off_disables_frontiers(monkeypatch):
+    rng = np.random.default_rng(65)
+    vals = _planted_values(rng, 40, 9, {77: 12})
+    sa, sb = hh.gen_shares(vals, 9, profile="fast", rng=rng)
+
+    def no_state(*a, **kw):
+        raise AssertionError("FrontierState built with DPF_TPU_HH_STATE=off")
+
+    monkeypatch.setattr(hh_state, "FrontierState", no_state)
+    with knobs.overrides({"DPF_TPU_HH_STATE": "off"}):
+        res = hh.find_heavy_hitters(sa, sb, threshold=10)
+    assert {int(v): int(c) for v, c in zip(res.values, res.counts)} == {
+        77: 12
+    }
+
+
+# ---------------------------------------------------------------------------
+# Zero retraces: a warmed descent repeats without compiling
+# ---------------------------------------------------------------------------
+
+
+def test_repeat_descent_zero_retrace():
+    rng = np.random.default_rng(66)
+    g, n, thr = 120, 10, 10
+    vals = _planted_values(rng, g, n, {700: 20, 44: 15})
+    kw = dict(threshold=thr, levels_per_round=2, state=True)
+    sa, sb = hh.gen_shares(vals, n, profile="fast", rng=rng)
+    first = hh.find_heavy_hitters(sa, sb, **kw)
+    # Fresh key material over the SAME values: the public descent (and
+    # therefore every plan shape) repeats exactly.
+    sa2, sb2 = hh.gen_shares(vals, n, profile="fast", rng=rng)
+    before = plans.trace_count()
+    second = hh.find_heavy_hitters(sa2, sb2, **kw)
+    assert plans.trace_count() == before, "repeated descent retraced"
+    assert _res_tuple(second) == _res_tuple(first)
+
+
+# ---------------------------------------------------------------------------
+# MXU count fold
+# ---------------------------------------------------------------------------
+
+
+def test_mxu_fold_matches_host_reduction():
+    rng = np.random.default_rng(67)
+    g = 70
+    rows_a = rng.integers(0, 1 << 32, size=(g, 2), dtype=np.uint64).astype(
+        np.uint32
+    )
+    rows_b = rng.integers(0, 1 << 32, size=(g, 2), dtype=np.uint64).astype(
+        np.uint32
+    )
+    for q in (45, 64, 70):  # in-row, exact, and beyond-row widths
+        with knobs.overrides({"DPF_TPU_HH_FOLD": "host"}):
+            want = hh.reconstruct_counts(rows_a, rows_b, q)
+        with knobs.overrides({"DPF_TPU_HH_FOLD": "mxu"}):
+            got = hh.reconstruct_counts(rows_a, rows_b, q)
+        np.testing.assert_array_equal(got, want)
+    # The plan-routed fold against a brute popcount, directly.
+    counts = plans.run_hh_fold(rows_a, 50)
+    want = np.array(
+        [
+            int(
+                np.count_nonzero(
+                    rows_a[:, j // 32] & np.uint32(1 << (j % 32))
+                )
+            )
+            for j in range(50)
+        ],
+        np.int64,
+    )
+    np.testing.assert_array_equal(counts, want)
+
+
+# ---------------------------------------------------------------------------
+# 8-virtual-device mesh identity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "profile,g,n,thr,lpr",
+    [("fast", 64, 10, 8, 2), ("compat", 32, 9, 5, 3)],
+)
+def test_mesh_descent_identity(profile, g, n, thr, lpr):
+    import jax
+
+    from dpf_tpu.parallel import serving_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual CPU devices")
+    rng = np.random.default_rng(68)
+    vals = _planted_values(rng, g, n, {3: thr + 4, 200: thr + 1})
+    sa, sb = hh.gen_shares(vals, n, profile=profile, rng=rng)
+    kw = dict(threshold=thr, levels_per_round=lpr, state=True)
+    ref = hh.find_heavy_hitters(sa, sb, **kw)
+    try:
+        with knobs.overrides({"DPF_TPU_MESH": "on"}):
+            serving_mesh.reset()
+            assert serving_mesh.active_mesh() is not None
+            res = hh.find_heavy_hitters(sa, sb, **kw)
+            # The sharded one-psum count fold, under the same mesh.
+            rows = rng.integers(
+                0, 1 << 32, size=(64, 2), dtype=np.uint64
+            ).astype(np.uint32)
+            counts = plans.run_hh_fold(rows, 50)
+    finally:
+        serving_mesh.reset()
+    assert _res_tuple(res) == _res_tuple(ref)
+    want = np.array(
+        [
+            int(np.count_nonzero(rows[:, j // 32] & np.uint32(1 << (j % 32))))
+            for j in range(50)
+        ],
+        np.int64,
+    )
+    np.testing.assert_array_equal(counts, want)
+
+
+def test_mesh_change_is_stale_not_wrong():
+    """A frontier built on one mesh refuses to serve on another (the
+    breaker's degraded single-device mode) instead of dispatching into a
+    mislaid shard layout."""
+    import jax
+
+    from dpf_tpu.parallel import serving_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual CPU devices")
+    rng = np.random.default_rng(69)
+    vals = rng.integers(0, 1 << 10, size=40, dtype=np.uint64)
+    sa, _ = hh.gen_shares(vals, 10, profile="fast", rng=rng)
+    try:
+        with knobs.overrides({"DPF_TPU_MESH": "on"}):
+            serving_mesh.reset()
+            st = hh_state.FrontierState("fast", sa.level_keys(9))
+    finally:
+        serving_mesh.reset()
+    with pytest.raises(hh_state.StaleState, match="mesh"):
+        st.advance(np.array([0, 1], np.uint64), 1)
+
+
+# ---------------------------------------------------------------------------
+# Serving session registry: bounds + unit eviction
+# ---------------------------------------------------------------------------
+
+
+def test_session_cache_bounds_and_identity():
+    rng = np.random.default_rng(70)
+    vals = rng.integers(0, 1 << 9, size=8, dtype=np.uint64)
+    sa, _ = hh.gen_shares(vals, 9, profile="fast", rng=rng)
+    kb = sa.level_keys(8)
+
+    def fresh():
+        return hh_state.FrontierState("fast", kb)
+
+    c = hh_state.SessionCache()
+    with knobs.overrides({"DPF_TPU_HH_STATE_MAX_SESSIONS": "2"}):
+        for sid in ("a", "b", "c"):
+            c.store(sid, "d0", fresh())
+        st = c.stats()
+        assert st["sessions"] == 2 and st["evicted"] == 1
+        assert c.lookup("a", "d0", "fast", 9) is None  # LRU victim
+        assert c.lookup("c", "d0", "fast", 9) is not None
+
+    # Key digest / shape mismatch is a NEW descent: evict + miss.
+    assert c.lookup("c", "OTHER", "fast", 9) is None
+    assert c.lookup("c", "d0", "fast", 9) is None
+    st = c.stats()
+    assert st["evicted"] == 2 and st["misses"] >= 3 and st["hits"] == 1
+
+    # Byte budget never evicts the last remaining session.
+    c.clear()
+    with knobs.overrides({"DPF_TPU_HH_STATE_MAX_BYTES": "1"}):
+        c.store("x", "d0", fresh())
+        c.store("y", "d0", fresh())
+        assert c.stats()["sessions"] == 1
+        assert c.lookup("y", "d0", "fast", 9) is not None
+
+    # Idle TTL.
+    c.clear()
+    with knobs.overrides({"DPF_TPU_HH_STATE_TTL_S": "1"}):
+        c.store("x", "d0", fresh())
+        c.sweep(now=time.time() + 5)
+    assert c.stats()["sessions"] == 0
+
+
+# ---------------------------------------------------------------------------
+# The served wire: /v1/hh/eval?session=  (with fault injection)
+# ---------------------------------------------------------------------------
+
+
+def test_served_sessions_byte_identical_with_faults(srv):
+    """A full descent over /v1/hh/eval?session= must return, round by
+    round, exactly the bytes an in-process FrontierState replay of the
+    same level-(n-1) keys produces (per-side determinism), and the two
+    sides' XOR must equal the stateless library reconstruction (at
+    interior depths the level-(n-1) keys yield a DIFFERENT — equally
+    valid — share pair than the legacy per-level keys, so only the
+    reconstruction is comparable across key families; at full depth the
+    per-side bytes coincide too).  Also across an injected dispatch
+    fault (503, next round recovers) and a key-material change on a
+    reused session id (digest evicts)."""
+    from dpf_tpu.serving import faults
+
+    g, n, thr = 24, 9, 5
+    rng = np.random.default_rng(71)
+    vals = _planted_values(rng, g, n, {300: 8, 44: 7})
+    sa, sb = hh.gen_shares(vals, n, profile="compat", rng=rng)
+    blobs = {"A": hh.share_to_blob(sa), "B": hh.share_to_blob(sb)}
+    shares = {"A": sa, "B": sb}
+    kl = len(blobs["A"]) // (g * n)
+
+    def top_keys(blob):
+        return b"".join(
+            blob[(c * n + n - 1) * kl : (c * n + n) * kl] for c in range(g)
+        )
+
+    keys = {s: top_keys(blobs[s]) for s in ("A", "B")}
+
+    def url(level, q, sid):
+        return (
+            f"{srv}/v1/hh/eval?log_n={n}&k={g}&q={q}&level={level}"
+            f"&profile=compat&format=packed&session={sid}"
+        )
+
+    mirror = {
+        s: hh_state.FrontierState("compat", shares[s].level_keys(n - 1))
+        for s in ("A", "B")
+    }
+
+    def run_round(level, cand_vals):
+        body = cand_vals.astype("<u8").tobytes()
+        out = {}
+        for side, sid in (("A", "sess-a"), ("B", "sess-b")):
+            raw = _post(url(level, cand_vals.size, sid), keys[side] + body)
+            rows = mirror[side].advance(
+                cand_vals >> np.uint64(n - level - 1), level + 1
+            )
+            assert raw == bitpack.words_to_wire(rows, cand_vals.size), (
+                f"session reply diverged at level {level} side {side}"
+            )
+            out[side] = rows
+        # The two sides reconstruct to the same public bits the
+        # stateless per-level keys would.
+        lib = hh.eval_level_shares(
+            shares["A"], level, cand_vals
+        ) ^ hh.eval_level_shares(shares["B"], level, cand_vals)
+        np.testing.assert_array_equal(out["A"] ^ out["B"], lib)
+        return out["A"], out["B"]
+
+    # Drive the public descent: 3 levels per round, prune on counts.
+    frontier = np.zeros(1, np.uint64)
+    hitters = {}
+    n_rounds = 0
+    for depth in (3, 6, 9):
+        kids = frontier
+        for _ in range(3):
+            kids = hh_state._children(kids)
+        cand_vals = kids << np.uint64(n - depth)
+        if depth == 6:
+            # Mid-descent fault: the dispatch stays UNAVAILABLE through
+            # the breaker's transparent retries -> 503; once the fault
+            # clears, the SAME round succeeds with identical bytes (the
+            # fault fired before the frontier advanced, so the session
+            # is intact — and even a poisoned one would be evicted and
+            # rebuilt from the root).
+            faults.install("dispatch.hh_extend:unavailable")
+            try:
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _post(
+                        url(depth - 1, cand_vals.size, "sess-a"),
+                        keys["A"] + cand_vals.astype("<u8").tobytes(),
+                    )
+                assert ei.value.code == 503
+            finally:
+                faults.clear()
+        ra, rb = run_round(depth - 1, cand_vals)
+        counts = hh.reconstruct_counts(ra, rb, cand_vals.size)
+        live = counts >= thr
+        frontier = kids[live]
+        if depth == n:
+            hitters = {
+                int(v): int(c)
+                for v, c in zip(cand_vals[live], counts[live])
+            }
+        n_rounds += 1
+    assert hitters == {300: 8, 44: 7}
+
+    stats = json.loads(_get(f"{srv}/v1/stats"))["hh_state"]
+    assert stats["sessions"] == 2
+    assert stats["hits"] >= 2 * (n_rounds - 1)
+    metrics = _get(f"{srv}/v1/metrics").decode()
+    assert "hh_session_hits_total" in metrics
+    assert "hh_sessions 2" in metrics
+
+    # Reusing a session id with DIFFERENT key material is a new descent
+    # (digest mismatch evicts), and the reply is still exact.
+    sa2, _ = hh.gen_shares(vals, n, profile="compat", rng=rng)
+    cand_vals = np.array([300, 44, 511], np.uint64)
+    raw = _post(
+        url(n - 1, cand_vals.size, "sess-a"),
+        top_keys(hh.share_to_blob(sa2)) + cand_vals.astype("<u8").tobytes(),
+    )
+    lib = hh.eval_level_shares(sa2, n - 1, cand_vals)
+    assert raw == bitpack.words_to_wire(lib, cand_vals.size)
+    assert json.loads(_get(f"{srv}/v1/stats"))["hh_state"]["evicted"] >= 1
+
+    # Session id with the engine knobbed OFF falls back to legacy.
+    with knobs.overrides({"DPF_TPU_HH_STATE": "off"}):
+        raw = _post(
+            url(n - 1, cand_vals.size, "sess-zz"),
+            keys["A"] + cand_vals.astype("<u8").tobytes(),
+        )
+    lib = hh.eval_level_shares(sa, n - 1, cand_vals)
+    assert raw == bitpack.words_to_wire(lib, cand_vals.size)
