@@ -137,6 +137,9 @@ _ROUTE_KNOBS = (
     # a separate key field ("tuned") — mode alone cannot tell two
     # different TUNED.json generations apart on resume.
     "DPF_TPU_TUNED", "DPF_TPU_TUNED_PATH",
+    # Device-dealer routing (cfg-gen): a device-tower gen row must never
+    # collide with a host-tower row on a ledger resume.
+    "DPF_TPU_GEN",
 )
 # DPF_TPU_BENCH_LEDGER_RETRY_ERRORS=1: sections whose recorded rows
 # contain an error row are NOT replayed (and not re-recorded) — the
@@ -2399,6 +2402,98 @@ def main():
         )
 
     _section("cfg5-dcf-native", cfg5_dcf_native)
+
+    # Device-side dealer (models/keys_gen.py): batched Gen throughput,
+    # device tower vs the host twin vs the native C++ single-key loop,
+    # for both DPF profiles and the DCF family.  EVERY rate row is
+    # gated on key-byte identity between the two towers under the same
+    # injected rng — a fast-but-wrong dealer must never post a number.
+    ngen = 10 if small else 20
+    gen_ks = (256,) if small else (1024, 65536)
+    # CPU smoke keeps the level-fused tower on: the unrolled compat
+    # tower traces nu copies of the bitsliced AES circuit and compiles
+    # for minutes on the host backend.
+    gen_fuse = {"DPF_TPU_FUSE": "auto"} if small else {}
+
+    def cfg_gen():
+        from dpf_tpu.backends import cpu_native as cn
+        from dpf_tpu.core import chacha_np, spec
+        from dpf_tpu.core.keys import gen_batch as gen_compat_batch
+        from dpf_tpu.models import dcf as dcf_mod
+        from dpf_tpu.models import keys_gen
+        from dpf_tpu.models.keys_chacha import gen_batch as gen_fast_batch
+
+        fams = (
+            ("compat", gen_compat_batch, spec.key_len, cn.gen),
+            ("fast", gen_fast_batch, chacha_np.key_len, cn.cc_gen),
+            ("dcf", dcf_mod.gen_lt_batch, dcf_mod.key_len, cn.dcf_gen),
+        )
+        for kind, gfn, klen, nfn in fams:
+            # Identity gate: same injected rng through both towers must
+            # yield byte-identical key pairs, with zero silent host
+            # fallbacks on the device side.
+            ga = rng.integers(0, 1 << ngen, size=128, dtype=np.uint64)
+            fb0 = keys_gen.fallbacks
+            with knobs.overrides({"DPF_TPU_GEN": "on", **gen_fuse}):
+                dp = gfn(ga, ngen, rng=np.random.default_rng(11))
+            with knobs.overrides({"DPF_TPU_GEN": "off"}):
+                hp = gfn(ga, ngen, rng=np.random.default_rng(11))
+            if (
+                any(d.to_bytes() != h.to_bytes() for d, h in zip(dp, hp))
+                or keys_gen.fallbacks != fb0
+            ):
+                raise RuntimeError(
+                    f"gen identity gate failed ({kind}, n={ngen}; "
+                    f"fallbacks={keys_gen.fallbacks - fb0})"
+                )
+            for kk in gen_ks:
+                alphas = rng.integers(
+                    0, 1 << ngen, size=kk, dtype=np.uint64
+                )
+                for label, mode in (("device", "on"), ("host", "off")):
+                    extra = gen_fuse if mode == "on" else {}
+                    fb0 = keys_gen.fallbacks
+                    with knobs.overrides(
+                        {"DPF_TPU_GEN": mode, **extra}
+                    ):
+                        gfn(alphas, ngen)  # warm: compile + plan cache
+                        dt = _timed_host_call(lambda: gfn(alphas, ngen))
+                        route = _route(f"gen-{label}", fuse=(mode == "on"))
+                    if mode == "on" and keys_gen.fallbacks != fb0:
+                        raise RuntimeError(
+                            f"gen {kind} K={kk}: device rate row hid "
+                            f"{keys_gen.fallbacks - fb0} host fallbacks"
+                        )
+                    _emit(
+                        f"Gen {kind} n={ngen} K={kk} ({label} dealer)",
+                        kk / dt / 1e3, "kkeys/sec", scale=1e3,
+                        bytes_out=2 * kk * klen(ngen), route=route,
+                    )
+                # Native single-key C++ loop — what a non-batched
+                # per-request dealer does on one core.
+                if cn.available():
+                    kn = min(kk, 512)
+                    rngb = np.random.default_rng(7)
+                    nfn(int(alphas[0]), ngen, rng=rngb)  # warm
+                    best = float("inf")
+                    for _ in range(3):
+                        t0 = time.perf_counter()
+                        for a in alphas[:kn]:
+                            nfn(int(a), ngen, rng=rngb)
+                        best = min(best, time.perf_counter() - t0)
+                    _emit(
+                        f"Gen {kind} n={ngen} K={kn} "
+                        "(native 1-key loop)",
+                        kn / best / 1e3, "kkeys/sec", scale=1e3,
+                        route="native-cpp-1core",
+                    )
+                else:
+                    _skipped(
+                        f"Gen {kind} n={ngen} K={kk} native",
+                        "native backend unavailable",
+                    )
+
+    _section("cfg-gen", cfg_gen)
 
 
 if __name__ == "__main__":

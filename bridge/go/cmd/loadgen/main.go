@@ -481,7 +481,10 @@ func main() {
 			"-wire2-addr selects the wire2 front), or hh (full "+
 			"heavy-hitters descent replay with self-checked recovery; "+
 			"-hh-clients/-hh-levels/-hh-threshold shape it, -wire2-addr "+
-			"adds a second descent over the wire2 front)")
+			"adds a second descent over the wire2 front), or gen "+
+			"(open-loop dealer load: every arrival is one /v1/gen key "+
+			"deal at a fresh alpha — run the sidecar with DPF_TPU_GEN=on "+
+			"to put the device tower on the hot path)")
 	pirRows := flag.Int("pir-rows", 4096, "pir mode: database rows")
 	pirRowBytes := flag.Int("pir-row-bytes", 32, "pir mode: bytes per row")
 	wire2Addr := flag.String("wire2-addr", "",
@@ -537,10 +540,30 @@ func main() {
 	c.DeadlineMs = *deadlineMs
 
 	// One request payload prepared up front: the load is the serving
-	// stack's dispatch path, not Gen (or the one-time DB upload).
+	// stack's dispatch path, not Gen (or the one-time DB upload) — except
+	// in gen mode, where the dealer IS the load.
 	var fire func() error
 	rng := rand.New(rand.NewSource(*seed + 1))
 	switch *mode {
+	case "gen":
+		// Every arrival is one dealt key pair through /v1/gen with the
+		// device tower on the hot path (start the sidecar with
+		// DPF_TPU_GEN=on to measure it; the fallback counter on
+		// /v1/stats tells you whether the device lane actually served).
+		// Alphas are drawn from a pregenerated slab by an atomic cursor:
+		// fire runs on many goroutines and rand.Rand is not
+		// goroutine-safe, but the offered points must still vary so the
+		// run cannot be served by a single memoized key.
+		alphas := make([]uint64, 1024)
+		for i := range alphas {
+			alphas[i] = uint64(rng.Int63n(int64(1) << *logN))
+		}
+		var cursor int64
+		fire = func() error {
+			a := alphas[atomic.AddInt64(&cursor, 1)%int64(len(alphas))]
+			_, _, err := c.Gen(a, *logN)
+			return err
+		}
 	case "points":
 		ka, _, err := c.Gen(uint64(rand.New(rand.NewSource(*seed)).Int63n(int64(1)<<*logN)), *logN)
 		if err != nil {
@@ -584,7 +607,7 @@ func main() {
 		}
 	default:
 		fmt.Fprintf(os.Stderr,
-		"loadgen: unknown -mode %q (points|pir|agg-epoch|hh)\n", *mode)
+		"loadgen: unknown -mode %q (points|pir|agg-epoch|hh|gen)\n", *mode)
 		os.Exit(1)
 	}
 

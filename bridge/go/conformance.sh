@@ -54,6 +54,16 @@ else
        "(go install honnef.co/go/tools/cmd/staticcheck@$STATICCHECK_PIN)" >&2
 fi
 
+# Device-dealer lane: the sidecar deals keys on-device (DPF_TPU_GEN=on,
+# dpf_tpu/models/keys_gen.py) unless the caller overrides it, so every
+# Gen-shaped conformance test — TestConformanceGenDealer in particular —
+# exercises the device correction-word tower.  Safe on any backend: the
+# device output is byte-identical to the host tower by construction
+# (pinned by tests/test_gen_device.py) and any device failure falls back
+# to the host tower with the same drawn seeds.
+DPF_TPU_GEN="${DPF_TPU_GEN:-on}"
+export DPF_TPU_GEN
+
 # With --wire2 the sidecar also opens the binary front on PORT+1; the
 # Go suite picks it up through DPFTPU_WIRE2_ADDR (wire2_test.go skips
 # without it, so the plain run is unchanged).

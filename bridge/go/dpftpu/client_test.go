@@ -86,6 +86,76 @@ func TestConformanceGenEval(t *testing.T) {
 	}
 }
 
+// TestConformanceGenDealer is the device-dealer gen conformance lane:
+// conformance.sh starts the sidecar under DPF_TPU_GEN=on, so every key
+// below is dealt by the on-device correction-word tower
+// (dpf_tpu/models/keys_gen.py), then reconstruction-checked through the
+// wire for both DPF profiles and a batched DCF deal.  Key BYTES cannot
+// be pinned here — /v1/gen draws fresh CSPRNG entropy per request by
+// design — the frozen-seed byte-identity of the device tower against
+// the host tower is pinned server-side (tests/test_gen_device.py,
+// injected rng).
+func TestConformanceGenDealer(t *testing.T) {
+	base := conformanceClient(t).BaseURL
+	const logN = 10
+	for _, profile := range []string{"compat", "fast"} {
+		c := New(base)
+		c.Profile = profile
+		for _, alpha := range []uint64{0, 331, (1 << logN) - 1} {
+			ka, kb, err := c.Gen(alpha, logN)
+			if err != nil {
+				t.Fatalf("%s dealer gen(alpha=%d): %v", profile, alpha, err)
+			}
+			for _, x := range []uint64{alpha, alpha ^ 1, 512} {
+				ba, err := c.Eval(ka, x, logN)
+				if err != nil {
+					t.Fatal(err)
+				}
+				bb, err := c.Eval(kb, x, logN)
+				if err != nil {
+					t.Fatal(err)
+				}
+				want := byte(0)
+				if x == alpha {
+					want = 1
+				}
+				if ba^bb != want {
+					t.Fatalf("%s dealer key broken at alpha=%d x=%d: %d ^ %d != %d",
+						profile, alpha, x, ba, bb, want)
+				}
+			}
+		}
+	}
+	// One batched DCF deal through the same coalesced gen lane.
+	c := New(base)
+	alphas := []uint64{17, 500, 1023}
+	ka, kb, err := c.DcfGen(alphas, logN)
+	if err != nil {
+		t.Fatal(err)
+	}
+	xs := [][]uint64{{16, 17, 18}, {0, 499, 500}, {1022, 1023, 512}}
+	ra, err := c.DcfEvalPoints(ka, xs, logN)
+	if err != nil {
+		t.Fatal(err)
+	}
+	rb, err := c.DcfEvalPoints(kb, xs, logN)
+	if err != nil {
+		t.Fatal(err)
+	}
+	for i := range alphas {
+		for j, x := range xs[i] {
+			want := byte(0)
+			if x < alphas[i] {
+				want = 1
+			}
+			if got := ra[i][j] ^ rb[i][j]; got != want {
+				t.Fatalf("dcf dealer key %d broken at x=%d: got %d, want %d",
+					i, x, got, want)
+			}
+		}
+	}
+}
+
 // TestConnectionReuse pins the client's keep-alive behavior without a
 // sidecar: sequential requests through one Client must ride ONE TCP
 // connection (the pooled Transport; each request fully drains and closes

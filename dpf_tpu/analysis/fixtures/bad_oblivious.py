@@ -177,6 +177,18 @@ def leaky_frontier_index_eval(seeds, state):
     return jnp.take(state.reshape(2, -1), sel, axis=1)
 
 
+def leaky_gen_alpha_eval(alphas, fcw):
+    """A dealer that applies the leaf correction by WRITING at the
+    secret point's index ON DEVICE — the forbidden gen shape.  The
+    production tower (models/keys_gen.py) keeps every per-level alpha
+    select as mask arithmetic (``msk = 0 - bit``) and applies the alpha
+    leaf flip on HOST during output marshalling; a device-side scatter
+    at alpha makes the write address — which HBM word the dealer
+    touches — a function of the dealt point."""
+    idx = (alphas[0] & jnp.uint32(7)).astype(jnp.int32)
+    return fcw.at[idx].set(fcw[idx] ^ jnp.uint32(1))
+
+
 #: (function, n secret leading args, total args builder) — the tests
 #: iterate this to keep fixture and assertion lists in sync.
 LEAKY = (
@@ -192,4 +204,5 @@ LEAKY = (
     ("leaky_shard_index_eval", leaky_shard_index_eval, "secret-index"),
     ("leaky_pir_chunk_eval", leaky_pir_chunk_eval, "secret-index"),
     ("leaky_frontier_index_eval", leaky_frontier_index_eval, "secret-index"),
+    ("leaky_gen_alpha_eval", leaky_gen_alpha_eval, "secret-index"),
 )

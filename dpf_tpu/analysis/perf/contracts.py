@@ -123,6 +123,47 @@ _OVERRIDES: dict[str, PerfContract] = {
         collectives={"psum": 1},
         note="the ONE count all-reduce of a sharded descent round",
     ),
+    # -- device-side dealer: the root seed/control-bit operands are dead
+    # once level 0 expands, so the donated twins reuse their buffers;
+    # the alpha-bit operand (last invar) is NOT donated — the host keeps
+    # it to build the reply.  Zero collectives even sharded: each shard
+    # towers its own keys, there is nothing to reduce. ---------------------
+    "gen/compat/unrolled": PerfContract(
+        donated=(0, 1, 2, 3),
+        note="root seed planes + control-bit lanes donated into level 0",
+    ),
+    "gen/compat/fused": PerfContract(
+        donated=(0, 1, 2, 3),
+        note="root seed planes + control-bit lanes donated into the scan",
+    ),
+    "gen/fast/unrolled": PerfContract(
+        donated=(0, 1, 2, 3),
+        note="root seed words + control bits donated into level 0",
+    ),
+    "gen/fast/fused": PerfContract(
+        donated=(0, 1, 2, 3),
+        note="root seed words + control bits donated into the scan",
+    ),
+    "gen/dcf/unrolled": PerfContract(
+        donated=(0, 1, 2, 3),
+        note="root seed words + control bits donated into level 0",
+    ),
+    "gen/dcf/fused": PerfContract(
+        donated=(0, 1, 2, 3),
+        note="root seed words + control bits donated into the scan",
+    ),
+    "gen_sharded/compat": PerfContract(
+        donated=(0, 1, 2, 3),
+        note="zero collectives: shards tower their own key lanes",
+    ),
+    "gen_sharded/fast": PerfContract(
+        donated=(0, 1, 2, 3),
+        note="zero collectives: shards tower their own keys",
+    ),
+    "gen_sharded/dcf": PerfContract(
+        donated=(0, 1, 2, 3),
+        note="zero collectives: shards tower their own keys",
+    ),
     # -- mesh aggregation: ONE all-reduce per streamed chunk -------------
     "agg_sharded/fold_xor": PerfContract(
         collectives=dict(_ONE_ALLGATHER), donated=(0,),
@@ -444,6 +485,43 @@ def _pir_site(sharded: bool) -> DonationSite:
     )
 
 
+def _gen_site(profile: str) -> DonationSite:
+    """The device dealer's donated twins (models/keys_gen.DONATED_TWINS):
+    the drawn root seeds and control bits are dead once the first level
+    expands.  One cc site covers both ChaCha families (fast + dcf share
+    ``_gen_cc_donated_jit``)."""
+    from ...models import keys_gen
+
+    compat = profile == "compat"
+    twin = "_gen_compat_donated_jit" if compat else "_gen_cc_donated_jit"
+    static, donate = keys_gen.DONATED_TWINS[twin]
+
+    def build() -> tuple[Any, Any, tuple]:
+        from ..trace import entrypoints as ep
+
+        if compat:
+            nu, args = ep._gen_compat_operands()
+            body_args = (nu, False, *args)
+            return (
+                keys_gen._gen_compat_donated_jit,
+                keys_gen._gen_compat_body, body_args,
+            )
+        nu, args = ep._gen_cc_operands(False)
+        return (
+            keys_gen._gen_cc_donated_jit, keys_gen._gen_cc_body,
+            (nu, False, False, *args),
+        )
+
+    routes = (
+        ("gen/compat/unrolled", "gen/compat/fused") if compat
+        else ("gen/fast/unrolled", "gen/fast/fused", "gen/dcf/unrolled",
+              "gen/dcf/fused")
+    )
+    return DonationSite(
+        f"models.keys_gen.{twin}", routes, static, donate, build
+    )
+
+
 def donation_sites() -> tuple[DonationSite, ...]:
     """The production donation surface (built lazily — the models import
     jax).  Every donated executable the serving stack can dispatch is
@@ -464,6 +542,8 @@ def donation_sites() -> tuple[DonationSite, ...]:
         _hh_extend_site("fast", leaf_first=True),
         _hh_extend_site("compat", leaf_first=False),
         _hh_extend_site("compat", leaf_first=True),
+        _gen_site("compat"),
+        _gen_site("fast"),
     )
 
 

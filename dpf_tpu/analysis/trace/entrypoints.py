@@ -881,6 +881,98 @@ def _pir_stream_combine():
 
 
 # ---------------------------------------------------------------------------
+# Device-side dealer (models/keys_gen.py; core.plans.run_gen).  Gen is
+# the one route family whose SECRET is the dealt point itself: the root
+# seeds, root control bits, and the per-level alpha path bits (``bits``
+# / the ``BM`` lane masks) are all secret-derived host operands, and
+# every per-level select in the tower must be mask arithmetic — the
+# certificates pin that no alpha bit ever reaches a branch or an index.
+# The unrolled and scan-fused towers are BOTH production-reachable
+# (DPF_TPU_FUSE defaults off; serving may pin it on), so both trace.
+# ---------------------------------------------------------------------------
+
+
+def _gen_cc_operands(dcf: bool, k: int = 32, log_n: int = 12):
+    import jax.numpy as jnp
+
+    from ...models import keys_gen
+    from ...models.keys_chacha import _draw_roots
+
+    nu = max(log_n - 9, 0)
+    s0, t0, s1, t1 = _draw_roots(k, _rng())
+    alphas = np.arange(k, dtype=np.uint64) % (1 << log_n)
+    bits = keys_gen._alpha_bits(alphas, log_n, nu)
+    return nu, (
+        jnp.asarray(s0), jnp.asarray(s1),
+        jnp.asarray(t0.astype(np.uint32)),
+        jnp.asarray(t1.astype(np.uint32)),
+        jnp.asarray(np.ascontiguousarray(bits)),
+    )
+
+
+def _gen_compat_operands(k: int = 32, log_n: int = 9):
+    import jax.numpy as jnp
+
+    from ...core.keys import _draw_roots
+    from ...models import keys_gen
+    from ...ops.aes_bitslice import pack_blocks_np
+
+    nu = max(log_n - 7, 0)
+    w = k // 32
+    s0, t0, s1, _t1 = _draw_roots(k, _rng())
+    alphas = np.arange(k, dtype=np.uint64) % (1 << log_n)
+    bm = keys_gen._pack_lane_bits(
+        keys_gen._alpha_bits(alphas, log_n, nu), w
+    )
+    t0_w = keys_gen._pack_lane_bits(t0.astype(np.uint32), w)
+    return nu, (
+        jnp.asarray(pack_blocks_np(s0)),
+        jnp.asarray(pack_blocks_np(s1)),
+        jnp.asarray(t0_w),
+        jnp.asarray(t0_w ^ np.uint32(0xFFFFFFFF)),
+        jnp.asarray(bm),
+    )
+
+
+def _gen_cc(dcf: bool, fused: bool):
+    from ...models import keys_gen
+
+    nu, args = _gen_cc_operands(dcf)
+    return _trace(
+        keys_gen._gen_cc_body, (nu, dcf, fused, *args),
+        static_argnums=(0, 1, 2), secret=range(3, 8),
+    )
+
+
+def _gen_compat_tower(fused: bool):
+    from ...models import keys_gen
+
+    nu, args = _gen_compat_operands()
+    return _trace(
+        keys_gen._gen_compat_body, (nu, fused, *args),
+        static_argnums=(0, 1), secret=range(2, 7),
+    )
+
+
+def _gen_sharded_cc(dcf: bool):
+    from ...parallel import sharding
+
+    mesh = _serving_mesh_8()
+    nu, args = _gen_cc_operands(dcf)  # 4 keys per shard
+    fn = sharding._sharded_gen_cc_sm(mesh, nu, dcf, False)
+    return _trace(fn, args, secret=range(0, 5))
+
+
+def _gen_sharded_compat():
+    from ...parallel import sharding
+
+    mesh = _serving_mesh_8()
+    nu, args = _gen_compat_operands(k=256)  # one lane word per shard
+    fn = sharding._sharded_gen_compat_sm(mesh, nu, False)
+    return _trace(fn, args, secret=range(0, 5))
+
+
+# ---------------------------------------------------------------------------
 # The matrix
 # ---------------------------------------------------------------------------
 
@@ -1139,6 +1231,56 @@ ROUTES: tuple[Route, ...] = (
         {"profile": "agg", "op": "add"},
         lambda: _agg_fold("add"),
     ),
+    # -- device-side dealer (models/keys_gen.py; /v1/gen, /v1/dcf_gen,
+    # /v1/hh/gen when DPF_TPU_GEN resolves on) -------------------------------
+    _route(
+        "gen/compat/unrolled",
+        "core.keys.gen_batch (core.plans.run_gen -> "
+        "models.keys_gen._gen_compat)",
+        "gen",
+        {"profile": "compat", "backend": "xla", "fuse": "off"},
+        lambda: _gen_compat_tower(False),
+    ),
+    _route(
+        "gen/compat/fused",
+        "core.keys.gen_batch (core.plans.run_gen -> "
+        "models.keys_gen._gen_compat, lax.scan tower)",
+        "gen",
+        {"profile": "compat", "backend": "xla", "fuse": "scan"},
+        lambda: _gen_compat_tower(True),
+    ),
+    _route(
+        "gen/fast/unrolled",
+        "models.keys_chacha.gen_batch (core.plans.run_gen -> "
+        "models.keys_gen._gen_cc)",
+        "gen",
+        {"profile": "fast", "backend": "xla", "fuse": "off"},
+        lambda: _gen_cc(False, False),
+    ),
+    _route(
+        "gen/fast/fused",
+        "models.keys_chacha.gen_batch (core.plans.run_gen -> "
+        "models.keys_gen._gen_cc, lax.scan tower)",
+        "gen",
+        {"profile": "fast", "backend": "xla", "fuse": "scan"},
+        lambda: _gen_cc(False, True),
+    ),
+    _route(
+        "gen/dcf/unrolled",
+        "models.dcf.gen_lt_batch (core.plans.run_gen -> "
+        "models.keys_gen._gen_cc with per-level value CWs)",
+        "gen",
+        {"profile": "dcf", "backend": "xla", "fuse": "off"},
+        lambda: _gen_cc(True, False),
+    ),
+    _route(
+        "gen/dcf/fused",
+        "models.dcf.gen_lt_batch (core.plans.run_gen -> "
+        "models.keys_gen._gen_cc, lax.scan tower)",
+        "gen",
+        {"profile": "dcf", "backend": "xla", "fuse": "scan"},
+        lambda: _gen_cc(True, True),
+    ),
     # -- mesh-native serving (DPF_TPU_MESH; parallel/sharding.py) -----------
     _route(
         "points_sharded/compat/xla/packed",
@@ -1255,6 +1397,30 @@ ROUTES: tuple[Route, ...] = (
         "hh_fold",
         {"profile": "public", "backend": "mxu", "mesh": 8},
         _hh_fold_sharded, min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "gen_sharded/compat",
+        "parallel.sharding.gen_compat_sharded_fn "
+        "(core.plans.run_gen mesh dispatch; zero collectives)",
+        "gen",
+        {"profile": "compat", "backend": "xla", "mesh": 8},
+        _gen_sharded_compat, min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "gen_sharded/fast",
+        "parallel.sharding.gen_cc_sharded_fn "
+        "(core.plans.run_gen mesh dispatch; zero collectives)",
+        "gen",
+        {"profile": "fast", "backend": "xla", "mesh": 8},
+        lambda: _gen_sharded_cc(False), min_devices=_MESH_SHARDS,
+    ),
+    _route(
+        "gen_sharded/dcf",
+        "parallel.sharding.gen_cc_sharded_fn "
+        "(core.plans.run_gen mesh dispatch; zero collectives)",
+        "gen",
+        {"profile": "dcf", "backend": "xla", "mesh": 8},
+        lambda: _gen_sharded_cc(True), min_devices=_MESH_SHARDS,
     ),
     # -- served 2-server PIR (models/pir.py; /v1/pir/query) ------------------
     _route(

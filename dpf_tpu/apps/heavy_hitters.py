@@ -135,12 +135,17 @@ def gen_shares(
     log_n: int,
     profile: str = "compat",
     rng: np.random.Generator | None = None,
+    gen=None,
 ) -> tuple[HHShare, HHShare]:
     """Trusted-dealer generation of both aggregators' share batches for G
     client values: ONE vectorized ``gen_batch`` over all ``log_n * G``
     level-DPFs (the per-client point of level ``i`` is the client's
-    ``(i+1)``-bit prefix, low bits zeroed)."""
-    gen, _, _ = _profile_api(profile)
+    ``(i+1)``-bit prefix, low bits zeroed).  ``gen`` overrides the
+    profile's gen_batch — the serving layer injects its coalescing gen
+    lane here so /v1/hh/gen rides the same device dealer dispatch as
+    /v1/gen."""
+    if gen is None:
+        gen, _, _ = _profile_api(profile)
     values = np.asarray(values, dtype=np.uint64)
     if values.ndim != 1 or values.shape[0] == 0:
         raise ValueError("heavy_hitters: values must be a non-empty vector")

@@ -11,10 +11,14 @@ struct-of-arrays tensor layout the accelerated evaluator wants:
     tcw    uint8[K, nu, 2]    per-level (tLCW, tRCW) control-bit CWs
     fcw    uint32[K, 4]       final output correction word
 
-Gen stays on the host (CPU): it is O(log N) sequential AES per key and needs
-a CSPRNG (reference dpf/dpf.go:80-81) — the wrong shape for a TPU — but it is
-*vectorized across the key batch*, so generating 4096 keys costs ~the same
-wall time as a handful.
+Gen draws its root seeds on the host (the CSPRNG boundary, reference
+dpf/dpf.go:80-81) and — with ``DPF_TPU_GEN`` resolved to the device (auto
+= TPU) — runs the per-level correction-word tower on the accelerator as a
+K-parallel bitsliced-AES scan (models/keys_gen.py) through the plan cache.
+The host tower below is the CPU/degraded twin: *vectorized across the key
+batch* (generating 4096 keys costs ~the same wall time as a handful), it
+serves small/CPU deployments and is the breaker fallback — byte-identical
+by construction, because both towers walk the same drawn seeds.
 """
 
 from __future__ import annotations
@@ -105,6 +109,26 @@ class KeyBatch:
         return [bytes(row) for row in out]
 
 
+def _draw_roots(
+    K: int, rng: np.random.Generator | None
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Draw + canonicalize both parties' root seeds: (s0, t0, s1, t1)
+    with control bits extracted and seed LSBs cleared.  This is the
+    CSPRNG boundary — the draw order is part of the byte-identity
+    contract between the host and device towers."""
+    if rng is None:
+        raw = np.frombuffer(os.urandom(32 * K), dtype=np.uint8).reshape(K, 32)
+        s0, s1 = raw[:, :16].copy(), raw[:, 16:].copy()
+    else:
+        s0 = rng.integers(0, 256, size=(K, 16), dtype=np.uint8)
+        s1 = rng.integers(0, 256, size=(K, 16), dtype=np.uint8)
+    t0 = (s0[:, 0] & 1).astype(np.uint8)
+    t1 = t0 ^ 1
+    s0[:, 0] &= 0xFE
+    s1[:, 0] &= 0xFE
+    return s0, t0, s1, t1
+
+
 def gen_batch(
     alphas: np.ndarray | list[int],
     log_n: int,
@@ -112,27 +136,39 @@ def gen_batch(
 ) -> tuple[KeyBatch, KeyBatch]:
     """Generate key pairs for a whole batch of points at once.
 
-    Vectorized mirror of the reference Gen (dpf/dpf.go:71-169): the level
-    loop is sequential (inherent data dependence) but every AES call runs
-    across all K keys as one numpy batch.  ``rng=None`` uses OS entropy.
-    """
+    Mirror of the reference Gen (dpf/dpf.go:71-169).  Root seeds are
+    drawn here (``rng=None`` uses OS entropy); the correction-word tower
+    runs on device through ``core/plans.run_gen`` when ``DPF_TPU_GEN``
+    resolves to the device, else as the vectorized host loop below —
+    byte-identical either way, since both walk the same seeds."""
     alphas = np.asarray(alphas, dtype=np.uint64)
     K = alphas.shape[0]
     if log_n > 63 or (alphas >= (np.uint64(1) << np.uint64(log_n))).any():
         raise ValueError("dpf: invalid parameters")
+
+    s0, t0, s1, t1 = _draw_roots(K, rng)
+    from ..models import keys_gen
+
+    if keys_gen.device_enabled():
+        out = keys_gen.try_gen_device("compat", alphas, log_n, s0, t0, s1, t1)
+        if out is not None:
+            return out
+    return _gen_from_roots(alphas, log_n, s0, t0, s1, t1)
+
+
+def _gen_from_roots(
+    alphas: np.ndarray,
+    log_n: int,
+    s0: np.ndarray,
+    t0: np.ndarray,
+    s1: np.ndarray,
+    t1: np.ndarray,
+) -> tuple[KeyBatch, KeyBatch]:
+    """The host correction-word tower (CPU/degraded twin): the level
+    loop is sequential (inherent data dependence) but every AES call
+    runs across all K keys as one numpy batch."""
+    K = alphas.shape[0]
     nu = max(log_n - 7, 0)
-
-    if rng is None:
-        raw = np.frombuffer(os.urandom(32 * K), dtype=np.uint8).reshape(K, 32)
-        s0, s1 = raw[:, :16].copy(), raw[:, 16:].copy()
-    else:
-        s0 = rng.integers(0, 256, size=(K, 16), dtype=np.uint8)
-        s1 = rng.integers(0, 256, size=(K, 16), dtype=np.uint8)
-
-    t0 = (s0[:, 0] & 1).astype(np.uint8)
-    t1 = t0 ^ 1
-    s0[:, 0] &= 0xFE
-    s1[:, 0] &= 0xFE
     root0, root_t0 = s0.copy(), t0.copy()
     root1, root_t1 = s1.copy(), t1.copy()
 

@@ -127,6 +127,16 @@ _declare(
     "even for non-bit-major backends).",
     "dpf_tpu/ops/aes_pallas.py", choices=("auto", "xla", "pallas"),
 )
+_declare(
+    "DPF_TPU_GEN", "str", "auto",
+    "Device-side batched key generation (models/keys_gen.py): run the "
+    "per-level Gen correction-word tower on the accelerator through the "
+    "plan cache for both profiles + DCF (auto = device on TPU, host "
+    "elsewhere).  Root seeds always draw from the host CSPRNG; the host "
+    "tower remains the degraded/breaker fallback, byte-identical on the "
+    "same seeds.",
+    "dpf_tpu/models/keys_gen.py", values="off|auto|on",
+)
 
 # Dispatch plans / serving fast path ----------------------------------------
 _declare(

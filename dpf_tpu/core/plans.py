@@ -131,7 +131,7 @@ def q_bucket(q: int) -> int:
 PLAN_ROUTES = frozenset(
     {
         "points", "dcf_points", "dcf_interval", "evalfull", "hh_level",
-        "hh_extend", "hh_fold", "agg_xor", "agg_add", "pir",
+        "hh_extend", "hh_fold", "agg_xor", "agg_add", "pir", "gen",
     }
 )
 
@@ -908,6 +908,61 @@ def _active_sbox() -> str:
     return sbox_circuit.active_sbox()
 
 
+def run_gen(
+    kind: str, alphas: np.ndarray, log_n: int,
+    s0: np.ndarray, t0: np.ndarray, s1: np.ndarray, t1: np.ndarray,
+) -> tuple:
+    """Plan-cached device-side key generation (the dealer route): drawn
+    root seeds + secret alphas -> one (key_a, key_b) batch pair, byte-
+    identical to the host ``gen_batch`` tower on the same seeds.
+
+    ``kind`` selects the key family — "compat" (AES planes tower),
+    "fast" (ChaCha words tower), "dcf" (ChaCha + value CWs) — and rides
+    the PlanKey profile slot so ``recent_shapes``/``warmup`` round-trip
+    it like any profile.  Seeds are drawn by the CALLER for the actual K
+    in host order (the CSPRNG boundary); this route zero-pads them to
+    the plan bucket (pad lanes tower garbage keys that are sliced off)
+    so padding never changes the rng draw count.  With the serving mesh
+    resolved the key axis shards across chips with zero collectives
+    (parallel/sharding.py); the compat planes tower pads K to the
+    32-key lane quantum times the shard count so lane words split
+    evenly."""
+    from ..models import keys_gen
+
+    if kind not in ("compat", "fast", "dcf"):
+        raise ValueError(f"gen: unknown kind {kind!r} (compat|fast|dcf)")
+    alphas = np.asarray(alphas, dtype=np.uint64)
+    K = alphas.shape[0]
+    mesh, n_shards = _dispatch_mesh()
+    with _tuned_dispatch("gen", kind, log_n, K, n_shards):
+        key = plan_key("gen", kind, log_n, K, 0, packed=True, mesh=n_shards)
+        plan, first = _CACHE.get(key)
+        obs_trace.add_event(
+            "plan_lookup", hit=not first, route="gen",
+            k_bucket=key.k_bucket, q_bucket=0,
+        )
+        t0_wall = time.perf_counter()
+        donate = donation_enabled()
+        with obs_trace.child_span("compute"):
+            # The gen bodies marshal their own output (the key material
+            # is the one D2H) — no separate d2h span, like the sharded
+            # routes.
+            if kind == "compat":
+                kp = max(key.k_bucket, 32 * max(n_shards, 1))
+                out = keys_gen.gen_device_compat(
+                    alphas, log_n, s0, t0, s1, t1, kp, mesh, donate
+                )
+            else:
+                out = keys_gen.gen_device_cc(
+                    kind, alphas, log_n, s0, t0, s1, t1, key.k_bucket,
+                    mesh, donate,
+                )
+        if first:
+            plan.compile_s = time.perf_counter() - t0_wall
+        plan.last_used = time.time()
+        return out
+
+
 def run_evalfull(profile: str, kb) -> np.ndarray:
     """Plan-cached full-domain expansion -> uint8[K, out_bytes].  With
     the serving mesh resolved, the key batch shards over the keys axis
@@ -960,10 +1015,11 @@ def warmup(shapes: list[dict]) -> list[dict]:
     first-request compile never lands on user traffic.
 
     Each spec: ``{"route": "points"|"dcf_points"|"dcf_interval"|
-    "evalfull"|"hh_level"|"agg_xor"|"agg_add"|"pir", "profile":
+    "evalfull"|"hh_level"|"agg_xor"|"agg_add"|"pir"|"gen", "profile":
     "compat"|"fast", "log_n": N, "k": K, "q": Q}`` (``q`` ignored for
     evalfull; ``profile`` ignored for the DCF routes, which are
-    fast-profile by construction).  A ``pir`` spec instead names a
+    fast-profile by construction; a ``gen`` spec's profile is the key
+    family — "compat"|"fast"|"dcf" — and ``q`` is ignored).  A ``pir`` spec instead names a
     REGISTERED database — ``{"route": "pir", "db": name, "k": K}`` —
     and warms its expansion + parity-matmul executables for the current
     mesh regime (log_n and profile come from the registry entry;
@@ -1093,6 +1149,14 @@ def warmup(shapes: list[dict]) -> list[dict]:
                         )
                     for _ in eval_full_stream(kb_s):
                         pass
+            elif route == "gen":
+                # One dealer-route shape ({"route": "gen", "profile":
+                # "compat"|"fast"|"dcf", "log_n": N, "k": K}): the kind
+                # rides the profile slot, so recent_shapes round-trips
+                # it like any profile.
+                from ..models import keys_gen
+
+                keys_gen.warm(profile, log_n, kb_count, rng)
             elif route == "dcf_interval":
                 from ..models import dcf
 

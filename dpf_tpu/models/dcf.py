@@ -175,20 +175,37 @@ def gen_lt_batch(
 
     Identical walk to keys_chacha.gen_batch (the DPF seed/control-bit
     machinery is unchanged) plus the per-level value CW and the in-leaf
-    comparison correction."""
+    comparison correction.  Seeds are drawn here; the tower runs on
+    device through ``core/plans.run_gen`` when ``DPF_TPU_GEN`` resolves
+    to the device, byte-identically."""
     alphas = np.asarray(alphas, dtype=np.uint64)
     K = alphas.shape[0]
     if log_n > 63 or log_n < 1 or (alphas >> np.uint64(log_n)).any():
         raise ValueError("dcf: invalid parameters")
-    nu = cc.nu_of(log_n)
 
-    raw = cc.gen_root_seeds(2 * K, rng)
-    s0 = np.ascontiguousarray(raw[:K]).view("<u4")
-    s1 = np.ascontiguousarray(raw[K:]).view("<u4")
-    t0 = (s0[:, 0] & 1).astype(np.uint8)
-    t1 = t0 ^ 1
-    s0[:, 0] &= ~np.uint32(1)
-    s1[:, 0] &= ~np.uint32(1)
+    from .keys_chacha import _draw_roots
+
+    s0, t0, s1, t1 = _draw_roots(K, rng)
+    from . import keys_gen
+
+    if keys_gen.device_enabled():
+        out = keys_gen.try_gen_device("dcf", alphas, log_n, s0, t0, s1, t1)
+        if out is not None:
+            return out
+    return _gen_lt_from_roots(alphas, log_n, s0, t0, s1, t1)
+
+
+def _gen_lt_from_roots(
+    alphas: np.ndarray,
+    log_n: int,
+    s0: np.ndarray,
+    t0: np.ndarray,
+    s1: np.ndarray,
+    t1: np.ndarray,
+) -> tuple[DcfKeyBatch, DcfKeyBatch]:
+    """The host DCF tower (CPU/degraded twin)."""
+    K = alphas.shape[0]
+    nu = cc.nu_of(log_n)
     root0, rt0 = s0.copy(), t0.copy()
     root1, rt1 = s1.copy(), t1.copy()
 

@@ -107,20 +107,12 @@ class KeyBatchFast:
         return [bytes(row) for row in out]
 
 
-def gen_batch(
-    alphas: np.ndarray | list[int],
-    log_n: int,
-    rng: np.random.Generator | None = None,
-) -> tuple[KeyBatchFast, KeyBatchFast]:
-    """Vectorized fast-profile Gen: the reference Gen level loop
-    (dpf/dpf.go:94-158) with the ChaCha node PRG, stopping 9 levels early
-    (512-bit leaves), every step batched over all K keys."""
-    alphas = np.asarray(alphas, dtype=np.uint64)
-    K = alphas.shape[0]
-    if log_n > 63 or (alphas >> np.uint64(log_n)).any():
-        raise ValueError("dpf-fast: invalid parameters")
-    nu = cc.nu_of(log_n)
-
+def _draw_roots(
+    K: int, rng: np.random.Generator | None
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Draw + canonicalize both parties' root seeds (the CSPRNG
+    boundary; one 2K draw, party A first — the draw order is part of
+    the host/device byte-identity contract)."""
     raw = cc.gen_root_seeds(2 * K, rng)
     s0 = np.ascontiguousarray(raw[:K]).view("<u4")
     s1 = np.ascontiguousarray(raw[K:]).view("<u4")
@@ -128,6 +120,46 @@ def gen_batch(
     t1 = t0 ^ 1
     s0[:, 0] &= ~np.uint32(1)
     s1[:, 0] &= ~np.uint32(1)
+    return s0, t0, s1, t1
+
+
+def gen_batch(
+    alphas: np.ndarray | list[int],
+    log_n: int,
+    rng: np.random.Generator | None = None,
+) -> tuple[KeyBatchFast, KeyBatchFast]:
+    """Fast-profile Gen: root seeds drawn on host, the correction-word
+    tower on device through ``core/plans.run_gen`` when ``DPF_TPU_GEN``
+    resolves to the device, else the vectorized host loop below —
+    byte-identical either way (same drawn seeds, deterministic tower)."""
+    alphas = np.asarray(alphas, dtype=np.uint64)
+    K = alphas.shape[0]
+    if log_n > 63 or (alphas >> np.uint64(log_n)).any():
+        raise ValueError("dpf-fast: invalid parameters")
+
+    s0, t0, s1, t1 = _draw_roots(K, rng)
+    from . import keys_gen
+
+    if keys_gen.device_enabled():
+        out = keys_gen.try_gen_device("fast", alphas, log_n, s0, t0, s1, t1)
+        if out is not None:
+            return out
+    return _gen_from_roots(alphas, log_n, s0, t0, s1, t1)
+
+
+def _gen_from_roots(
+    alphas: np.ndarray,
+    log_n: int,
+    s0: np.ndarray,
+    t0: np.ndarray,
+    s1: np.ndarray,
+    t1: np.ndarray,
+) -> tuple[KeyBatchFast, KeyBatchFast]:
+    """The host tower (CPU/degraded twin): the reference Gen level loop
+    (dpf/dpf.go:94-158) with the ChaCha node PRG, stopping 9 levels
+    early (512-bit leaves), every step batched over all K keys."""
+    K = alphas.shape[0]
+    nu = cc.nu_of(log_n)
     root0, rt0 = s0.copy(), t0.copy()
     root1, rt1 = s1.copy(), t1.copy()
 

@@ -1,0 +1,549 @@
+"""Device-side batched key generation — the dealer on the TPU.
+
+Gen was the last compute path still running as host NumPy AES/ChaCha:
+across a K-key batch every one of the ``nu`` sequential tree levels is a
+K-wide PRG expansion — exactly the batch shape the bitsliced-AES planes
+(ops/aes_bitslice.py) and the ChaCha word kernels (models/dpf_chacha.py)
+already own.  This module runs the per-level correction-word tower on
+device, K-parallel, for all three key families:
+
+  * ``fast``   — ChaCha12 tree (models/keys_chacha.gen_batch's math) on
+    4x uint32[K] seed-word lanes via ``_prg_expand``/``_convert``;
+  * ``dcf``    — the same tree plus the per-level value CW
+    (models/dcf.gen_lt_batch) via ``_prg_expand_v``;
+  * ``compat`` — fixed-key AES-128-MMO (core/keys.gen_batch) on
+    bitsliced [128, K/32] planes, one ``prg_planes`` call per party per
+    level, so the key axis lives in lane bits and shards cleanly.
+
+The CSPRNG boundary stays on host: root seeds are drawn exactly where
+and how the host gens draw them (``os.urandom`` / the injected rng, same
+call order), because seed entropy is the ONLY part of Gen that needs a
+CSPRNG — given identical root seeds the tower is deterministic, so the
+device output is **byte-identical** to the host ``gen_batch`` by
+construction (pinned by tests/test_gen_device.py under an injected rng).
+Alpha bits and control bits ride as host-precomputed secret-derived
+operands; on device every per-level select is mask arithmetic
+(``msk = 0 - bit``), never a branch or a secret index — the gen routes
+carry obliviousness certificates like every eval route.
+
+Routing (``DPF_TPU_GEN`` off|auto|on; auto = device on TPU): the host
+``gen_batch``/``gen_lt_batch`` entrypoints draw seeds, then hand the
+tower to ``core/plans.run_gen`` (plan-bucketed, zero-retrace after
+warmup, mesh-sharded over the key axis) when the device path is enabled.
+Any device failure — and degraded serving under an open breaker
+(``host_only()``) — falls back to the host tower **with the already-
+drawn seeds**, so the fallback is byte-identical, not just
+distribution-identical.
+
+Level-carry donation: the root seed/control-bit operands are dead once
+the first level expands, so the donated jit twins let XLA reuse their
+buffers in place (``DONATED_TWINS`` is the perf-contract ledger's
+evidence source, like models/dpf_chacha.py).  ``DPF_TPU_FUSE`` != off
+additionally runs both towers as one ``lax.scan`` over levels (the
+carries are shape-uniform), collapsing nu dispatch nodes into one fused
+loop body — for the compat planes tower this also collapses nu copies
+of the bitsliced AES circuit out of the traced graph, cutting compile
+time from minutes to seconds at deep domains.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import knobs
+from .dpf_chacha import _convert, _prg_expand, _prg_expand_v
+
+# ---------------------------------------------------------------------------
+# Routing: DPF_TPU_GEN + the degraded-mode override
+# ---------------------------------------------------------------------------
+
+_TLS = threading.local()
+
+#: Count of device-gen dispatches that fell back to the host tower after
+#: an exception (tests assert this stays 0 on healthy paths).
+fallbacks = 0
+
+
+@contextlib.contextmanager
+def host_only():
+    """Force the host gen tower on this thread (the serving layer wraps
+    degraded/breaker-open gen dispatches in this, so an open circuit
+    never routes key generation at a wedged device)."""
+    prev = getattr(_TLS, "host_only", 0)
+    _TLS.host_only = prev + 1
+    try:
+        yield
+    finally:
+        _TLS.host_only = prev
+
+
+def device_enabled() -> bool:
+    """Resolve DPF_TPU_GEN (off|auto|on; default auto = TPU only),
+    honoring an active ``host_only()`` scope."""
+    if getattr(_TLS, "host_only", 0):
+        return False
+    raw = knobs.get_raw("DPF_TPU_GEN")
+    v = knobs.knob("DPF_TPU_GEN").default if not raw else raw.lower()
+    if v in ("on", "1", "true"):
+        return True
+    if v in ("off", "0", "false"):
+        return False
+    if v != "auto":
+        raise ValueError(f"DPF_TPU_GEN={v!r} unknown (off|auto|on)")
+    return jax.default_backend() == "tpu"
+
+
+def try_gen_device(kind, alphas, log_n, s0, t0, s1, t1):
+    """Dispatch one drawn-seed batch through the plan-cached device
+    tower; ``None`` on failure (the caller re-towers the SAME seeds on
+    host, byte-identically — the degraded twin)."""
+    if alphas.shape[0] == 0:
+        return None
+    from ..core import plans
+
+    try:
+        return plans.run_gen(kind, alphas, log_n, s0, t0, s1, t1)
+    except Exception:  # noqa: BLE001 — any device failure degrades to host
+        global fallbacks
+        fallbacks += 1
+        return None
+
+
+def fused_enabled() -> bool:
+    """Level-fused (lax.scan) ChaCha gen tower under DPF_TPU_FUSE."""
+    return knobs.get_str("DPF_TPU_FUSE") != "off"
+
+
+# ---------------------------------------------------------------------------
+# ChaCha tower (fast + DCF): 4x uint32[K] seed-word lanes
+# ---------------------------------------------------------------------------
+
+
+def _level_gen_cc(s0w, s1w, t0, t1, bit, dcf):
+    """One Gen level for both parties: expand, publish the level's CWs,
+    descend alpha's KEEP child.  All selects are mask arithmetic on the
+    secret alpha bit (``msk = 0 - bit``) — no branches, no indexing."""
+    if dcf:
+        l0, r0, v0 = _prg_expand_v(s0w)
+        l1, r1, v1 = _prg_expand_v(s1w)
+    else:
+        l0, r0 = _prg_expand(s0w)
+        l1, r1 = _prg_expand(s1w)
+    one = jnp.uint32(1)
+    t0l, t0r = l0[0] & one, r0[0] & one
+    t1l, t1r = l1[0] & one, r1[0] & one
+    clear = ~one
+    l0 = [l0[0] & clear, l0[1], l0[2], l0[3]]
+    r0 = [r0[0] & clear, r0[1], r0[2], r0[3]]
+    l1 = [l1[0] & clear, l1[1], l1[2], l1[3]]
+    r1 = [r1[0] & clear, r1[1], r1[2], r1[3]]
+
+    msk = jnp.uint32(0) - bit  # all-ones when alpha descends right
+    # LOSE child = the one alpha does NOT descend into.
+    scw = [
+        ((l0[i] ^ l1[i]) & msk) | ((r0[i] ^ r1[i]) & ~msk) for i in range(4)
+    ]
+    tlcw = t0l ^ t1l ^ bit ^ one
+    trcw = t0r ^ t1r ^ bit
+    vcw = ((v0 ^ v1 ^ bit) & one) if dcf else None
+
+    keep0 = [(r0[i] & msk) | (l0[i] & ~msk) for i in range(4)]
+    keep1 = [(r1[i] & msk) | (l1[i] & ~msk) for i in range(4)]
+    kt0 = (t0r & msk) | (t0l & ~msk)
+    kt1 = (t1r & msk) | (t1l & ~msk)
+    ktcw = (trcw & msk) | (tlcw & ~msk)
+
+    tm0 = jnp.uint32(0) - t0
+    tm1 = jnp.uint32(0) - t1
+    ns0 = [keep0[i] ^ (scw[i] & tm0) for i in range(4)]
+    ns1 = [keep1[i] ^ (scw[i] & tm1) for i in range(4)]
+    nt0 = kt0 ^ (t0 & ktcw)
+    nt1 = kt1 ^ (t1 & ktcw)
+    return ns0, ns1, nt0, nt1, scw, tlcw, trcw, vcw
+
+
+def _gen_cc_body(nu, dcf, fused, s0, s1, t0, t1, bits):
+    """ChaCha gen tower: cleared root seed words uint32[K, 4] x2, root
+    control bits uint32[K] x2, alpha bits uint32[nu, K] (level-major) ->
+    (scw uint32[nu, K, 4], tlcw/trcw uint32[nu, K], fcw uint32[K, 16]
+    [, vcw uint32[nu, K]])."""
+    K = s0.shape[0]
+    s0w = [s0[:, i] for i in range(4)]
+    s1w = [s1[:, i] for i in range(4)]
+
+    if nu and fused:
+
+        def step(carry, bit):
+            c0, c1, ct0, ct1 = carry
+            n0, n1, nt0, nt1, scw, tl, tr, vcw = _level_gen_cc(
+                list(c0), list(c1), ct0, ct1, bit, dcf
+            )
+            ys = (jnp.stack(scw, axis=-1), tl, tr)
+            if dcf:
+                ys = ys + (vcw,)
+            return (tuple(n0), tuple(n1), nt0, nt1), ys
+
+        carry, ys = jax.lax.scan(
+            step, (tuple(s0w), tuple(s1w), t0, t1), bits
+        )
+        s0w, s1w = list(carry[0]), list(carry[1])
+        scw_all, tl_all, tr_all = ys[0], ys[1], ys[2]
+        vcw_all = ys[3] if dcf else None
+    else:
+        scw_l, tl_l, tr_l, vcw_l = [], [], [], []
+        for i in range(nu):
+            s0w, s1w, t0, t1, scw, tl, tr, vcw = _level_gen_cc(
+                s0w, s1w, t0, t1, bits[i], dcf
+            )
+            scw_l.append(jnp.stack(scw, axis=-1))
+            tl_l.append(tl)
+            tr_l.append(tr)
+            if dcf:
+                vcw_l.append(vcw)
+        z = jnp.zeros((0, K), jnp.uint32)
+        scw_all = (
+            jnp.stack(scw_l) if nu else jnp.zeros((0, K, 4), jnp.uint32)
+        )
+        tl_all = jnp.stack(tl_l) if nu else z
+        tr_all = jnp.stack(tr_l) if nu else z
+        vcw_all = (jnp.stack(vcw_l) if nu else z) if dcf else None
+
+    conv0 = _convert(s0w)
+    conv1 = _convert(s1w)
+    fcw = jnp.stack([conv0[i] ^ conv1[i] for i in range(16)], axis=-1)
+    out = (scw_all, tl_all, tr_all, fcw)
+    if dcf:
+        out = out + (vcw_all,)
+    return out
+
+
+_gen_cc_jit = partial(jax.jit, static_argnums=(0, 1, 2))(_gen_cc_body)
+# Donated twin: the root seed/control-bit carries are dead after level 0
+# expands (plans.donation_enabled gates selection, like every other twin).
+_gen_cc_donated_jit = partial(
+    jax.jit, static_argnums=(0, 1, 2), donate_argnums=(3, 4, 5, 6)
+)(_gen_cc_body)
+
+
+# ---------------------------------------------------------------------------
+# AES compat tower: bitsliced [128, K/32] planes per party
+# ---------------------------------------------------------------------------
+
+
+def _level_gen_compat(S0, S1, T0, T1, bm):
+    """One compat Gen level on bitsliced planes.  Plane row 0 is every
+    key's byte-0 LSB (the control bit); clearing it zeroes the row, and
+    the per-key ``^ 1`` of tlcw is a lane-wide complement."""
+    from ..ops.aes_bitslice import prg_planes
+
+    W = S0.shape[1]
+    ones = jnp.uint32(0xFFFFFFFF)
+    L0, R0 = prg_planes(S0)
+    L1, R1 = prg_planes(S1)
+    t0l, t0r = L0[0], R0[0]
+    t1l, t1r = L1[0], R1[0]
+    zero = jnp.zeros((W,), jnp.uint32)
+    L0, R0 = L0.at[0].set(zero), R0.at[0].set(zero)
+    L1, R1 = L1.at[0].set(zero), R1.at[0].set(zero)
+
+    scw = ((L0 ^ L1) & bm) | ((R0 ^ R1) & ~bm)  # LOSE side
+    tlcw = t0l ^ t1l ^ bm ^ ones
+    trcw = t0r ^ t1r ^ bm
+
+    keep0 = (R0 & bm) | (L0 & ~bm)
+    keep1 = (R1 & bm) | (L1 & ~bm)
+    kt0 = (t0r & bm) | (t0l & ~bm)
+    kt1 = (t1r & bm) | (t1l & ~bm)
+    ktcw = (trcw & bm) | (tlcw & ~bm)
+    S0 = keep0 ^ (scw & T0)
+    S1 = keep1 ^ (scw & T1)
+    T0 = kt0 ^ (T0 & ktcw)
+    T1 = kt1 ^ (T1 & ktcw)
+    return S0, S1, T0, T1, scw, tlcw, trcw
+
+
+def _gen_compat_body(nu, fused, S0, S1, T0, T1, BM):
+    """Compat gen tower on bitsliced planes: cleared root seed planes
+    uint32[128, W] x2 (32 keys per lane word), root control-bit lane
+    words uint32[W] x2, alpha-bit lane masks uint32[nu, W] ->
+    (scw uint32[K, nu, 4] per-key words, tlcw/trcw uint32[nu, W] lane
+    words, fcw uint32[K, 4])."""
+    from ..ops.aes_bitslice import (
+        RK_MASKS_L,
+        aes128_mmo_planes,
+        unpack_planes,
+    )
+
+    W = S0.shape[1]
+    if nu and fused:
+
+        def step(carry, bm):
+            c0, c1, ct0, ct1 = carry
+            n0, n1, nt0, nt1, scw, tl, tr = _level_gen_compat(
+                c0, c1, ct0, ct1, bm
+            )
+            return (n0, n1, nt0, nt1), (scw, tl, tr)
+
+        carry, ys = jax.lax.scan(step, (S0, S1, T0, T1), BM)
+        S0, S1, T0, T1 = carry
+        scw_stack = ys[0].transpose(1, 0, 2)  # [nu,128,W] -> [128,nu,W]
+        tl_all, tr_all = ys[1], ys[2]
+    elif nu:
+        scw_l, tl_l, tr_l = [], [], []
+        for i in range(nu):
+            S0, S1, T0, T1, scw, tl, tr = _level_gen_compat(
+                S0, S1, T0, T1, BM[i]
+            )
+            scw_l.append(scw)
+            tl_l.append(tl)
+            tr_l.append(tr)
+        scw_stack = jnp.stack(scw_l, axis=1)
+        tl_all = jnp.stack(tl_l)
+        tr_all = jnp.stack(tr_l)
+    else:
+        scw_stack = None
+
+    conv0 = aes128_mmo_planes(S0, RK_MASKS_L)
+    conv1 = aes128_mmo_planes(S1, RK_MASKS_L)
+    if nu:
+        # [128, nu, W] -> per-key words uint32[K, nu, 4] on device.
+        scw_words = unpack_planes(scw_stack)
+    else:
+        scw_words = jnp.zeros((W * 32, 0, 4), jnp.uint32)
+        tl_all = jnp.zeros((0, W), jnp.uint32)
+        tr_all = jnp.zeros((0, W), jnp.uint32)
+    fcw_words = unpack_planes((conv0 ^ conv1)[:, None, :])[:, 0, :]
+    return scw_words, tl_all, tr_all, fcw_words
+
+
+_gen_compat_jit = partial(jax.jit, static_argnums=(0, 1))(_gen_compat_body)
+_gen_compat_donated_jit = partial(
+    jax.jit, static_argnums=(0, 1), donate_argnums=(2, 3, 4, 5)
+)(_gen_compat_body)
+
+#: jitted-twin evidence for the perf-contract ledger (same format as
+#: models/dpf_chacha.DONATED_TWINS): name -> (static_argnums,
+#: donate_argnums).
+DONATED_TWINS = {
+    "_gen_cc_donated_jit": ((0, 1, 2), (3, 4, 5, 6)),
+    "_gen_compat_donated_jit": ((0, 1), (2, 3, 4, 5)),
+}
+
+
+# ---------------------------------------------------------------------------
+# Host-side operand prep + output marshalling
+# ---------------------------------------------------------------------------
+
+
+def _pad_rows(a: np.ndarray, kp: int) -> np.ndarray:
+    """Zero-pad the leading (key) axis to the plan bucket.  Seeds are
+    drawn for the ACTUAL K first (host rng order is part of the byte-
+    identity contract); the pad lanes tower garbage keys that are
+    sliced back off."""
+    k = a.shape[0]
+    if k == kp:
+        return a
+    return np.concatenate([a, np.zeros((kp - k,) + a.shape[1:], a.dtype)])
+
+
+def _alpha_bits(alphas: np.ndarray, log_n: int, nu: int) -> np.ndarray:
+    """Level-major alpha path bits uint32[nu, K] (secret-derived host
+    operand — the dealer knows alpha)."""
+    shifts = np.uint64(log_n) - 1 - np.arange(nu, dtype=np.uint64)
+    return ((alphas[None, :] >> shifts[:, None]) & np.uint64(1)).astype(
+        np.uint32
+    )
+
+
+def _pack_lane_bits(bits: np.ndarray, w: int) -> np.ndarray:
+    """0/1 rows [..., K] -> lane words uint32[..., w] (key k at word
+    k//32 bit k%32 — the aes_bitslice plane lane order)."""
+    k = bits.shape[-1]
+    padded = np.zeros(bits.shape[:-1] + (w * 32,), np.uint32)
+    padded[..., :k] = bits
+    padded = padded.reshape(bits.shape[:-1] + (w, 32))
+    return (padded << np.arange(32, dtype=np.uint32)).sum(
+        -1, dtype=np.uint32
+    )
+
+
+def _unpack_lane_bits(words: np.ndarray, k: int) -> np.ndarray:
+    """Inverse of _pack_lane_bits: uint32[..., W] -> uint8[..., k]."""
+    bits = (
+        words[..., :, None] >> np.arange(32, dtype=np.uint32)
+    ) & np.uint32(1)
+    flat = words.shape[:-1] + (words.shape[-1] * 32,)
+    return bits.reshape(flat)[..., :k].astype(np.uint8)
+
+
+def _fast_low(alphas: np.ndarray, log_n: int) -> np.ndarray:
+    from ..core import chacha_np as cc
+
+    if log_n >= cc.LEAF_LOG:
+        return alphas & np.uint64(cc.LEAF_BITS - 1)
+    return alphas
+
+
+def gen_device_cc(
+    kind: str,
+    alphas: np.ndarray,
+    log_n: int,
+    s0: np.ndarray,
+    t0: np.ndarray,
+    s1: np.ndarray,
+    t1: np.ndarray,
+    kp: int,
+    mesh=None,
+    donate: bool = False,
+):
+    """ChaCha-tree device gen (``fast`` | ``dcf``): drawn roots ->
+    (key_a, key_b) batch pair, byte-identical to the host tower."""
+    K = alphas.shape[0]
+    nu = max(log_n - 9, 0)
+    dcf = kind == "dcf"
+    bits = _pad_rows(_alpha_bits(alphas, log_n, nu).T, kp).T
+    args = (
+        jnp.asarray(_pad_rows(s0, kp)),
+        jnp.asarray(_pad_rows(s1, kp)),
+        jnp.asarray(_pad_rows(t0.astype(np.uint32), kp)),
+        jnp.asarray(_pad_rows(t1.astype(np.uint32), kp)),
+        jnp.asarray(np.ascontiguousarray(bits)),
+    )
+    if mesh is not None:
+        from ..parallel import sharding
+
+        fn = sharding.gen_cc_sharded_fn(
+            mesh, nu, dcf, fused_enabled(), donate
+        )
+        out = fn(*args)
+    else:
+        fn = _gen_cc_donated_jit if donate else _gen_cc_jit
+        out = fn(nu, dcf, fused_enabled(), *args)
+    scw_d, tl_d, tr_d, fcw_d = out[0], out[1], out[2], out[3]
+
+    scw = np.ascontiguousarray(
+        np.asarray(scw_d).transpose(1, 0, 2)[:K]  # host-sync: gen marshalling (the keys ARE the reply)
+    )
+    tcw = np.ascontiguousarray(
+        np.stack(
+            [np.asarray(tl_d).T[:K], np.asarray(tr_d).T[:K]], axis=2  # host-sync: gen marshalling
+        ).astype(np.uint8)
+    )
+    conv_diff = np.asarray(fcw_d)[:K].copy()  # host-sync: gen marshalling
+    low = _fast_low(alphas, log_n)
+    if dcf:
+        from . import dcf as dcf_mod
+
+        fvcw = conv_diff ^ dcf_mod._lt_leaf_mask(low)
+        vcw = np.ascontiguousarray(
+            np.asarray(out[4]).T[:K].astype(np.uint8)  # host-sync: gen marshalling
+        )
+
+        def mk(root, rt):
+            return dcf_mod.DcfKeyBatch(
+                log_n, root, rt, scw.copy(), tcw.copy(), vcw.copy(), fvcw
+            )
+
+        return mk(s0, t0), mk(s1, t1)
+    from .keys_chacha import KeyBatchFast
+
+    low_i = low.astype(np.int64)
+    conv_diff[np.arange(K), low_i >> 5] ^= np.uint32(1) << (
+        low_i & 31
+    ).astype(np.uint32)
+
+    def mk(root, rt):
+        return KeyBatchFast(log_n, root, rt, scw.copy(), tcw.copy(),
+                            conv_diff)
+
+    return mk(s0, t0), mk(s1, t1)
+
+
+def gen_device_compat(
+    alphas: np.ndarray,
+    log_n: int,
+    s0: np.ndarray,
+    t0: np.ndarray,
+    s1: np.ndarray,
+    t1: np.ndarray,
+    kp: int,
+    mesh=None,
+    donate: bool = False,
+):
+    """AES-compat device gen on bitsliced planes: drawn roots (uint8
+    [K, 16] seeds, uint8[K] control bits) -> (key_a, key_b)."""
+    from ..ops.aes_bitslice import pack_blocks_np
+
+    K = alphas.shape[0]
+    nu = max(log_n - 7, 0)
+    w = kp // 32
+    bm = _pack_lane_bits(_alpha_bits(alphas, log_n, nu), w)
+    t0_w = _pack_lane_bits(t0.astype(np.uint32), w)
+    args = (
+        jnp.asarray(pack_blocks_np(_pad_rows(s0, kp))),
+        jnp.asarray(pack_blocks_np(_pad_rows(s1, kp))),
+        jnp.asarray(t0_w),
+        jnp.asarray(t0_w ^ np.uint32(0xFFFFFFFF)),
+        jnp.asarray(bm),
+    )
+    if mesh is not None:
+        from ..parallel import sharding
+
+        fn = sharding.gen_compat_sharded_fn(
+            mesh, nu, fused_enabled(), donate
+        )
+        out = fn(*args)
+    else:
+        fn = _gen_compat_donated_jit if donate else _gen_compat_jit
+        out = fn(nu, fused_enabled(), *args)
+    scw_d, tl_d, tr_d, fcw_d = out
+
+    # host-sync: gen output marshalling (the keys ARE the reply)
+    scw = np.ascontiguousarray(np.asarray(scw_d)[:K])
+    tcw = np.stack(
+        [
+            _unpack_lane_bits(np.asarray(tl_d), K).T,  # host-sync: gen marshalling
+            _unpack_lane_bits(np.asarray(tr_d), K).T,  # host-sync: gen marshalling
+        ],
+        axis=2,
+    )
+    fcw = np.asarray(fcw_d)[:K].copy().view(np.uint8).reshape(K, 16)  # host-sync: gen marshalling
+    low = (alphas & np.uint64(127)).astype(np.int64)
+    fcw[np.arange(K), low // 8] ^= (1 << (low % 8)).astype(np.uint8)
+    fcw = fcw.view("<u4")
+
+    from ..core.keys import KeyBatch
+
+    def mk(root, rt):
+        return KeyBatch(
+            log_n, root.view("<u4"), rt, scw.copy(), tcw.copy(), fcw
+        )
+
+    return mk(s0, t0), mk(s1, t1)
+
+
+# ---------------------------------------------------------------------------
+# Warmup support (core/plans.warmup's "gen" branch)
+# ---------------------------------------------------------------------------
+
+
+def warm(kind: str, log_n: int, k: int, rng) -> None:
+    """Compile the gen plan for one (kind, log_n, K-bucket): draw roots
+    the way the host gen draws them, run the device route once."""
+    from ..core import plans
+
+    alphas = np.zeros(k, np.uint64)
+    if kind == "compat":
+        from ..core.keys import _draw_roots
+    elif kind in ("fast", "dcf"):
+        from .keys_chacha import _draw_roots
+    else:
+        raise ValueError(f"gen: unknown kind {kind!r} (compat|fast|dcf)")
+    s0, t0, s1, t1 = _draw_roots(k, rng)
+    plans.run_gen(kind, alphas, log_n, s0, t0, s1, t1)

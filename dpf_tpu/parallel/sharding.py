@@ -1090,3 +1090,88 @@ def hh_count_fold_sharded(x: np.ndarray, mesh: Mesh) -> np.ndarray:
         raise ValueError(f"hh: rows {g} must tile the {n}-shard mesh")
     # host-sync: tiny per-round count vector
     return np.asarray(_sharded_hh_count_fold(mesh)(x), dtype=np.int64)
+
+
+# ---------------------------------------------------------------------------
+# Sharded key generation (models/keys_gen.py) — the dealer over the mesh
+#
+# Gen is pure key-batch data parallelism: each shard towers its slice of
+# the drawn root seeds with its slice of the alpha bits — ZERO
+# collectives (the perf contract pins it).  The ChaCha towers shard
+# key-major (axis 0 / the trailing K axis of level-major operands); the
+# compat planes tower shards its lane-word axis, i.e. contiguous 32-key
+# groups, so per-shard plane unpacks concatenate back in global key
+# order.  Leaf-axis meshes recompute redundantly across LEAF_AXIS, like
+# the pointwise routes.
+# ---------------------------------------------------------------------------
+
+
+def _sharded_gen_cc_sm(mesh: Mesh, nu: int, dcf: bool, fused: bool):
+    from functools import partial
+
+    from ..models import keys_gen
+
+    level = P(None, KEYS_AXIS)  # level-major [nu, K] operands/CWs
+    return shard_map_compat(
+        partial(keys_gen._gen_cc_body, nu, dcf, fused),
+        mesh=mesh,
+        in_specs=(
+            P(KEYS_AXIS, None),  # s0 words
+            P(KEYS_AXIS, None),  # s1 words
+            P(KEYS_AXIS),  # t0
+            P(KEYS_AXIS),  # t1
+            level,  # alpha bits
+        ),
+        out_specs=(P(None, KEYS_AXIS, None), level, level, P(KEYS_AXIS, None))
+        + ((level,) if dcf else ()),
+        check_vma=False,
+    )
+
+
+@cache
+def gen_cc_sharded_fn(
+    mesh: Mesh, nu: int, dcf: bool, fused: bool, donate: bool = False
+):
+    """The sharded ChaCha gen tower (``fast`` / ``dcf``) for one
+    (mesh, domain) bucket — the mesh twin of keys_gen._gen_cc_jit; the
+    donated variant donates the root seed/control-bit operands exactly
+    like the single-device twin."""
+    fn = _sharded_gen_cc_sm(mesh, nu, dcf, fused)
+    jitted = (
+        jax.jit(fn, donate_argnums=(0, 1, 2, 3)) if donate else jax.jit(fn)
+    )
+    return SHARDED_JITS.register(jitted)
+
+
+def _sharded_gen_compat_sm(mesh: Mesh, nu: int, fused: bool):
+    from functools import partial
+
+    from ..models import keys_gen
+
+    lanes = P(None, KEYS_AXIS)  # [128, W] planes / [nu, W] lane masks
+    return shard_map_compat(
+        partial(keys_gen._gen_compat_body, nu, fused),
+        mesh=mesh,
+        in_specs=(lanes, lanes, P(KEYS_AXIS), P(KEYS_AXIS), lanes),
+        out_specs=(
+            P(KEYS_AXIS, None, None),  # per-key scw words
+            lanes,  # tlcw lane words
+            lanes,  # trcw lane words
+            P(KEYS_AXIS, None),  # per-key fcw words
+        ),
+        check_vma=False,
+    )
+
+
+@cache
+def gen_compat_sharded_fn(
+    mesh: Mesh, nu: int, fused: bool, donate: bool = False
+):
+    """The sharded compat gen tower for one (mesh, domain) bucket — the
+    mesh twin of keys_gen._gen_compat_jit (caller pads the key axis to
+    32 lanes x shard count, keys_gen.gen_device_compat)."""
+    fn = _sharded_gen_compat_sm(mesh, nu, fused)
+    jitted = (
+        jax.jit(fn, donate_argnums=(0, 1, 2, 3)) if donate else jax.jit(fn)
+    )
+    return SHARDED_JITS.register(jitted)

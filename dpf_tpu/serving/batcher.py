@@ -187,6 +187,34 @@ class HHExtendWork:
 
 
 @dataclass
+class GenWork:
+    """One key-generation request: K alpha points -> K serialized key
+    pairs (the /v1/gen, /v1/dcf_gen, and /v1/hh/gen bodies).  The lane
+    is (route, key family, log_n): concurrent gen requests of one
+    family coalesce into ONE device tower dispatch over the
+    concatenated alpha batch — root seeds draw fresh OS entropy per
+    dispatch, so coalescing never correlates two requests' keys beyond
+    what one request's own batch already shares (nothing)."""
+
+    kind: str  # compat | fast | dcf — the plan key's profile slot
+    alphas: np.ndarray  # uint64 [K]
+    log_n: int
+    deadline: float | None = None
+    trace: object = None
+    queue_wait: float = 0.0
+    dispatch_s: float = 0.0
+    coalesced: int = 0
+
+    @property
+    def n_keys(self) -> int:
+        return int(self.alphas.shape[0])
+
+    @property
+    def lane(self) -> tuple:
+        return ("gen", self.kind, self.log_n)
+
+
+@dataclass
 class PirWork:
     """One PIR query request: K query keys against one registered
     database (the /v1/pir/query body).  The lane keys on the DB OBJECT
@@ -314,6 +342,58 @@ def dispatch_hh_extend(items: list[HHExtendWork]) -> list[np.ndarray]:
         )
         for it in items
     ]
+
+
+def _gen_call(kind: str, alphas: np.ndarray, log_n: int):
+    """One gen dispatch for a key family -> (batch_a, batch_b); root
+    seeds draw OS entropy (``rng=None``), the tower routes through
+    core/plans.run_gen when the device dealer is enabled."""
+    if kind == "dcf":
+        from ..models import dcf
+
+        return dcf.gen_lt_batch(alphas, log_n)
+    if kind == "fast":
+        from ..models.keys_chacha import gen_batch
+    else:
+        from ..core.keys import gen_batch
+    return gen_batch(alphas, log_n)
+
+
+def _slice_key_batch(b, off: int, k: int):
+    """Row-slice a struct-of-arrays key batch (inverse of
+    ``_concat_key_batches``; views are fine — serialization copies)."""
+    import dataclasses
+
+    return type(b)(
+        b.log_n,
+        *(
+            getattr(b, f.name)[off : off + k]
+            for f in dataclasses.fields(b)
+            if isinstance(getattr(b, f.name), np.ndarray)
+        ),
+    )
+
+
+def dispatch_gen(items: list[GenWork]) -> list[tuple]:
+    """Lane dispatcher for the gen routes -> per-item (batch_a, batch_b)
+    key-pair batches.  A coalesced batch towers ONCE over the
+    concatenated alphas and each request slices its key rows back."""
+    faults.fire("dispatch.gen")
+    if len(items) == 1:
+        it = items[0]
+        return [_gen_call(it.kind, it.alphas, it.log_n)]
+    alphas = np.concatenate([it.alphas for it in items])
+    ka, kb = _gen_call(items[0].kind, alphas, items[0].log_n)
+    out, off = [], 0
+    for it in items:
+        out.append(
+            (
+                _slice_key_batch(ka, off, it.n_keys),
+                _slice_key_batch(kb, off, it.n_keys),
+            )
+        )
+        off += it.n_keys
+    return out
 
 
 def dispatch_pir(items: list[PirWork]) -> list[np.ndarray]:

@@ -57,11 +57,13 @@ from ..utils.profiling import PhaseTimer
 from . import faults
 from .batcher import (
     Batcher,
+    GenWork,
     HHExtendWork,
     HHWork,
     IntervalWork,
     PirWork,
     PointsWork,
+    dispatch_gen,
     dispatch_hh,
     dispatch_hh_extend,
     dispatch_interval,
@@ -303,6 +305,21 @@ def _wire_format(q: dict) -> bool:
 def _run_evalfull(profile: str, kb):
     faults.fire("dispatch.evalfull")
     return plans.run_evalfull(profile, kb)
+
+
+def _run_gen(st, kind, alphas, log_n, deadline, trace):
+    """Gen routes through the micro-batcher gen lane -> (batch_a,
+    batch_b).  Degraded dispatches pin the host tower
+    (``keys_gen.host_only``) — an open breaker must not route key
+    generation at a wedged device; the host twin is byte-identical by
+    construction.  (Degraded st.run always passes through on the
+    calling thread, so the thread-local scope covers the dispatch.)"""
+    from ..models import keys_gen
+
+    work = GenWork(kind, alphas, log_n, deadline=deadline, trace=trace)
+    ctx = keys_gen.host_only() if st.degraded() else contextlib.nullcontext()
+    with ctx:
+        return st.run(work, dispatch_gen)
 
 
 def _profile_api(profile: str):
@@ -701,17 +718,19 @@ def _handle(req: Request, st: _ServingState, trace) -> Reply:
     profile = q.get("profile", "compat")
     api, key_len, batch_cls = _profile_api(profile)
     if route in ("/v1/gen", "/v1/eval"):
-        # The two tiny CSPRNG/pointwise conveniences: no log_n-batch
-        # machinery, no deadline bracketing (they predate the serving
-        # fast path and keep their direct shape) — but the deadline
-        # HEADER is still validated, like every other route (a
-        # malformed value must be a 400 on both fronts).
         log_n = int(q["log_n"])
-        req.deadline()
+        deadline = req.deadline()
         if route == "/v1/gen":
+            # Single-point gen rides the coalescing gen lane: concurrent
+            # requests of one key family tower as ONE device dispatch
+            # (the dealer on the TPU, models/keys_gen.py).
             alpha = int(q.get("alpha", 0))
-            ka, kb = api.Gen(alpha, log_n)
-            return Reply(200, [ka + kb])
+            kind = "fast" if profile == "fast" else "compat"
+            ka, kb = _run_gen(
+                st, kind, np.array([alpha], np.uint64), log_n, deadline,
+                trace,
+            )
+            return Reply(200, [ka.to_bytes()[0] + kb.to_bytes()[0]])
         # wire-copy-ok: one-key single-point debug route, not hot path
         bit = api.Eval(body.tobytes(), int(q["x"]), log_n)
         return Reply(200, [bytes([bit])])
@@ -792,7 +811,7 @@ def _handle(req: Request, st: _ServingState, trace) -> Reply:
         if len(body) != k * 8:
             raise ValueError(f"body must be {k}*8 alpha bytes")
         alphas = np.frombuffer(body, dtype="<u8")
-        da, db = dcf.gen_lt_batch(alphas, log_n)
+        da, db = _run_gen(st, "dcf", alphas, log_n, deadline, trace)
         return Reply(
             200, [b"".join(da.to_bytes()), b"".join(db.to_bytes())]
         )
@@ -881,7 +900,15 @@ def _handle(req: Request, st: _ServingState, trace) -> Reply:
         if len(body) != k * 8:
             raise ValueError(f"body must be {k}*8 value bytes")
         values = np.frombuffer(body, dtype="<u8")
-        sa, sb = hh_app.gen_shares(values, log_n, profile=profile)
+        kind = "fast" if profile == "fast" else "compat"
+        sa, sb = hh_app.gen_shares(
+            values, log_n, profile=profile,
+            # The level-point gen rides the same coalescing gen lane as
+            # /v1/gen (rng is the lane's own OS entropy).
+            gen=lambda pts, n, rng=None: _run_gen(
+                st, kind, pts, n, deadline, trace
+            ),
+        )
         return Reply(
             200, [hh_app.share_to_blob(sa), hh_app.share_to_blob(sb)]
         )

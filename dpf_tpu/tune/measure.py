@@ -154,6 +154,19 @@ class DeviceBackend:
             da, _ = dcf.gen_lt_batch(alphas, log_n, rng=rng)
             xs = np.zeros((k, q), np.uint64)
             fn = lambda: plans.run_points(route, "fast", da, xs)  # noqa: E731
+        elif route == "gen":
+            # The device dealer: roots drawn once, the tower re-runs per
+            # rep (the tower is the measured work; run_gen is the plan
+            # route, so the FUSE/DONATE overlay steers the executable).
+            if profile == "compat":
+                from ..core.keys import _draw_roots
+            else:
+                from ..models.keys_chacha import _draw_roots
+
+            s0, t0, s1, t1 = _draw_roots(k, rng)
+            fn = lambda: plans.run_gen(  # noqa: E731
+                profile, alphas, log_n, s0, t0, s1, t1
+            )
         elif route in ("points", "hh_level", "evalfull"):
             if profile == "fast":
                 from ..models.keys_chacha import gen_batch

@@ -72,6 +72,14 @@ _AXES: dict[tuple[str, str], tuple[Axis, ...]] = {
     ("agg_add", "agg"): (_DONATE,),
     ("pir", "compat"): (_FUSE, _PIR_CHUNK),
     ("pir", "fast"): (_FUSE, _PIR_CHUNK),
+    # The device dealer (models/keys_gen.py): the gen route's profile
+    # slot is the key FAMILY (compat|fast|dcf).  Tunables are the
+    # level-fused tower and the root-operand donation — both read
+    # inside the dispatch scope; DPF_TPU_GEN itself is route selection
+    # ABOVE the plan layer (host vs device), so it is not an axis.
+    ("gen", "compat"): (_FUSE, _DONATE),
+    ("gen", "fast"): (_FUSE, _DONATE),
+    ("gen", "dcf"): (_FUSE, _DONATE),
 }
 
 

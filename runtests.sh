@@ -29,7 +29,10 @@
 #       the served-PIR suite,
 #       tests/test_pir_serving.py — registry/run_pir/native byte
 #       identity, the streamed chunk scan, mesh dispatch + degraded
-#       fallback, the /v1/pir/* wire):
+#       fallback, the /v1/pir/* wire — and the device-side dealer
+#       (tests/test_gen_device.py — device-vs-host gen byte identity on
+#       every key family through every door: entrypoints, run_gen
+#       direct, serving mesh, host_only(), forced-failure fallback)):
 #       the Pallas kernel suites (fused + walk + expand routes, interpret
 #       mode), the S-box circuit invariants, the packed<->unpacked
 #       output differentials (every packed route vs its byte-per-bit twin
@@ -91,7 +94,7 @@ elif [ "${1:-}" = "--fast" ]; then
       tests/test_serving_stress.py tests/test_analysis.py \
       tests/test_oblivious.py tests/test_perf_contracts.py \
       tests/test_apps.py tests/test_hh_state.py tests/test_pir_serving.py \
-      tests/test_wire2.py \
+      tests/test_wire2.py tests/test_gen_device.py \
       -q -m 'not slow' "$@"
 else
   # -m is last-wins in pytest, so a caller-supplied -m overrides ours.

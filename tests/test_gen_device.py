@@ -1,0 +1,199 @@
+"""Device-side dealer (models/keys_gen.py): byte identity and plan
+discipline for batched on-device key generation.
+
+The contract under test is the dealer's one invariant: with the SAME
+injected CSPRNG, the device correction-word tower and the host tower
+produce byte-identical key batches for every family — compat (AES
+planes), fast (ChaCha words), DCF (ChaCha + value CWs) — through every
+door: the ``gen_batch`` entrypoints, ``core/plans.run_gen`` directly
+(so a silent host fallback cannot mask a device bug), the 8-shard
+serving mesh, the ``host_only()`` degraded scope, and the
+forced-failure fallback.  ``keys_gen.fallbacks`` is pinned wherever
+the device lane must actually have served: a hidden fallback would
+make every identity here vacuous.
+"""
+
+import numpy as np
+import pytest
+
+from dpf_tpu.core import keys as core_keys
+from dpf_tpu.core import knobs, plans
+from dpf_tpu.models import dcf, keys_chacha, keys_gen
+
+LOG_N = 10
+
+#: DPF_TPU_FUSE defaults to "off"; "auto" puts the lax.scan level tower
+#: on the path so the fused executables are what these identities pin.
+FUSE = {"DPF_TPU_FUSE": "auto"}
+
+GENS = (
+    ("compat", core_keys.gen_batch),
+    ("fast", keys_chacha.gen_batch),
+    ("dcf", dcf.gen_lt_batch),
+)
+
+
+def _alphas(k=16, seed=5):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 1 << LOG_N, size=k, dtype=np.uint64)
+
+
+def _pair_bytes(pair):
+    ka, kb = pair
+    return ka.to_bytes(), kb.to_bytes()
+
+
+@pytest.mark.parametrize("label,gen", GENS, ids=[g[0] for g in GENS])
+def test_gen_device_matches_host(label, gen):
+    alphas = _alphas()
+    fb0 = keys_gen.fallbacks
+    with knobs.overrides({"DPF_TPU_GEN": "on", **FUSE}):
+        dev = _pair_bytes(gen(alphas, LOG_N, rng=np.random.default_rng(7)))
+    assert keys_gen.fallbacks == fb0, "device gen silently fell back"
+    with knobs.overrides({"DPF_TPU_GEN": "off"}):
+        host = _pair_bytes(gen(alphas, LOG_N, rng=np.random.default_rng(7)))
+    assert dev == host
+
+
+@pytest.mark.parametrize("label,gen", GENS, ids=[g[0] for g in GENS])
+def test_gen_fused_matches_unrolled(label, gen):
+    """DPF_TPU_FUSE must be a compile-shape knob, never an output knob:
+    the scan tower and the unrolled tower walk the same levels."""
+    alphas = _alphas(seed=8)
+    out = {}
+    for fuse in ("off", "auto"):
+        with knobs.overrides({"DPF_TPU_GEN": "on", "DPF_TPU_FUSE": fuse}):
+            out[fuse] = _pair_bytes(
+                gen(alphas, LOG_N, rng=np.random.default_rng(7))
+            )
+    assert out["off"] == out["auto"]
+
+
+@pytest.mark.parametrize("kind", ["compat", "fast", "dcf"])
+def test_run_gen_direct_matches_host_tower(kind):
+    """Drive the plan-cached device route with pre-drawn roots and
+    compare against the host tower on the SAME roots — no fallback seam
+    in the loop, so a device-tower bug cannot hide behind degradation."""
+    k = 8
+    alphas = _alphas(k=k, seed=9)
+    if kind == "compat":
+        s0, t0, s1, t1 = core_keys._draw_roots(k, np.random.default_rng(3))
+        host = core_keys._gen_from_roots(alphas, LOG_N, s0, t0, s1, t1)
+    else:
+        s0, t0, s1, t1 = keys_chacha._draw_roots(
+            k, np.random.default_rng(3)
+        )
+        tower = (
+            dcf._gen_lt_from_roots
+            if kind == "dcf"
+            else keys_chacha._gen_from_roots
+        )
+        host = tower(alphas, LOG_N, s0, t0, s1, t1)
+    with knobs.overrides(FUSE):
+        dev = plans.run_gen(kind, alphas, LOG_N, s0, t0, s1, t1)
+    assert _pair_bytes(dev) == _pair_bytes(host)
+
+
+def test_gen_no_retrace_after_warmup():
+    """Serving discipline: the second same-shape dealt batch must be a
+    plan-cache hit, not a retrace (plan keys bucket K, so same K ->
+    same executable)."""
+    alphas = _alphas(k=8, seed=11)
+    with knobs.overrides({"DPF_TPU_GEN": "on", **FUSE}):
+        keys_chacha.gen_batch(alphas, LOG_N, rng=np.random.default_rng(1))
+        n0 = plans.trace_count()
+        fb0 = keys_gen.fallbacks
+        keys_chacha.gen_batch(alphas, LOG_N, rng=np.random.default_rng(2))
+    assert plans.trace_count() == n0
+    assert keys_gen.fallbacks == fb0
+
+
+def test_gen_mesh_identity(monkeypatch):
+    """The 8-shard serving mesh deals byte-identically to the host
+    tower: shards tower disjoint key lanes with zero collectives, and
+    the marshalled batch cannot depend on the partition."""
+    from dpf_tpu.parallel import serving_mesh
+
+    alphas = _alphas(k=24, seed=13)
+    host = {}
+    for label, gen in GENS:
+        with knobs.overrides({"DPF_TPU_GEN": "off"}):
+            host[label] = _pair_bytes(
+                gen(alphas, LOG_N, rng=np.random.default_rng(17))
+            )
+    monkeypatch.setenv("DPF_TPU_MESH", "on")
+    monkeypatch.setenv("DPF_TPU_MESH_DEVICES", "0")
+    serving_mesh.reset()
+    try:
+        fb0 = keys_gen.fallbacks
+        for label, gen in GENS:
+            with knobs.overrides({"DPF_TPU_GEN": "on", **FUSE}):
+                dev = _pair_bytes(
+                    gen(alphas, LOG_N, rng=np.random.default_rng(17))
+                )
+            assert dev == host[label], f"mesh gen diverged for {label}"
+        assert keys_gen.fallbacks == fb0, "mesh gen silently fell back"
+    finally:
+        serving_mesh.reset()
+
+
+def test_host_only_scope_forces_host():
+    """The degraded-mode override: inside ``host_only()`` the device
+    lane is off even under DPF_TPU_GEN=on, and the dealt bytes are the
+    host tower's (same drawn seeds, same keys)."""
+    alphas = _alphas(k=8, seed=15)
+    with knobs.overrides({"DPF_TPU_GEN": "on"}):
+        with keys_gen.host_only():
+            assert not keys_gen.device_enabled()
+            a = _pair_bytes(
+                core_keys.gen_batch(
+                    alphas, LOG_N, rng=np.random.default_rng(4)
+                )
+            )
+        assert keys_gen.device_enabled()
+    with knobs.overrides({"DPF_TPU_GEN": "off"}):
+        b = _pair_bytes(
+            core_keys.gen_batch(alphas, LOG_N, rng=np.random.default_rng(4))
+        )
+    assert a == b
+
+
+def test_device_failure_degrades_byte_identically(monkeypatch):
+    """A wedged device must cost a fallback counter tick and NOTHING
+    else: the host re-tower walks the same already-drawn seeds, so the
+    dealt keys are the bytes a healthy device would have produced."""
+    alphas = _alphas(k=8, seed=19)
+    with knobs.overrides({"DPF_TPU_GEN": "off"}):
+        want = _pair_bytes(
+            keys_chacha.gen_batch(alphas, LOG_N, rng=np.random.default_rng(6))
+        )
+
+    def wedged(*a, **k):
+        raise RuntimeError("injected device wedge")
+
+    monkeypatch.setattr(plans, "run_gen", wedged)
+    fb0 = keys_gen.fallbacks
+    with knobs.overrides({"DPF_TPU_GEN": "on"}):
+        got = _pair_bytes(
+            keys_chacha.gen_batch(alphas, LOG_N, rng=np.random.default_rng(6))
+        )
+    assert got == want
+    assert keys_gen.fallbacks == fb0 + 1
+
+
+def test_hh_gen_shares_identity():
+    """/v1/hh/gen's dealer path: gen_shares' one vectorized gen over all
+    log_n * G level-DPFs deals the same blobs either side of the
+    device/host seam."""
+    from dpf_tpu.apps import heavy_hitters as hh
+
+    values = [3, 5, 7, 1019, 3, 3]
+    out = {}
+    for mode in ("on", "off"):
+        with knobs.overrides({"DPF_TPU_GEN": mode, **FUSE}):
+            sa, sb = hh.gen_shares(
+                values, LOG_N, profile="fast",
+                rng=np.random.default_rng(23),
+            )
+            out[mode] = (hh.share_to_blob(sa), hh.share_to_blob(sb))
+    assert out["on"] == out["off"]
