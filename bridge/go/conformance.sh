@@ -32,6 +32,12 @@ if [ -n "$unformatted" ]; then
   exit 1
 fi
 go vet ./...
+# copylocks is PINNED explicitly on top of the default vet suite: a
+# dpftpu client/pending struct copied by value (sync.Mutex inside)
+# silently forks its lock, and the default analyzer set is not a
+# contract across Go releases.  Keep this line even if `go vet ./...`
+# above already covers it today.
+go vet -copylocks ./...
 
 # staticcheck is a stronger linter than vet (unused results, API misuse,
 # simplifications); like the -race lane it is part of the discipline

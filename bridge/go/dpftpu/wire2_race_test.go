@@ -1,0 +1,159 @@
+// Sidecar-free wire2 concurrency test: a local fake server speaking the
+// frame protocol lets the race detector hammer the client's shared
+// stream table (smu/streams, the write mutex, the readLoop hand-off)
+// without any Python process — so this runs in every `go test -race`,
+// not just conformance.sh.
+package dpftpu
+
+import (
+	"encoding/binary"
+	"fmt"
+	"io"
+	"math"
+	"net"
+	"net/url"
+	"strings"
+	"sync"
+	"testing"
+	"time"
+)
+
+// fakeWire2Server accepts ONE connection, answers every stream with
+// "echo:" + its marker param, and answers PING with PONG.  Replies go
+// out from per-stream goroutines with a stream-dependent delay, so
+// completions land out of order — the interleaving the client's stream
+// table must survive.
+func fakeWire2Server(t *testing.T) string {
+	t.Helper()
+	ln, err := net.Listen("tcp", "127.0.0.1:0")
+	if err != nil {
+		t.Fatalf("listen: %v", err)
+	}
+	t.Cleanup(func() { ln.Close() })
+	go func() {
+		conn, err := ln.Accept()
+		if err != nil {
+			return
+		}
+		defer conn.Close()
+		preface := make([]byte, 8)
+		if _, err := io.ReadFull(conn, preface); err != nil ||
+			string(preface[:4]) != "DPF2" {
+			return
+		}
+		var wmu sync.Mutex
+		reply := func(sid uint32, body []byte) {
+			// Spread completion order around: stream N's reply waits
+			// N%3 ms, so later streams routinely finish first.
+			time.Sleep(time.Duration(sid%3) * time.Millisecond)
+			msg := appendWire2Hdr(nil, wire2RespHead, wire2TResp, 0, 0, sid)
+			msg = binary.LittleEndian.AppendUint16(msg, 200)
+			msg = binary.LittleEndian.AppendUint16(msg, 0)
+			msg = binary.LittleEndian.AppendUint64(msg,
+				math.Float64bits(0))
+			msg = binary.LittleEndian.AppendUint64(msg, uint64(len(body)))
+			msg = appendWire2Hdr(msg, uint32(len(body)), wire2TRespData,
+				wire2FEndStream, 0, sid)
+			msg = append(msg, body...)
+			wmu.Lock()
+			conn.Write(msg)
+			wmu.Unlock()
+		}
+		markers := map[uint32]string{}
+		hdr := make([]byte, wire2HdrLen)
+		for {
+			if _, err := io.ReadFull(conn, hdr); err != nil {
+				return
+			}
+			length := binary.LittleEndian.Uint32(hdr[0:4])
+			ftype := hdr[4]
+			flags := hdr[5]
+			sid := binary.LittleEndian.Uint32(hdr[8:12])
+			payload := make([]byte, length)
+			if _, err := io.ReadFull(conn, payload); err != nil {
+				return
+			}
+			switch ftype {
+			case wire2THeaders:
+				q, _ := url.ParseQuery(string(payload[8:]))
+				markers[sid] = q.Get("marker")
+				if flags&wire2FEndStream != 0 {
+					go reply(sid, []byte("echo:"+markers[sid]))
+				}
+			case wire2TData:
+				if flags&wire2FEndStream != 0 {
+					go reply(sid, []byte("echo:"+markers[sid]))
+				}
+			case wire2TPing:
+				pong := appendWire2Hdr(nil, length, wire2TPong, 0, 0, 0)
+				pong = append(pong, payload...)
+				wmu.Lock()
+				conn.Write(pong)
+				wmu.Unlock()
+			default:
+				return
+			}
+		}
+	}()
+	return ln.Addr().String()
+}
+
+// TestStreamTableRace: 16 goroutines multiplex requests and pings on
+// ONE client; every reply must match ITS stream's marker, and the
+// pending-stream table must drain to empty (a leaked entry is a reply
+// delivered to the wrong waiter or dropped).  Run under -race this
+// covers the smu/streams handoff between Do, readLoop, and Ping.
+func TestStreamTableRace(t *testing.T) {
+	addr := fakeWire2Server(t)
+	c, err := DialWire2(addr)
+	if err != nil {
+		t.Fatalf("dial: %v", err)
+	}
+	defer c.Close()
+	c.Trace = false
+
+	const workers, reps = 16, 8
+	var wg sync.WaitGroup
+	errs := make(chan error, workers*reps)
+	for i := 0; i < workers; i++ {
+		wg.Add(1)
+		go func(i int) {
+			defer wg.Done()
+			for r := 0; r < reps; r++ {
+				marker := fmt.Sprintf("w%d-r%d", i, r)
+				var body []byte
+				if r%2 == 1 { // odd reps exercise the DATA path too
+					body = []byte(strings.Repeat("x", 64))
+				}
+				got, err := c.Do(wire2RouteWarmup,
+					url.Values{"marker": {marker}}, body)
+				if err != nil {
+					errs <- err
+					return
+				}
+				if string(got) != "echo:"+marker {
+					errs <- fmt.Errorf(
+						"stream crossed: want echo:%s, got %q", marker, got)
+					return
+				}
+				if r%3 == 0 {
+					if err := c.Ping(); err != nil {
+						errs <- err
+						return
+					}
+				}
+			}
+		}(i)
+	}
+	wg.Wait()
+	close(errs)
+	for err := range errs {
+		t.Fatal(err)
+	}
+	c.smu.Lock()
+	leaked := len(c.streams)
+	c.smu.Unlock()
+	if leaked != 0 {
+		t.Fatalf("stream table leaked %d entries", leaked)
+	}
+}

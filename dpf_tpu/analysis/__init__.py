@@ -1,9 +1,9 @@
 """Repo-native static analysis: the discipline the ROADMAP's production
 north star needs, checked on every commit for free.
 
-Six file/AST-based passes plus two jaxpr-level passes over the whole tree
-(one entrypoint: ``python -m dpf_tpu.analysis`` / ``scripts/lint_all.sh``;
-exits nonzero on any finding):
+Seven file/AST-based passes plus two jaxpr-level passes over the whole
+tree (one entrypoint: ``python -m dpf_tpu.analysis`` /
+``scripts/lint_all.sh``; exits nonzero on any finding):
 
   knob-registry   every DPF_TPU_* env knob is declared once in
                   dpf_tpu/core/knobs.py and read only through it —
@@ -33,6 +33,19 @@ exits nonzero on any finding):
                   is declared in pytest.ini (an undeclared marker makes
                   ``-m`` selections silently skip nothing), and the
                   collection-order hook's file references resolve.
+  lock-discipline the serving plane's concurrency contract
+                  (``analysis/concurrency/``): every threading primitive
+                  declared with an owner + ordering rank in the lock
+                  registry, acquisition-order inversions/cycles over the
+                  AST ``with``-nesting graph, guarded-field inference
+                  (written under a lock somewhere, touched lock-free
+                  elsewhere — ``# lock-free-ok: <why>`` sanctions the
+                  reviewed benign reads), and no lock held across a
+                  device dispatch / socket I/O / sleep / thread join
+                  (``# lock-held-ok: <why>`` is the escape hatch).  The
+                  same package ships the deterministic interleaving
+                  harness (``concurrency/sched.py``) the concurrency
+                  scenario tests replay seeded schedules through.
   oblivious-trace the jaxpr-level oblivious-dataflow verifier
                   (``analysis/trace/``): every production route traced
                   to a ClosedJaxpr, the interprocedural taint lattice
@@ -81,7 +94,10 @@ from __future__ import annotations
 # perf-contract verifier and the test-discipline pass joined, and
 # knob-registry grew unused-knob detection.  "4": the tuned-defaults
 # pass joined (committed autotuner output validated every commit).
-LINT_SUITE_VERSION = "4"
+# "5": the lock-discipline pass joined (whole-repo lock registry,
+# acquisition-order graph, guarded-field inference, held-across-blocking
+# — the serving plane's concurrency contract checked every commit).
+LINT_SUITE_VERSION = "5"
 
 # name -> (module, callable); imported lazily so `import dpf_tpu.analysis`
 # stays cheap for the bench harness's version stamp.  Passes run in
@@ -93,6 +109,7 @@ PASSES = {
     "host-sync": ("dpf_tpu.analysis.host_sync_pass", "run"),
     "pallas-jit": ("dpf_tpu.analysis.pallas_discipline_pass", "run"),
     "test-discipline": ("dpf_tpu.analysis.test_discipline_pass", "run"),
+    "lock-discipline": ("dpf_tpu.analysis.concurrency.lock_pass", "run"),
     "tuned-defaults": ("dpf_tpu.analysis.tuned_pass", "run"),
     "oblivious-trace": ("dpf_tpu.analysis.trace_pass", "run"),
     "perf-contract": ("dpf_tpu.analysis.perf_pass", "run"),

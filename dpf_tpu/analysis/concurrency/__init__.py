@@ -1,0 +1,41 @@
+"""Concurrency discipline for the serving plane: the ``lock-discipline``
+static pass plus the deterministic interleaving harness.
+
+The serving plane is deeply threaded — batcher lanes, the breaker state
+machine, wire2's per-connection frame readers and worker pool, the HH
+session cache, the plan cache, and the shared stats lock — and a race
+there is a correctness bug that no kernel differential can catch.  This
+package is the discipline layer:
+
+  ``registry``   the whole-repo lock registry: every ``Lock`` / ``RLock``
+                 / ``Condition`` / ``Event`` the production tree creates,
+                 declared with an owner, a kind, and an acquisition-order
+                 rank (docs/DESIGN.md section 21 documents the ranking).
+  ``lock_pass``  the static verifier (PASSES entry ``lock-discipline``):
+                 undeclared primitive creations, acquisition-order
+                 inversions/cycles over the AST ``with``-nesting graph,
+                 guarded-field inference (written under a lock somewhere,
+                 touched lock-free elsewhere), and the held-across-
+                 blocking check (no lock across a device dispatch, socket
+                 I/O, ``time.sleep``, or a thread join).
+  ``sched``      the deterministic interleaving harness: a seeded
+                 round-robin scheduler that serializes 2-4 scenario
+                 threads at lock boundaries (``sys.setprofile`` C-call
+                 events) and seeded line-granularity preemption points
+                 (``sys.settrace``), so a deadlock or torn read found in
+                 CI replays byte-for-byte from its seed.
+"""
+
+from __future__ import annotations
+
+from .registry import FIXTURE_LOCKS, LOCKS, LockDecl
+from .sched import DeadlockDetected, DetScheduler, stress_switch_interval
+
+__all__ = [
+    "FIXTURE_LOCKS",
+    "LOCKS",
+    "LockDecl",
+    "DeadlockDetected",
+    "DetScheduler",
+    "stress_switch_interval",
+]

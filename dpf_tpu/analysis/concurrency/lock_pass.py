@@ -1,0 +1,818 @@
+"""lock-discipline: static concurrency verifier for the serving plane.
+
+Four rules over the threaded scope (serving/, obs/, apps/, tune/,
+parallel/, backends/, core/plans.py):
+
+  R1 (registry)      every ``threading.Lock/RLock/Condition/Event``
+      creation is declared in ``concurrency/registry.py`` with an owner
+      and an acquisition-order rank; an undeclared creation is a
+      finding, and so is a declaration whose creation site no longer
+      exists (whole-tree scans only — the table cannot rot).
+  R2 (lock order)    the acquisition-order graph built from ``with``-
+      block nesting, followed through resolved calls (import aliases,
+      ``self.`` methods, annotated parameters): a nested acquisition
+      must strictly increase the declared rank unless both locks are in
+      the same declared group (the shared re-entrant stats family), and
+      any cycle between declared locks is a finding.
+  R3 (guarded field) a field written under a lock somewhere but read or
+      written lock-free elsewhere is a torn read waiting for traffic;
+      ``# lock-free-ok: <why>`` on the access line is the reviewed
+      sanction for the genuinely benign ones.  Tracked per class
+      (``self.attr``) and per module (globals written under a module
+      lock).  A ``*_locked``-suffixed function is callers-hold-the-lock
+      by convention and counts as guarded.
+  R4 (held across)   no declared lock may be held across a device
+      dispatch (``plans.run_*``), socket I/O (``recv/recv_into/sendall/
+      sendmsg``), ``time.sleep``, a thread ``join``, or a ``wait`` on a
+      DIFFERENT primitive — the exact shape that turns one wedged
+      dispatch into a full serving stall.  Declared ``io_ok`` locks
+      (the wire2 write-serialization locks) are sanctioned for the
+      socket sends that are their whole purpose, nothing else.
+      ``# lock-held-ok: <why>`` on the call line is the in-place escape
+      hatch, mirroring ``# host-sync:``.
+
+Call resolution is deliberately shallow-but-honest: exact targets
+(same-module functions, ``self.`` methods, import-alias dotted names,
+parameters with class annotations) propagate transitively; when a
+method call cannot be resolved exactly, R2 falls back to matching the
+method NAME against every scanned class's lock-acquiring methods (an
+over-approximation that is safe for ordering — extra edges only
+tighten the rank discipline), while R4 uses exact targets only (a
+false "blocks" verdict would be noise).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable
+
+from .. import common
+from .registry import FIXTURE_LOCKS, LOCKS, LockDecl
+
+PASS = "lock-discipline"
+
+_SCOPE = (
+    "dpf_tpu/serving",
+    "dpf_tpu/obs",
+    "dpf_tpu/apps",
+    "dpf_tpu/tune",
+    "dpf_tpu/parallel",
+    "dpf_tpu/backends",
+    "dpf_tpu/core/plans.py",
+    "dpf_tpu/analysis/fixtures",
+)
+
+_PRIMITIVES = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "cond",
+    "threading.Event": "event",
+}
+
+# Socket ops named by the rule (method names — sockets are duck-typed
+# at every call site in the tree).
+_SOCKET_OPS = {"recv", "recv_into", "sendall", "sendmsg"}
+
+
+def _mod_of(rel: str) -> str:
+    """Repo-relative path -> dotted site prefix (works for fixture files
+    too, unlike common.dotted_module — registry keys use this form)."""
+    return rel.replace(os.sep, "/")[: -len(".py")].replace("/", ".")
+
+
+def _aliases(tree: ast.Module, mod: str) -> dict[str, str]:
+    """common.import_aliases plus RELATIVE from-imports resolved against
+    this module's dotted name (the serving tree imports its siblings
+    almost exclusively as ``from ..core import plans``)."""
+    out = common.import_aliases(tree)
+    pkg = mod.split(".")[:-1]
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.level:
+            base = pkg[: len(pkg) - (node.level - 1)]
+            if not base:
+                continue
+            head = ".".join(base + ([node.module] if node.module else []))
+            for a in node.names:
+                if a.name != "*":
+                    out[a.asname or a.name] = f"{head}.{a.name}"
+    return out
+
+
+@dataclasses.dataclass
+class _Acq:
+    """One ``with``-acquisition of a declared lock."""
+
+    site: str
+    expr: str  # ast.dump of the context expr (same-object wait check)
+    line: int
+
+
+@dataclasses.dataclass
+class _FuncInfo:
+    qual: str  # "Class.method" or "func", module-local
+    mod: str
+    rel: str
+    acquires: list[_Acq] = dataclasses.field(default_factory=list)
+    # (held-stack snapshot, call node, exact targets "mod:qual", attr name)
+    calls: list[tuple[tuple[_Acq, ...], ast.Call, list[str], str | None]] = (
+        dataclasses.field(default_factory=list)
+    )
+    # direct blocking ops anywhere in the body: (kind, line)
+    blocking: list[tuple[str, int]] = dataclasses.field(default_factory=list)
+
+
+class _Scan:
+    """Whole-scan state shared across files."""
+
+    def __init__(self, decls: dict[str, LockDecl]):
+        self.decls = decls
+        self.findings: list[common.Finding] = []
+        self.created: set[str] = set()  # declared sites actually seen
+        self.funcs: dict[str, _FuncInfo] = {}  # "mod:qual" -> info
+        # method name -> ["mod:qual", ...] for the R2 name fallback
+        self.by_method: dict[str, list[str]] = {}
+        # R2 edges: (outer site, inner site) -> (rel, line)
+        self.edges: dict[tuple[str, str], tuple[str, int]] = {}
+
+    def finding(self, rel: str, line: int, msg: str) -> None:
+        self.findings.append(common.Finding(rel, line, PASS, msg))
+
+
+def _class_of(site: str) -> str:
+    """'mod.Class.attr' -> 'mod.Class' ('' for module globals).  Class
+    names may be private (``_Conn``), so strip underscores first."""
+    head = site.rsplit(".", 1)[0]
+    tail = head.rsplit(".", 1)[-1].lstrip("_")
+    return head if tail[:1].isupper() else ""
+
+
+# Method names the R2 name fallback must NOT match: they collide with
+# dict/list/set/socket builtins, so an unresolved ``self._table.get(k)``
+# under a lock would otherwise fabricate an edge to every scanned class
+# that happens to define a lock-taking method of the same name.  Exact
+# (type-resolved) calls are unaffected.
+_FALLBACK_DENY = frozenset({
+    "get", "pop", "clear", "items", "keys", "values", "setdefault",
+    "append", "update", "add", "discard", "remove", "put", "join",
+    "wait", "set", "copy", "sort", "extend", "index", "count", "close",
+    "read", "write", "send", "recv", "acquire", "release", "start",
+})
+
+
+class _FileVisitor:
+    """One file: creations, per-function acquisition structure, guarded
+    fields.  Runs as an explicit recursive walk (not ast.NodeVisitor) so
+    the held-lock stack threads through ``with`` bodies naturally."""
+
+    def __init__(self, scan: _Scan, rel: str, tree: ast.Module,
+                 lines: list[str]):
+        self.scan = scan
+        self.rel = rel
+        self.mod = _mod_of(rel)
+        self.tree = tree
+        self.lines = lines
+        self.aliases = _aliases(tree, self.mod)
+        # class name -> {attr: [(write?, guarded?, lock site|None, line)]}
+        self.fields: dict[str, dict[str, list]] = {}
+        self.globals_: dict[str, list] = {}
+        self.module_names: set[str] = set()
+        # param/local name -> dotted class, per function (annotation typing)
+        self._var_types: dict[str, str] = {}
+        self._assigned_calls: set[int] = set()  # id()s of captured creations
+
+    # -- entry ---------------------------------------------------------
+
+    def run(self) -> None:
+        for name in self._module_level_names():
+            self.module_names.add(name)
+        self._collect_creations()
+        body_ctx = _Ctx(cls=None, func=None)
+        self._walk_body(self.tree.body, body_ctx)
+        self._check_stray_creations()
+        self._report_fields()
+
+    def _module_level_names(self) -> Iterable[str]:
+        for node in self.tree.body:
+            targets: list[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    yield t.id
+
+    # -- R1: creations -------------------------------------------------
+
+    def _primitive_kind(self, call: ast.AST) -> str | None:
+        if not isinstance(call, ast.Call):
+            return None
+        dotted = common.resolve_dotted(call.func, self.aliases)
+        return _PRIMITIVES.get(dotted or "")
+
+    def _collect_creations(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            if value is None:
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for sub in ast.walk(value):
+                kind = self._primitive_kind(sub)
+                if kind is None:
+                    continue
+                self._assigned_calls.add(id(sub))
+                site = self._site_for(targets, sub)
+                if site is None:
+                    self.scan.finding(
+                        self.rel, sub.lineno,
+                        f"{kind} created without a nameable site — bind it "
+                        "to a module global or a self attribute so it can "
+                        "be declared in analysis/concurrency/registry.py",
+                    )
+                    continue
+                decl = self.scan.decls.get(site)
+                if decl is None:
+                    self.scan.finding(
+                        self.rel, sub.lineno,
+                        f"undeclared {kind} creation: declare '{site}' with "
+                        "an owner and rank in "
+                        "analysis/concurrency/registry.py",
+                    )
+                    continue
+                self.scan.created.add(site)
+                if decl.kind != kind:
+                    self.scan.finding(
+                        self.rel, sub.lineno,
+                        f"'{site}' declared as {decl.kind} but created as "
+                        f"{kind} — fix the registry entry",
+                    )
+
+    def _site_for(self, targets: list[ast.expr],
+                  call: ast.AST) -> str | None:
+        """Site name for a primitive assigned to the FIRST sane target:
+        self.attr -> mod.Class.attr, NAME -> mod.NAME."""
+        cls = self._enclosing_class(call)
+        for t in targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self" and cls):
+                return f"{self.mod}.{cls}.{t.attr}"
+            if isinstance(t, ast.Name):
+                if cls and not self._at_module_level(call):
+                    return f"{self.mod}.{cls}.{t.id}"
+                return f"{self.mod}.{t.id}"
+        return None
+
+    def _enclosing_class(self, node: ast.AST) -> str | None:
+        for cls in [n for n in ast.walk(self.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            for sub in ast.walk(cls):
+                if sub is node:
+                    return cls.name
+        return None
+
+    def _at_module_level(self, node: ast.AST) -> bool:
+        for stmt in self.tree.body:
+            for sub in ast.walk(stmt):
+                if sub is node:
+                    return isinstance(stmt, (ast.Assign, ast.AnnAssign))
+        return False
+
+    def _check_stray_creations(self) -> None:
+        for node in ast.walk(self.tree):
+            kind = self._primitive_kind(node)
+            if kind is not None and id(node) not in self._assigned_calls:
+                self.scan.finding(
+                    self.rel, node.lineno,
+                    f"{kind} created outside an assignment — bind it to a "
+                    "declarable site (registry rule R1)",
+                )
+
+    # -- the recursive walk --------------------------------------------
+
+    def _walk_body(self, body: list[ast.stmt], ctx: "_Ctx") -> None:
+        for stmt in body:
+            self._walk_stmt(stmt, ctx)
+
+    def _walk_stmt(self, stmt: ast.stmt, ctx: "_Ctx") -> None:
+        if isinstance(stmt, ast.ClassDef):
+            self._walk_body(stmt.body, _Ctx(cls=stmt.name, func=None))
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = f"{ctx.cls}.{stmt.name}" if ctx.cls else stmt.name
+            if ctx.func is not None:  # nested def: parent.child
+                qual = f"{ctx.func.qual}.{stmt.name}"
+            info = _FuncInfo(qual=qual, mod=self.mod, rel=self.rel)
+            self.scan.funcs[f"{self.mod}:{qual}"] = info
+            self.scan.by_method.setdefault(stmt.name, []).append(
+                f"{self.mod}:{qual}"
+            )
+            self._var_types = self._annotation_types(stmt)
+            fctx = _Ctx(cls=ctx.cls, func=info, fname=stmt.name,
+                        var_types=self._var_types)
+            self._walk_body(stmt.body, fctx)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired: list[_Acq] = []
+            for item in stmt.items:
+                self._walk_expr_tree(item.context_expr, ctx)
+                site = self._resolve_lock(item.context_expr, ctx)
+                if site is not None:
+                    acq = _Acq(site=site,
+                               expr=ast.dump(item.context_expr),
+                               line=stmt.lineno)
+                    self._note_acquire(acq, ctx)
+                    ctx.held.append(acq)
+                    acquired.append(acq)
+            self._walk_body(stmt.body, ctx)
+            for _ in acquired:
+                ctx.held.pop()
+            return
+        # generic statement: expressions at THIS level, then child
+        # statement bodies (so accesses/calls are classified against the
+        # held-lock context actually in force where they appear)
+        for field in ast.iter_fields(stmt):
+            value = field[1]
+            values = value if isinstance(value, list) else [value]
+            for v in values:
+                if isinstance(v, ast.stmt):
+                    self._walk_stmt(v, ctx)
+                elif isinstance(v, ast.expr):
+                    self._walk_expr_tree(v, ctx)
+                elif isinstance(v, (ast.excepthandler, ast.match_case)):
+                    for sub in getattr(v, "body", []):
+                        self._walk_stmt(sub, ctx)
+        self._note_accesses(stmt, ctx)
+
+    def _walk_expr_tree(self, expr: ast.expr | None, ctx: "_Ctx") -> None:
+        if expr is None:
+            return
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._note_call(node, ctx)
+
+    # -- lock resolution ----------------------------------------------
+
+    def _annotation_types(self, fn: ast.FunctionDef |
+                          ast.AsyncFunctionDef) -> dict[str, str]:
+        """Param name -> dotted class for simple class annotations, so
+        ``with cache._lock:`` resolves through ``cache: SessionCache``."""
+        out: dict[str, str] = {}
+        args = list(fn.args.posonlyargs) + list(fn.args.args) + list(
+            fn.args.kwonlyargs
+        )
+        for a in args:
+            ann = a.annotation
+            if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                try:
+                    ann = ast.parse(ann.value, mode="eval").body
+                except SyntaxError:
+                    continue
+            if isinstance(ann, ast.Name):
+                out[a.arg] = self.aliases.get(
+                    ann.id, f"{self.mod}.{ann.id}"
+                )
+            elif isinstance(ann, ast.Attribute):
+                dotted = common.resolve_dotted(ann, self.aliases)
+                if dotted:
+                    out[a.arg] = dotted
+        return out
+
+    def _resolve_lock(self, expr: ast.expr, ctx: "_Ctx") -> str | None:
+        decls = self.scan.decls
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name):
+                if base.id == "self" and ctx.cls:
+                    site = f"{self.mod}.{ctx.cls}.{expr.attr}"
+                    if site in decls:
+                        return site
+                typed = ctx.var_types.get(base.id)
+                if typed:
+                    site = f"{typed}.{expr.attr}"
+                    if site in decls:
+                        return site
+            dotted = common.resolve_dotted(expr, self.aliases)
+            if dotted and dotted in decls:
+                return dotted
+            return None
+        if isinstance(expr, ast.Name):
+            site = f"{self.mod}.{expr.id}"
+            if site in decls:
+                return site
+            dotted = self.aliases.get(expr.id)
+            if dotted and dotted in decls:
+                return dotted
+        return None
+
+    def _note_acquire(self, acq: _Acq, ctx: "_Ctx") -> None:
+        if ctx.func is not None:
+            ctx.func.acquires.append(acq)
+        for outer in ctx.held:
+            key = (outer.site, acq.site)
+            self.scan.edges.setdefault(key, (self.rel, acq.line))
+
+    # -- R4 + call graph -----------------------------------------------
+
+    def _note_call(self, call: ast.Call, ctx: "_Ctx") -> None:
+        kind = self._blocking_kind(call, ctx)
+        if kind is not None and ctx.func is not None:
+            ctx.func.blocking.append((kind, call.lineno))
+        if kind is not None and ctx.held:
+            self._held_across(list(ctx.held), kind, call.lineno, direct=True)
+        if ctx.func is None:
+            return
+        targets, attr = self._call_targets(call, ctx)
+        ctx.func.calls.append((tuple(ctx.held), call, targets, attr))
+
+    def _held_across(self, held: list[_Acq], kind: str, line: int,
+                     direct: bool, via: str = "") -> None:
+        if common.pragma(self.lines, line, "lock-held-ok") is not None:
+            return
+        for acq in held:
+            decl = self.scan.decls[acq.site]
+            if decl.io_ok and kind.startswith("socket "):
+                continue
+            suffix = f" (via {via})" if via else ""
+            self.scan.finding(
+                self.rel, line,
+                f"lock '{acq.site}' held across {kind}{suffix} — release "
+                "it first, or sanction with '# lock-held-ok: <why>'",
+            )
+
+    def _blocking_kind(self, call: ast.Call, ctx: "_Ctx") -> str | None:
+        dotted = common.resolve_dotted(call.func, self.aliases)
+        if dotted == "time.sleep":
+            return "time.sleep"
+        if dotted and ".plans.run_" in dotted:
+            return f"device dispatch (plans.{dotted.rsplit('.', 1)[-1]})"
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        attr = call.func.attr
+        recv = call.func.value
+        if attr in _SOCKET_OPS:
+            return f"socket {attr}"
+        if attr == "join":
+            if isinstance(recv, (ast.Constant, ast.JoinedStr)):
+                return None  # str.join
+            if dotted and dotted.startswith("os.path"):
+                return None
+            return "thread join"
+        if attr == "wait":
+            # cond.wait() inside ``with cond:`` releases its own lock —
+            # the sanctioned pattern.  wait on a DIFFERENT primitive
+            # while holding a lock is the lost-wakeup stall.
+            dump = ast.dump(call.func.value)
+            if any(a.expr == dump for a in ctx.held):
+                return None
+            return "wait on a different primitive"
+        return None
+
+    def _call_targets(self, call: ast.Call,
+                      ctx: "_Ctx") -> tuple[list[str], str | None]:
+        """Exact targets ("mod:qual") plus the bare attr name for the
+        R2 name fallback."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            dotted = self.aliases.get(fn.id)
+            if dotted:
+                mod, _, name = dotted.rpartition(".")
+                return [f"{mod}:{name}"], None
+            return [f"{self.mod}:{fn.id}"], None
+        if not isinstance(fn, ast.Attribute):
+            return [], None
+        attr = fn.attr
+        base = fn.value
+        if isinstance(base, ast.Name):
+            if base.id == "self" and ctx.cls:
+                return [f"{self.mod}:{ctx.cls}.{attr}"], None
+            typed = ctx.var_types.get(base.id)
+            if typed:
+                mod, _, cls = typed.rpartition(".")
+                return [f"{mod}:{cls}.{attr}"], attr
+        dotted = common.resolve_dotted(fn, self.aliases)
+        if dotted:
+            mod, _, name = dotted.rpartition(".")
+            # Module-anchored call (``json.load(f)``): the target is
+            # exact, so never fall back to matching bare method names
+            # against the whole repo (that is how ``json.load`` would
+            # impersonate ``PirRegistry.load``).
+            root: ast.expr = base
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            exact = isinstance(root, ast.Name) and root.id in self.aliases
+            return [f"{mod}:{name}"], None if exact else attr
+        return [], attr
+
+    # -- R3: guarded fields --------------------------------------------
+
+    def _note_accesses(self, stmt: ast.stmt, ctx: "_Ctx") -> None:
+        """Field/global accesses in one statement (expressions already
+        walked for calls; here we classify reads/writes)."""
+        if ctx.func is None:
+            return  # module-level statements are construction
+        init = ctx.fname in ("__init__", "__post_init__")
+        guarded_cls = (
+            any(_class_of(a.site) == f"{self.mod}.{ctx.cls}"
+                for a in ctx.held)
+            or (ctx.fname or "").endswith("_locked")
+        )
+        guarded_mod = (
+            any(a.site in self.scan.decls
+                and _class_of(a.site) == "" and a.site.startswith(self.mod)
+                for a in ctx.held)
+            or bool(ctx.held)
+            or (ctx.fname or "").endswith("_locked")
+        )
+        lock_name = ctx.held[-1].site if ctx.held else None
+        writes, reads = _accesses_in(stmt)
+        for node, is_write in writes + reads:
+            if isinstance(node, ast.Attribute):
+                if not (isinstance(node.value, ast.Name)
+                        and node.value.id == "self" and ctx.cls):
+                    continue
+                attr = node.attr
+                site = f"{self.mod}.{ctx.cls}.{attr}"
+                if site in self.scan.decls or attr.startswith("__"):
+                    continue
+                if init:
+                    continue
+                rec = self.fields.setdefault(ctx.cls, {}).setdefault(
+                    attr, []
+                )
+                rec.append((is_write, guarded_cls, lock_name, node.lineno))
+            elif isinstance(node, ast.Name):
+                name = node.id
+                if name not in self.module_names:
+                    continue
+                if f"{self.mod}.{name}" in self.scan.decls:
+                    continue
+                rec = self.globals_.setdefault(name, [])
+                rec.append((is_write, guarded_mod, lock_name, node.lineno))
+
+    def _report_fields(self) -> None:
+        for cls, fields in self.fields.items():
+            for attr, accesses in fields.items():
+                self._report_one(f"{cls}.{attr}", accesses)
+        for name, accesses in self.globals_.items():
+            # a global only read in functions is config, not shared
+            # mutable state — require a guarded WRITE to arm the rule
+            self._report_one(name, accesses)
+
+    def _report_one(self, label: str, accesses: list) -> None:
+        guarded_writes = [a for a in accesses if a[0] and a[1]]
+        if not guarded_writes:
+            return
+        lock = next((a[2] for a in guarded_writes if a[2]), "its lock")
+        for is_write, guarded, _, line in accesses:
+            if guarded:
+                continue
+            if common.pragma(self.lines, line, "lock-free-ok") is not None:
+                continue
+            what = "written" if is_write else "read"
+            self.scan.finding(
+                self.rel, line,
+                f"'{label}' is written under {lock} but {what} lock-free "
+                "here — take the lock, or sanction with "
+                "'# lock-free-ok: <why>'",
+            )
+
+
+@dataclasses.dataclass
+class _Ctx:
+    cls: str | None
+    func: _FuncInfo | None
+    fname: str | None = None
+    held: list[_Acq] = dataclasses.field(default_factory=list)
+    var_types: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+def _stmt_exprs(stmt: ast.stmt) -> tuple[list[ast.expr], list[ast.expr]]:
+    """(write-target exprs, read exprs) at THIS statement's own level —
+    never descends into nested statements, whose held-lock context
+    differs (the walk classifies those when it reaches them)."""
+    if isinstance(stmt, ast.Assign):
+        return list(stmt.targets), [stmt.value]
+    if isinstance(stmt, ast.AugAssign):
+        return [stmt.target], [stmt.value]
+    if isinstance(stmt, ast.AnnAssign):
+        return ([stmt.target], [stmt.value]) if stmt.value else ([], [])
+    if isinstance(stmt, ast.Delete):
+        return list(stmt.targets), []
+    if isinstance(stmt, ast.Expr):
+        return [], [stmt.value]
+    if isinstance(stmt, ast.Return):
+        return [], [stmt.value] if stmt.value else []
+    if isinstance(stmt, ast.Raise):
+        return [], [e for e in (stmt.exc, stmt.cause) if e]
+    if isinstance(stmt, ast.Assert):
+        return [], [e for e in (stmt.test, stmt.msg) if e]
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [], [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.target], [stmt.iter]
+    return [], []
+
+
+def _accesses_in(stmt: ast.stmt) -> tuple[list, list]:
+    """(writes, reads) of Attribute/Name nodes in one statement's own
+    expressions.  Writes: assignment/loop targets, augmented targets,
+    subscript-store bases.  Reads: Load-context accesses (including a
+    mutating method's receiver — mutation through a read still needs
+    the lock)."""
+    writes: list = []
+    reads: list = []
+    write_roots: set[int] = set()
+    target_exprs, read_exprs = _stmt_exprs(stmt)
+    for t in target_exprs:
+        base: ast.expr = t
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        if isinstance(base, (ast.Attribute, ast.Name)):
+            writes.append((base, True))
+            write_roots.add(id(base))
+    for top in target_exprs + read_exprs:
+        for node in ast.walk(top):
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                if id(node) in write_roots:
+                    continue
+                ctx = getattr(node, "ctx", None)
+                if isinstance(ctx, (ast.Store, ast.Del)):
+                    writes.append((node, True))
+                elif isinstance(ctx, ast.Load):
+                    reads.append((node, False))
+    return writes, reads
+
+
+# ---------------------------------------------------------------------------
+# Interprocedural closure + order checks
+# ---------------------------------------------------------------------------
+
+
+def _transitive(scan: _Scan) -> tuple[dict[str, set[str]],
+                                      dict[str, list[tuple[str, int]]]]:
+    """Fixpoint over the EXACT call graph: for every function, the set
+    of declared locks it may acquire and the blocking ops it may reach."""
+    acq: dict[str, set[str]] = {}
+    blk: dict[str, list[tuple[str, int]]] = {}
+    for key, info in scan.funcs.items():
+        acq[key] = {a.site for a in info.acquires}
+        blk[key] = list(info.blocking)
+    changed = True
+    while changed:
+        changed = False
+        for key, info in scan.funcs.items():
+            for _, _, targets, _ in info.calls:
+                for t in targets:
+                    if t not in scan.funcs or t == key:
+                        continue
+                    if not acq[t] <= acq[key]:
+                        acq[key] |= acq[t]
+                        changed = True
+                    for b in blk[t]:
+                        if b not in blk[key]:
+                            blk[key].append(b)
+                            changed = True
+    return acq, blk
+
+
+def _order_and_blocking(scan: _Scan,
+                        visitors: dict[str, _FileVisitor]) -> None:
+    acq_trans, blk_trans = _transitive(scan)
+    for key, info in scan.funcs.items():
+        vis = visitors[info.rel]
+        for held, call, targets, attr in info.calls:
+            if not held:
+                continue
+            inner: set[str] = set()
+            resolved = [t for t in targets if t in scan.funcs]
+            for t in resolved:
+                inner |= acq_trans[t]
+                for kind, _ in blk_trans[t]:
+                    label = t.split(":", 1)[1]
+                    vis._held_across(list(held), kind, call.lineno,
+                                     direct=False, via=label)
+            if not resolved and attr and attr not in _FALLBACK_DENY:
+                # R2 name fallback: every scanned class method with this
+                # name that DIRECTLY acquires declared locks
+                for cand in scan.by_method.get(attr, ()):
+                    cinfo = scan.funcs[cand]
+                    inner |= {a.site for a in cinfo.acquires}
+            for outer in held:
+                for site in inner:
+                    key2 = (outer.site, site)
+                    scan.edges.setdefault(key2, (info.rel, call.lineno))
+
+
+def _check_edges(scan: _Scan) -> None:
+    decls = scan.decls
+    for (outer, inner), (rel, line) in sorted(scan.edges.items()):
+        do, di = decls[outer], decls[inner]
+        if do.kind == "event" or di.kind == "event":
+            continue
+        if outer == inner:
+            if do.kind not in ("rlock", "cond"):
+                scan.finding(
+                    rel, line,
+                    f"non-reentrant lock '{outer}' re-acquired while "
+                    "already held — self-deadlock",
+                )
+            continue
+        if do.group and do.group == di.group:
+            continue  # shared re-entrant family
+        if di.rank <= do.rank:
+            scan.finding(
+                rel, line,
+                f"acquisition-order inversion: '{inner}' (rank {di.rank}) "
+                f"acquired while holding '{outer}' (rank {do.rank}) — "
+                "nested acquisition must increase rank "
+                "(analysis/concurrency/registry.py)",
+            )
+    _check_cycles(scan)
+
+
+def _check_cycles(scan: _Scan) -> None:
+    graph: dict[str, set[str]] = {}
+    for (outer, inner) in scan.edges:
+        if outer == inner:
+            continue
+        do, di = scan.decls[outer], scan.decls[inner]
+        if do.kind == "event" or di.kind == "event":
+            continue
+        if do.group and do.group == di.group:
+            continue
+        graph.setdefault(outer, set()).add(inner)
+    seen: set[str] = set()
+    reported: set[frozenset] = set()
+
+    def dfs(node: str, stack: list[str], on_stack: set[str]) -> None:
+        seen.add(node)
+        stack.append(node)
+        on_stack.add(node)
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_stack:
+                cycle = stack[stack.index(nxt):] + [nxt]
+                cid = frozenset(cycle)
+                if cid not in reported:
+                    reported.add(cid)
+                    rel, line = scan.edges[(node, nxt)]
+                    scan.finding(
+                        rel, line,
+                        "lock-order cycle: " + " -> ".join(cycle),
+                    )
+            elif nxt not in seen:
+                dfs(nxt, stack, on_stack)
+        stack.pop()
+        on_stack.discard(node)
+
+    for node in sorted(graph):
+        if node not in seen:
+            dfs(node, [], set())
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+
+def run(root: str | None = None,
+        files: list[str] | None = None) -> list[common.Finding]:
+    root = root or common.repo_root()
+    whole_tree = files is None
+    if files is None:
+        files = [
+            rel for rel in common.iter_py_files(root)
+            if common.in_scope(rel, _SCOPE)
+        ]
+    else:
+        files = [rel for rel in files if common.in_scope(rel, _SCOPE)]
+    decls = dict(LOCKS)
+    decls.update(FIXTURE_LOCKS)
+    scan = _Scan(decls)
+    visitors: dict[str, _FileVisitor] = {}
+    for rel in files:
+        try:
+            tree, lines = common.parse_file(root, rel)
+        except SyntaxError as e:
+            scan.finding(rel, e.lineno or 1, f"syntax error: {e.msg}")
+            continue
+        vis = _FileVisitor(scan, rel, tree, lines)
+        visitors[rel] = vis
+        vis.run()
+    _order_and_blocking(scan, visitors)
+    _check_edges(scan)
+    if whole_tree:
+        for site in sorted(set(LOCKS) - scan.created):
+            scan.finding(
+                "dpf_tpu/analysis/concurrency/registry.py", 1,
+                f"stale lock declaration: '{site}' has no creation site "
+                "in the tree — remove or fix the registry entry",
+            )
+    return scan.findings

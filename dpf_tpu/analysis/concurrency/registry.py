@@ -1,0 +1,209 @@
+"""The lock registry: every threading primitive the production tree
+creates, declared with an owner and an acquisition-order rank.
+
+Why a central table and not per-site pragmas: lock ORDER is a global
+property — two locks deadlock because of how *different* modules nest
+them, so the ranking has to live where both declarations are visible at
+once.  The ``lock-discipline`` pass cross-checks this table against the
+tree in both directions: a primitive creation with no declaration is a
+finding (someone added a lock without ranking it), and a declaration
+whose creation site no longer exists is a finding too (the table cannot
+rot).
+
+Site naming: ``<dotted module>.<Class>.<attr>`` for instance primitives
+(``self._lock = threading.Lock()`` inside a class) and
+``<dotted module>.<NAME>`` for module globals.  The pass derives the
+same names from the AST, so the key IS the match.
+
+Ranking discipline (docs/DESIGN.md section 21): nested acquisition must
+strictly increase rank, except inside one ``group`` — a group names a
+family that shares ONE re-entrant lock object at serving time (the
+components take a ``lock=`` parameter and the serving state passes its
+stats RLock to all of them), so nesting inside the family is re-entry,
+not a second lock.  Events carry rank 0: they are signalled, never
+held, so they take no part in ordering (but still must be declared —
+an undeclared Event is usually a missed shutdown path).
+
+``io_ok`` marks the write-serialization locks whose entire PURPOSE is
+to be held across a gathered socket send (one request's frames must hit
+the wire atomically between multiplexed streams).  The held-across-
+blocking rule skips socket sends under an ``io_ok`` lock and still
+flags everything else (a device dispatch or a sleep under a write lock
+stalls every stream on the connection).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class LockDecl:
+    """One declared primitive: who owns it, what it is, where it sits
+    in the acquisition order, and what it is sanctioned to do."""
+
+    owner: str  # subsystem responsible (matches the module's layer)
+    kind: str  # "lock" | "rlock" | "cond" | "event"
+    rank: int  # nested acquisition must strictly increase rank
+    group: str = ""  # same-group nesting allowed (shared re-entrant family)
+    io_ok: bool = False  # may be held across socket sends (write serialization)
+    doc: str = ""
+
+
+LOCKS: dict[str, LockDecl] = {
+    # -- serving state singleton (rank 5: constructed first, builds
+    # components whose constructors touch rank-30 module locks) --------
+    "dpf_tpu.serving.handlers._STATE_LOCK": LockDecl(
+        owner="serving", kind="lock", rank=5,
+        doc="per-process _ServingState singleton construction",
+    ),
+    # -- the shared stats family (rank 10): ONE RLock at serving time.
+    # handlers._ServingState passes stats_lock into the batcher, key
+    # cache, breaker, metrics hub, and HH session cache so /v1/stats
+    # snapshots are consistent across all of them; standalone instances
+    # (unit tests) get their own object, same rank. ---------------------
+    "dpf_tpu.serving.handlers._ServingState.stats_lock": LockDecl(
+        owner="serving", kind="rlock", rank=10, group="stats",
+        doc="the shared serving stats RLock (the group's one real object)",
+    ),
+    "dpf_tpu.serving.batcher.Batcher._lock": LockDecl(
+        owner="serving", kind="lock", rank=10, group="stats",
+        doc="lane queues + counters; the stats RLock when shared",
+    ),
+    "dpf_tpu.serving.breaker.CircuitBreaker._lock": LockDecl(
+        owner="serving", kind="lock", rank=10, group="stats",
+        doc="breaker state machine; the stats RLock when shared",
+    ),
+    "dpf_tpu.serving.keycache.KeyCache._lock": LockDecl(
+        owner="serving", kind="lock", rank=10, group="stats",
+        doc="repack LRU; builds run OUTSIDE it (misses overlap)",
+    ),
+    "dpf_tpu.obs.metrics.MetricsHub._lock": LockDecl(
+        owner="obs", kind="rlock", rank=10, group="stats",
+        doc="histogram/counter registry; the stats RLock when shared",
+    ),
+    "dpf_tpu.apps.hh_state.SessionCache._lock": LockDecl(
+        owner="apps", kind="rlock", rank=10, group="stats",
+        doc="descent-session registry; the stats RLock when shared",
+    ),
+    # -- module/loader locks reachable from under the stats lock
+    # (stats_snapshot fans out to their stats() surfaces) ---------------
+    "dpf_tpu.serving.faults._PLAN_LOCK": LockDecl(
+        owner="serving", kind="lock", rank=20,
+        doc="install/clear of the process fault plan",
+    ),
+    "dpf_tpu.apps.pir_store._REGISTRY_LOCK": LockDecl(
+        owner="apps", kind="lock", rank=20,
+        doc="per-process PirRegistry singleton construction",
+    ),
+    "dpf_tpu.core.plans.PlanCache._lock": LockDecl(
+        owner="core", kind="lock", rank=30,
+        doc="plan-key table; compiles happen outside it",
+    ),
+    "dpf_tpu.obs.trace.FlightRecorder._lock": LockDecl(
+        owner="obs", kind="lock", rank=30,
+        doc="flight-recorder ring buffer",
+    ),
+    "dpf_tpu.obs.profile._LOCK": LockDecl(
+        owner="obs", kind="lock", rank=30,
+        doc="one profiler capture at a time (admin path)",
+    ),
+    "dpf_tpu.parallel.serving_mesh._LOCK": LockDecl(
+        owner="parallel", kind="lock", rank=30,
+        doc="serving-mesh resolution cache",
+    ),
+    "dpf_tpu.tune.tuned._LOCK": LockDecl(
+        owner="tune", kind="lock", rank=30,
+        doc="TUNED.json load/validate cache (file I/O on first touch)",
+    ),
+    "dpf_tpu.serving.faults.FaultPlan._lock": LockDecl(
+        owner="serving", kind="lock", rank=30,
+        doc="fault-plan counters; injected sleeps happen outside it",
+    ),
+    "dpf_tpu.apps.pir_store.PirRegistry._lock": LockDecl(
+        owner="apps", kind="lock", rank=30,
+        doc="name -> PirDB table",
+    ),
+    "dpf_tpu.backends.cpu_native._lock": LockDecl(
+        owner="backends", kind="lock", rank=30,
+        doc="one-time native library build/load",
+    ),
+    "dpf_tpu.apps.pir_store.PirDB._lock": LockDecl(
+        owner="apps", kind="lock", rank=40,
+        doc="per-DB counters + server table; HBM placement outside it",
+    ),
+    "dpf_tpu.parallel.sharding._ShardedJits._lock": LockDecl(
+        owner="parallel", kind="lock", rank=40,
+        doc="sharded-jit registry (reached via plans trace_count)",
+    ),
+    # -- wire2: per-connection / per-client primitives (rank 50+; never
+    # held while calling into serving, which runs lock-free from the
+    # worker pool) ------------------------------------------------------
+    "dpf_tpu.serving.wire2._Conn._lock": LockDecl(
+        owner="wire2", kind="lock", rank=50,
+        doc="server-side stream table + worker-pool accounting",
+    ),
+    "dpf_tpu.serving.wire2.Wire2Server._lock": LockDecl(
+        owner="wire2", kind="lock", rank=50,
+        doc="live-connection set",
+    ),
+    "dpf_tpu.serving.wire2.Wire2Client._slock": LockDecl(
+        owner="wire2", kind="lock", rank=50,
+        doc="client stream table + sid allocator",
+    ),
+    "dpf_tpu.serving.wire2._Conn._wlock": LockDecl(
+        owner="wire2", kind="lock", rank=55, io_ok=True,
+        doc="server write side: one reply's frames go out atomically",
+    ),
+    "dpf_tpu.serving.wire2.Wire2Client._wlock": LockDecl(
+        owner="wire2", kind="lock", rank=55, io_ok=True,
+        doc="client write side: one request's frames go out atomically",
+    ),
+    "dpf_tpu.serving.wire2._BufPool._lock": LockDecl(
+        owner="wire2", kind="lock", rank=60,
+        doc="pooled receive buffers",
+    ),
+    "dpf_tpu.serving.wire2._StreamBody._cond": LockDecl(
+        owner="wire2", kind="cond", rank=60,
+        doc="body fill/consume handshake; recv happens OUTSIDE it",
+    ),
+    # -- events (rank 0: signalled, never held) -------------------------
+    "dpf_tpu.serving.batcher._Req.done": LockDecl(
+        owner="serving", kind="event", rank=0,
+        doc="per-request completion latch (leader -> follower)",
+    ),
+    "dpf_tpu.serving.wire2._Pending.event": LockDecl(
+        owner="wire2", kind="event", rank=0,
+        doc="client reply-complete latch (reader -> caller)",
+    ),
+}
+
+
+# Declarations for the seeded-violation fixture
+# (dpf_tpu/analysis/fixtures/bad_locks.py).  Real scans never see the
+# fixtures directory, so these are reachable only when the test harness
+# points the pass at a fixture file explicitly.  ``_UNDECLARED`` in the
+# fixture is deliberately missing here — that omission IS the seeded
+# undeclared-creation violation.
+FIXTURE_LOCKS: dict[str, LockDecl] = {
+    "dpf_tpu.analysis.fixtures.bad_locks.BadOrder._a": LockDecl(
+        owner="fixture", kind="lock", rank=10,
+        doc="seeded: outer lock of the inversion pair",
+    ),
+    "dpf_tpu.analysis.fixtures.bad_locks.BadOrder._b": LockDecl(
+        owner="fixture", kind="lock", rank=20,
+        doc="seeded: inner lock of the inversion pair",
+    ),
+    "dpf_tpu.analysis.fixtures.bad_locks.TornCounter._lock": LockDecl(
+        owner="fixture", kind="lock", rank=10,
+        doc="seeded: guards bump() but not read()",
+    ),
+    "dpf_tpu.analysis.fixtures.bad_locks.HeldAcrossDispatch._lock": LockDecl(
+        owner="fixture", kind="lock", rank=10,
+        doc="seeded: held across plans.run_points",
+    ),
+    "dpf_tpu.analysis.fixtures.bad_locks.HeldAcrossRecv._lock": LockDecl(
+        owner="fixture", kind="lock", rank=10,
+        doc="seeded: held across sock.recv",
+    ),
+}

@@ -1,0 +1,621 @@
+"""Deterministic interleaving harness: replay thread schedules from a seed.
+
+The static half of this package (``lock_pass``) proves properties over
+the AST; this module is the dynamic half — it takes the 2-4 thread
+shapes the serving plane actually runs (batcher lanes, breaker trips,
+session-cache eviction vs eval, wire2 stream open/close) and drives
+them through a SEEDED scheduler so that a deadlock or torn read found
+once reproduces byte-for-byte in CI forever.
+
+How it works — token passing, not time slicing:
+
+  * Exactly one scenario thread owns the *token* (is granted) at any
+    moment; everyone else waits on a grant Event or is blocked inside a
+    C-level acquire.  Because scenario Python only executes under the
+    token, the whole run is a total order, and the seeded RNG that
+    picks each grant is the only choice point: seed -> schedule ->
+    trace is a pure function.
+  * Lock traffic is observed two ways, because CPython 3.10 shows it
+    two ways.  Direct C-method calls (``lock.acquire()``,
+    ``lock.release()``, the ``__exit__`` a ``with`` block runs, and
+    everything ``threading.Condition``/``Event`` do internally) raise
+    ``c_call``/``c_return`` profile events a per-thread
+    ``sys.setprofile`` hook intercepts.  But the ``SETUP_WITH`` opcode
+    calls ``__enter__`` straight from C with NO profile event — so for
+    files named in ``trace_files`` the harness pre-parses every ``with``
+    statement, and a ``sys.settrace`` line hook evaluates the context
+    expression against the live frame to learn which lock is about to
+    be acquired ("pending").  Any later event from that thread proves
+    the acquire completed and converts pending into held.
+  * At an acquire the thread logs what it wants, drops to "limbo", and
+    falls into the C acquire (which may block).  When the acquire is
+    known to have completed the thread goes "ready" and waits for the
+    next grant.  Releases update the ledger at ``c_call`` time —
+    BEFORE the C release wakes any waiter — so the trace order never
+    races the kernel's wakeup order.
+  * The caller's thread runs the scheduler loop: whenever no thread is
+    running and none is about to wake ("transit": wants a lock the
+    ledger says is free), it picks the next thread from the ready set
+    with ``random.Random(seed)``.
+  * Optional line-granularity preemption (``preempt_every=(lo, hi)``):
+    the line hook yields every k-th line inside ``trace_files``, k
+    drawn from the same RNG — this is what widens the read/write
+    window of a torn counter so a seed can expose it.
+
+Deadlock is a *state* the loop recognizes, not a timeout: no thread
+running, ready, or in transit, and the wait-for edges (thread -> holder
+of the lock it wants) contain a cycle.  The loop appends the cycle to
+the trace and raises :class:`DeadlockDetected`; the C-blocked threads
+are daemons and are abandoned.
+
+Limits, by design: a ``with`` block in a file NOT listed in
+``trace_files`` is invisible at entry (list the component's source file
+to see it); a thread blocked on something the ledger cannot see (an
+``Event.wait`` serviced by a non-scenario thread, a socket read)
+eventually gets marked "parked" after a settle window and re-admitted
+when it wakes — component scenarios that talk to real server threads
+stay correct but their park/wake timing is wall-clock, so only
+pure-lock fixtures (no external wakers) are byte-for-byte
+deterministic.  Timed acquires are detected by a post-return ledger
+check and never corrupt the ledger.
+"""
+
+from __future__ import annotations
+
+import ast
+import contextlib
+import dis
+import os
+import random
+import sys
+import threading
+import time
+from typing import Any, Callable, Iterator
+
+_LOCK_TYPE = type(threading.Lock())
+_RLOCK_TYPE = type(threading.RLock())
+_LOCK_TYPES: tuple[type, ...] = (_LOCK_TYPE, _RLOCK_TYPE)
+
+# C-method names on _thread lock types that move lock state.  The
+# ``_release_save`` / ``_acquire_restore`` pair is Condition.wait's
+# full-release / re-acquire of an RLock regardless of count.
+_ACQ_NAMES = frozenset({"acquire", "acquire_lock", "__enter__", "_acquire_restore"})
+_REL_NAMES = frozenset({"release", "release_lock", "__exit__", "_release_save"})
+
+
+class DeadlockDetected(RuntimeError):
+    """Raised by :meth:`DetScheduler.run` when the wait-for graph has a
+    cycle.  ``trace`` is the full schedule that led there (the last
+    line is the cycle); ``cycle`` is the thread names in cycle order."""
+
+    def __init__(self, message: str, trace: list[str], cycle: list[str]):
+        super().__init__(message)
+        self.trace = list(trace)
+        self.cycle = list(cycle)
+
+
+def _pure_load(node: ast.expr) -> bool:
+    """True for a side-effect-free Name/Attribute chain the line hook
+    may safely re-evaluate against the frame."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return isinstance(node, ast.Name)
+
+
+def _with_map(path: str) -> dict[int, list[Any]]:
+    """lineno -> compiled context expressions for every ``with`` whose
+    items are pure loads (the shape ``with self._lock:`` compiles to —
+    the one acquire CPython hides from profile hooks)."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    out: dict[int, list[Any]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.With):
+            continue
+        codes = []
+        for item in node.items:
+            expr = item.context_expr
+            if _pure_load(expr):
+                codes.append(
+                    compile(ast.Expression(expr), path, "eval")
+                )
+        if codes:
+            out[node.lineno] = codes
+    return out
+
+
+class DetScheduler:
+    """Seeded deterministic scheduler for 2-4 thread lock scenarios.
+
+    Usage::
+
+        sched = DetScheduler(seed=7, trace_files=(fixture.__file__,))
+        sched.spawn(lambda: worker_a(obj), name="a")
+        sched.spawn(lambda: worker_b(obj), name="b")
+        trace = sched.run()          # list[str]; raises DeadlockDetected
+
+    One instance drives one run; build a fresh instance (same seed) to
+    replay.  List every source file whose ``with <lock>:`` blocks the
+    scenario should observe in ``trace_files``.  ``name_lock`` attaches
+    stable display names to lock objects before ``run`` (anonymous
+    locks are named L0, L1, ... in first-touch order, which is itself
+    deterministic)."""
+
+    def __init__(
+        self,
+        seed: int,
+        *,
+        trace_files: tuple[str, ...] = (),
+        preempt_every: tuple[int, int] | None = None,
+        settle_s: float = 0.5,
+        hang_s: float = 20.0,
+        deadline_s: float = 120.0,
+    ):
+        self._rng = random.Random(seed)
+        self._mu = threading.Lock()
+        self._ctl = threading.Event()
+        self._fns: list[tuple[str, Callable[[], Any]]] = []
+        self._threads: list[threading.Thread] = []
+        self._grants: list[threading.Event] = []
+        self._status: list[str] = []  # ready|running|limbo|parked|done
+        self._wants: list[int | None] = []
+        self._pending: list[list[int] | None] = []  # with-entry acquires in flight
+        self._countdown: list[int | None] = []
+        self._locks: dict[int, Any] = {}  # key -> lock obj (keepalive: ids stay unique)
+        self._lock_ids: dict[int, int] = {}  # id(obj) -> key
+        self._names: dict[int, str] = {}  # key -> display name
+        self._holders: dict[int, tuple[int, int]] = {}  # key -> (tid, count)
+        self._trace: list[str] = []
+        self._errors: dict[int, BaseException] = {}
+        self._trace_files = {os.path.abspath(p) for p in trace_files}
+        self._with_maps = {p: _with_map(p) for p in sorted(self._trace_files)}
+        self._file_key: dict[str, str | None] = {}
+        self._entry_offs: dict[Any, frozenset[int]] = {}  # code -> with-entry f_lasti
+        self._preempt_every = preempt_every
+        self._settle_s = settle_s
+        self._hang_s = hang_s
+        self._deadline_s = deadline_s
+        self._started = False
+
+    # ---- scenario assembly -------------------------------------------
+
+    def spawn(self, fn: Callable[[], Any], name: str | None = None) -> int:
+        """Register a scenario thread; returns its tid.  Threads start
+        only when :meth:`run` is called."""
+        if self._started:
+            raise RuntimeError("scheduler already ran")
+        tid = len(self._fns)
+        self._fns.append((name or f"t{tid}", fn))
+        self._grants.append(threading.Event())
+        self._status.append("ready")
+        self._wants.append(None)
+        self._pending.append(None)
+        self._countdown.append(None)
+        return tid
+
+    def name_lock(self, obj: Any, name: str) -> None:
+        """Pre-register ``obj`` under a stable display name for traces."""
+        with self._mu:
+            key = self._key_locked(obj)
+            self._names[key] = name
+
+    # ---- bookkeeping (callers hold self._mu) -------------------------
+
+    def _key_locked(self, obj: Any) -> int:
+        i = id(obj)
+        key = self._lock_ids.get(i)
+        if key is None:
+            key = len(self._locks)
+            self._lock_ids[i] = key
+            self._locks[key] = obj
+            self._names[key] = f"L{key}"
+        return key
+
+    def _tn(self, tid: int) -> str:
+        return self._fns[tid][0]
+
+    def _hold_locked(self, tid: int, key: int) -> None:
+        held = self._holders.get(key)
+        if held is not None and held[0] == tid:
+            self._holders[key] = (tid, held[1] + 1)
+        else:
+            self._holders[key] = (tid, 1)
+        self._trace.append(f"{self._tn(tid)} acquired {self._names[key]}")
+
+    def _transit_locked(self, tid: int) -> bool:
+        """True if the lock ``tid`` is blocked on should wake it without
+        any further scheduling (free per the ledger, or re-entrant
+        self-acquisition)."""
+        key = self._wants[tid]
+        if key is None:
+            return True
+        held = self._holders.get(key)
+        if held is None:
+            return True
+        return held[0] == tid and isinstance(self._locks[key], _RLOCK_TYPE)
+
+    def _got_lock_locked(self, tid: int, obj: Any, key: int) -> bool:
+        """Did this thread's just-returned acquire actually succeed?
+        (A timed acquire can return empty-handed.)"""
+        if isinstance(obj, _RLOCK_TYPE):
+            try:
+                return bool(obj._is_owned())
+            except AttributeError:  # pragma: no cover - C RLock always has it
+                return True
+        held = self._holders.get(key)
+        # Free per the ledger -> we took it.  Still charged to someone
+        # (possibly ourselves: a Condition waiter re-lock that timed
+        # out) -> we came back empty.
+        return held is None
+
+    # ---- worker side -------------------------------------------------
+
+    def _pause(self, tid: int) -> None:
+        g = self._grants[tid]
+        g.wait()
+        g.clear()
+
+    def _resolve_pending(self, tid: int) -> None:
+        """A new event from ``tid`` proves its with-entry acquire(s)
+        completed: move pending to held and take the post-acquire
+        grant point."""
+        if self._pending[tid] is None:
+            return
+        with self._mu:
+            keys = self._pending[tid]
+            self._pending[tid] = None
+            if keys:
+                for key in keys:
+                    self._hold_locked(tid, key)
+            self._wants[tid] = None
+            self._status[tid] = "ready"
+            self._ctl.set()
+        self._pause(tid)
+
+    def _with_attempt(self, tid: int, frame: Any, codes: list[Any]) -> None:
+        """Line hook is sitting on a ``with`` statement: learn which
+        lock(s) it is about to acquire."""
+        locks = []
+        for code in codes:
+            try:
+                obj = eval(code, frame.f_globals, frame.f_locals)  # noqa: S307
+            except Exception:  # noqa: BLE001 - stale map entry; not a lock
+                continue
+            if isinstance(obj, _LOCK_TYPES):
+                locks.append(obj)
+        if not locks:
+            return
+        with self._mu:
+            keys = [self._key_locked(o) for o in locks]
+            self._pending[tid] = keys
+            # The interesting want is the first lock someone else holds.
+            want = keys[0]
+            for key in keys:
+                held = self._holders.get(key)
+                if held is not None and held[0] != tid:
+                    want = key
+                    break
+            self._wants[tid] = want
+            self._status[tid] = "limbo"
+            self._trace.append(f"{self._tn(tid)} wants {self._names[want]}")
+            self._ctl.set()
+        # fall through into SETUP_WITH's C acquire; it may block
+
+    def _acq_call(self, tid: int, obj: Any) -> None:
+        with self._mu:
+            key = self._key_locked(obj)
+            self._wants[tid] = key
+            self._status[tid] = "limbo"
+            self._trace.append(f"{self._tn(tid)} wants {self._names[key]}")
+            self._ctl.set()
+        # fall through into the C acquire; it may block
+
+    def _acq_return(self, tid: int, obj: Any) -> None:
+        with self._mu:
+            key = self._key_locked(obj)
+            if self._got_lock_locked(tid, obj, key):
+                self._hold_locked(tid, key)
+            self._wants[tid] = None
+            self._status[tid] = "ready"
+            self._ctl.set()
+        self._pause(tid)
+
+    def _rel_call(self, tid: int, obj: Any, name: str) -> None:
+        # Ledger updates happen BEFORE the C release executes, so a
+        # blocked waiter can never log its wakeup ahead of this release.
+        with self._mu:
+            key = self._key_locked(obj)
+            held = self._holders.get(key)
+            if held is None:
+                return
+            htid, count = held
+            full = (
+                name == "_release_save"
+                or count <= 1
+                or not isinstance(obj, _RLOCK_TYPE)
+            )
+            if full:
+                del self._holders[key]
+                self._trace.append(f"{self._tn(tid)} released {self._names[key]}")
+            else:
+                self._holders[key] = (htid, count - 1)
+            self._ctl.set()
+
+    def _rel_return(self, tid: int) -> None:
+        with self._mu:
+            self._status[tid] = "ready"
+            self._ctl.set()
+        self._pause(tid)
+
+    def _profiler(self, tid: int) -> Callable[[Any, str, Any], None]:
+        def hook(frame: Any, event: str, arg: Any) -> None:
+            # Any event proves forward progress past a pending with-entry.
+            self._resolve_pending(tid)
+            if event != "c_call" and event != "c_return":
+                return
+            name = getattr(arg, "__name__", None)
+            if name in _ACQ_NAMES:
+                obj = getattr(arg, "__self__", None)
+                if isinstance(obj, _LOCK_TYPES):
+                    if event == "c_call":
+                        self._acq_call(tid, obj)
+                    else:
+                        self._acq_return(tid, obj)
+            elif name in _REL_NAMES:
+                obj = getattr(arg, "__self__", None)
+                if isinstance(obj, _LOCK_TYPES):
+                    if event == "c_call":
+                        self._rel_call(tid, obj, name)
+                    else:
+                        self._rel_return(tid)
+
+        return hook
+
+    # A with-entry for the context exprs we track (pure Name/Attribute
+    # loads) compiles to a straight chain of these ops ending in
+    # SETUP_WITH.  Anything else between the event offset and the next
+    # SETUP_WITH (the __exit__ call, a jump, a RERAISE) means the event
+    # is NOT an entry.
+    _ENTRY_CHAIN_OPS = frozenset(
+        {
+            "LOAD_FAST", "LOAD_ATTR", "LOAD_GLOBAL", "LOAD_NAME",
+            "LOAD_DEREF", "LOAD_CLASSDEREF", "LOAD_CONST", "DUP_TOP",
+            "NOP", "EXTENDED_ARG",
+        }
+    )
+
+    def _with_entries(self, code: Any) -> frozenset[int]:
+        """Offsets at which a 'line' event means execution is ENTERING a
+        with statement (vs revisiting its line for the __exit__
+        sequence).  Line events can land mid-run — the compiler
+        duplicates a ``finally``/``except`` body's with statement and
+        the exception path jumps straight to the copy — so this is
+        every offset from which a pure load chain reaches the next
+        SETUP_WITH, not just line-run starts."""
+        cached = self._entry_offs.get(code)
+        if cached is None:
+            out = set()
+            reaches = False  # scanning backwards: next-op reaches SETUP_WITH
+            for ins in reversed(list(dis.get_instructions(code))):
+                if ins.opname in ("SETUP_WITH", "BEFORE_WITH"):
+                    reaches = True
+                elif ins.opname not in self._ENTRY_CHAIN_OPS:
+                    reaches = False
+                if reaches:
+                    out.add(ins.offset)
+            cached = frozenset(out)
+            self._entry_offs[code] = cached
+        return cached
+
+    def _preempt(self, tid: int, lineno: int) -> None:
+        pause = False
+        with self._mu:
+            c = self._countdown[tid]
+            if c is None:
+                assert self._preempt_every is not None
+                c = self._rng.randrange(*self._preempt_every)
+            c -= 1
+            if c > 0:
+                self._countdown[tid] = c
+            else:
+                self._countdown[tid] = None
+                self._status[tid] = "ready"
+                self._trace.append(f"{self._tn(tid)} preempt :{lineno}")
+                self._ctl.set()
+                pause = True
+        if pause:
+            self._pause(tid)
+
+    def _tracer(self, tid: int) -> Callable[..., Any]:
+        def local_tracer(frame: Any, event: str, arg: Any) -> Any:
+            self._resolve_pending(tid)
+            if event == "line":
+                fkey = self._file_key.get(frame.f_code.co_filename)
+                if fkey is not None:
+                    codes = self._with_maps[fkey].get(frame.f_lineno)
+                    # The with-statement's LINE fires twice: at entry
+                    # (SETUP_WITH) and again for the __exit__ sequence.
+                    # Only the run that contains SETUP_WITH is an
+                    # acquire attempt.
+                    if codes is not None and frame.f_lasti in self._with_entries(
+                        frame.f_code
+                    ):
+                        self._with_attempt(tid, frame, codes)
+                        return local_tracer  # acquire is its own yield point
+                if self._preempt_every is not None:
+                    self._preempt(tid, frame.f_lineno)
+            return local_tracer
+
+        def global_tracer(frame: Any, event: str, arg: Any) -> Any:
+            self._resolve_pending(tid)
+            if event != "call":
+                return None
+            fname = frame.f_code.co_filename
+            fkey = self._file_key.get(fname, "")
+            if fkey == "":
+                ap = os.path.abspath(fname)
+                fkey = ap if ap in self._trace_files else None
+                self._file_key[fname] = fkey
+            return local_tracer if fkey is not None else None
+
+        return global_tracer
+
+    def _worker(self, tid: int, fn: Callable[[], Any]) -> None:
+        self._pause(tid)  # first grant arrives before hooks exist
+        sys.setprofile(self._profiler(tid))
+        if self._trace_files:
+            sys.settrace(self._tracer(tid))
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 - reported via run()
+            with self._mu:
+                self._errors[tid] = e
+        finally:
+            sys.setprofile(None)
+            sys.settrace(None)
+            with self._mu:
+                self._status[tid] = "done"
+                self._trace.append(f"{self._tn(tid)} done")
+                self._ctl.set()
+
+    # ---- scheduler side (runs in the caller's thread) ----------------
+
+    def _decide_locked(self) -> tuple[str, Any]:
+        sts = self._status
+        if all(s == "done" for s in sts):
+            return ("done", None)
+        if any(s == "running" for s in sts):
+            return ("wait_run", None)
+        transit = [
+            t for t, s in enumerate(sts) if s == "limbo" and self._transit_locked(t)
+        ]
+        if transit:
+            return ("wait_transit", transit)
+        ready = [t for t, s in enumerate(sts) if s == "ready"]
+        if ready:
+            return ("grant", ready[self._rng.randrange(len(ready))])
+        # Nobody runnable: limbo threads blocked on held locks, parked
+        # threads awaiting external wakers.  Cycle -> deadlock verdict.
+        edges: dict[int, int] = {}
+        for t, s in enumerate(sts):
+            if s != "limbo":
+                continue
+            key = self._wants[t]
+            if key is None:
+                continue
+            held = self._holders.get(key)
+            if held is not None and held[0] != t:
+                edges[t] = held[0]
+        cyc = _find_cycle(edges)
+        if cyc:
+            parts = []
+            for t in cyc:
+                key = self._wants[t]
+                lname = self._names[key] if key is not None else "?"
+                parts.append(
+                    f"{self._tn(t)} waits {lname} held by {self._tn(edges[t])}"
+                )
+            return ("deadlock", ("deadlock: " + "; ".join(parts), cyc))
+        return ("wait_hang", None)
+
+    def run(self, *, raise_errors: bool = True) -> list[str]:
+        """Drive the scenario to completion; returns the trace.
+
+        Raises :class:`DeadlockDetected` on a wait-for cycle and
+        ``RuntimeError`` on a hang (every thread waiting on something
+        no scenario thread will ever provide) or deadline blowout.
+        Worker exceptions re-raise here (lowest tid first) unless
+        ``raise_errors=False`` — they stay in ``self.errors`` either
+        way."""
+        if self._started:
+            raise RuntimeError("scheduler already ran")
+        if not self._fns:
+            raise RuntimeError("no scenario threads spawned")
+        self._started = True
+        for tid, (name, fn) in enumerate(self._fns):
+            t = threading.Thread(
+                target=self._worker, args=(tid, fn), name=f"det-{name}", daemon=True
+            )
+            self._threads.append(t)
+            t.start()
+        deadline = time.monotonic() + self._deadline_s
+        while True:
+            self._ctl.clear()
+            grant: int | None = None
+            with self._mu:
+                kind, payload = self._decide_locked()
+                if kind == "grant":
+                    grant = payload
+                    self._status[grant] = "running"
+                    self._trace.append(f"grant {self._tn(grant)}")
+                elif kind == "deadlock":
+                    self._trace.append(payload[0])
+            if kind == "done":
+                break
+            if kind == "deadlock":
+                msg, cyc = payload
+                raise DeadlockDetected(msg, self._trace, [self._tn(t) for t in cyc])
+            if grant is not None:
+                self._grants[grant].set()
+            elif kind == "wait_transit":
+                if not self._ctl.wait(self._settle_s):
+                    # An expected wakeup never came: the thread is
+                    # blocked on something outside the ledger (event
+                    # waiter, socket).  Park it; its own hooks re-admit
+                    # it when the external waker fires.
+                    with self._mu:
+                        for t in payload:
+                            if self._status[t] == "limbo" and self._transit_locked(t):
+                                self._status[t] = "parked"
+                                self._trace.append(f"{self._tn(t)} parked")
+            elif kind == "wait_run":
+                self._ctl.wait(1.0)
+            else:  # wait_hang
+                if not self._ctl.wait(self._hang_s):
+                    raise RuntimeError(
+                        "interleaving hang: no scenario thread can make "
+                        "progress and no wait-for cycle exists (external "
+                        "waker missing?); trace:\n" + "\n".join(self._trace)
+                    )
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "scenario deadline exceeded; trace:\n" + "\n".join(self._trace)
+                )
+        for t in self._threads:
+            t.join(timeout=5.0)
+        if raise_errors and self._errors:
+            raise self._errors[min(self._errors)]
+        return list(self._trace)
+
+    @property
+    def errors(self) -> dict[int, BaseException]:
+        return dict(self._errors)
+
+
+def _find_cycle(edges: dict[int, int]) -> list[int] | None:
+    """A cycle in the wait-for graph (each node has at most one out
+    edge, so chain-walking suffices), or None.  Iteration order is
+    sorted, so the reported cycle is deterministic."""
+    for start in sorted(edges):
+        seen: list[int] = []
+        t = start
+        while t in edges and t not in seen:
+            seen.append(t)
+            t = edges[t]
+        if t in seen:
+            return seen[seen.index(t) :]
+    return None
+
+
+@contextlib.contextmanager
+def stress_switch_interval(interval_s: float = 1e-5) -> Iterator[None]:
+    """Shrink the interpreter's thread switch interval so free-running
+    (non-DetScheduler) stress scenarios context-switch thousands of
+    times more often — the cheap way to shake out torn state when a
+    scenario's waker lives outside the scheduler's ledger."""
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(interval_s)
+    try:
+        yield
+    finally:
+        sys.setswitchinterval(old)

@@ -1,0 +1,94 @@
+"""Seeded lock-discipline violations (analysis/concurrency/lock_pass.py).
+
+Excluded from real scans (common.iter_py_files skips fixtures/); the
+test suite points the pass at this file explicitly and asserts every
+seeded violation fires.  The classes double as the DYNAMIC fixtures for
+the deterministic interleaving harness (tests import them and drive the
+bad shapes through sched.DetScheduler, so the statically-flagged
+deadlock and torn read are also REPRODUCED, byte-for-byte, from a
+seed).
+
+Seeded violations, one per rule:
+
+  R1  ``_UNDECLARED`` — a module lock with no registry declaration
+      (every other lock here is declared in registry.FIXTURE_LOCKS).
+  R2  ``BadOrder.inverted`` — acquires ``_b`` (rank 20) then ``_a``
+      (rank 10): an acquisition-order inversion, and together with
+      ``forward`` an order cycle (the classic AB/BA deadlock).
+  R3  ``TornCounter.read`` — ``count`` is written under ``_lock`` in
+      ``bump`` but read lock-free in ``read``.
+  R4  ``HeldAcrossDispatch.fire`` — ``_lock`` held across a device
+      dispatch; ``HeldAcrossRecv.pull`` — ``_lock`` held across
+      ``sock.recv``.
+"""
+
+import threading
+
+from dpf_tpu.core import plans
+
+_UNDECLARED = threading.Lock()  # R1: not in the registry on purpose
+
+
+class BadOrder:
+    """AB/BA deadlock shape: ``forward`` takes a then b, ``inverted``
+    takes b then a.  Two threads, one in each, deadlock."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def inverted(self):
+        with self._b:
+            with self._a:  # R2: rank 10 under rank 20
+                pass
+
+
+class TornCounter:
+    """The unguarded-counter torn read: ``bump`` guards the
+    read-modify-write, ``read`` and ``torn_bump`` skip the lock.  The
+    two-line read-then-write in ``torn_bump`` is the preemption window
+    the deterministic scheduler widens on purpose."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+    def torn_bump(self):
+        snapshot = self.count  # R3: lock-free read-modify-write
+        self.count = snapshot + 1  # R3: lock-free write
+
+    def read(self):
+        return self.count  # R3: lock-free read
+
+
+class HeldAcrossDispatch:
+    """One wedged dispatch under this lock stalls every caller."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def fire(self, profile, kb, xs):
+        with self._lock:
+            return plans.run_points(  # R4: dispatch under a lock
+                "/v1/eval_points_batch", profile, kb, xs
+            )
+
+
+class HeldAcrossRecv:
+    """A slow peer under this lock stalls every caller."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def pull(self, sock):
+        with self._lock:
+            return sock.recv(4)  # R4: socket read under a lock

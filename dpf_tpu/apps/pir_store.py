@@ -242,6 +242,9 @@ _REGISTRY_LOCK = threading.Lock()
 
 
 def registry() -> PirRegistry:
+    # A racing reset() hands the caller the pre-reset registry, which
+    # stays fully usable on its own.
+    # lock-free-ok: atomic reference read of the singleton
     return _REGISTRY
 
 

@@ -131,7 +131,19 @@ def available() -> bool:
 
 def load_error() -> str | None:
     _load()
+    # lock-free-ok: write-once under _lock; the _load() call above synchronizes
     return _load_error
+
+
+def _require():
+    """The loaded library, or the RuntimeError every native entrypoint
+    raises when the build/load failed (single flagged read of the
+    write-once error)."""
+    lib = _load()
+    if lib is None:
+        # lock-free-ok: write-once under _lock; stable once _load() returned
+        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    return lib
 
 
 def have_aesni() -> bool:
@@ -145,9 +157,7 @@ def _u8ptr(a: np.ndarray):
 
 def gen(alpha: int, log_n: int, rng: np.random.Generator | None = None) -> tuple[bytes, bytes]:
     """Native Gen; entropy drawn host-side (deterministic with seeded rng)."""
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    lib = _require()
     if rng is None:
         seeds = np.frombuffer(os.urandom(32), dtype=np.uint8).copy()
     else:
@@ -163,9 +173,7 @@ def gen(alpha: int, log_n: int, rng: np.random.Generator | None = None) -> tuple
 
 
 def eval_point(key: bytes, x: int, log_n: int) -> int:
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    lib = _require()
     kb = np.frombuffer(bytes(key), dtype=np.uint8)
     rc = lib.dpfn_eval(_u8ptr(kb), len(kb), x, log_n)
     if rc < 0:
@@ -174,9 +182,7 @@ def eval_point(key: bytes, x: int, log_n: int) -> int:
 
 
 def eval_full(key: bytes, log_n: int) -> bytes:
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    lib = _require()
     kb = np.frombuffer(bytes(key), dtype=np.uint8)
     out = np.empty(int(lib.dpfn_output_len(log_n)), np.uint8)
     rc = lib.dpfn_eval_full(_u8ptr(kb), len(kb), log_n, _u8ptr(out), out.size)
@@ -187,9 +193,7 @@ def eval_full(key: bytes, log_n: int) -> bytes:
 
 def eval_full_batch(keys: list[bytes], log_n: int) -> np.ndarray:
     """Sequential single-core batch (the baseline configuration)."""
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    lib = _require()
     klen = int(lib.dpfn_key_len(log_n))
     arr = np.frombuffer(b"".join(keys), dtype=np.uint8)
     if arr.size != klen * len(keys):
@@ -209,9 +213,7 @@ def eval_full_batch(keys: list[bytes], log_n: int) -> np.ndarray:
 
 def cc_gen(alpha: int, log_n: int, rng: np.random.Generator | None = None) -> tuple[bytes, bytes]:
     """Native fast-profile Gen (key layout: core/chacha_np.py)."""
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    lib = _require()
     if rng is None:
         seeds = np.frombuffer(os.urandom(32), dtype=np.uint8).copy()
     else:
@@ -227,9 +229,7 @@ def cc_gen(alpha: int, log_n: int, rng: np.random.Generator | None = None) -> tu
 
 
 def cc_eval_point(key: bytes, x: int, log_n: int) -> int:
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    lib = _require()
     kb = np.frombuffer(bytes(key), dtype=np.uint8)
     rc = lib.dpfn_cc_eval(_u8ptr(kb), len(kb), x, log_n)
     if rc < 0:
@@ -238,9 +238,7 @@ def cc_eval_point(key: bytes, x: int, log_n: int) -> int:
 
 
 def cc_eval_full(key: bytes, log_n: int) -> bytes:
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    lib = _require()
     kb = np.frombuffer(bytes(key), dtype=np.uint8)
     out = np.empty(int(lib.dpfn_cc_output_len(log_n)), np.uint8)
     rc = lib.dpfn_cc_eval_full(_u8ptr(kb), len(kb), log_n, _u8ptr(out), out.size)
@@ -250,9 +248,7 @@ def cc_eval_full(key: bytes, log_n: int) -> bytes:
 
 
 def cc_eval_full_batch(keys: list[bytes], log_n: int) -> np.ndarray:
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    lib = _require()
     klen = int(lib.dpfn_cc_key_len(log_n))
     arr = np.frombuffer(b"".join(keys), dtype=np.uint8)
     if arr.size != klen * len(keys):
@@ -266,9 +262,7 @@ def cc_eval_full_batch(keys: list[bytes], log_n: int) -> np.ndarray:
 
 
 def eval_points_batch(keys: list[bytes], xs: np.ndarray, log_n: int) -> np.ndarray:
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    lib = _require()
     klen = int(lib.dpfn_key_len(log_n))
     arr = np.frombuffer(b"".join(keys), dtype=np.uint8)
     if arr.size != klen * len(keys):
@@ -294,9 +288,7 @@ def _points_batch_packed(
     """Shared driver for the three packed batch entries -> uint8 rows
     [K, ceil(Q/8)], LSB-first (the core/bitpack wire contract; the bytes
     are the like-for-like baseline of the accelerated packed routes)."""
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    lib = _require()
     klen = int(getattr(lib, key_len_fn)(log_n))
     arr = np.frombuffer(b"".join(keys), dtype=np.uint8)
     if arr.size != klen * len(keys):
@@ -329,9 +321,7 @@ def eval_points_batch_packed(
 def cc_eval_points_batch(keys: list[bytes], xs: np.ndarray, log_n: int) -> np.ndarray:
     """Fast-profile batched pointwise evaluation (mirror of
     ``eval_points_batch`` over the ChaCha key layout)."""
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    lib = _require()
     klen = int(lib.dpfn_cc_key_len(log_n))
     arr = np.frombuffer(b"".join(keys), dtype=np.uint8)
     if arr.size != klen * len(keys):
@@ -370,9 +360,7 @@ def dcf_gen(
 ) -> tuple[bytes, bytes]:
     """Native DCF Gen for one gate ``1{x < alpha}`` (key layout:
     models/dcf.py — seed | t | nu*(sCW|tL|tR|VCW) | FVCW)."""
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    lib = _require()
     if rng is None:
         seeds = np.frombuffer(os.urandom(32), dtype=np.uint8).copy()
     else:
@@ -390,9 +378,7 @@ def dcf_gen(
 def dcf_eval_points_batch(keys: list[bytes], xs: np.ndarray, log_n: int) -> np.ndarray:
     """Native DCF comparison walk: keys (one per gate) evaluated at xs
     uint64[K, Q] -> uint8[K, Q] shares."""
-    lib = _load()
-    if lib is None:
-        raise RuntimeError(f"native backend unavailable: {_load_error}")
+    lib = _require()
     klen = int(lib.dpfn_dcf_key_len(log_n))
     arr = np.frombuffer(b"".join(keys), dtype=np.uint8)
     if arr.size != klen * len(keys):

@@ -238,6 +238,10 @@ class CircuitBreaker:
                         target=self._probe_loop, daemon=True
                     )
         if start_probe:
+            # start_probe is true only on the trip that just assigned
+            # _probe_thread under the lock, and the is_alive() guard
+            # keeps other trips from replacing it until this one exits.
+            # lock-free-ok: only the assigning trip reaches this start()
             self._probe_thread.start()
 
     def _probe_loop(self) -> None:

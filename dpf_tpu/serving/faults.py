@@ -242,12 +242,17 @@ def clear() -> None:
 
 
 def active() -> FaultPlan | None:
+    # install/clear swap the whole plan object under _PLAN_LOCK.
+    # lock-free-ok: atomic reference read
     return _PLAN
 
 
 def fire(site: str) -> None:
     """The instrumented seams call this; a no-op (one attribute read)
     when no plan is installed."""
+    # The instrumented seams are hot paths: one atomic reference read,
+    # then work against the captured plan object.
+    # lock-free-ok: atomic reference read on the request path
     plan = _PLAN
     if plan is not None:
         plan.fire(site)
@@ -263,6 +268,7 @@ class injected:
         self.plan: FaultPlan | None = None
 
     def __enter__(self) -> FaultPlan:
+        # lock-free-ok: test-only save/restore; atomic reference read
         self._prev = _PLAN
         self.plan = install(self.spec)
         return self.plan

@@ -255,6 +255,10 @@ class _StreamBody(handlers.BodyReader):
 
     # -- frame-reader side --------------------------------------------------
     def fill_from(self, sock: socket.socket, n: int) -> None:
+        # Only the connection's frame reader advances ``filled``, and
+        # the recv must stay OUTSIDE the condition so a slow uploader
+        # never blocks the handler draining already-filled bytes.
+        # lock-free-ok: single-writer read of its own last write
         _recv_exact_into(sock, self.mv[self.filled : self.filled + n])
         with self._cond:
             self.filled += n

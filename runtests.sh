@@ -8,16 +8,21 @@
 #       excluded by the default -m; append your own -m to override, e.g.
 #       `./runtests.sh -m slow` for the fused acceptance sweep, or
 #       `./runtests.sh -m ''` for absolutely everything)
-#   ./runtests.sh --lint                 static-analysis lane: the eight
+#   ./runtests.sh --lint                 static-analysis lane: the nine
 #       repo-native passes (knob registry incl. unused-knob detection,
 #       secret hygiene, host-sync, pallas/jit discipline, test-suite
-#       wiring discipline, tuned-defaults TUNED.json validation, the
-#       oblivious-trace jaxpr verifier with its certificate drift check,
-#       and the perf-contract verifier with its
-#       collective/donation/dispatch budgets — one shared trace cache, so
-#       each route traces once) + docs/KNOBS.md drift + mypy typed-core
-#       and Go vet/fmt when those toolchains exist —
-#       scripts/lint_all.sh, hermetic, no TPU.
+#       wiring discipline, tuned-defaults TUNED.json validation,
+#       lock-discipline — the declared-lock registry, lock-order graph,
+#       guarded-field inference, and held-across-blocking rules over
+#       the whole serving plane — the oblivious-trace jaxpr verifier
+#       with its certificate drift check, and the perf-contract
+#       verifier with its collective/donation/dispatch budgets — one
+#       shared trace cache, so each route traces once) + the
+#       concurrency suite (tests/test_concurrency.py: every rule fires
+#       on its seeded fixture, and the deterministic interleaving
+#       harness reproduces seeded deadlocks/torn reads byte-for-byte)
+#       + docs/KNOBS.md drift + mypy typed-core and Go vet/fmt when
+#       those toolchains exist — scripts/lint_all.sh, hermetic, no TPU.
 #   ./runtests.sh --fast [pytest args]   kernel differential smoke lane
 #       (now incl. the protocol-applications layer, tests/test_apps.py —
 #       heavy-hitters recovery + the 10^5-key plan-cached acceptance run,
@@ -73,6 +78,10 @@
 #       warmup, breaker-open fallback to single-device, the mesh
 #       stats/metrics surfaces) plus the sharded-evaluator
 #       differentials (tests/test_sharding.py).
+# Hang watchdog (tests/conftest.py): dump all thread stacks every N s
+# of no progress.  The tier-1 and --faults lanes arm it by default;
+# any lane honors an explicit caller value.
+HANG_DUMP="${PYTEST_HANG_DUMP_S:-}"
 if [ "${1:-}" = "--lint" ]; then
   exec "$(dirname "$0")/scripts/lint_all.sh"
 elif [ "${1:-}" = "--mesh" ]; then
@@ -84,6 +93,9 @@ elif [ "${1:-}" = "--tune" ]; then
   set -- tests/test_tune.py -q -m 'not slow' "$@"
 elif [ "${1:-}" = "--faults" ]; then
   shift
+  # Fault lane is the hang-prone one (injected latencies, breaker
+  # cooldowns, threaded stress): arm the watchdog on a short fuse.
+  HANG_DUMP="${PYTEST_HANG_DUMP_S:-120}"
   set -- tests/test_load_survival.py tests/test_serving_stress.py \
       -q -m 'not slow' "$@"
 elif [ "${1:-}" = "--fast" ]; then
@@ -95,13 +107,18 @@ elif [ "${1:-}" = "--fast" ]; then
       tests/test_oblivious.py tests/test_perf_contracts.py \
       tests/test_apps.py tests/test_hh_state.py tests/test_pir_serving.py \
       tests/test_wire2.py tests/test_gen_device.py \
+      tests/test_concurrency.py \
       -q -m 'not slow' "$@"
 else
   # -m is last-wins in pytest, so a caller-supplied -m overrides ours.
+  # Tier-1 arms the conftest hang watchdog: a wedged threaded test
+  # dumps every thread's stack before the outer timeout kills the run.
+  HANG_DUMP="${PYTEST_HANG_DUMP_S:-300}"
   set -- tests/ -q -m 'not slow' "$@"
 fi
 exec env -u PALLAS_AXON_POOL_IPS \
     -u PALLAS_AXON_REMOTE_COMPILE -u PALLAS_AXON_TPU_GEN \
+    PYTEST_HANG_DUMP_S="${HANG_DUMP:-}" \
     JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}" \
     python -m pytest "$@"

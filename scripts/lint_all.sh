@@ -2,21 +2,26 @@
 # One-command static-analysis gate (hermetic: CPU jax, no TPU, no axon
 # tunnel — safe in CI and on laptops).  Runs:
 #
-#   1. python -m dpf_tpu.analysis      the eight repo-native passes
+#   1. python -m dpf_tpu.analysis      the nine repo-native passes
 #      (knob-registry incl. unused-knob detection, secret-hygiene,
 #      host-sync, pallas-jit, test-discipline, tuned-defaults (the
 #      committed docs/TUNED.json autotuner output vs the schema/registry
-#      contract), the oblivious-trace jaxpr verifier with its
-#      certificate drift check, and the perf-contract verifier —
-#      collective/donation/dispatch budgets over the SAME route traces
-#      via the shared trace cache)
-#   2. --check-knobs-doc               docs/KNOBS.md drift vs the registry
-#   3. mypy --strict (mypy.ini)        dpf_tpu/core + dpf_tpu/analysis
+#      contract), lock-discipline (declared-lock registry, lock-order
+#      graph, guarded-field inference, held-across-blocking — the
+#      serving plane's concurrency contract), the oblivious-trace jaxpr
+#      verifier with its certificate drift check, and the perf-contract
+#      verifier — collective/donation/dispatch budgets over the SAME
+#      route traces via the shared trace cache)
+#   2. tests/test_concurrency.py       the lock-discipline fixture fires
+#      every rule + the deterministic interleaving harness reproduces
+#      its seeded deadlock/torn-read byte-for-byte (also in --fast)
+#   3. --check-knobs-doc               docs/KNOBS.md drift vs the registry
+#   4. mypy --strict (mypy.ini)        dpf_tpu/core + dpf_tpu/analysis
 #      (skipped with a notice when no mypy is installed)
-#   4. gofmt -l / go vet               bridge/go hygiene (skipped with a
-#      notice when no Go toolchain is installed; bridge/go/conformance.sh
-#      additionally runs staticcheck + `go test -race` against a live
-#      sidecar)
+#   5. gofmt -l / go vet               bridge/go hygiene (incl. the
+#      copylocks checker) (skipped with a notice when no Go toolchain is
+#      installed; bridge/go/conformance.sh additionally runs staticcheck
+#      + `go test -race` against a live sidecar)
 #
 # Exits nonzero on ANY finding.  Wired into `./runtests.sh --lint`.
 set -e
@@ -37,6 +42,8 @@ run_py() {
 status=0
 
 run_py -m dpf_tpu.analysis || status=1
+run_py -m pytest tests/test_concurrency.py -q -m 'not slow' \
+    -p no:cacheprovider || status=1
 run_py -m dpf_tpu.analysis --check-knobs-doc || status=1
 
 # Gate on the module, not a PATH binary: the lane runs `python -m mypy`,

@@ -6,6 +6,7 @@ recipe lives in ``_hermetic.py`` (shared with ``__graft_entry__`` and
 ``runtests.sh``).
 """
 
+import faulthandler
 import os
 import sys
 
@@ -14,6 +15,26 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from _hermetic import apply_hermetic_cpu_env
 
 apply_hermetic_cpu_env(8)
+
+
+def pytest_sessionstart(session):
+    """Arm a hang watchdog when the lane asks for one.
+
+    ``PYTEST_HANG_DUMP_S=N`` (runtests.sh sets it for the tier-1 and
+    --faults lanes) makes faulthandler dump EVERY thread's stack to
+    stderr each N seconds of no completion — so when a threaded serving
+    test wedges under the outer ``timeout``, the log shows who holds
+    what lock instead of a bare SIGKILL.  Not a knob: test-harness
+    plumbing, deliberately outside the DPF_TPU_ namespace."""
+    secs = os.environ.get("PYTEST_HANG_DUMP_S", "")
+    if secs:
+        faulthandler.dump_traceback_later(
+            float(secs), repeat=True, exit=False
+        )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    faulthandler.cancel_dump_traceback_later()
 
 
 def pytest_collection_modifyitems(config, items):

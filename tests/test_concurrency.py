@@ -1,0 +1,468 @@
+"""Concurrency contract suite: the ``lock-discipline`` analysis pass and
+the deterministic interleaving harness (``analysis/concurrency/``).
+
+Registered in the ``runtests.sh --lint`` lane (scripts/lint_all.sh runs
+it alongside the passes) AND importable standalone.  Four layers:
+
+  * the seeded fixture (``analysis/fixtures/bad_locks.py``) fires every
+    rule — undeclared lock, order inversion + cycle, torn counter
+    (unguarded read AND write), lock held across dispatch / socket recv;
+  * the real tree is clean (asserted by test_analysis.py's
+    ``test_real_tree_clean``, which auto-includes this pass);
+  * the deterministic scheduler reproduces a seeded deadlock and a
+    seeded torn read BYTE-FOR-BYTE across repeated runs — the property
+    that makes a concurrency repro attachable to a bug report;
+  * real serving-plane components survive scripted interleavings:
+    breaker trip/re-warm and SessionCache eviction-vs-eval under the
+    scheduler, batcher lane and the wire2 stream table under
+    switch-interval stress.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dpf_tpu.analysis import PASSES, get_pass
+from dpf_tpu.analysis.common import repo_root
+from dpf_tpu.analysis.concurrency import (
+    FIXTURE_LOCKS,
+    LOCKS,
+    DeadlockDetected,
+    DetScheduler,
+    stress_switch_interval,
+)
+from dpf_tpu.analysis.fixtures import bad_locks as bl
+
+ROOT = repo_root()
+FIXTURE = "dpf_tpu/analysis/fixtures/bad_locks.py"
+
+pytestmark = pytest.mark.filterwarnings("ignore::RuntimeWarning")
+
+
+def _fixture_findings():
+    return get_pass("lock-discipline")(ROOT, files=[FIXTURE])
+
+
+# ---------------------------------------------------------------------------
+# Static pass: every rule fires on the seeded fixture
+# ---------------------------------------------------------------------------
+
+
+def test_pass_is_registered():
+    assert "lock-discipline" in PASSES
+
+
+def test_fixture_fires_every_rule():
+    """bad_locks.py seeds one violation per rule; the pass must find all
+    eight, at the seeded lines, with actionable messages."""
+    findings = _fixture_findings()
+    msgs = {(f.line, f.message) for f in findings}
+    assert len(findings) == 8, sorted(msgs)
+
+    def fired(line, *needles):
+        hits = [m for ln, m in msgs if ln == line and all(n in m for n in needles)]
+        assert hits, (line, needles, sorted(msgs))
+
+    # R1: undeclared lock creation.
+    fired(29, "undeclared", "_UNDECLARED", "registry.py")
+    # R2: acquisition-order inversion + the cycle it closes.
+    fired(47, "inversion", "BadOrder._a", "rank 10", "rank 20")
+    fired(47, "lock-order cycle", "BadOrder._a", "BadOrder._b")
+    # R3: torn counter — unguarded read, unguarded write, unguarded read.
+    fired(66, "TornCounter.count", "read lock-free")
+    fired(67, "TornCounter.count", "written lock-free")
+    fired(70, "TornCounter.count", "read lock-free")
+    # R4: lock held across blocking calls.
+    fired(81, "held across device dispatch", "plans.run_points")
+    fired(94, "held across socket recv")
+
+
+def test_fixture_rules_carry_sanction_hints():
+    """Every finding tells the reader HOW to sanction a deliberate
+    exception (the pragma tags) or where to declare (the registry)."""
+    for f in _fixture_findings():
+        if "lock-order cycle" in f.message:
+            continue  # derived from the inversions, which carry the hint
+        assert (
+            "lock-free-ok" in f.message
+            or "lock-held-ok" in f.message
+            or "registry.py" in f.message
+        ), f.message
+
+
+def test_registry_is_well_formed():
+    """Declared locks carry valid kinds and ranks; rank 0 is reserved
+    for rankless sync objects (Events) that never nest."""
+    kinds = {"lock", "rlock", "cond", "event"}
+    for table in (LOCKS, FIXTURE_LOCKS):
+        for site, decl in table.items():
+            assert decl.kind in kinds, site
+            assert decl.rank >= 0, site
+            assert decl.owner, site
+            if decl.kind == "event":
+                assert decl.rank == 0, f"{site}: Events are rankless"
+    # Group members share one rank (interchangeable leaves).
+    by_group: dict[str, set[int]] = {}
+    for site, decl in LOCKS.items():
+        if decl.group:
+            by_group.setdefault(decl.group, set()).add(decl.rank)
+    for group, ranks in by_group.items():
+        assert len(ranks) == 1, (group, ranks)
+
+
+# ---------------------------------------------------------------------------
+# Deterministic scheduler: seeded deadlock, byte-identical across runs
+# ---------------------------------------------------------------------------
+
+_DEADLOCK_SEED = 4  # ab/ba interleaving under this seed provably deadlocks
+_CLEAN_SEED = 0  # and under this one provably completes
+
+
+def _deadlock_run(seed):
+    """One scheduled run of the fixture's BadOrder inversion; returns
+    the trace (completed) or the DeadlockDetected (wedged)."""
+    bo = bl.BadOrder()
+    sched = DetScheduler(seed, trace_files=(bl.__file__,))
+    sched.name_lock(bo._a, "A")
+    sched.name_lock(bo._b, "B")
+    sched.spawn(bo.forward, name="fwd")
+    sched.spawn(bo.inverted, name="inv")
+    try:
+        return sched.run()
+    except DeadlockDetected as e:
+        return e
+
+
+def test_seeded_deadlock_reproduces_identically():
+    """THE acceptance property: three consecutive runs of the seeded
+    deadlock produce the identical trace, the identical cycle, and the
+    identical diagnosis — a deadlock is a repro, not a flake."""
+    runs = [_deadlock_run(_DEADLOCK_SEED) for _ in range(3)]
+    for r in runs:
+        assert isinstance(r, DeadlockDetected), r
+        assert set(r.cycle) == {"fwd", "inv"}
+        assert "fwd" in str(r) and "inv" in str(r)
+    assert runs[0].trace == runs[1].trace == runs[2].trace
+    # The trace tells the whole story: both threads got their first
+    # lock, then each wanted the other's.
+    t = runs[0].trace
+    assert "fwd acquired A" in t and "inv acquired B" in t
+    assert t[-1].startswith("deadlock:")
+
+
+def test_clean_seed_completes_identically():
+    """A seed that serializes the two critical sections completes — and
+    does so with the same trace every time."""
+    runs = [_deadlock_run(_CLEAN_SEED) for _ in range(3)]
+    for r in runs:
+        assert isinstance(r, list), r
+        assert "fwd done" in r and "inv done" in r
+    assert runs[0] == runs[1] == runs[2]
+
+
+def test_different_seeds_explore_different_interleavings():
+    """The seed is the only choice point: across a small seed range the
+    harness finds BOTH outcomes (deadlock and completion)."""
+    outcomes = {
+        isinstance(_deadlock_run(s), DeadlockDetected) for s in range(8)
+    }
+    assert outcomes == {True, False}
+
+
+# ---------------------------------------------------------------------------
+# Deterministic scheduler: seeded torn read
+# ---------------------------------------------------------------------------
+
+_TORN_SEED = 0  # preempts between TornCounter's read and write-back
+
+
+def _torn_run(seed, bump):
+    tc = bl.TornCounter()
+    sched = DetScheduler(
+        seed, trace_files=(bl.__file__,), preempt_every=(1, 4)
+    )
+    sched.name_lock(tc._lock, "C")
+    target = tc.torn_bump if bump == "torn" else tc.bump
+    sched.spawn(target, name="w0")
+    sched.spawn(target, name="w1")
+    sched.run()
+    return tc.read(), None
+
+
+def test_seeded_torn_read_loses_an_update_deterministically():
+    """Under the seeded preemption schedule both workers read 0 before
+    either writes back: the torn counter ends at 1, not 2 — and the
+    loss reproduces identically across three runs."""
+    results = [_torn_run(_TORN_SEED, "torn")[0] for _ in range(3)]
+    assert results == [1, 1, 1]
+
+
+def test_locked_bump_immune_to_every_schedule():
+    """The locked bump() survives the same adversarial schedules: no
+    seed in the probe range can tear it."""
+    for seed in range(6):
+        count, _ = _torn_run(seed, "locked")
+        assert count == 2, seed
+
+
+# ---------------------------------------------------------------------------
+# Scenario: circuit breaker trip and re-warm under scripted interleavings
+# ---------------------------------------------------------------------------
+
+
+def _breaker_mod_file():
+    from dpf_tpu.serving import breaker as breaker_mod
+
+    return breaker_mod.__file__
+
+
+def test_breaker_trip_under_scheduler():
+    """Three concurrent dispatch failures against a threshold-2 breaker:
+    whatever the interleaving, the trip count is exactly 1, every caller
+    gets an error, and the counters reconcile — no lost update, no
+    double trip."""
+    from dpf_tpu.serving.breaker import OPEN, CircuitBreaker
+    from dpf_tpu.serving.errors import OverloadedError
+
+    for seed in range(4):
+        br = CircuitBreaker(
+            threshold=2, cooldown_ms=60_000, retries=0, backoff_ms=0,
+            probe=None, probe_enabled=False, lock=threading.Lock(),
+        )
+
+        def failing():
+            raise RuntimeError("UNAVAILABLE: scripted device failure")
+
+        outcomes: list[str] = []
+
+        def worker():
+            try:
+                br.call(failing)
+            except OverloadedError:
+                outcomes.append("fast_fail")
+            except RuntimeError:
+                outcomes.append("transient")
+
+        sched = DetScheduler(seed, trace_files=(_breaker_mod_file(),))
+        sched.name_lock(br._lock, "BRK")
+        for _ in range(3):
+            sched.spawn(worker)
+        sched.run()
+
+        stats = br.stats()
+        assert br.state == OPEN, (seed, stats)
+        assert len(outcomes) == 3, (seed, outcomes)
+        assert stats["trips"] == 1, (seed, stats)
+        assert outcomes.count("transient") == stats["transient_failures"]
+        assert outcomes.count("fast_fail") == stats["fast_fails"]
+        assert stats["transient_failures"] >= 2, (seed, stats)
+
+
+def test_breaker_rewarm_closes_after_cooldown():
+    """The re-warm half of the scenario: cooldown expiry moves the
+    breaker to half-open, one successful trial closes it, and the
+    recovery is counted."""
+    from dpf_tpu.serving.breaker import CLOSED, HALF_OPEN, CircuitBreaker
+
+    br = CircuitBreaker(
+        threshold=1, cooldown_ms=30, retries=0, backoff_ms=0,
+        probe=None, probe_enabled=False, lock=threading.Lock(),
+    )
+    with pytest.raises(RuntimeError):
+        br.call(lambda: (_ for _ in ()).throw(
+            RuntimeError("UNAVAILABLE: scripted device failure")
+        ))
+    assert br.degraded()
+    deadline = time.monotonic() + 5.0
+    while br.state != HALF_OPEN:
+        assert time.monotonic() < deadline, br.stats()
+        time.sleep(0.01)
+    assert br.call(lambda: "warm") == "warm"
+    assert br.state == CLOSED
+    assert br.stats()["recoveries"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Scenario: SessionCache eviction racing lookups under the scheduler
+# ---------------------------------------------------------------------------
+
+
+class _StubState:
+    """Duck-typed FrontierState for cache bookkeeping: the cache only
+    reads profile / log_n / nbytes."""
+
+    profile = "compat"
+    log_n = 10
+    nbytes = 1024
+
+
+def test_session_cache_eviction_vs_eval_under_scheduler():
+    """An evictor and two lookup workers race on one session id under
+    scripted interleavings: every lookup either hits the live session
+    or misses cleanly (never a torn _Session), and hits+misses always
+    equals the number of lookups."""
+    from dpf_tpu.apps import hh_state
+    from dpf_tpu.apps.hh_state import SessionCache
+
+    for seed in range(4):
+        cache = SessionCache(lock=threading.RLock())
+        cache.store("sid", "digest", _StubState())
+        results: list[str] = []
+
+        def looker():
+            for _ in range(3):
+                s = cache.lookup("sid", "digest", "compat", 10)
+                results.append("hit" if s is not None else "miss")
+
+        def evictor():
+            cache.evict("sid")
+            cache.store("sid", "digest", _StubState())
+
+        sched = DetScheduler(
+            seed, trace_files=(hh_state.__file__,)
+        )
+        sched.name_lock(cache._lock, "HH")
+        sched.spawn(looker, name="look0")
+        sched.spawn(looker, name="look1")
+        sched.spawn(evictor, name="evict")
+        sched.run()
+
+        assert len(results) == 6, (seed, results)
+        st = cache.stats()
+        assert st["hits"] == results.count("hit"), (seed, st)
+        assert st["misses"] == results.count("miss") + 0, (seed, st)
+        assert st["evicted"] == 1, (seed, st)
+        # The re-stored session is live and consistent afterwards.
+        assert cache.lookup("sid", "digest", "compat", 10) is not None
+
+
+# ---------------------------------------------------------------------------
+# Scenario: batcher lane under switch-interval stress
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_lane_rows_uncrossed_under_stress():
+    """The micro-batcher's submit/coalesce/slice seam under an
+    aggressive thread switch interval (the batcher's leader handoff
+    runs on Event timing, so it gets the stress harness, not the
+    scripted scheduler): each submitter must get rows derived from ITS
+    key id, never a lane-mate's."""
+    from dpf_tpu.core import bitpack
+    from dpf_tpu.serving import Batcher
+    from dpf_tpu.serving.batcher import PointsWork
+
+    def fake_dispatch(items):
+        out = []
+        for it in items:
+            k, q = it.xs.shape
+            words = np.zeros((k, bitpack.packed_words(q)), np.uint32)
+            for r in range(k):
+                words[r] = np.uint32(it.kb.ids[r] * 1000) + np.arange(
+                    bitpack.packed_words(q), dtype=np.uint32
+                )
+            out.append(words)
+        return out
+
+    class _Kb:
+        def __init__(self, ids):
+            self.ids = list(ids)
+            self.log_n = 10
+
+    batcher = Batcher(window_us=2000, max_keys=64)
+    n, q = 6, 8
+    errors: list[BaseException] = []
+    barrier = threading.Barrier(n)
+
+    def worker(i):
+        try:
+            barrier.wait(timeout=30)
+            for r in range(10):
+                key_id = i * 100 + r
+                work = PointsWork(
+                    "points", "compat", _Kb([key_id]),
+                    np.zeros((1, q), np.uint64),
+                )
+                rows = batcher.submit(work, fake_dispatch)
+                expect = np.uint32(key_id * 1000) + np.arange(
+                    bitpack.packed_words(q), dtype=np.uint32
+                )
+                np.testing.assert_array_equal(rows[0], expect)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    with stress_switch_interval(1e-5):
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "batcher worker hung"
+    if errors:
+        raise errors[0]
+
+
+# ---------------------------------------------------------------------------
+# Scenario: wire2 stream table under switch-interval stress
+# ---------------------------------------------------------------------------
+
+
+def test_wire2_stream_table_drains_under_stress(monkeypatch):
+    """Concurrent generate + ping traffic on ONE wire2 connection under
+    an aggressive switch interval: every reply is correct for ITS
+    stream, and the client's pending-stream table drains to empty (a
+    leaked entry = a reply routed to the wrong waiter or dropped)."""
+    from dpf_tpu import server as srv_mod
+    from dpf_tpu.core import spec
+    from dpf_tpu.serving.wire2 import Wire2Client
+
+    monkeypatch.setenv("DPF_TPU_WIRE2", "on")
+    monkeypatch.setenv("DPF_TPU_WIRE2_PORT", "0")
+    srv_mod.reset_serving_state()
+    s = srv_mod.serve(port=0)
+    try:
+        host, port = s.wire2.address[0], s.wire2.address[1]
+        log_n = 8
+        kl = spec.key_len(log_n)
+        errors: list[BaseException] = []
+        barrier = threading.Barrier(3)
+
+        with Wire2Client(host, port) as w2:
+
+            def worker(i):
+                try:
+                    barrier.wait(timeout=30)
+                    for r in range(4):
+                        blob = w2.request(
+                            "/v1/gen",
+                            {"log_n": log_n, "alpha": i * 10 + r,
+                             "profile": "compat"},
+                        )
+                        # /v1/gen returns both parties' keys.
+                        assert len(blob) == 2 * kl, (i, r, len(blob))
+                        w2.ping()
+                except BaseException as e:  # noqa: BLE001 — surfaced below
+                    errors.append(e)
+
+            with stress_switch_interval(1e-5):
+                threads = [
+                    threading.Thread(target=worker, args=(i,))
+                    for i in range(3)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=120)
+            assert not any(t.is_alive() for t in threads), "wire2 hang"
+            if errors:
+                raise errors[0]
+            with w2._slock:
+                assert w2._streams == {}, "stream table leaked entries"
+    finally:
+        s.shutdown()
+        srv_mod.reset_serving_state()
