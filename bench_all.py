@@ -55,6 +55,7 @@ import time
 import numpy as np
 
 from dpf_tpu.analysis import LINT_SUITE_VERSION
+from dpf_tpu.analysis.contract import CONTRACT_VERSION
 from dpf_tpu.analysis.perf import PERF_CONTRACT_VERSION
 from dpf_tpu.analysis.trace import OBLIVIOUS_VERIFIER_VERSION
 from dpf_tpu.core import knobs
@@ -183,6 +184,10 @@ def _ledger_key(scale: str) -> dict:
         # (docs/PERF_CONTRACTS.md) pinned their collective/donation/
         # dispatch budgets — a budget change re-measures.
         "perf": PERF_CONTRACT_VERSION,
+        # ...and which cross-language surface contract (docs/
+        # CONTRACT.json) pinned the routes/frames/codes the measured
+        # clients spoke — a vocabulary change re-measures.
+        "contract": CONTRACT_VERSION,
         # Content digest of the tuned-defaults file: rows measured under
         # one TUNED.json generation must never replay under another
         # ("absent" when no file — also a distinct identity).

@@ -1,0 +1,256 @@
+// Command contract-dump emits the Go bridge's wire surface as JSON on
+// stdout: route consts, client paths, wire2 frame types/flags/sizes,
+// the connection preface, header names, the APIError code vocabulary,
+// and the wire2 pseudo-params.
+//
+// It is the go/ast twin of the Python regex fallback in
+// dpf_tpu/analysis/contract/go_extract.py — both emit the exact same
+// JSON shape, pinned against each other by the committed golden dump
+// (dpf_tpu/analysis/fixtures/bad_contract/go_dump_golden.json).  The
+// `contract` step of bridge/go/conformance.sh pipes this output into
+// `python -m dpf_tpu.analysis.contract --check-go-dump -`, which diffs
+// it against the committed docs/CONTRACT.json.
+//
+// Run from bridge/go:  go run ./cmd/contract-dump
+package main
+
+import (
+	"encoding/json"
+	"fmt"
+	"go/ast"
+	"go/parser"
+	"go/token"
+	"os"
+	"regexp"
+	"sort"
+	"strconv"
+	"strings"
+	"unicode"
+)
+
+var sourceFiles = []string{"dpftpu/client.go", "dpftpu/wire2.go"}
+
+type dump struct {
+	Routes      map[string]int `json:"routes"`
+	ClientPaths []string       `json:"client_paths"`
+	FrameTypes  map[string]int `json:"frame_types"`
+	Flags       map[string]int `json:"flags"`
+	HdrLen      int            `json:"hdr_len"`
+	RespHeadLen int            `json:"resp_head_len"`
+	DataChunk   int            `json:"data_chunk"`
+	Magic       string         `json:"magic"`
+	Headers     []string       `json:"headers"`
+	ErrorCodes  map[string]int `json:"error_codes"`
+	Params      []string       `json:"params"`
+}
+
+// camelToUpperSnake mirrors go_extract.camel_to_upper_snake:
+// RespData -> RESP_DATA, EndStream -> END_STREAM, Goaway -> GOAWAY.
+func camelToUpperSnake(s string) string {
+	r := []rune(s)
+	var b strings.Builder
+	for i, c := range r {
+		if i > 0 && unicode.IsUpper(c) {
+			prev := r[i-1]
+			boundary := unicode.IsLower(prev) || unicode.IsDigit(prev)
+			if !boundary && unicode.IsUpper(prev) && i+1 < len(r) {
+				boundary = unicode.IsLower(r[i+1])
+			}
+			if boundary {
+				b.WriteByte('_')
+			}
+		}
+		b.WriteRune(unicode.ToUpper(c))
+	}
+	return b.String()
+}
+
+// isUpperSuffix reports whether id is prefix followed by an upper-case
+// camel suffix — mirrors the fallback's `wire2T([A-Z]\w*)` patterns so
+// a future lower-camel const (wire2Timeout) cannot classify as a frame
+// type in one extractor and not the other.
+func isUpperSuffix(id, prefix string) bool {
+	if !strings.HasPrefix(id, prefix) || len(id) == len(prefix) {
+		return false
+	}
+	return unicode.IsUpper(rune(id[len(prefix)]))
+}
+
+// evalInt handles the two const-expression forms the bridge uses:
+// plain int literals and `1 << 20`-style shifts.
+func evalInt(e ast.Expr) (int, bool) {
+	switch v := e.(type) {
+	case *ast.BasicLit:
+		if v.Kind == token.INT {
+			n, err := strconv.Atoi(v.Value)
+			return n, err == nil
+		}
+	case *ast.BinaryExpr:
+		if v.Op == token.SHL {
+			l, lok := evalInt(v.X)
+			r, rok := evalInt(v.Y)
+			if lok && rok {
+				return l << r, true
+			}
+		}
+	case *ast.ParenExpr:
+		return evalInt(v.X)
+	}
+	return 0, false
+}
+
+func litByte(e ast.Expr) (byte, bool) {
+	if lit, ok := e.(*ast.BasicLit); ok {
+		switch lit.Kind {
+		case token.CHAR:
+			c, _, _, err := strconv.UnquoteChar(
+				strings.Trim(lit.Value, "'"), '\'')
+			return byte(c), err == nil
+		case token.INT:
+			n, err := strconv.Atoi(lit.Value)
+			return byte(n), err == nil
+		}
+	}
+	return 0, false
+}
+
+var (
+	pathRe  = regexp.MustCompile(`^(/v1/[a-z_/]+)(\?|$)`)
+	codeRe  = regexp.MustCompile(`"(\w+)"\s*\((\d+)`)
+	hdrRe   = regexp.MustCompile(`^(X-DPF-[\w-]+|Retry-After)$`)
+	paramRe = regexp.MustCompile(`^_\w+$`)
+)
+
+func sortedKeys(set map[string]bool) []string {
+	out := make([]string, 0, len(set))
+	for k := range set {
+		out = append(out, k)
+	}
+	sort.Strings(out)
+	return out
+}
+
+func main() {
+	d := dump{
+		Routes:     map[string]int{},
+		FrameTypes: map[string]int{},
+		Flags:      map[string]int{},
+		ErrorCodes: map[string]int{},
+	}
+	paths := map[string]bool{}
+	headers := map[string]bool{}
+	params := map[string]bool{}
+
+	fset := token.NewFileSet()
+	for _, file := range sourceFiles {
+		f, err := parser.ParseFile(fset, file, nil, parser.ParseComments)
+		if err != nil {
+			fmt.Fprintf(os.Stderr, "contract-dump: %v\n", err)
+			os.Exit(1)
+		}
+		ast.Inspect(f, func(n ast.Node) bool {
+			switch node := n.(type) {
+			case *ast.ValueSpec:
+				for i, name := range node.Names {
+					if i >= len(node.Values) {
+						continue
+					}
+					val, ok := evalInt(node.Values[i])
+					if !ok {
+						continue
+					}
+					id := name.Name
+					switch {
+					case strings.HasPrefix(id, "wire2Route"):
+						d.Routes[strings.TrimPrefix(id, "wire2Route")] = val
+					case isUpperSuffix(id, "wire2T"):
+						d.FrameTypes[camelToUpperSnake(
+							strings.TrimPrefix(id, "wire2T"))] = val
+					case isUpperSuffix(id, "wire2F"):
+						d.Flags[camelToUpperSnake(
+							strings.TrimPrefix(id, "wire2F"))] = val
+					case id == "wire2HdrLen":
+						d.HdrLen = val
+					case id == "wire2RespHead":
+						d.RespHeadLen = val
+					case id == "wire2DataChunk":
+						d.DataChunk = val
+					}
+				}
+				// var wire2Magic = []byte{'D', 'P', 'F', '2', 1, 0, 0, 0}
+				for i, name := range node.Names {
+					if name.Name != "wire2Magic" || i >= len(node.Values) {
+						continue
+					}
+					lit, ok := node.Values[i].(*ast.CompositeLit)
+					if !ok {
+						continue
+					}
+					raw := make([]byte, 0, len(lit.Elts))
+					for _, el := range lit.Elts {
+						if b, ok := litByte(el); ok {
+							raw = append(raw, b)
+						}
+					}
+					d.Magic = fmt.Sprintf("%x", raw)
+				}
+			case *ast.BasicLit:
+				if node.Kind != token.STRING {
+					return true
+				}
+				s, err := strconv.Unquote(node.Value)
+				if err != nil {
+					return true
+				}
+				if m := pathRe.FindStringSubmatch(s); m != nil {
+					paths[m[1]] = true
+				}
+				if hdrRe.MatchString(s) {
+					headers[s] = true
+				}
+			case *ast.CallExpr:
+				sel, ok := node.Fun.(*ast.SelectorExpr)
+				if !ok || sel.Sel.Name != "Set" || len(node.Args) == 0 {
+					return true
+				}
+				if lit, ok := node.Args[0].(*ast.BasicLit); ok &&
+					lit.Kind == token.STRING {
+					if s, err := strconv.Unquote(lit.Value); err == nil &&
+						paramRe.MatchString(s) {
+						params[s] = true
+					}
+				}
+			case *ast.GenDecl:
+				// The APIError doc comment is the Go side's statement
+				// of the error vocabulary.
+				if node.Tok != token.TYPE || node.Doc == nil {
+					return true
+				}
+				for _, spec := range node.Specs {
+					ts, ok := spec.(*ast.TypeSpec)
+					if !ok || ts.Name.Name != "APIError" {
+						continue
+					}
+					for _, m := range codeRe.FindAllStringSubmatch(
+						node.Doc.Text(), -1) {
+						status, err := strconv.Atoi(m[2])
+						if err == nil {
+							d.ErrorCodes[m[1]] = status
+						}
+					}
+				}
+			}
+			return true
+		})
+	}
+
+	d.ClientPaths = sortedKeys(paths)
+	d.Headers = sortedKeys(headers)
+	d.Params = sortedKeys(params)
+
+	enc := json.NewEncoder(os.Stdout)
+	if err := enc.Encode(d); err != nil {
+		fmt.Fprintf(os.Stderr, "contract-dump: %v\n", err)
+		os.Exit(1)
+	}
+}

@@ -1,5 +1,6 @@
 #!/bin/sh
-# One-command sidecar conformance run: gofmt -l + go vet, start the
+# One-command sidecar conformance run: gofmt -l + go vet + the
+# surface-contract dump (cmd/contract-dump vs docs/CONTRACT.json), start the
 # sidecar, run the Go suite under the RACE DETECTOR (dpftpu/client_test.go
 # — Gen/Eval/EvalFull XOR reconstruction, frozen golden vectors, packed +
 # unpacked wire formats, and the 16-goroutine pooled-Transport stress),
@@ -46,6 +47,17 @@ go vet -copylocks ./...
 # staticcheck makes the lane's verdict drift with whatever version a
 # machine happens to have — new checks appear, old ones retire, and the
 # same tree flips red/green across machines.
+# Surface contract: dump the Go bridge's wire surface with the go/ast
+# extractor (cmd/contract-dump) and diff it against the committed
+# docs/CONTRACT.json.  This is the toolchain-equipped twin of the
+# surface-contract lint pass — the Python side runs a regex fallback
+# when `go` is absent, so THIS step is where the real parser gets its
+# verdict recorded.  A drift here means a Go-side constant moved
+# without re-certification (python -m dpf_tpu.analysis --write-contract).
+go run ./cmd/contract-dump | \
+  PYTHONPATH="$(cd ../.. && pwd)" \
+  python -m dpf_tpu.analysis.contract --check-go-dump -
+
 STATICCHECK_PIN="2023.1.7"
 if command -v staticcheck >/dev/null 2>&1; then
   if ! staticcheck -version 2>/dev/null | grep -q "$STATICCHECK_PIN"; then

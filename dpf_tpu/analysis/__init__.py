@@ -1,7 +1,7 @@
 """Repo-native static analysis: the discipline the ROADMAP's production
 north star needs, checked on every commit for free.
 
-Seven file/AST-based passes plus two jaxpr-level passes over the whole
+Eight file/AST-based passes plus two jaxpr-level passes over the whole
 tree (one entrypoint: ``python -m dpf_tpu.analysis`` /
 ``scripts/lint_all.sh``; exits nonzero on any finding):
 
@@ -63,6 +63,19 @@ tree (one entrypoint: ``python -m dpf_tpu.analysis`` /
                   fresh against the current tunable-knob declarations
                   (a stale file fails soft at serving time by design —
                   CI is where it must fail hard).
+  surface-contract  the cross-language surface verifier
+                  (``analysis/contract/``): the route/route_id table,
+                  wire2 frame types + 12-byte header layout, the
+                  ``{code, detail}`` error vocabulary, the ``X-DPF-*``
+                  headers, the ``dpf_*`` metric names, and the
+                  ``dpfn_*`` native ABI extracted statically from the
+                  Python sidecar, the Go bridge (go/ast via
+                  ``bridge/go/cmd/contract-dump`` when a toolchain
+                  exists, a pinned regex fallback otherwise), and the
+                  C/ctypes pair — cross-checked against each other and
+                  against the committed ``docs/CONTRACT.json``
+                  (``--write-contract`` re-certifies intentional
+                  changes; same drift policy as OBLIVIOUS.md).
   perf-contract   the jaxpr-level performance-contract verifier
                   (``analysis/perf/``): the SAME route traces (shared
                   trace cache — each route traces once per lint run)
@@ -97,7 +110,10 @@ from __future__ import annotations
 # "5": the lock-discipline pass joined (whole-repo lock registry,
 # acquisition-order graph, guarded-field inference, held-across-blocking
 # — the serving plane's concurrency contract checked every commit).
-LINT_SUITE_VERSION = "5"
+# "6": the surface-contract pass joined (routes, wire2 frames, error
+# codes, headers, metrics, and the dpfn_* ABI cross-checked across the
+# Python/Go/C surfaces against the committed docs/CONTRACT.json).
+LINT_SUITE_VERSION = "6"
 
 # name -> (module, callable); imported lazily so `import dpf_tpu.analysis`
 # stays cheap for the bench harness's version stamp.  Passes run in
@@ -111,6 +127,7 @@ PASSES = {
     "test-discipline": ("dpf_tpu.analysis.test_discipline_pass", "run"),
     "lock-discipline": ("dpf_tpu.analysis.concurrency.lock_pass", "run"),
     "tuned-defaults": ("dpf_tpu.analysis.tuned_pass", "run"),
+    "surface-contract": ("dpf_tpu.analysis.contract.contract_pass", "run"),
     "oblivious-trace": ("dpf_tpu.analysis.trace_pass", "run"),
     "perf-contract": ("dpf_tpu.analysis.perf_pass", "run"),
 }

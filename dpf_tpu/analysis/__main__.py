@@ -9,6 +9,9 @@
                                                    # docs/OBLIVIOUS.md + json
     python -m dpf_tpu.analysis --write-perf-contracts  # re-certify the
                                                    # performance contracts
+    python -m dpf_tpu.analysis --write-contract    # re-certify the
+                                                   # cross-language
+                                                   # surface contract
 
 Exits 0 on a clean tree, 1 on any finding (CI contract:
 ``scripts/lint_all.sh`` / ``runtests.sh --lint``).
@@ -81,6 +84,13 @@ def main(argv=None) -> int:
         "every production route and donation site and regenerate "
         "docs/PERF_CONTRACTS.md + docs/perf_contracts.json (fails "
         "without writing when any budget is violated)",
+    )
+    ap.add_argument(
+        "--write-contract", action="store_true",
+        help="re-certify the cross-language surface contract: extract "
+        "the Python/Go/C surfaces and regenerate docs/CONTRACT.json + "
+        "docs/CONTRACT.md (fails without writing when the surfaces "
+        "disagree with each other)",
     )
     args = ap.parse_args(argv)
     root = os.path.abspath(args.root) if args.root else repo_root()
@@ -208,6 +218,30 @@ def main(argv=None) -> int:
                     f"(needs >= {s.min_devices} devices, have fewer)"
                 )
         for rel in perf_certify.write(root, certs):
+            print(f"wrote {rel}")
+        return 0
+
+    if args.write_contract:
+        if os.path.realpath(root) != os.path.realpath(repo_root()):
+            # Same guard as the other re-certifiers: the Go fallback
+            # and ctypes extraction describe THIS checkout's sources;
+            # writing their contract into a foreign --root would attest
+            # the wrong tree.
+            print(
+                "--write-contract certifies the checkout it is imported "
+                "from; run it from the target tree (foreign --root "
+                f"{root!r} refused)",
+                file=sys.stderr,
+            )
+            return 1
+        from .contract import contract_pass
+
+        try:
+            wrote = contract_pass.write(root)
+        except ValueError as e:
+            print(e, file=sys.stderr)
+            return 1
+        for rel in wrote:
             print(f"wrote {rel}")
         return 0
 

@@ -1,0 +1,43 @@
+"""The cross-language surface contract (``surface-contract`` pass).
+
+The system spans three language surfaces that must stay byte-compatible
+with each other and with the reference: the Python sidecar (HTTP/1.1 +
+wire2 fronts), the Go bridge (``bridge/go/dpftpu``), and the native CPU
+baseline (``native/dpf_native.cc`` behind the ctypes wiring in
+``backends/cpu_native.py``).  Every shared constant — the route_id
+table, the wire2 frame types and 12-byte header layout, the
+``{code, detail}`` error vocabulary, the ``X-DPF-*`` headers, the
+``dpf_*`` metric names, and the ``dpfn_*`` ABI — used to be an
+independent hand-written literal on each side; a one-character drift in
+any mirror shipped silently until a conformance run happened to
+exercise it.
+
+This package extracts each surface STATICALLY:
+
+  ``py_extract``   AST over serving/handlers.py, serving/wire2.py,
+                   serving/errors.py, serving/headers.py, and
+                   obs/metrics.py (routes, frames, codes, headers,
+                   metrics).
+  ``go_extract``   ``bridge/go/cmd/contract-dump`` (go/ast, JSON on
+                   stdout) when a Go toolchain exists; a regex fallback
+                   over bridge/go/dpftpu/*.go otherwise — same output
+                   shape, pinned against each other by the committed
+                   golden dump (tests/test_contract.py).
+  ``c_abi``        the ``extern "C" dpfn_*`` declarations in
+                   native/dpf_native.cc diffed against the ctypes
+                   argtypes/restype wiring in backends/cpu_native.py.
+
+All three project into ONE canonical committed ``docs/CONTRACT.json``
+(+ human ``docs/CONTRACT.md``) with the OBLIVIOUS.md drift policy: any
+mismatch BETWEEN surfaces, or between the surfaces and the committed
+contract, is a finding; an intentional change re-certifies with
+``python -m dpf_tpu.analysis --write-contract``.  Semantics and caveats:
+docs/DESIGN.md §22.
+"""
+
+from __future__ import annotations
+
+# Bump when the contract schema or extraction rules change materially
+# (bench ledgers keyed on it re-measure — a contract-discipline change
+# alters what the measured tree was allowed to serve).
+CONTRACT_VERSION = "1"

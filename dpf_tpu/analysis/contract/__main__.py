@@ -1,0 +1,108 @@
+"""``python -m dpf_tpu.analysis.contract`` — contract utilities.
+
+    python -m dpf_tpu.analysis.contract                  # run the pass
+    python -m dpf_tpu.analysis.contract --check-go-dump -   # diff a
+        contract-dump JSON (stdin, or a file path) against the committed
+        docs/CONTRACT.json — the `contract` step of
+        bridge/go/conformance.sh, where the REAL go/ast extractor runs
+        instead of the Python regex fallback.
+
+Exits 0 when coherent, 1 on any drift.  Re-certification lives on the
+suite entrypoint: ``python -m dpf_tpu.analysis --write-contract``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any
+
+from ..common import repo_root
+from . import contract_pass
+
+
+def _py_view(contract: dict[str, Any]) -> dict[str, Any]:
+    """The committed contract reshaped as the Python-surface dict the
+    Go cross-check consumes — lets one checker serve both the lint pass
+    (tree vs Go) and conformance.sh (contract vs contract-dump)."""
+    w2 = contract["wire2"]
+    return {
+        "routes": {p: r["id"] for p, r in contract["routes"].items()},
+        "wire2": {
+            "frame_types": w2["frame_types"],
+            "flags": w2["flags"],
+            "hdr_len": w2["hdr_len"],
+            "resp_len": w2["resp_head_len"],
+            "data_chunk": w2["data_chunk"],
+            "magic": w2["magic"],
+        },
+        "error_codes": contract["error_codes"],
+        "headers": contract["headers"],
+        "params": contract["wire2_params"],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dpf_tpu.analysis.contract", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "--check-go-dump", metavar="FILE", default=None,
+        help="diff a contract-dump JSON ('-' = stdin) against the "
+        "committed docs/CONTRACT.json",
+    )
+    ap.add_argument(
+        "--root", default=None,
+        help="tree whose committed contract to use (default: this "
+        "checkout)",
+    )
+    args = ap.parse_args(argv)
+    root = os.path.abspath(args.root) if args.root else repo_root()
+
+    if args.check_go_dump is not None:
+        committed = contract_pass.load_committed(root)
+        if committed is None:
+            print(
+                f"{contract_pass.CONTRACT_JSON} missing — certify with "
+                "'python -m dpf_tpu.analysis --write-contract'",
+                file=sys.stderr,
+            )
+            return 1
+        if args.check_go_dump == "-":
+            dump = json.load(sys.stdin)
+        else:
+            with open(args.check_go_dump, encoding="utf-8") as f:
+                dump = json.load(f)
+        findings: list = []
+        contract_pass._go_check(_py_view(committed), dump, findings)
+        go_codes = sorted(dump.get("error_codes", {}))
+        if go_codes != committed.get("go_error_codes", []):
+            from ..common import Finding
+
+            findings.append(Finding(
+                "bridge/go/dpftpu/client.go", 1, contract_pass.PASS,
+                f"Go error-code vocabulary {go_codes} differs from the "
+                f"contract's {committed.get('go_error_codes')}",
+            ))
+        for f in findings:
+            print(f)
+        print(
+            f"surface-contract go-dump check: {len(findings)} finding(s)",
+            file=sys.stderr,
+        )
+        return 1 if findings else 0
+
+    findings = contract_pass.run(root)
+    for f in findings:
+        print(f)
+    print(
+        f"surface-contract: {len(findings)} finding(s)", file=sys.stderr
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

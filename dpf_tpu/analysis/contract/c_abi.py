@@ -1,0 +1,142 @@
+"""Native ABI extraction: ``extern "C" dpfn_*`` vs the ctypes wiring.
+
+The C side is the declarations in ``native/dpf_native.cc``; the Python
+side is the ``lib.dpfn_*.restype`` / ``.argtypes`` assignments in
+``backends/cpu_native.py``.  Both canonicalize to the same small type
+vocabulary so the contract pass can diff them symbol-by-symbol:
+
+  int        C ``int`` / ``ctypes.c_int``
+  u64        C ``uint64_t`` / ``ctypes.c_uint64``
+  u8p        C ``const uint8_t*`` / ``ctypes.POINTER(ctypes.c_uint8)``
+  u64p       C ``const uint64_t*`` / ``ctypes.POINTER(ctypes.c_uint64)``
+
+``(void)`` canonicalizes to an empty arg list; a symbol whose C side
+takes no arguments may legitimately skip ``argtypes`` on the Python
+side (ctypes' default calling convention is fine for niladic ints —
+``dpfn_usable`` / ``dpfn_have_aesni``).  A symbol with C parameters but
+no ``argtypes`` wiring is a finding: every call would go through
+ctypes' guess-the-ABI path.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Any
+
+C_FILE = os.path.join("native", "dpf_native.cc")
+CTYPES_FILE = os.path.join("dpf_tpu", "backends", "cpu_native.py")
+
+# Declarations start at column 0 inside the extern "C" blocks; the
+# param list may span lines, hence [^)]* with re.M only on the opener.
+_C_DECL = re.compile(
+    r"(?m)^(int|uint64_t|void)\s+(dpfn_\w+)\s*\(([^)]*)\)"
+)
+
+_C_TYPES = {
+    "int": "int",
+    "uint64_t": "u64",
+    "uint8_t*": "u8p",
+    "uint64_t*": "u64p",
+}
+_RET_TYPES = {"int": "int", "uint64_t": "u64", "void": "void"}
+
+_CTYPES_NAMES = {"c_int": "int", "c_uint64": "u64", "c_uint8": "u8"}
+
+
+def _canon_c_param(param: str) -> str:
+    """``const uint8_t* seed0`` -> ``u8p``."""
+    toks = param.replace("*", " * ").split()
+    toks = [t for t in toks if t != "const"]
+    # drop the trailing identifier when present: [type, ('*',) name?]
+    if toks and toks[-1] not in ("*",) and toks[-1] not in _C_TYPES:
+        star = "*" if "*" in toks[:-1] else ""
+        base = toks[0]
+    else:
+        star = "*" if "*" in toks else ""
+        base = toks[0]
+    key = base + star
+    if key not in _C_TYPES:
+        raise ValueError(f"unrecognized C parameter type {param!r}")
+    return _C_TYPES[key]
+
+
+def extract_c(root: str, rel: str = C_FILE) -> dict[str, Any] | None:
+    path = os.path.join(root, rel)
+    if not os.path.isfile(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    out: dict[str, dict[str, Any]] = {}
+    for m in _C_DECL.finditer(src):
+        ret, name, params = m.group(1), m.group(2), m.group(3)
+        params = params.strip()
+        if params in ("", "void"):
+            args: list[str] = []
+        else:
+            args = [_canon_c_param(p) for p in params.split(",")]
+        out[name] = {"restype": _RET_TYPES[ret], "args": args}
+    return out
+
+
+def _ctype_name(node: ast.AST, aliases: dict[str, str]) -> str | None:
+    """Canonicalize a ctypes type expression used in restype/argtypes."""
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id)
+    if isinstance(node, ast.Attribute) and node.attr in _CTYPES_NAMES:
+        return _CTYPES_NAMES[node.attr]
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "POINTER"
+        and len(node.args) == 1
+    ):
+        inner = _ctype_name(node.args[0], aliases)
+        return f"{inner}p" if inner else None
+    return None
+
+
+def extract_ctypes(root: str, rel: str = CTYPES_FILE) -> dict[str, Any] | None:
+    """``dpfn_*`` symbol -> {"restype": ..., "args": [...] | None} from
+    the ``lib.<sym>.restype`` / ``.argtypes`` assignments (AST; the
+    module is never imported)."""
+    path = os.path.join(root, rel)
+    if not os.path.isfile(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=rel)
+
+    # Local pointer aliases: u8p = ctypes.POINTER(ctypes.c_uint8), ...
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            resolved = _ctype_name(node.value, aliases)
+            if resolved and resolved.endswith("p"):
+                aliases[node.targets[0].id] = resolved
+
+    out: dict[str, dict[str, Any]] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        tgt = node.targets[0]
+        if not (
+            isinstance(tgt, ast.Attribute)
+            and tgt.attr in ("restype", "argtypes")
+            and isinstance(tgt.value, ast.Attribute)
+            and tgt.value.attr.startswith("dpfn_")
+        ):
+            continue
+        sym = tgt.value.attr
+        entry = out.setdefault(sym, {"restype": None, "args": None})
+        if tgt.attr == "restype":
+            entry["restype"] = _ctype_name(node.value, aliases)
+        elif isinstance(node.value, (ast.List, ast.Tuple)):
+            entry["args"] = [
+                _ctype_name(el, aliases) for el in node.value.elts
+            ]
+    return out

@@ -1,0 +1,483 @@
+"""The ``surface-contract`` pass: cross-language drift detection.
+
+Extracts the Python, Go, and native-ABI surfaces (:mod:`py_extract`,
+:mod:`go_extract`, :mod:`c_abi`), cross-checks them against each other,
+projects them into the canonical contract dict, and diffs that against
+the committed ``docs/CONTRACT.json``.  Any mismatch — between surfaces,
+or between the surfaces and the committed contract — is a finding; an
+intentional change re-certifies with
+``python -m dpf_tpu.analysis --write-contract`` (the OBLIVIOUS.md drift
+policy).
+
+Fixture mode: ``run(root, files=[...])`` maps each fixture file onto
+the surface role its basename prefix names (``handlers_*`` substitutes
+for serving/handlers.py, ``wire2_*`` for serving/wire2.py, ``errors_*``
+for serving/errors.py, ``cpu_native_*`` for backends/cpu_native.py);
+every OTHER surface still comes from the real tree, so a one-sided
+drift fires exactly the cross-surface findings it would ship with.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from ..common import Finding
+from . import CONTRACT_VERSION, c_abi, go_extract, py_extract
+
+PASS = "surface-contract"
+CONTRACT_JSON = os.path.join("docs", "CONTRACT.json")
+CONTRACT_MD = os.path.join("docs", "CONTRACT.md")
+
+_GO_WIRE2 = "bridge/go/dpftpu/wire2.go"
+_GO_CLIENT = "bridge/go/dpftpu/client.go"
+
+# Fixture basename prefix -> the surface role it substitutes for.
+# Checked in order; first match wins (cpu_native_ before native_).
+_FIXTURE_ROLES = (
+    ("handlers_", "handlers"),
+    ("wire2_", "wire2"),
+    ("errors_", "errors"),
+    ("headers_", "headers"),
+    ("metrics_", "metrics"),
+    ("cpu_native_", "ctypes"),
+    ("native_", "c"),
+)
+
+
+def _fixture_overrides(files) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for rel in files or ():
+        base = os.path.basename(rel)
+        for prefix, role in _FIXTURE_ROLES:
+            if base.startswith(prefix):
+                out[role] = rel
+                break
+    return out
+
+
+def _surface_rel(role: str, overrides: dict[str, str]) -> str:
+    if role == "c":
+        return overrides.get("c", c_abi.C_FILE).replace(os.sep, "/")
+    if role == "ctypes":
+        return overrides.get("ctypes", c_abi.CTYPES_FILE).replace(
+            os.sep, "/"
+        )
+    return overrides.get(role, py_extract.SURFACES[role]).replace(
+        os.sep, "/"
+    )
+
+
+def _py_internal(
+    py: dict[str, Any], overrides: dict[str, str], out: list[Finding]
+) -> None:
+    def f(role: str, msg: str, line: int = 1) -> None:
+        out.append(Finding(_surface_rel(role, overrides), line, PASS, msg))
+
+    role_of = {
+        "handlers": "handlers", "wire2": "wire2", "errors": "errors",
+        "headers": "headers", "metrics": "metrics",
+    }
+    for role, element in py.get("missing", []):
+        f(role_of.get(role, "handlers"),
+          f"surface element {element!r} not found in {role} surface")
+
+    routes = py.get("routes", {})
+    ids = sorted(routes.values())
+    for rid in sorted({i for i in ids if ids.count(i) > 1}):
+        dup = sorted(p for p, i in routes.items() if i == rid)
+        f("handlers", f"route id {rid} assigned to multiple paths: {dup}")
+    for path in py.get("sink_routes", []):
+        if routes and path not in routes:
+            f("handlers", f"SINK_ROUTES entry {path!r} is not in ROUTE_IDS")
+
+    error_codes = py.get("error_codes", {})
+    for code, lines in sorted(py.get("reply_codes", {}).items()):
+        if error_codes and code not in error_codes:
+            f("handlers",
+              f"_reply_error uses code {code!r} absent from errors.CODES",
+              line=lines[0])
+    for cls, code in sorted(py.get("class_codes", {}).items()):
+        if error_codes and code not in error_codes:
+            f("errors",
+              f"exception class {cls} declares code {code!r} absent "
+              "from CODES (http_status derivation would fail at import)")
+
+    w2 = py.get("wire2", {})
+    for kind in ("frame_types", "flags"):
+        table = w2.get(kind, {})
+        by_val: dict[int, list[str]] = {}
+        for name, val in table.items():
+            by_val.setdefault(val, []).append(name)
+        for val, names in sorted(by_val.items()):
+            if len(names) > 1:
+                f("wire2",
+                  f"wire2 {kind.replace('_', ' ')} value {val} collides: "
+                  f"{sorted(names)}")
+    magic = w2.get("magic")
+    if magic is not None and len(magic) != 16:
+        f("wire2", f"wire2 MAGIC must be 8 bytes, got {len(magic) // 2}")
+
+    ns = py.get("metric_namespace", "dpf")
+    for name in sorted(py.get("metrics", {})):
+        if not name.startswith(f"{ns}_"):
+            f("metrics",
+              f"metric {name!r} escapes the {ns}_* namespace")
+    for name in py.get("metric_duplicates", []):
+        f("metrics", f"metric {name!r} registered more than once")
+
+
+def _go_check(
+    py: dict[str, Any], go: dict[str, Any], out: list[Finding]
+) -> None:
+    def f(rel: str, msg: str) -> None:
+        out.append(Finding(rel, 1, PASS, msg))
+
+    routes = py.get("routes", {})
+    go_routes = dict(go.get("routes", {}))
+    for path, rid in sorted(routes.items()):
+        const = go_extract.const_name_for_path(path)
+        if const not in go_routes:
+            f(_GO_WIRE2,
+              f"route {path!r} (id {rid}) has no Go const "
+              f"wire2Route{const}")
+        elif go_routes[const] != rid:
+            f(_GO_WIRE2,
+              f"route {path!r}: Go wire2Route{const}={go_routes[const]} "
+              f"but Python route_id is {rid}")
+    known = {go_extract.const_name_for_path(p) for p in routes}
+    for const in sorted(set(go_routes) - known):
+        f(_GO_WIRE2,
+          f"Go const wire2Route{const}={go_routes[const]} names no "
+          "Python route")
+    for path in go.get("client_paths", []):
+        if routes and path not in routes:
+            f(_GO_CLIENT,
+              f"Go client posts to {path!r}, which is not in ROUTE_IDS")
+
+    w2 = py.get("wire2", {})
+    for py_key, go_key, label in (
+        ("frame_types", "frame_types", "frame type table"),
+        ("flags", "flags", "flag table"),
+    ):
+        if w2.get(py_key) != go.get(go_key) and w2.get(py_key) is not None:
+            f(_GO_WIRE2,
+              f"wire2 {label} differs: Python {w2.get(py_key)} vs "
+              f"Go {go.get(go_key)}")
+    for py_key, go_key, label in (
+        ("hdr_len", "hdr_len", "frame header length"),
+        ("resp_len", "resp_head_len", "RESP head length"),
+        ("data_chunk", "data_chunk", "DATA chunk size"),
+        ("magic", "magic", "connection preface"),
+    ):
+        if w2.get(py_key) is not None and w2.get(py_key) != go.get(go_key):
+            f(_GO_WIRE2,
+              f"wire2 {label} differs: Python {w2.get(py_key)!r} vs "
+              f"Go {go.get(go_key)!r}")
+
+    error_codes = py.get("error_codes", {})
+    for code, status in sorted(go.get("error_codes", {}).items()):
+        if error_codes and code not in error_codes:
+            f(_GO_CLIENT,
+              f"Go APIError documents code {code!r}, absent from "
+              "errors.CODES")
+        elif error_codes and error_codes[code] != status:
+            f(_GO_CLIENT,
+              f"error code {code!r}: Go documents HTTP {status}, "
+              f"Python CODES says {error_codes[code]}")
+
+    headers = py.get("headers", {})
+    go_headers = set(go.get("headers", []))
+    for key, name in sorted(headers.items()):
+        if name not in go_headers:
+            f(_GO_CLIENT,
+              f"{key} header {name!r} does not appear in the Go bridge")
+
+    params = py.get("params", {})
+    if params and sorted(params.values()) != go.get("params", []):
+        f(_GO_WIRE2,
+          f"wire2 pseudo-params differ: Python "
+          f"{sorted(params.values())} vs Go {go.get('params')}")
+
+
+def _abi_check(
+    c: dict[str, Any] | None,
+    pyabi: dict[str, Any] | None,
+    overrides: dict[str, str],
+    out: list[Finding],
+) -> None:
+    c_rel = _surface_rel("c", overrides)
+    py_rel = _surface_rel("ctypes", overrides)
+    if c is None:
+        out.append(Finding(c_rel, 1, PASS, "native ABI source not found"))
+        return
+    if pyabi is None:
+        out.append(Finding(py_rel, 1, PASS, "ctypes wiring not found"))
+        return
+    for sym in sorted(set(c) - set(pyabi)):
+        out.append(Finding(py_rel, 1, PASS,
+                           f"C exports {sym} but cpu_native.py never "
+                           "wires it"))
+    for sym in sorted(set(pyabi) - set(c)):
+        out.append(Finding(py_rel, 1, PASS,
+                           f"ctypes wires {sym}, which native/"
+                           "dpf_native.cc does not export"))
+    for sym in sorted(set(c) & set(pyabi)):
+        want, have = c[sym], pyabi[sym]
+        if have["restype"] != want["restype"]:
+            out.append(Finding(py_rel, 1, PASS,
+                               f"{sym}: restype {have['restype']} vs C "
+                               f"return {want['restype']}"))
+        if have["args"] is None:
+            if want["args"]:
+                out.append(Finding(py_rel, 1, PASS,
+                                   f"{sym}: C takes {len(want['args'])} "
+                                   "parameter(s) but no argtypes are "
+                                   "wired"))
+        elif have["args"] != want["args"]:
+            out.append(Finding(py_rel, 1, PASS,
+                               f"{sym}: argtypes {have['args']} vs C "
+                               f"parameters {want['args']}"))
+
+
+def _canonical(
+    py: dict[str, Any],
+    go: dict[str, Any],
+    c: dict[str, Any] | None,
+) -> dict[str, Any]:
+    routes = py.get("routes", {})
+    sinks = set(py.get("sink_routes", []))
+    client_paths = set(go.get("client_paths", []))
+    w2 = py.get("wire2", {})
+    return {
+        "contract_version": CONTRACT_VERSION,
+        "routes": {
+            path: {
+                "id": rid,
+                "sink": path in sinks,
+                "go_const": go_extract.const_name_for_path(path),
+                "go_client": path in client_paths,
+            }
+            for path, rid in sorted(routes.items())
+        },
+        "http_only_routes": py.get("http_only", []),
+        "wire2": {
+            "magic": w2.get("magic"),
+            "hdr_format": w2.get("hdr_format"),
+            "hdr_len": w2.get("hdr_len"),
+            "resp_format": w2.get("resp_format"),
+            "resp_head_len": w2.get("resp_len"),
+            "frame_types": dict(sorted(w2.get("frame_types", {}).items())),
+            "flags": dict(sorted(w2.get("flags", {}).items())),
+            "data_chunk": w2.get("data_chunk"),
+        },
+        "error_codes": dict(sorted(py.get("error_codes", {}).items())),
+        "error_classes": dict(sorted(py.get("class_codes", {}).items())),
+        "go_error_codes": sorted(go.get("error_codes", {})),
+        "headers": dict(sorted(py.get("headers", {}).items())),
+        "wire2_params": dict(sorted(py.get("params", {}).items())),
+        "metrics": dict(sorted(py.get("metrics", {}).items())),
+        "native_abi": {
+            sym: {"restype": v["restype"], "args": v["args"]}
+            for sym, v in sorted((c or {}).items())
+        },
+    }
+
+
+def _diff_paths(a: Any, b: Any, prefix: str = "", limit: int = 8) -> list[str]:
+    """Leaf paths where ``a`` and ``b`` differ (first ``limit``)."""
+    out: list[str] = []
+
+    def walk(x: Any, y: Any, at: str) -> None:
+        if len(out) >= limit:
+            return
+        if isinstance(x, dict) and isinstance(y, dict):
+            for k in sorted(set(x) | set(y)):
+                walk(x.get(k), y.get(k), f"{at}.{k}" if at else str(k))
+        elif x != y:
+            out.append(f"{at}: {x!r} -> {y!r}")
+
+    walk(a, b, prefix)
+    return out
+
+
+def load_committed(root: str) -> dict[str, Any] | None:
+    path = os.path.join(root, CONTRACT_JSON)
+    if not os.path.isfile(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def build(
+    root: str, overrides: dict[str, str] | None = None
+) -> tuple[dict[str, Any], list[Finding]]:
+    """-> (canonical contract, cross-surface findings)."""
+    overrides = overrides or {}
+    findings: list[Finding] = []
+    py = py_extract.extract(
+        root,
+        {r: p for r, p in overrides.items() if r in py_extract.SURFACES},
+    )
+    go = go_extract.extract(root)
+    c = c_abi.extract_c(root, overrides.get("c", c_abi.C_FILE))
+    pyabi = c_abi.extract_ctypes(
+        root, overrides.get("ctypes", c_abi.CTYPES_FILE)
+    )
+    _py_internal(py, overrides, findings)
+    _go_check(py, go, findings)
+    _abi_check(c, pyabi, overrides, findings)
+    return _canonical(py, go, c), findings
+
+
+def run(root: str, files=None) -> list[Finding]:
+    overrides = _fixture_overrides(files)
+    if files is not None and not overrides:
+        return []
+    contract, findings = build(root, overrides)
+    committed = load_committed(root)
+    if committed is None:
+        findings.append(Finding(
+            CONTRACT_JSON.replace(os.sep, "/"), 1, PASS,
+            "committed contract missing — certify with 'python -m "
+            "dpf_tpu.analysis --write-contract'",
+        ))
+    elif committed != contract:
+        drift = _diff_paths(committed, contract)
+        findings.append(Finding(
+            CONTRACT_JSON.replace(os.sep, "/"), 1, PASS,
+            "committed contract is stale vs the tree ("
+            + "; ".join(drift)
+            + ") — if intentional, re-certify with 'python -m "
+            "dpf_tpu.analysis --write-contract'",
+        ))
+    return findings
+
+
+def render_markdown(contract: dict[str, Any]) -> str:
+    """The human twin of CONTRACT.json (docs/CONTRACT.md)."""
+    L: list[str] = []
+    L.append("# Surface contract")
+    L.append("")
+    L.append(
+        "Generated by `python -m dpf_tpu.analysis --write-contract` — "
+        "do not edit by hand.  The `surface-contract` pass diffs the "
+        "tree's Python, Go, and native-ABI surfaces against "
+        "`docs/CONTRACT.json` (this file is the readable rendering) on "
+        "every lint run; semantics in docs/DESIGN.md §22."
+    )
+    L.append("")
+    L.append(f"Contract version: {contract['contract_version']}")
+    L.append("")
+    L.append("## Routes")
+    L.append("")
+    L.append("| id | path | Go const | sink | Go client |")
+    L.append("|---:|------|----------|:----:|:---------:|")
+    for path, r in sorted(
+        contract["routes"].items(), key=lambda kv: kv[1]["id"]
+    ):
+        L.append(
+            f"| {r['id']} | `{path}` | `wire2Route{r['go_const']}` | "
+            f"{'y' if r['sink'] else ''} | "
+            f"{'y' if r['go_client'] else ''} |"
+        )
+    L.append("")
+    L.append(
+        "HTTP-only (no wire2 route id): "
+        + ", ".join(f"`{p}`" for p in contract["http_only_routes"])
+    )
+    L.append("")
+    w2 = contract["wire2"]
+    L.append("## wire2 framing")
+    L.append("")
+    L.append(f"- preface: `{w2['magic']}` (hex)")
+    L.append(
+        f"- frame header: `{w2['hdr_format']}` ({w2['hdr_len']} bytes); "
+        f"RESP head: `{w2['resp_format']}` ({w2['resp_head_len']} bytes)"
+    )
+    L.append(f"- DATA chunk: {w2['data_chunk']} bytes")
+    L.append(
+        "- frame types: "
+        + ", ".join(
+            f"{name}={val}"
+            for name, val in sorted(
+                w2["frame_types"].items(), key=lambda kv: kv[1]
+            )
+        )
+    )
+    L.append(
+        "- flags: "
+        + ", ".join(
+            f"{name}={val}" for name, val in sorted(w2["flags"].items())
+        )
+    )
+    L.append("")
+    L.append("## Error codes")
+    L.append("")
+    L.append("| code | HTTP | Go client |")
+    L.append("|------|-----:|:---------:|")
+    go_codes = set(contract["go_error_codes"])
+    for code, status in sorted(
+        contract["error_codes"].items(), key=lambda kv: (kv[1], kv[0])
+    ):
+        L.append(
+            f"| `{code}` | {status} | {'y' if code in go_codes else ''} |"
+        )
+    L.append("")
+    L.append(
+        "Raising classes: "
+        + ", ".join(
+            f"`{cls}` -> `{code}`"
+            for cls, code in sorted(contract["error_classes"].items())
+        )
+    )
+    L.append("")
+    L.append("## Headers and wire2 pseudo-params")
+    L.append("")
+    for key, name in sorted(contract["headers"].items()):
+        L.append(f"- {key}: `{name}`")
+    for key, name in sorted(contract["wire2_params"].items()):
+        L.append(f"- wire2 {key} param: `{name}`")
+    L.append("")
+    L.append(f"## Metrics ({len(contract['metrics'])})")
+    L.append("")
+    for name, kind in sorted(contract["metrics"].items()):
+        L.append(f"- `{name}` ({kind})")
+    L.append("")
+    L.append(
+        f"## Native ABI ({len(contract['native_abi'])} `dpfn_*` symbols)"
+    )
+    L.append("")
+    L.append("| symbol | returns | args |")
+    L.append("|--------|---------|------|")
+    for sym, sig in sorted(contract["native_abi"].items()):
+        args = ", ".join(sig["args"]) if sig["args"] else "void"
+        L.append(f"| `{sym}` | {sig['restype']} | {args} |")
+    L.append("")
+    return "\n".join(L)
+
+
+def write(root: str) -> list[str]:
+    """Re-certify: build from the real tree and write CONTRACT.json +
+    CONTRACT.md.  Raises ValueError (without writing) when the surfaces
+    disagree with each other — certification records a coherent tree,
+    it does not bless a drift."""
+    contract, findings = build(root)
+    if findings:
+        raise ValueError(
+            "refusing to certify a tree whose surfaces disagree:\n"
+            + "\n".join(str(f) for f in findings)
+        )
+    wrote: list[str] = []
+    jpath = os.path.join(root, CONTRACT_JSON)
+    os.makedirs(os.path.dirname(jpath), exist_ok=True)
+    with open(jpath, "w", encoding="utf-8") as f:
+        json.dump(contract, f, indent=2, sort_keys=True)
+        f.write("\n")
+    wrote.append(CONTRACT_JSON)
+    mpath = os.path.join(root, CONTRACT_MD)
+    with open(mpath, "w", encoding="utf-8") as f:
+        f.write(render_markdown(contract))
+    wrote.append(CONTRACT_MD)
+    return wrote

@@ -1,0 +1,197 @@
+"""Go-side surface extraction for the surface-contract pass.
+
+Two paths to the same JSON shape:
+
+  * ``bridge/go/cmd/contract-dump`` — a go/ast program emitting the
+    surface as JSON on stdout.  Used when a Go toolchain is on PATH
+    (CI's conformance job; ``bridge/go/conformance.sh contract`` step).
+  * :func:`extract_fallback` — a regex scan over the SAME two files
+    (``bridge/go/dpftpu/client.go`` / ``wire2.go``).  Used when the
+    toolchain is absent (skip-with-warning, the staticcheck precedent):
+    the lint lane still sees the Go constants, just through a dumber
+    parser.
+
+The two are pinned against each other by the committed golden dump
+(``dpf_tpu/analysis/fixtures/bad_contract/go_dump_golden.json`` —
+asserted equal to the fallback's output in tests/test_contract.py), so
+the fallback cannot silently rot while CI runs the real parser.
+
+Surface shape (both producers):
+
+  routes        Go const suffix ("Gen", "HHEval", ...) -> route id
+  client_paths  sorted "/v1/..." literals the HTTP client posts to
+  frame_types   normalized name ("RESP_DATA") -> value
+  flags         normalized name ("END_STREAM") -> value
+  hdr_len / resp_head_len / data_chunk   ints
+  magic         hex string of the 8-byte preface
+  headers       sorted X-DPF-* / Retry-After literals
+  error_codes   code -> status from the APIError doc comment
+  params        sorted "_..." pseudo-param literals
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+from typing import Any
+
+GO_DIR = os.path.join("bridge", "go")
+GO_FILES = (
+    os.path.join("bridge", "go", "dpftpu", "client.go"),
+    os.path.join("bridge", "go", "dpftpu", "wire2.go"),
+)
+
+_CAMEL_SPLIT = re.compile(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])")
+
+
+def camel_to_upper_snake(name: str) -> str:
+    """``RespData`` -> ``RESP_DATA``; ``EndStream`` -> ``END_STREAM``."""
+    return _CAMEL_SPLIT.sub("_", name).upper()
+
+
+def _read(root: str, rel: str) -> str:
+    with open(os.path.join(root, rel), encoding="utf-8") as f:
+        return f.read()
+
+
+def _int_expr(text: str) -> int:
+    """``42`` or ``1 << 20`` from a Go const expression."""
+    m = re.fullmatch(r"\s*(\d+)\s*(?:<<\s*(\d+)\s*)?", text)
+    if not m:
+        raise ValueError(f"unparseable Go int expression {text!r}")
+    v = int(m.group(1))
+    return v << int(m.group(2)) if m.group(2) else v
+
+
+def extract_fallback(
+    root: str, files: tuple[str, ...] = GO_FILES
+) -> dict[str, Any]:
+    """Regex extraction over the bridge sources — the no-toolchain
+    twin of contract-dump's go/ast output."""
+    srcs = {rel: _read(root, rel) for rel in files if
+            os.path.isfile(os.path.join(root, rel))}
+    all_src = "\n".join(srcs.values())
+
+    routes = {
+        m.group(1): int(m.group(2))
+        for m in re.finditer(r"wire2Route(\w+)\s*=\s*(\d+)", all_src)
+    }
+    frame_types = {
+        camel_to_upper_snake(m.group(1)): int(m.group(2))
+        for m in re.finditer(r"\bwire2T([A-Z]\w*)\s*=\s*(\d+)", all_src)
+    }
+    flags = {
+        camel_to_upper_snake(m.group(1)): int(m.group(2))
+        for m in re.finditer(r"\bwire2F([A-Z]\w*)\s*=\s*(\d+)", all_src)
+    }
+
+    def named_int(name: str) -> int | None:
+        m = re.search(rf"\b{name}\s*=\s*([^\n]+)", all_src)
+        return _int_expr(m.group(1)) if m else None
+
+    magic = None
+    m = re.search(r"wire2Magic\s*=\s*\[\]byte\{([^}]*)\}", all_src)
+    if m:
+        vals = []
+        for tok in m.group(1).split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            if tok.startswith("'") and tok.endswith("'"):
+                vals.append(ord(tok[1:-1]))
+            else:
+                vals.append(int(tok))
+        magic = bytes(vals).hex()
+
+    client_paths = sorted(
+        {m.group(1) for m in re.finditer(r'"(/v1/[a-z_/]+)[?"]', all_src)}
+    )
+    headers = sorted(
+        {
+            m.group(1)
+            for m in re.finditer(r'"(X-DPF-[\w-]+|Retry-After)"', all_src)
+        }
+    )
+    params = sorted(
+        {m.group(1) for m in re.finditer(r'Set\("(_\w+)"', all_src)}
+    )
+
+    # The APIError doc comment is the Go side's statement of the error
+    # vocabulary: code "shed" (429, ...), "unavailable" (503, ...) ...
+    error_codes: dict[str, int] = {}
+    m = re.search(
+        r"((?://[^\n]*\n)+)type APIError struct", all_src
+    )
+    if m:
+        for cm in re.finditer(r'"(\w+)"\s*\((\d+)', m.group(1)):
+            error_codes[cm.group(1)] = int(cm.group(2))
+
+    return {
+        "routes": routes,
+        "client_paths": client_paths,
+        "frame_types": frame_types,
+        "flags": flags,
+        "hdr_len": named_int("wire2HdrLen"),
+        "resp_head_len": named_int("wire2RespHead"),
+        "data_chunk": named_int("wire2DataChunk"),
+        "magic": magic,
+        "headers": headers,
+        "error_codes": error_codes,
+        "params": params,
+    }
+
+
+def toolchain_available() -> bool:
+    return shutil.which("go") is not None
+
+
+def extract_dump(root: str) -> dict[str, Any] | None:
+    """Run contract-dump under the Go toolchain; None (with a stderr
+    notice — the staticcheck skip idiom) when unavailable or failing."""
+    if not toolchain_available():
+        print(
+            "surface-contract: no Go toolchain; using the regex "
+            "fallback extractor (bridge/go/conformance.sh runs the "
+            "go/ast contract-dump)",
+            file=sys.stderr,
+        )
+        return None
+    try:
+        proc = subprocess.run(
+            ["go", "run", "./cmd/contract-dump"],
+            cwd=os.path.join(root, GO_DIR),
+            capture_output=True,
+            text=True,
+            timeout=120,
+            check=True,
+        )
+        return json.loads(proc.stdout)
+    except (OSError, subprocess.SubprocessError, ValueError) as e:
+        print(
+            f"surface-contract: contract-dump failed ({e}); using the "
+            "regex fallback extractor",
+            file=sys.stderr,
+        )
+        return None
+
+
+def extract(root: str) -> dict[str, Any]:
+    """The Go surface: go/ast dump when possible, regex otherwise."""
+    return extract_dump(root) or extract_fallback(root)
+
+
+# Expected Go const-name suffix for a route path: "/v1/eval_points_batch"
+# -> "EvalPointsBatch", "/v1/hh/gen" -> "HHGen".  The special cases are
+# the Go bridge's own spellings — pinned here so a rename on either side
+# is a visible diff, not a silent re-derivation.
+_TOKEN_CASE = {"hh": "HH", "db": "DB", "evalfull": "EvalFull", "pir": "Pir"}
+
+
+def const_name_for_path(path: str) -> str:
+    tokens = [t for part in path.removeprefix("/v1/").split("/")
+              for t in part.split("_")]
+    return "".join(_TOKEN_CASE.get(t, t.capitalize()) for t in tokens)

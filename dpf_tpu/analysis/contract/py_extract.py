@@ -1,0 +1,311 @@
+"""Python-side surface extraction (AST only — nothing is imported).
+
+Reads the five Python surface files and returns one dict the contract
+pass cross-checks against the Go and C surfaces:
+
+  routes         path -> wire2 route id        (handlers.ROUTE_IDS)
+  sink_routes    streamed-upload paths         (handlers.SINK_ROUTES)
+  http_only      GET/observability paths with no wire2 id (respond_get
+                 ``path == "..."`` compares + ``route == "..."``
+                 compares anywhere, minus the route table)
+  reply_codes    code -> [line, ...] of every ``_reply_error("code",``
+                 call in handlers.py/wire2.py (membership-checked
+                 against the canonical table)
+  error_codes    code -> HTTP status           (errors.CODES)
+  class_codes    exception class -> code       (errors.py ClassDefs)
+  headers        {"deadline","trace","retry_after"} -> header name
+  params         {"deadline","trace"} -> wire2 pseudo-param name
+  wire2          magic hex, header/resp struct formats + sizes, frame
+                 types, flags, data chunk size
+  metrics        dpf_* metric name -> kind     (obs/metrics.py
+                 ``w.family(f"{ns}_...", kind, ...)`` calls)
+
+Every extractor is tolerant of an ABSENT element only in fixture mode
+(the seeded-drift fixtures are small single-surface files); on the real
+tree a missing element is itself a finding (``missing`` list).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import struct
+from typing import Any
+
+# role -> the real tree's repo-relative path
+SURFACES = {
+    "handlers": "dpf_tpu/serving/handlers.py",
+    "wire2": "dpf_tpu/serving/wire2.py",
+    "errors": "dpf_tpu/serving/errors.py",
+    "headers": "dpf_tpu/serving/headers.py",
+    "metrics": "dpf_tpu/obs/metrics.py",
+}
+
+_HEADER_NAMES = {
+    "DEADLINE_HEADER": "deadline",
+    "TRACE_HEADER": "trace",
+    "RETRY_AFTER_HEADER": "retry_after",
+}
+_PARAM_NAMES = {"DEADLINE_PARAM": "deadline", "TRACE_PARAM": "trace"}
+
+
+def _parse(root: str, rel: str) -> ast.Module | None:
+    path = os.path.join(root, rel)
+    if not os.path.isfile(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        return ast.parse(f.read(), filename=rel)
+
+
+def _const_int(node: ast.AST) -> int | None:
+    """Evaluate an int constant, allowing ``1 << 20``-style shifts."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.LShift):
+        left, right = _const_int(node.left), _const_int(node.right)
+        if left is not None and right is not None:
+            return left << right
+    return None
+
+
+def _module_assigns(tree: ast.Module):
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name):
+                yield tgt.id, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name):
+                yield node.target.id, node.value
+
+
+def _extract_handlers(tree: ast.Module, out: dict[str, Any]) -> None:
+    for name, value in _module_assigns(tree):
+        if name == "ROUTE_IDS" and isinstance(value, ast.Dict):
+            routes: dict[str, int] = {}
+            for k, v in zip(value.keys, value.values):
+                if (
+                    isinstance(k, ast.Constant)
+                    and isinstance(k.value, int)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)
+                ):
+                    routes[v.value] = k.value
+            out["routes"] = routes
+        elif name == "SINK_ROUTES":
+            strings = [
+                n.value
+                for n in ast.walk(value)
+                if isinstance(n, ast.Constant) and isinstance(n.value, str)
+            ]
+            out["sink_routes"] = sorted(strings)
+    # GET/observability routes: string compares against a ``path`` or
+    # ``*.route`` operand (respond_get's dispatch plus the POST-side
+    # "/v1/profile" special case).  Tuple-membership compares
+    # (``route in ("/v1/warmup", ...)``) count too.
+    compared: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        left = node.left
+        is_path = isinstance(left, ast.Name) and left.id in ("path", "route")
+        is_path = is_path or (
+            isinstance(left, ast.Attribute) and left.attr == "route"
+        )
+        if not is_path:
+            continue
+        for comp in node.comparators:
+            for n in ast.walk(comp):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    if n.value.startswith("/"):
+                        compared.add(n.value)
+    out["route_compares"] = compared
+
+
+def _extract_reply_codes(tree: ast.Module, out: dict[str, Any]) -> None:
+    codes: dict[str, list[int]] = out.setdefault("reply_codes", {})
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        fn_name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None
+        )
+        if fn_name != "_reply_error" or not node.args:
+            continue
+        arg0 = node.args[0]
+        if isinstance(arg0, ast.Constant) and isinstance(arg0.value, str):
+            codes.setdefault(arg0.value, []).append(node.lineno)
+
+
+def _extract_errors(tree: ast.Module, out: dict[str, Any]) -> None:
+    for name, value in _module_assigns(tree):
+        if name == "CODES" and isinstance(value, ast.Dict):
+            table: dict[str, int] = {}
+            for k, v in zip(value.keys, value.values):
+                if (
+                    isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, int)
+                ):
+                    table[k.value] = v.value
+            out["error_codes"] = table
+    class_codes: dict[str, str] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for stmt in node.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == "code"
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, str)
+            ):
+                class_codes[node.name] = stmt.value.value
+    if class_codes:
+        out["class_codes"] = class_codes
+
+
+def _extract_headers(tree: ast.Module, out: dict[str, Any]) -> None:
+    headers: dict[str, str] = {}
+    params: dict[str, str] = {}
+    for name, value in _module_assigns(tree):
+        if not (
+            isinstance(value, ast.Constant) and isinstance(value.value, str)
+        ):
+            continue
+        if name in _HEADER_NAMES:
+            headers[_HEADER_NAMES[name]] = value.value
+        elif name in _PARAM_NAMES:
+            params[_PARAM_NAMES[name]] = value.value
+    if headers:
+        out["headers"] = headers
+    if params:
+        out["params"] = params
+
+
+def _extract_wire2(tree: ast.Module, out: dict[str, Any]) -> None:
+    w2: dict[str, Any] = {"frame_types": {}, "flags": {}}
+    for name, value in _module_assigns(tree):
+        if name == "MAGIC" and isinstance(value, ast.Constant) and isinstance(
+            value.value, bytes
+        ):
+            w2["magic"] = value.value.hex()
+        elif name in ("_HDR", "_RESP") and isinstance(value, ast.Call):
+            if value.args and isinstance(value.args[0], ast.Constant):
+                fmt = value.args[0].value
+                key = "hdr" if name == "_HDR" else "resp"
+                w2[f"{key}_format"] = fmt
+                w2[f"{key}_len"] = struct.calcsize(fmt)
+        elif name.startswith("T_"):
+            v = _const_int(value)
+            if v is not None:
+                w2["frame_types"][name[2:]] = v
+        elif name.startswith("F_"):
+            v = _const_int(value)
+            if v is not None:
+                w2["flags"][name[2:]] = v
+        elif name == "_CLIENT_CHUNK":
+            v = _const_int(value)
+            if v is not None:
+                w2["data_chunk"] = v
+    out["wire2"] = w2
+
+
+def _extract_metrics(tree: ast.Module, out: dict[str, Any]) -> None:
+    ns = "dpf"
+    for name, value in _module_assigns(tree):
+        if name == "_NAMESPACE" and isinstance(value, ast.Constant):
+            ns = value.value
+    metrics: dict[str, str] = {}
+    duplicates: list[str] = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "family"
+            and len(node.args) >= 2
+        ):
+            continue
+        name_arg, kind_arg = node.args[0], node.args[1]
+        full: str | None = None
+        if isinstance(name_arg, ast.JoinedStr):
+            # f"{ns}_shed_total" — one FormattedValue + one Constant.
+            parts: list[str] = []
+            for v in name_arg.values:
+                if isinstance(v, ast.FormattedValue):
+                    parts.append(ns)
+                elif isinstance(v, ast.Constant):
+                    parts.append(str(v.value))
+            full = "".join(parts)
+        elif isinstance(name_arg, ast.Constant):
+            full = str(name_arg.value)
+        if full is None or not isinstance(kind_arg, ast.Constant):
+            continue
+        if full in metrics:
+            duplicates.append(full)
+        metrics[full] = str(kind_arg.value)
+    out["metrics"] = metrics
+    out["metric_namespace"] = ns
+    if duplicates:
+        out["metric_duplicates"] = duplicates
+
+
+def extract(
+    root: str, overrides: dict[str, str] | None = None
+) -> dict[str, Any]:
+    """The Python surface of ``root``.  ``overrides`` maps a role name
+    (see :data:`SURFACES`) to an alternate repo-relative file — the
+    seeded-drift fixtures substitute one small surface file at a time.
+    ``missing`` lists (role, element) pairs absent from their file."""
+    overrides = overrides or {}
+    out: dict[str, Any] = {"missing": []}
+    trees: dict[str, ast.Module | None] = {}
+    for role, rel in SURFACES.items():
+        use = overrides.get(role, rel)
+        trees[role] = _parse(root, use)
+        out.setdefault("files", {})[role] = use
+        if trees[role] is None:
+            out["missing"].append((role, "file"))
+
+    if trees["handlers"] is not None:
+        _extract_handlers(trees["handlers"], out)
+        _extract_reply_codes(trees["handlers"], out)
+    if trees["wire2"] is not None:
+        _extract_wire2(trees["wire2"], out)
+        _extract_reply_codes(trees["wire2"], out)
+    if trees["errors"] is not None:
+        _extract_errors(trees["errors"], out)
+    if trees["headers"] is not None:
+        _extract_headers(trees["headers"], out)
+    if trees["metrics"] is not None:
+        _extract_metrics(trees["metrics"], out)
+
+    for role, element in (
+        ("handlers", "routes"),
+        ("handlers", "sink_routes"),
+        ("errors", "error_codes"),
+        ("headers", "headers"),
+        ("headers", "params"),
+        ("metrics", "metrics"),
+    ):
+        if trees[role] is not None and element not in out:
+            out["missing"].append((role, element))
+    if trees["wire2"] is not None:
+        w2 = out.get("wire2", {})
+        for element in ("magic", "hdr_format", "resp_format"):
+            if element not in w2:
+                out["missing"].append(("wire2", element))
+        if not w2.get("frame_types"):
+            out["missing"].append(("wire2", "frame_types"))
+
+    if "routes" in out:
+        out["http_only"] = sorted(
+            out.pop("route_compares", set()) - set(out["routes"])
+        )
+    else:
+        out.pop("route_compares", None)
+    return out

@@ -1,0 +1,19 @@
+"""Seeded drift: ctypes argtypes disagreeing with the C header.
+
+dpfn_gen takes (alpha, log_n, seed0, seed1, ka, kb) — six parameters —
+but this wiring drops the final key-output pointer.  Every call through
+it would push the wrong frame.  The surface-contract pass must report
+the argtypes mismatch against the extern "C" declaration (plus, since
+this file substitutes the whole ctypes surface, an unwired finding for
+every other exported symbol).
+"""
+
+import ctypes
+
+u8p = ctypes.POINTER(ctypes.c_uint8)
+
+lib = None  # never executed — the pass reads this file as AST only
+
+lib.dpfn_gen.restype = ctypes.c_int
+# drift: the C side takes six parameters (..., u8p ka, u8p kb)
+lib.dpfn_gen.argtypes = [ctypes.c_uint64, ctypes.c_uint64, u8p, u8p, u8p]

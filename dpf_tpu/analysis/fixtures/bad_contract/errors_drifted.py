@@ -1,0 +1,19 @@
+"""Seeded drift: an error code renamed in the canonical table only.
+
+"unavailable" becomes "overloaded" here while handlers.py still replies
+with _reply_error("unavailable", ...) and the Go APIError doc still
+maps "unavailable" (503) — the surface-contract pass must report both
+the undeclared reply code and the Go-side orphan.
+"""
+
+CODES: dict[str, int] = {
+    "shed": 429,
+    "overloaded": 503,  # drift: the tree says "unavailable"
+    "deadline": 504,
+    "internal": 500,
+    "bad_request": 400,
+    "cold": 503,
+    "breaker_open": 503,
+    "profile_forbidden": 403,
+    "profile_active": 409,
+}

@@ -1,0 +1,26 @@
+"""Seeded drift: route id 1 renamed on the Python side only.
+
+The Go bridge still says wire2RouteGen = 1, so the surface-contract
+pass must report both halves of the tear: the renamed Python path has
+no Go const, and the orphaned Go const names no Python route.
+"""
+
+ROUTE_IDS = {
+    1: "/v1/generate",  # drift: the tree says /v1/gen
+    2: "/v1/eval",
+    3: "/v1/evalfull",
+    4: "/v1/evalfull_batch",
+    5: "/v1/eval_points_batch",
+    6: "/v1/dcf_gen",
+    7: "/v1/dcf_eval_points",
+    8: "/v1/dcf_interval_gen",
+    9: "/v1/dcf_interval_eval",
+    10: "/v1/hh/gen",
+    11: "/v1/hh/eval",
+    12: "/v1/agg/submit",
+    13: "/v1/pir/db",
+    14: "/v1/pir/query",
+    15: "/v1/warmup",
+}
+
+SINK_ROUTES = frozenset({"/v1/agg/submit", "/v1/pir/db"})

@@ -1,0 +1,27 @@
+"""Seeded drift: a route renumbered on the Python side only.
+
+/v1/warmup moves from id 15 to 16 here while the Go bridge still pins
+wire2RouteWarmup = 15 — a wire2 client and server would disagree about
+which handler a frame addresses.  The surface-contract pass must report
+the id mismatch.
+"""
+
+ROUTE_IDS = {
+    1: "/v1/gen",
+    2: "/v1/eval",
+    3: "/v1/evalfull",
+    4: "/v1/evalfull_batch",
+    5: "/v1/eval_points_batch",
+    6: "/v1/dcf_gen",
+    7: "/v1/dcf_eval_points",
+    8: "/v1/dcf_interval_gen",
+    9: "/v1/dcf_interval_eval",
+    10: "/v1/hh/gen",
+    11: "/v1/hh/eval",
+    12: "/v1/agg/submit",
+    13: "/v1/pir/db",
+    14: "/v1/pir/query",
+    16: "/v1/warmup",  # drift: Go says wire2RouteWarmup = 15
+}
+
+SINK_ROUTES = frozenset({"/v1/agg/submit", "/v1/pir/db"})

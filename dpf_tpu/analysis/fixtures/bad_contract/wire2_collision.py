@@ -1,0 +1,26 @@
+"""Seeded drift: two wire2 frame types sharing a value.
+
+T_RESP_DATA collides with T_RESP here — a demultiplexer could not tell
+a response head from a response body chunk.  The surface-contract pass
+must report the collision (and the resulting divergence from the Go
+frame table).
+"""
+
+import struct
+
+MAGIC = b"DPF2\x01\x00\x00\x00"
+
+_HDR = struct.Struct("<IBBHI")
+_RESP = struct.Struct("<HHdQ")
+
+T_HEADERS = 1
+T_DATA = 2
+T_RESP = 3
+T_RESP_DATA = 3  # drift: the tree (and Go) say 4
+T_GOAWAY = 5
+T_PING = 6
+T_PONG = 7
+
+F_END_STREAM = 1
+
+_CLIENT_CHUNK = 1 << 20

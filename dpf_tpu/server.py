@@ -175,9 +175,12 @@ from .core import knobs
 from .obs import trace as obs_trace
 from .serving import faults, handlers
 from .serving.handlers import (  # noqa: F401 — the sidecar's public surface
-    DEADLINE_HEADER,
-    TRACE_HEADER,
     reset_serving_state,
+)
+from .serving.headers import (  # noqa: F401 — shared wire vocabulary
+    DEADLINE_HEADER,
+    RETRY_AFTER_HEADER,
+    TRACE_HEADER,
 )
 
 # Back-compat aliases: tests and benches reach the serving singleton
@@ -227,7 +230,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(reply.body_len))
         if reply.retry_after_s is not None:
             self.send_header(
-                "Retry-After", str(max(1, math.ceil(reply.retry_after_s)))
+                RETRY_AFTER_HEADER,
+                str(max(1, math.ceil(reply.retry_after_s))),
             )
         self.end_headers()
         for chunk in reply.chunks:
@@ -297,7 +301,7 @@ class _Handler(BaseHTTPRequestHandler):
             # A malformed header is a clean 400, never a dropped
             # connection with a server-side traceback.
             self._write_reply(handlers._reply_error(
-                400, "bad_request", "Content-Length is not an integer"
+                "bad_request", "Content-Length is not an integer"
             ))
             self.close_connection = True  # the body, if any, is unread
             return

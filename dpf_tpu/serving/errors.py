@@ -15,17 +15,46 @@ lint pass treats error-reply calls as taint sinks, and the server maps
 
 from __future__ import annotations
 
+# ---------------------------------------------------------------------------
+# The canonical error-code table: every ``code`` string the sidecar can
+# put in a ``{code, detail}`` reply body, with its one HTTP status.
+# Handlers, wire2, and the readyz surface all derive the status from
+# this table (``_reply_error`` looks it up), so a literal cannot drift
+# from its class's canonical code; the Go client's documented code set
+# (bridge/go/dpftpu/client.go, APIError) is pinned against this table
+# by the ``surface-contract`` analysis pass and docs/CONTRACT.json.
+# ---------------------------------------------------------------------------
+CODES: dict[str, int] = {
+    # Class-carried codes (the ServingError hierarchy below).
+    "shed": 429,          # admission control refused (ShedError)
+    "unavailable": 503,   # circuit open / transient device failure
+    "deadline": 504,      # request deadline expired (DeadlineError)
+    "internal": 500,      # unexpected failure, type name only
+    # Literal-only codes (no exception class: replied in-line).
+    "bad_request": 400,   # parameter/shape validation failure
+    "cold": 503,          # /readyz before the first POST /v1/warmup
+    "breaker_open": 503,  # /readyz while the circuit is not closed
+    "profile_forbidden": 403,  # /v1/profile without DPF_TPU_PROFILE_ALLOW
+    "profile_active": 409,     # /v1/profile start while a capture runs
+}
+
 
 class ServingError(RuntimeError):
     """Base for errors with a defined HTTP mapping.
 
-    ``http_status``/``code`` identify the failure class on the wire;
-    ``retry_after_s`` (when set) becomes the reply's ``Retry-After``
-    header, rounded up to whole seconds.
+    Subclasses declare only ``code``; ``http_status`` is derived from
+    the canonical :data:`CODES` table (one source of truth — a subclass
+    cannot carry a status its code does not mean).  ``retry_after_s``
+    (when set) becomes the reply's ``Retry-After`` header, rounded up
+    to whole seconds.
     """
 
-    http_status = 500
     code = "internal"
+    http_status = CODES["internal"]
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        cls.http_status = CODES[cls.code]
 
     def __init__(self, detail: str, retry_after_s: float | None = None):
         super().__init__(detail)
@@ -38,7 +67,6 @@ class ShedError(ServingError):
     depth or age watermark.  Shedding at the door keeps accepted-request
     latency bounded instead of letting p99 collapse into timeouts."""
 
-    http_status = 429
     code = "shed"
 
 
@@ -47,7 +75,6 @@ class OverloadedError(ServingError):
     transient device signature after retries): fail fast instead of
     burning a queue slot on work that cannot complete."""
 
-    http_status = 503
     code = "unavailable"
 
 
@@ -57,7 +84,6 @@ class DeadlineError(ServingError):
     deadline passed while its dispatch ran ("flight") — counted
     separately in /v1/stats."""
 
-    http_status = 504
     code = "deadline"
 
     def __init__(self, detail: str, where: str = "queue"):

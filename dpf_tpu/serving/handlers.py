@@ -71,19 +71,12 @@ from .batcher import (
     dispatch_points,
 )
 from .breaker import CircuitBreaker, is_transient
-from .errors import DeadlineError, ServingError
+from .errors import CODES, DeadlineError, ServingError
+from .headers import (  # noqa: F401 — re-exported (server.py, tests)
+    DEADLINE_HEADER,
+    TRACE_HEADER,
+)
 from .keycache import KeyCache
-
-# Per-request deadline header: remaining budget in milliseconds.  The
-# ``DPF_TPU_DEADLINE_MS`` knob sets the server default for requests that
-# omit it (0 = no default deadline).  The wire2 front carries the same
-# value as the ``_deadline_ms`` pseudo-param in its header block.
-DEADLINE_HEADER = "X-DPF-Deadline-Ms"
-
-# Per-request trace id header (obs/trace.py): propagated from the client
-# (the Go client stamps one per request) or generated at ingress.  The
-# wire2 front carries it as the ``_trace`` pseudo-param.
-TRACE_HEADER = "X-DPF-Trace"
 
 # ServingError.code -> flight-recorder outcome (obs/trace.OUTCOMES).
 _ERROR_OUTCOMES = {
@@ -598,16 +591,19 @@ def _stream_mode(q: dict, out_bytes: int) -> bool:
 
 
 def _reply_error(
-    status: int, code: str, detail: str,
+    code: str, detail: str,
     retry_after_s: float | None = None,
 ) -> Reply:
     """Structured error reply: ``{code, detail}`` JSON plus a
     Retry-After hint (whole seconds, rounded up by the front) when the
-    error carries a backoff.  ``detail`` must be client-safe — the
-    secret-hygiene lint treats this call as a taint sink."""
+    error carries a backoff.  The HTTP status is DERIVED from the
+    canonical ``errors.CODES`` table — call sites name the failure
+    class once and cannot drift from its status.  ``detail`` must be
+    client-safe — the secret-hygiene lint treats this call as a taint
+    sink."""
     body = json.dumps({"code": code, "detail": detail}).encode()
     return Reply(
-        status, [body], "application/json", retry_after_s=retry_after_s
+        CODES[code], [body], "application/json", retry_after_s=retry_after_s
     )
 
 
@@ -628,7 +624,7 @@ def map_error(e: Exception, st: _ServingState) -> Reply:
     wedged device.  Shared by ``respond`` and the fronts' write paths
     (an injected ``reply.write`` fault maps identically on both)."""
     if isinstance(e, ServingError):
-        reply = _reply_error(e.http_status, e.code, e.detail, e.retry_after_s)
+        reply = _reply_error(e.code, e.detail, e.retry_after_s)
         reply.outcome = _ERROR_OUTCOMES.get(e.code, "error")
     elif isinstance(e, (ValueError, KeyError)):
         # Validation failures: our own parameter/shape messages (the
@@ -637,16 +633,16 @@ def map_error(e: Exception, st: _ServingState) -> Reply:
         detail = (
             f"missing parameter {e}" if isinstance(e, KeyError) else str(e)
         )
-        reply = _reply_error(400, "bad_request", detail)
+        reply = _reply_error("bad_request", detail)
         reply.outcome = "bad_request"
     elif is_transient(e):
         reply = _reply_error(
-            503, "unavailable", type(e).__name__,
+            "unavailable", type(e).__name__,
             retry_after_s=st.breaker.cooldown_s,
         )
         reply.outcome = "error"
     else:
-        reply = _reply_error(500, "internal", type(e).__name__)
+        reply = _reply_error("internal", type(e).__name__)
         reply.outcome = "error"
     return reply
 
@@ -1218,11 +1214,11 @@ def _profile_request(body: memoryview) -> Reply:
         else:
             raise ValueError(f"unknown action {action!r} (start|stop|status)")
     except obs_profile.ProfileForbidden as e:
-        return _reply_error(403, "profile_forbidden", str(e))
+        return _reply_error("profile_forbidden", str(e))
     except obs_profile.ProfileBusy as e:
-        return _reply_error(409, "profile_active", str(e))
+        return _reply_error("profile_active", str(e))
     except obs_profile.ProfileError as e:
-        return _reply_error(400, "bad_request", str(e))
+        return _reply_error("bad_request", str(e))
     return _json_reply(out)
 
 
@@ -1239,13 +1235,13 @@ def respond_get(path: str, params: dict, st: _ServingState) -> Reply:
     if path == "/readyz":
         if st.breaker.degraded():
             return _reply_error(
-                503, "breaker_open",
+                "breaker_open",
                 f"circuit breaker is {st.breaker.state}",
                 retry_after_s=st.breaker.cooldown_s,
             )
         if not st.warmed:
             return _reply_error(
-                503, "cold", "warmup has not run (POST /v1/warmup first)"
+                "cold", "warmup has not run (POST /v1/warmup first)"
             )
         return Reply(200, [b"ready"], "text/plain")
     if path == "/v1/stats":
@@ -1267,7 +1263,7 @@ def respond_get(path: str, params: dict, st: _ServingState) -> Reply:
                 )
             n = int(params.get("n", 32))
         except ValueError as e:
-            return _reply_error(400, "bad_request", str(e))
+            return _reply_error("bad_request", str(e))
         traces = st.tracer.recorder.query(
             n=n,
             slowest=params.get("slowest") == "1",

@@ -78,6 +78,7 @@ from urllib.parse import urlencode
 from ..core import knobs
 from ..obs import trace as obs_trace
 from . import faults, handlers
+from . import headers as wire_headers
 
 MAGIC = b"DPF2\x01\x00\x00\x00"
 
@@ -498,7 +499,7 @@ class _Conn:
             # 429 the lane watermarks use, instead of queueing them
             # invisibly in the reader.
             reply = handlers._reply_error(
-                429, "shed",
+                "shed",
                 f"connection stream cap reached ({self.max_streams} "
                 "concurrent; raise DPF_TPU_WIRE2_MAX_STREAMS or add a "
                 "connection)",
@@ -513,7 +514,7 @@ class _Conn:
             # one frame OOM the sidecar.  Refuse and discard; the
             # connection (and its neighbors) survive.
             reply = handlers._reply_error(
-                400, "bad_request",
+                "bad_request",
                 f"declared body_len {body_len} exceeds "
                 "DPF_TPU_WIRE2_MAX_BODY_BYTES "
                 f"({self.max_body}); split the upload or raise the knob",
@@ -583,8 +584,8 @@ class _Conn:
         st = handlers.serving_state()
         body = stream.body
         params = dict(stream.params)
-        deadline_ms = params.pop("_deadline_ms", None)
-        trace_id = params.pop("_trace", None)
+        deadline_ms = params.pop(wire_headers.DEADLINE_PARAM, None)
+        trace_id = params.pop(wire_headers.TRACE_PARAM, None)
         req = handlers.Request(
             route=stream.route,
             params=params,
@@ -960,11 +961,11 @@ class Wire2Client:
             # identical requests; encode once, not per call).
             qs = params.encode() if isinstance(params, str) else params
             if deadline_ms is not None or trace_id is not None:
-                extra = dict(
-                    _deadline_ms=str(deadline_ms)
+                extra = {
+                    wire_headers.DEADLINE_PARAM: str(deadline_ms)
                     if deadline_ms is not None else None,
-                    _trace=trace_id,
-                )
+                    wire_headers.TRACE_PARAM: trace_id,
+                }
                 tail = urlencode(
                     {k: v for k, v in extra.items() if v is not None}
                 ).encode()
@@ -972,9 +973,9 @@ class Wire2Client:
         else:
             q = dict(params or {})
             if deadline_ms is not None:
-                q["_deadline_ms"] = str(deadline_ms)
+                q[wire_headers.DEADLINE_PARAM] = str(deadline_ms)
             if trace_id is not None:
-                q["_trace"] = trace_id
+                q[wire_headers.TRACE_PARAM] = trace_id
             qs = urlencode(q).encode()
         mv = body if isinstance(body, memoryview) else memoryview(body)
         if mv.format != "B" or mv.ndim != 1:

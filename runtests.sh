@@ -8,13 +8,15 @@
 #       excluded by the default -m; append your own -m to override, e.g.
 #       `./runtests.sh -m slow` for the fused acceptance sweep, or
 #       `./runtests.sh -m ''` for absolutely everything)
-#   ./runtests.sh --lint                 static-analysis lane: the nine
+#   ./runtests.sh --lint                 static-analysis lane: the ten
 #       repo-native passes (knob registry incl. unused-knob detection,
 #       secret hygiene, host-sync, pallas/jit discipline, test-suite
 #       wiring discipline, tuned-defaults TUNED.json validation,
 #       lock-discipline — the declared-lock registry, lock-order graph,
 #       guarded-field inference, and held-across-blocking rules over
-#       the whole serving plane — the oblivious-trace jaxpr verifier
+#       the whole serving plane — surface-contract (the cross-language
+#       route/frame/error-code/header/metric/ABI vocabulary vs the
+#       committed docs/CONTRACT.json), the oblivious-trace jaxpr verifier
 #       with its certificate drift check, and the perf-contract
 #       verifier with its collective/donation/dispatch budgets — one
 #       shared trace cache, so each route traces once) + the

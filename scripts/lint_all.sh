@@ -2,13 +2,16 @@
 # One-command static-analysis gate (hermetic: CPU jax, no TPU, no axon
 # tunnel — safe in CI and on laptops).  Runs:
 #
-#   1. python -m dpf_tpu.analysis      the nine repo-native passes
+#   1. python -m dpf_tpu.analysis      the ten repo-native passes
 #      (knob-registry incl. unused-knob detection, secret-hygiene,
 #      host-sync, pallas-jit, test-discipline, tuned-defaults (the
 #      committed docs/TUNED.json autotuner output vs the schema/registry
 #      contract), lock-discipline (declared-lock registry, lock-order
 #      graph, guarded-field inference, held-across-blocking — the
-#      serving plane's concurrency contract), the oblivious-trace jaxpr
+#      serving plane's concurrency contract), surface-contract (routes,
+#      wire2 frames, error codes, headers, metrics, and the dpfn_* ABI
+#      cross-checked across the Python/Go/C surfaces against the
+#      committed docs/CONTRACT.json), the oblivious-trace jaxpr
 #      verifier with its certificate drift check, and the perf-contract
 #      verifier — collective/donation/dispatch budgets over the SAME
 #      route traces via the shared trace cache)
@@ -64,6 +67,11 @@ if command -v go >/dev/null 2>&1; then
     status=1
   fi
   (cd bridge/go && go vet ./...) || status=1
+  # The go/ast surface dump vs the committed contract: the lint pass
+  # above already checked the Go files through its regex fallback, but
+  # with a toolchain present the REAL parser gets the verdict.
+  (cd bridge/go && go run ./cmd/contract-dump) | \
+    run_py -m dpf_tpu.analysis.contract --check-go-dump - || status=1
 else
   echo "lint_all.sh: no Go toolchain; skipping gofmt/go vet" \
        "(bridge/go/conformance.sh runs them plus 'go test -race')" >&2

@@ -1,0 +1,267 @@
+"""The surface-contract pass: seeded drifts fire, the real tree is
+clean, the committed docs/CONTRACT.json covers the whole vocabulary and
+is fresh, and the Go regex fallback agrees with the committed golden
+contract-dump output.
+
+Tier-1 (runtests.sh --fast and the default lane); everything here is
+hermetic AST/regex extraction — no TPU, no network, no Go toolchain
+(the go/ast extractor itself runs in bridge/go/conformance.sh, which
+diffs its dump against the same committed contract this suite pins).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+from dpf_tpu.analysis import LINT_SUITE_VERSION, get_pass
+from dpf_tpu.analysis.common import repo_root
+from dpf_tpu.analysis.contract import (
+    CONTRACT_VERSION,
+    c_abi,
+    contract_pass,
+    go_extract,
+    py_extract,
+)
+
+ROOT = repo_root()
+FIXDIR = "dpf_tpu/analysis/fixtures/bad_contract/"
+GOLDEN = os.path.join(ROOT, FIXDIR, "go_dump_golden.json")
+
+
+def _run(fixture: str):
+    return get_pass("surface-contract")(ROOT, files=[FIXDIR + fixture])
+
+
+def _messages(found) -> str:
+    return "\n".join(f.message for f in found)
+
+
+# ---------------------------------------------------------------------------
+# Seeded drifts: each fixture substitutes ONE surface file while every
+# other surface comes from the real tree, so the pass must report the
+# exact cross-surface tear that one-sided edit would ship.
+# ---------------------------------------------------------------------------
+
+
+def test_renamed_route_fires():
+    messages = _messages(_run("handlers_renamed_route.py"))
+    # Both halves of the tear: the renamed Python path has no Go const,
+    # and the orphaned Go const names no Python route.
+    assert "route '/v1/generate' (id 1) has no Go const" in messages
+    assert "wire2RouteGen=1 names no Python route" in messages
+    # The Go HTTP client still posts to the old path.
+    assert "Go client posts to '/v1/gen'" in messages
+
+
+def test_renumbered_route_fires():
+    messages = _messages(_run("handlers_renumbered.py"))
+    assert (
+        "route '/v1/warmup': Go wire2RouteWarmup=15 but Python "
+        "route_id is 16" in messages
+    )
+
+
+def test_frame_type_collision_fires():
+    messages = _messages(_run("wire2_collision.py"))
+    assert "frame types value 3 collides: ['RESP', 'RESP_DATA']" in messages
+    # ...and the collided table no longer matches the Go bridge.
+    assert "wire2 frame type table differs" in messages
+
+
+def test_error_code_drift_fires():
+    found = _run("errors_drifted.py")
+    messages = _messages(found)
+    # handlers.py still replies with the renamed code...
+    assert "_reply_error uses code 'unavailable' absent" in messages
+    # ...and the Go client still documents it.
+    assert (
+        "Go APIError documents code 'unavailable', absent" in messages
+    )
+    # The reply-code finding lands on the call site in handlers.py.
+    reply = [f for f in found if "uses code" in f.message]
+    assert reply and reply[0].path == "dpf_tpu/serving/handlers.py"
+    assert reply[0].line > 1
+
+
+def test_ctypes_abi_mismatch_fires():
+    messages = _messages(_run("cpu_native_badabi.py"))
+    assert (
+        "dpfn_gen: argtypes ['u64', 'u64', 'u8p', 'u8p', 'u8p'] vs C "
+        "parameters ['u64', 'u64', 'u8p', 'u8p', 'u8p', 'u8p']"
+        in messages
+    )
+
+
+def test_drift_fixtures_also_stale_the_committed_contract():
+    # The OBLIVIOUS.md policy: a drifted surface disagrees with the
+    # committed contract too, so even a drift mirrored on EVERY live
+    # surface (which the cross-checks could not see) would still fail
+    # until --write-contract re-certifies.
+    for fixture in (
+        "handlers_renamed_route.py",
+        "handlers_renumbered.py",
+        "wire2_collision.py",
+        "errors_drifted.py",
+    ):
+        messages = _messages(_run(fixture))
+        assert "committed contract is stale" in messages, fixture
+        assert "--write-contract" in messages, fixture
+
+
+# ---------------------------------------------------------------------------
+# The real tree is clean and the committed contract is fresh + covering.
+# ---------------------------------------------------------------------------
+
+
+def test_real_tree_clean():
+    assert get_pass("surface-contract")(ROOT) == []
+
+
+def test_committed_contract_fresh():
+    contract, findings = contract_pass.build(ROOT)
+    assert findings == []
+    assert contract_pass.load_committed(ROOT) == contract
+
+
+def test_committed_contract_coverage():
+    c = contract_pass.load_committed(ROOT)
+    assert c is not None, "docs/CONTRACT.json must be committed"
+    assert c["contract_version"] == CONTRACT_VERSION
+    # Every wire2 route, with ids 1..15 exactly once.
+    assert len(c["routes"]) >= 15
+    assert sorted(r["id"] for r in c["routes"].values()) == list(
+        range(1, len(c["routes"]) + 1)
+    )
+    # All wire2 frame types and the END_STREAM flag.
+    assert set(c["wire2"]["frame_types"]) == {
+        "HEADERS", "DATA", "RESP", "RESP_DATA", "GOAWAY", "PING", "PONG",
+    }
+    assert c["wire2"]["flags"] == {"END_STREAM": 1}
+    assert c["wire2"]["hdr_len"] == 12
+    assert c["wire2"]["resp_head_len"] == 20
+    # The full error vocabulary, statuses included.
+    for code, status in (
+        ("shed", 429), ("unavailable", 503), ("deadline", 504),
+        ("internal", 500), ("bad_request", 400),
+    ):
+        assert c["error_codes"][code] == status
+    # Both X-DPF-* headers plus Retry-After.
+    assert c["headers"]["deadline"] == "X-DPF-Deadline-Ms"
+    assert c["headers"]["trace"] == "X-DPF-Trace"
+    assert c["headers"]["retry_after"] == "Retry-After"
+    assert c["wire2_params"] == {
+        "deadline": "_deadline_ms", "trace": "_trace",
+    }
+    # Every dpfn_* export, signatures included.
+    assert len(c["native_abi"]) >= 22
+    assert set(c["native_abi"]) == set(c_abi.extract_c(ROOT))
+    # The metric namespace is fully enumerated.
+    assert len(c["metrics"]) >= 40
+    assert all(n.startswith("dpf_") for n in c["metrics"])
+
+
+def test_contract_md_in_sync():
+    with open(os.path.join(ROOT, contract_pass.CONTRACT_MD)) as f:
+        have = f.read()
+    contract, _ = contract_pass.build(ROOT)
+    assert have == contract_pass.render_markdown(contract)
+
+
+def test_mutated_contract_is_a_finding(tmp_path, monkeypatch):
+    # Mutating one mirrored constant in the committed file (the review
+    # side of the drift policy) must fail the pass until re-certified.
+    c = contract_pass.load_committed(ROOT)
+    mutated = copy.deepcopy(c)
+    mutated["wire2"]["frame_types"]["RESP_DATA"] = 9
+    monkeypatch.setattr(
+        contract_pass, "load_committed", lambda root: mutated
+    )
+    found = get_pass("surface-contract")(ROOT)
+    assert len(found) == 1
+    assert "committed contract is stale" in found[0].message
+    assert "wire2.frame_types.RESP_DATA: 9 -> 4" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# The Go surface: golden dump pins the regex fallback to the go/ast
+# extractor's output, and the conformance-side CLI accepts/rejects dumps
+# against the committed contract.
+# ---------------------------------------------------------------------------
+
+
+def test_go_fallback_matches_golden_dump():
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    assert go_extract.extract_fallback(ROOT) == golden
+
+
+def test_golden_dump_covers_every_route():
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    py = py_extract.extract(ROOT)
+    want = {
+        go_extract.const_name_for_path(p): rid
+        for p, rid in py["routes"].items()
+    }
+    assert golden["routes"] == want
+
+
+def _check_go_dump(dump: dict) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [
+            sys.executable, "-m", "dpf_tpu.analysis.contract",
+            "--check-go-dump", "-",
+        ],
+        input=json.dumps(dump), capture_output=True, text=True, cwd=ROOT,
+    )
+
+
+def test_check_go_dump_accepts_golden():
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    proc = _check_go_dump(golden)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_check_go_dump_rejects_drift():
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    golden["routes"]["Warmup"] = 16
+    proc = _check_go_dump(golden)
+    assert proc.returncode == 1
+    assert "wire2RouteWarmup=16" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Re-certification: foreign roots are refused; the writer round-trips.
+# ---------------------------------------------------------------------------
+
+
+def test_write_contract_refuses_foreign_root(tmp_path):
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "dpf_tpu.analysis",
+            "--write-contract", "--root", str(tmp_path),
+        ],
+        capture_output=True, text=True, cwd=ROOT,
+    )
+    assert proc.returncode == 1
+    assert "foreign --root" in proc.stderr
+    assert not (tmp_path / "docs" / "CONTRACT.json").exists()
+
+
+def test_ledger_key_carries_contract_version(monkeypatch):
+    monkeypatch.setenv("DPF_TPU_BENCH_LEDGER_KEY", "pinned")
+    sys.path.insert(0, ROOT)
+    try:
+        import bench_all
+
+        key = bench_all._ledger_key("small")
+    finally:
+        sys.path.remove(ROOT)
+    assert key["contract"] == CONTRACT_VERSION
+    assert key["lint"] == LINT_SUITE_VERSION
